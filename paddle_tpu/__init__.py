@@ -1,0 +1,84 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's capabilities.
+
+Built from scratch for JAX/XLA/Pallas/pjit — not a port. See SURVEY.md at the repo root for the
+reference blueprint this build follows; reference file:line citations appear in module docstrings.
+"""
+from __future__ import annotations
+
+from .version import full_version as __version__
+
+# int64 is paddle's default integer dtype; jax demotes to 32-bit unless x64 is on.
+# Float defaults remain f32 because every creation path passes dtype explicitly
+# (python float scalars stay weakly typed, so f64 does not leak into f32 compute).
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+# ---- core ----
+from .core import dtype as _dtype_mod
+from .core.dtype import (
+    bfloat16, bool_, complex64, complex128, convert_dtype, finfo, float16,
+    float32, float64, get_default_dtype, iinfo, int8, int16, int32, int64,
+    set_default_dtype, uint8,
+)
+from .core.place import (
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace, device_count,
+    get_device, is_compiled_with_cuda, is_compiled_with_tpu, set_device,
+)
+from .core.random import get_rng_state, seed, set_rng_state
+from .core.flags import get_flags, set_flags
+from .core.tensor import Tensor
+from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled
+from .core.dispatch import amp_guard as _amp_guard  # noqa: F401
+
+# ---- ops (also attaches Tensor methods) ----
+from .ops import *  # noqa: F401,F403
+from .ops import F as _F  # noqa: F401
+
+bool = bool_  # paddle.bool
+
+# ---- subpackages ----
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import autograd  # noqa: E402
+from . import amp  # noqa: E402
+from . import io  # noqa: E402
+from . import vision  # noqa: E402
+from . import distributed  # noqa: E402
+from . import jit  # noqa: E402
+from . import static  # noqa: E402
+from . import metric  # noqa: E402
+from . import profiler  # noqa: E402
+from .framework import io as _fw_io  # noqa: E402
+from .framework.io import load, save  # noqa: E402
+from .jit import to_static  # noqa: E402
+
+# paddle.disable_static / enable_static parity: dygraph is the default mode.
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static(place=None):
+    global _static_mode
+    _static_mode = False
+    if place is not None:
+        set_device(place)
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def is_grad_enabled_():  # legacy alias
+    return is_grad_enabled()
+
+
+def summary(net, input_size=None, dtypes=None):
+    n_params = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if not p.stop_gradient)
+    print(f"Total params: {n_params}\nTrainable params: {trainable}")
+    return {"total_params": n_params, "trainable_params": trainable}

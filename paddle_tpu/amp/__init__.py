@@ -1,0 +1,136 @@
+"""AMP: auto_cast + GradScaler.
+
+Reference: python/paddle/amp/auto_cast.py + grad_scaler.py:26; C++ autocast
+imperative/amp_auto_cast.cc; check_finite_and_unscale + update_loss_scaling ops.
+
+TPU-native: the low dtype is bfloat16 whose exponent range equals f32 — loss scaling is
+mathematically unnecessary for bf16, so GradScaler becomes a near-no-op there but keeps the full
+dynamic-loss-scaling machinery for float16 parity (and for tests).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.autograd import no_grad
+from ..core.dispatch import amp_guard
+from ..core.tensor import Tensor
+
+
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16"):
+    return amp_guard(enable=enable, dtype=dtype, level=level,
+                     custom_white_list=custom_white_list,
+                     custom_black_list=custom_black_list)
+
+
+amp_guard = amp_guard  # paddle.fluid.dygraph.amp_guard alias
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None,
+             save_dtype=None):
+    """O2: cast model params to the low dtype (master weights live in the optimizer's
+    f32 state, see optimizer/functional.py)."""
+    if level == "O2":
+        items = models if isinstance(models, (list, tuple)) else [models]
+        for m in items:
+            for p in m.parameters():
+                if dtypes.is_floating(p.dtype):
+                    p._data = p._data.astype(dtypes.convert_dtype(dtype))
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=1000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    @no_grad()
+    def _unscale(self, optimizer):
+        """check_finite_and_unscale analogue: one fused finite-check over all grads."""
+        if not self._enable:
+            return
+        found = jnp.zeros((), jnp.bool_)
+        inv = 1.0 / self._scale
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad._data
+            found = found | ~jnp.all(jnp.isfinite(g))
+            p.grad = Tensor((g * inv).astype(g.dtype))
+        self._found_inf = bool(found)
+        self._unscaled = True
+
+    def unscale_(self, optimizer):
+        self._unscale(optimizer)
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self._unscale(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, d):
+        self._scale = d["scale"]
+        self._good_steps = d.get("good_steps", 0)
+        self._bad_steps = d.get("bad_steps", 0)

@@ -1,0 +1,115 @@
+"""paddle.autograd equivalent: PyLayer + backward + grad.
+
+Reference: python/paddle/autograd/py_layer.py; eager PyLayer plumbing in
+paddle/fluid/eager/pylayer/. A PyLayer's backward is spliced into the tape as a custom Node.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import (  # noqa: F401
+    Node, enable_grad, grad, is_grad_enabled, no_grad, run_backward,
+    set_grad_enabled,
+)
+from ..core.tensor import Tensor
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    run_backward(tensors, grad_tensors, retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def set_materialize_grads(self, v):
+        pass
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op:
+
+        class Exp(PyLayer):
+            @staticmethod
+            def forward(ctx, x): ...
+            @staticmethod
+            def backward(ctx, dy): ...
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.autograd import is_grad_enabled
+
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        need_grad = is_grad_enabled() and any(not t.stop_gradient for t in tensor_args)
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+
+        if need_grad:
+            diff_inputs = [t for t in tensor_args]
+
+            def vjp_fn(cotangents):
+                cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                cot_tensors = [Tensor(c, stop_gradient=True) for c in cots]
+                with no_grad():
+                    in_grads = cls.backward(ctx, *cot_tensors)
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = (in_grads,)
+                result = []
+                gi = iter(in_grads)
+                for t in diff_inputs:
+                    g = next(gi, None)
+                    result.append(None if g is None else (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+                return tuple(result)
+
+            node = Node(
+                vjp_fn,
+                diff_inputs,
+                [(tuple(o.shape), np.dtype(o.dtype)) for o in outs],
+                name=cls.__name__,
+            )
+            for i, o in enumerate(outs):
+                o._stop_gradient = False
+                o._node = node
+                o._out_index = i
+
+        return tuple(outs) if multi else outs[0]
+
+
+LegacyPyLayer = PyLayer
+
+
+def set_grad_enabled_fn(mode):
+    return set_grad_enabled(mode)

@@ -1,0 +1,298 @@
+"""Eager autograd engine.
+
+The reference has two generations of define-by-run autograd: the legacy dygraph tracer
+(`paddle/fluid/imperative/tracer.cc`, `basic_engine.cc`) and the eager final-state engine with
+generated GradNodes (`paddle/fluid/eager/backward.cc:522` RunBackward, `grad_node_info.h:90`).
+
+TPU-native design: a grad node *is* the `jax.vjp` closure of the op's XLA lowering — no generated
+per-op grad kernels are needed, XLA differentiates the same computation the forward ran. The engine
+below reproduces the reference's semantics (in-degree style readiness via reverse-topological walk,
+`GradTensorHolder`-style cotangent accumulation, per-tensor hooks, leaf `.grad` accumulation).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+_grad_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_grad_state, "enabled", True)
+
+
+def _set_grad_enabled(v: bool):
+    _grad_state.enabled = v
+
+
+class no_grad:
+    """Context manager *and* decorator, like paddle.no_grad."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, func):
+        import functools
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return func(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+
+class set_grad_enabled:
+    def __init__(self, mode: bool):
+        self._mode = bool(mode)
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+
+class Node:
+    """One recorded op: holds the vjp closure and edges to input tensors.
+
+    Analogue of `egr::GradNodeBase` (grad_node_info.h:90); `out_avals` plays the role of the
+    grad-slot meta so missing cotangents can be zero-filled (GradTensorHolder behavior).
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "n_outputs", "name", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_avals, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = tuple(inputs)  # Tensors (strong refs keep the graph alive)
+        self.out_avals = out_avals  # [(shape, dtype), ...]
+        self.n_outputs = len(out_avals)
+        self.name = name
+
+    def __repr__(self):
+        return f"<Node {self.name} n_out={self.n_outputs}>"
+
+
+def _topo_order(root: Node) -> List[Node]:
+    """Reverse-postorder DFS = consumers before producers along every edge."""
+    order: List[Node] = []
+    visited = set()
+    stack = [(root, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            prod = t._node
+            if prod is not None and id(prod) not in visited and not t.stop_gradient:
+                stack.append((prod, False))
+    order.reverse()
+    return order
+
+
+def _accumulate(existing, new):
+    if existing is None:
+        return new
+    return existing + new
+
+
+def _is_float0(x) -> bool:
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph: bool = False, grad_sink=None):
+    """Engine entry: the analogue of `egr::RunBackward` (eager/backward.cc:522).
+
+    grad_sink: optional {id(tensor): [accumulated_array_or_None]} — when given
+    (paddle.grad functional mode), gradients are deposited ONLY into the sink and
+    `.grad` of leaves is left untouched (egr::RunPartialGrad behavior).
+    """
+    from .tensor import Tensor
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    import jax.numpy as jnp
+
+    # Seed cotangents.
+    node_cots = {}
+    leaf_seeds = []
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True; nothing to do"
+            )
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    f"grad must be provided for non-scalar tensor of shape {t.shape}"
+                )
+            g_data = jnp.ones_like(t._data)
+        else:
+            g_data = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._node is None:
+            leaf_seeds.append((t, g_data))
+        else:
+            slots = node_cots.setdefault(id(t._node), [None] * t._node.n_outputs)
+            slots[t._out_index] = _accumulate(slots[t._out_index], g_data)
+            roots.append(t._node)
+
+    for t, g_data in leaf_seeds:
+        _deposit_grad(t, g_data, grad_sink)
+
+    if not roots:
+        return
+
+    # Build a combined topological order over all roots.
+    order: List[Node] = []
+    seen = set()
+    for r in roots:
+        for n in _topo_order(r):
+            if id(n) not in seen:
+                seen.add(id(n))
+                order.append(n)
+    # A simple merge is not generally a topo order for multiple roots; re-sort by
+    # Kahn on the subgraph to be safe.
+    order = _kahn_sort(order)
+
+    for node in order:
+        slots = node_cots.get(id(node))
+        if slots is None:
+            continue
+        cots = []
+        for aval, s in zip(node.out_avals, slots):
+            if s is None:
+                shape, dt = aval
+                s = jnp.zeros(shape, dt)
+            cots.append(s)
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through the graph a second time; "
+                "first backward ran with retain_graph=False"
+            )
+        cot_arg = tuple(cots) if node.n_outputs > 1 else cots[0]
+        in_cots = node.vjp_fn(cot_arg)
+        if not retain_graph:
+            node.vjp_fn = None
+        for inp, ic in zip(node.inputs, in_cots):
+            if inp.stop_gradient or _is_float0(ic) or ic is None:
+                continue
+            for hook in inp._hooks:
+                out = hook(Tensor(ic, stop_gradient=True))
+                if out is not None:
+                    ic = out._data if isinstance(out, Tensor) else out
+            prod = inp._node
+            if prod is None:
+                _deposit_grad(inp, ic, grad_sink)
+            else:
+                slots2 = node_cots.setdefault(id(prod), [None] * prod.n_outputs)
+                slots2[inp._out_index] = _accumulate(slots2[inp._out_index], ic)
+                if inp._retain_grads or (grad_sink is not None and id(inp) in grad_sink):
+                    _deposit_grad(inp, ic, grad_sink)
+        node_cots.pop(id(node), None)
+
+
+def _kahn_sort(nodes: List[Node]) -> List[Node]:
+    node_set = {id(n): n for n in nodes}
+    # edge consumer -> producer; process consumer first
+    indeg = {id(n): 0 for n in nodes}  # number of unprocessed consumers
+    producers = {id(n): [] for n in nodes}
+    for n in nodes:
+        for t in n.inputs:
+            p = t._node
+            if p is not None and id(p) in node_set and not t.stop_gradient:
+                indeg[id(p)] += 1
+                producers[id(n)].append(id(p))
+    ready = [n for n in nodes if indeg[id(n)] == 0]
+    out = []
+    while ready:
+        n = ready.pop()
+        out.append(n)
+        for pid in producers[id(n)]:
+            indeg[pid] -= 1
+            if indeg[pid] == 0:
+                ready.append(node_set[pid])
+    if len(out) != len(nodes):  # pragma: no cover - cycles impossible in a tape
+        raise RuntimeError("cycle detected in autograd graph")
+    return out
+
+
+def _deposit_grad(t, g_data, grad_sink=None):
+    from .tensor import Tensor
+
+    if grad_sink is not None:
+        slot = grad_sink.get(id(t))
+        if slot is not None:
+            slot[0] = g_data if slot[0] is None else slot[0] + g_data
+        return  # functional mode: never touch .grad
+    if t._grad is None:
+        t._grad = Tensor(g_data, stop_gradient=True)
+    else:
+        t._grad = Tensor(t._grad._data + g_data, stop_gradient=True)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    allow_unused: bool = False,
+):
+    """Functional paddle.grad: returns grads of `outputs` wrt `inputs` without
+    touching `.grad`. (create_graph / double-grad is deferred; see TODO.)"""
+    from .tensor import Tensor
+
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError("double grad not yet supported on the eager tape")
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    sink = {id(t): [None] for t in inputs}
+    run_backward(outputs, grad_outputs, retain_graph=bool(retain_graph), grad_sink=sink)
+    result = []
+    for t in inputs:
+        g = sink[id(t)][0]
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "one of the input tensors received no gradient; "
+                "pass allow_unused=True to get None instead"
+            )
+        result.append(None if g is None else Tensor(g, stop_gradient=True))
+    return result

@@ -1,0 +1,195 @@
+"""Op dispatch: the phi KernelFactory analogue.
+
+Reference: every dygraph op goes Python -> generated python-C -> phi API -> KernelFactory::SelectKernel
+(`paddle/phi/core/kernel_factory.h:260`) -> device kernel, while the tracer records a GradNode
+(`paddle/fluid/imperative/tracer.cc:173`).
+
+TPU-native: there is exactly one backend (XLA); a "kernel" is a jnp/lax/pallas function. `apply`
+plays tracer + dispatcher: it unwraps Tensors, applies AMP autocast (the analogue of
+`imperative/amp_auto_cast.cc`), runs the kernel (via `jax.vjp` when grads are needed so the grad
+node is the vjp closure), optionally checks nan/inf (`FLAGS_check_nan_inf`,
+`framework/details/nan_inf_utils_detail.cc:314`), and wires the autograd graph.
+
+A registry records (name -> kernel) so tooling/tests can enumerate the op surface like
+phi's KernelFactory::kernels() does.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .autograd import Node, is_grad_enabled
+from .flags import flag
+from .tensor import Tensor
+
+KERNELS: Dict[str, Callable] = {}
+
+_amp_state = threading.local()
+
+# AMP op lists: the analogue of the reference's black/white lists
+# (python/paddle/fluid/dygraph/amp/auto_cast.py). On TPU the low dtype is bfloat16.
+AMP_WHITE = {
+    "matmul", "conv2d", "conv1d", "conv3d", "conv2d_transpose", "bmm", "mm",
+    "einsum", "linear", "addmm", "mv", "attention",
+}
+AMP_BLACK = {
+    "exp", "log", "log2", "log10", "log1p", "softmax", "log_softmax",
+    "cross_entropy", "softmax_with_cross_entropy", "mean", "sum", "norm",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "cumsum",
+    "pow", "rsqrt", "sigmoid_cross_entropy_with_logits", "binary_cross_entropy",
+    "nll_loss", "kl_div", "erf", "logsumexp", "var", "std",
+}
+
+
+class amp_guard:
+    def __init__(self, enable=True, dtype="bfloat16", level="O1", custom_white_list=None,
+                 custom_black_list=None):
+        self.enable = enable
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.level = level
+        self.white = AMP_WHITE | set(custom_white_list or ())
+        self.black = (AMP_BLACK - set(custom_white_list or ())) | set(custom_black_list or ())
+
+    def __enter__(self):
+        self._prev = getattr(_amp_state, "ctx", None)
+        _amp_state.ctx = self if self.enable else None
+        return self
+
+    def __exit__(self, *exc):
+        _amp_state.ctx = self._prev
+        return False
+
+
+def amp_ctx():
+    return getattr(_amp_state, "ctx", None)
+
+
+def register_kernel(name: str):
+    def deco(fn):
+        KERNELS[name] = fn
+        return fn
+
+    return deco
+
+
+def _is_float_array(x):
+    return dtypes.is_floating(x.dtype)
+
+
+def _autocast_dtype_for(name: str, arrays):
+    ctx = amp_ctx()
+    if ctx is None:
+        return None
+    if ctx.level == "O2":
+        # pure low-precision except black list
+        if name in ctx.black:
+            return np.dtype(np.float32)
+        return ctx.dtype
+    if name in ctx.white:
+        return ctx.dtype
+    if name in ctx.black:
+        return np.dtype(np.float32)
+    return None
+
+
+def _wrap_out(data, stop_gradient):
+    return Tensor(data, stop_gradient=stop_gradient)
+
+
+def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=None,
+          differentiable: bool = True):
+    """Run `kernel(*arrays, **attrs)` with autograd recording.
+
+    tensor_args: sequence of Tensors (already converted by the op wrapper).
+    nondiff_mask: optional bools marking args that can never receive grad
+      (e.g. integer index tensors) — they are closed over, not vjp-ed.
+    differentiable=False: never record (comparisons, int-valued ops).
+    """
+    attrs = attrs or {}
+    arrays = [t._data for t in tensor_args]
+
+    cast_to = _autocast_dtype_for(name, arrays)
+
+    if nondiff_mask is None:
+        nondiff_mask = [not _is_float_array(a) for a in arrays]
+
+    diff_idx = [i for i, nd in enumerate(nondiff_mask) if not nd]
+    aux_idx = [i for i, nd in enumerate(nondiff_mask) if nd]
+
+    def f(*diff_arrays):
+        full = list(arrays)
+        for i, a in zip(diff_idx, diff_arrays):
+            full[i] = a
+        if cast_to is not None:
+            full = [
+                a.astype(cast_to) if _is_float_array(a) and a.dtype != cast_to else a
+                for a in full
+            ]
+        return kernel(*full, **attrs)
+
+    diff_arrays = [arrays[i] for i in diff_idx]
+
+    need_grad = (
+        differentiable
+        and is_grad_enabled()
+        and any(not tensor_args[i].stop_gradient for i in diff_idx)
+    )
+
+    if need_grad and diff_idx:
+        out_data, vjp_fn = jax.vjp(f, *diff_arrays)
+    else:
+        out_data = f(*diff_arrays)
+        vjp_fn = None
+
+    multi = isinstance(out_data, (tuple, list))
+    outs_data = list(out_data) if multi else [out_data]
+
+    if flag("check_nan_inf"):
+        _check_nan_inf(name, outs_data)
+
+    outs = [_wrap_out(d, stop_gradient=not need_grad) for d in outs_data]
+
+    if vjp_fn is not None:
+        node = Node(
+            vjp_fn,
+            [tensor_args[i] for i in diff_idx],
+            [(tuple(d.shape), np.dtype(d.dtype)) for d in outs_data],
+            name=name,
+        )
+        for i, o in enumerate(outs):
+            o._node = node
+            o._out_index = i
+
+    if multi:
+        return tuple(outs)
+    return outs[0]
+
+
+def _check_nan_inf(name, outs_data):
+    for d in outs_data:
+        if _is_float_array(d):
+            if not bool(jnp.isfinite(d).all()):
+                raise FloatingPointError(
+                    f"Operator {name} output contains Inf/Nan "
+                    f"(FLAGS_check_nan_inf is set)"
+                )
+
+
+def as_tensor(x, dtype=None):
+    """Coerce op operands: Tensor passthrough, scalars/arrays wrapped."""
+    if isinstance(x, Tensor):
+        return x.astype(dtype) if dtype is not None and x.dtype != dtypes.convert_dtype(dtype) else x
+    if isinstance(x, (bool, int, float, complex)):
+        # weak-typed scalar: let jnp promote like the reference's scalar attrs do
+        return Tensor(jnp.asarray(x), stop_gradient=True)
+    if dtype is not None:
+        return Tensor(jnp.array(x, dtypes.convert_dtype(dtype)), stop_gradient=True)
+    a = np.asarray(x)
+    if a.dtype == np.float64:
+        a = a.astype(dtypes.get_default_dtype())
+    return Tensor(jnp.array(a), stop_gradient=True)

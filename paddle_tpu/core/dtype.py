@@ -1,0 +1,112 @@
+"""Dtype system.
+
+The reference models dtypes as a proto enum (`paddle/fluid/framework/framework.proto` VarType.Type)
+threaded through phi `KernelKey(backend, layout, dtype)`. TPU-natively we piggyback on numpy/jax
+dtypes: a dtype *is* an `np.dtype`, and the set of supported dtypes is what XLA supports on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # jax.numpy provides bfloat16 via ml_dtypes
+    import ml_dtypes
+
+    bfloat16 = np.dtype(ml_dtypes.bfloat16)
+    float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+    float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+except ImportError:  # pragma: no cover
+    bfloat16 = None
+    float8_e4m3fn = None
+    float8_e5m2 = None
+
+float16 = np.dtype(np.float16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+uint8 = np.dtype(np.uint8)
+uint16 = np.dtype(np.uint16)
+uint32 = np.dtype(np.uint32)
+uint64 = np.dtype(np.uint64)
+bool_ = np.dtype(np.bool_)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+
+_STR_ALIASES = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64, "int": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+}
+
+# Default dtypes follow the reference's Python surface: float literals -> FP32
+# (configurable via set_default_dtype), int literals -> INT64.
+_default_float_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_float_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"set_default_dtype only supports floating dtypes, got {d}")
+    _default_float_dtype = d
+
+
+def get_default_dtype():
+    return _default_float_dtype
+
+
+def convert_dtype(d):
+    """Normalize str / np.dtype / python type to an np.dtype."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        key = d.lower()
+        if key not in _STR_ALIASES:
+            raise TypeError(f"unsupported dtype string: {d!r}")
+        out = _STR_ALIASES[key]
+        if out is None:
+            raise TypeError(f"dtype {d!r} unavailable (ml_dtypes missing)")
+        return out
+    if d is float:
+        return _default_float_dtype
+    if d is int:
+        return int64
+    if d is bool:
+        return bool_
+    return np.dtype(d)
+
+
+def is_floating(d) -> bool:
+    d = convert_dtype(d)
+    return np.issubdtype(d, np.floating) or d == bfloat16
+
+
+def is_integer(d) -> bool:
+    return np.issubdtype(convert_dtype(d), np.integer)
+
+
+def is_complex(d) -> bool:
+    return np.issubdtype(convert_dtype(d), np.complexfloating)
+
+
+def is_bool(d) -> bool:
+    return convert_dtype(d) == bool_
+
+
+def finfo(d):
+    import jax.numpy as jnp
+
+    return jnp.finfo(convert_dtype(d))
+
+
+def iinfo(d):
+    import jax.numpy as jnp
+
+    return jnp.iinfo(convert_dtype(d))

@@ -1,0 +1,54 @@
+"""Runtime flag registry.
+
+Mirrors the reference's gflags surface (`paddle/fluid/platform/flags.cc`,
+`PADDLE_DEFINE_EXPORTED_*`, settable from env as FLAGS_* and from Python via paddle.set_flags).
+TPU-natively there is no C++ gflags; a plain registry with env bootstrapping gives the same
+contract (`FLAGS_check_nan_inf=1 python train.py` and `paddle_tpu.set_flags({...})`).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default, help_: str = ""):
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        if isinstance(default, bool):
+            default = env.lower() in ("1", "true", "yes", "on")
+        elif isinstance(default, int):
+            default = int(env)
+        elif isinstance(default, float):
+            default = float(env)
+        else:
+            default = env
+    _REGISTRY[name] = default
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        k = k.removeprefix("FLAGS_")
+        if k not in _REGISTRY:
+            raise KeyError(f"unknown flag {k!r}; known: {sorted(_REGISTRY)}")
+        _REGISTRY[k] = v
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {("FLAGS_" + n.removeprefix("FLAGS_")): _REGISTRY[n.removeprefix("FLAGS_")] for n in names}
+
+
+def flag(name: str):
+    return _REGISTRY[name]
+
+
+# Core flags (analogues of platform/flags.cc entries that matter on TPU).
+define_flag("check_nan_inf", False, "check every op output for nan/inf (debug)")
+define_flag("benchmark", False, "synchronize after each op for timing")
+define_flag("allocator_strategy", "xla", "kept for parity; XLA/PJRT owns device memory")
+define_flag("eager_op_jit", True, "jit-cache per-op computations in dygraph")
+define_flag("tpu_matmul_precision", "default", "default|high|highest for MXU matmuls")
+define_flag("seed", 0, "global random seed")

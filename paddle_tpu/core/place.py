@@ -1,0 +1,133 @@
+"""Device placement.
+
+The reference has a C++ `Place` class hierarchy (CPUPlace/CUDAPlace/... —
+`paddle/fluid/platform/place.h`) plus a DeviceContext pool. On TPU the runtime is PJRT behind JAX:
+a Place wraps a `jax.Device`, and "the device context" is XLA's per-device stream — there is
+nothing to pool manually. We keep the Place API surface (construction, equality, guard) because
+user code and tests use it.
+"""
+from __future__ import annotations
+
+import threading
+
+_state = threading.local()
+
+
+class Place:
+    device_type: str = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def jax_device(self):
+        import jax
+
+        devs = [d for d in jax.devices() if _platform_matches(d, self.device_type)]
+        if not devs:
+            # CPU is always available as a fallback host platform.
+            import jax.extend.backend as _b  # noqa: F401
+
+            devs = jax.devices("cpu")
+        return devs[self.device_id % len(devs)]
+
+
+def _platform_matches(dev, device_type: str) -> bool:
+    plat = dev.platform.lower()
+    if device_type == "tpu":
+        # 'axon' is the tunneled single-chip TPU platform; treat any non-cpu
+        # accelerator platform as the TPU place.
+        return plat in ("tpu", "axon") or plat not in ("cpu",)
+    return plat == device_type
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class CUDAPlace(Place):  # accepted for API parity; maps onto the accelerator
+    device_type = "tpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+def _default_place() -> Place:
+    import jax
+
+    try:
+        plat = jax.default_backend()
+    except Exception:
+        plat = "cpu"
+    if plat == "cpu":
+        return CPUPlace(0)
+    return TPUPlace(0)
+
+
+def set_device(device) -> Place:
+    """set_device("tpu"), set_device("tpu:1"), set_device("cpu"), or a Place."""
+    if isinstance(device, Place):
+        place = device
+    else:
+        s = str(device).lower()
+        if ":" in s:
+            kind, _, idx = s.partition(":")
+        else:
+            kind, idx = s, "0"
+        if kind in ("cpu",):
+            place = CPUPlace(int(idx))
+        elif kind in ("tpu", "gpu", "cuda", "xpu", "npu", "axon"):
+            place = TPUPlace(int(idx))
+        else:
+            raise ValueError(f"unknown device {device!r}")
+    _state.place = place
+    return place
+
+
+def get_device() -> str:
+    p = get_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def get_place() -> Place:
+    p = getattr(_state, "place", None)
+    if p is None:
+        p = _default_place()
+        _state.place = p
+    return p
+
+
+def is_compiled_with_cuda() -> bool:  # API parity; TPU build has no CUDA
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+def device_count() -> int:
+    import jax
+
+    return jax.device_count()

@@ -1,0 +1,93 @@
+"""Global RNG state.
+
+The reference threads per-device `Generator` state through kernels (curand states). JAX RNG is
+functional (explicit keys), so the dygraph surface keeps a *stateful* global generator that splits
+a root key on every draw; traced/pjit code paths must take keys explicitly (see
+`paddle_tpu.distributed.engine`), which is the TPU-idiomatic design.
+
+Also hosts the model-parallel RNG tree used by tensor parallelism (the analogue of
+`python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py`).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+class Generator:
+    def __init__(self, seed: int = _DEFAULT_SEED):
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(int(seed))
+        self._count = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        self._count += 1
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return (self._seed, self._count)
+
+    def set_state(self, state):
+        seed, count = state
+        self.manual_seed(seed)
+        for _ in range(count):
+            self.next_key()
+
+
+def default_generator() -> Generator:
+    gen = getattr(_state, "gen", None)
+    if gen is None:
+        gen = Generator(_DEFAULT_SEED)
+        _state.gen = gen
+    return gen
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed equivalent: reseeds the global (and mp-local) generators."""
+    g = default_generator().manual_seed(s)
+    named = getattr(_state, "named", None)
+    if named:
+        for i, (name, gen) in enumerate(sorted(named.items())):
+            gen.manual_seed(s + 100003 * (i + 1))
+    return g
+
+
+def next_key():
+    return default_generator().next_key()
+
+
+def get_rng_state():
+    named = getattr(_state, "named", {}) or {}
+    return {
+        "default": default_generator().get_state(),
+        "named": {k: g.get_state() for k, g in named.items()},
+    }
+
+
+def set_rng_state(state):
+    default_generator().set_state(state["default"])
+    for k, s in state.get("named", {}).items():
+        named_generator(k).set_state(s)
+
+
+def named_generator(name: str) -> Generator:
+    """Named RNG trees, e.g. 'global_seed' vs 'local_seed' for model parallelism."""
+    named = getattr(_state, "named", None)
+    if named is None:
+        named = {}
+        _state.named = named
+    if name not in named:
+        named[name] = Generator(_DEFAULT_SEED + (hash(name) % 99991))
+    return named[name]

@@ -1,0 +1,253 @@
+"""The eager Tensor.
+
+Reference analogue: the pybind eager `Tensor` (`paddle/fluid/pybind/eager.cc`) wrapping a phi
+`DenseTensor` (`paddle/phi/core/dense_tensor.h:38`) plus `AutogradMeta`. Here the storage is a
+`jax.Array` (a PJRT buffer on TPU) and the autograd meta is (`_node`, `_out_index`, `_grad`).
+
+Tensors are registered as a JAX pytree node so they can flow through `jax.jit`/`pjit` directly —
+that is the bridge between the dygraph surface and traced/distributed execution.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from . import place as place_mod
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "_stop_gradient",
+        "_grad",
+        "_node",
+        "_out_index",
+        "_hooks",
+        "_retain_grads",
+        "name",
+        "persistable",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, data, stop_gradient: bool = True, name: str = ""):
+        if isinstance(data, Tensor):
+            data = data._data
+        self._data = data
+        self._stop_gradient = bool(stop_gradient)
+        self._grad: Optional[Tensor] = None
+        self._node = None
+        self._out_index = 0
+        self._hooks = []
+        self._retain_grads = False
+        self.name = name
+        self.persistable = False
+
+    # ---- basic meta ----
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def place(self):
+        try:
+            dev = list(self._data.devices())[0]
+            if dev.platform == "cpu":
+                return place_mod.CPUPlace(dev.id)
+            return place_mod.TPUPlace(dev.id)
+        except Exception:
+            return place_mod.get_place()
+
+    @property
+    def stop_gradient(self):
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._stop_gradient = bool(v)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        if g is not None and not isinstance(g, Tensor):
+            g = Tensor(jnp.asarray(g), stop_gradient=True)
+        self._grad = g
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def retain_grads(self):
+        self._retain_grads = True
+        return self
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    # ---- conversion ----
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __int__(self):
+        return int(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __bool__(self):
+        if self._data.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is ambiguous"
+            )
+        return bool(self._data.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    # ---- device movement ----
+    def to(self, device=None, dtype=None, blocking=None):
+        t = self
+        if dtype is not None:
+            t = t.astype(dtype)
+        if device is not None:
+            place = device if isinstance(device, place_mod.Place) else _parse_place(device)
+            data = jax.device_put(t._data, place.jax_device())
+            out = Tensor(data, stop_gradient=t._stop_gradient, name=t.name)
+            out._node, out._out_index = t._node, t._out_index
+            return out
+        return t
+
+    def cpu(self):
+        return self.to(place_mod.CPUPlace(0))
+
+    def tpu(self, device_id: int = 0):
+        return self.to(place_mod.TPUPlace(device_id))
+
+    cuda = tpu  # API parity
+
+    def pin_memory(self):
+        return self
+
+    # ---- autograd ----
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from .autograd import run_backward
+
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def detach(self):
+        out = Tensor(self._data, stop_gradient=True, name=self.name)
+        return out
+
+    def detach_(self):
+        self._node = None
+        self._stop_gradient = True
+        return self
+
+    # ---- mutation (used by optimizers under no_grad) ----
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        value = jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._data.shape}"
+            )
+        self._data = value.astype(self._data.dtype)
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    def _replace_data(self, data):
+        """Raw storage swap (optimizer fast path, donation-friendly)."""
+        self._data = data
+        return self
+
+    def block_until_ready(self):
+        self._data.block_until_ready()
+        return self
+
+    # ---- repr ----
+    def __repr__(self):
+        grad_txt = f", stop_gradient={self._stop_gradient}"
+        try:
+            value = np.array2string(
+                np.asarray(self._data), precision=6, separator=", ", threshold=64
+            )
+        except Exception:
+            value = "<unmaterialized>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+            f"{grad_txt},\n       {value})"
+        )
+
+    # Arithmetic dunders, indexing, and method-style ops are attached by
+    # paddle_tpu.ops at import time (the analogue of the generated
+    # `core.eager.ops` method table, pybind/eager_method.cc).
+
+
+def _parse_place(device):
+    s = str(device).lower()
+    kind, _, idx = s.partition(":")
+    idx = int(idx or 0)
+    if kind == "cpu":
+        return place_mod.CPUPlace(idx)
+    return place_mod.TPUPlace(idx)
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._data,), (t._stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    (data,) = children
+    sg, name = aux
+    return Tensor(data, stop_gradient=sg, name=name)
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)

@@ -1,0 +1,9 @@
+"""paddle.distributed equivalent — the TPU-native distributed stack.
+
+Round-1 milestone ordering (SURVEY.md §7): env contract + mesh/topology first, then the
+collective API (xccl = XLA collectives over ICI/DCN), fleet facade, and meta_parallel
+strategies. See distributed/mesh.py for the HybridCommunicateGroup analogue.
+"""
+from .env import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
+)

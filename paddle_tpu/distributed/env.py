@@ -1,0 +1,84 @@
+"""Distributed environment contract.
+
+Reference env-var contract (launch/controllers/collective.py, parallel.py:185-189):
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT /
+MASTER_ADDR / MASTER_PORT. TPU multi-controller: one process per host, all local TPU chips
+belong to this process; jax.distributed.initialize is the rendezvous (coordinator = rank 0's
+endpoint — the TCPStore analogue).
+"""
+from __future__ import annotations
+
+import os
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.trainer_endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self.device_id = int(os.environ.get("FLAGS_selected_tpus",
+                                            os.environ.get("FLAGS_selected_gpus", "0")).split(",")[0])
+        self.master_addr = os.environ.get("MASTER_ADDR", "")
+        self.master_port = os.environ.get("MASTER_PORT", "")
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Multi-controller bootstrap: hand rendezvous to jax.distributed (PJRT coordination
+    service plays the TCPStore role; reference parallel.py:235 builds core.TCPStore here)."""
+    global _initialized
+    env = ParallelEnv()
+    if env.world_size > 1 and not _initialized:
+        import jax
+
+        coordinator = env.master_addr and f"{env.master_addr}:{env.master_port}"
+        if not coordinator and env.trainer_endpoints and env.trainer_endpoints[0]:
+            coordinator = env.trainer_endpoints[0]
+        jax.distributed.initialize(
+            coordinator_address=coordinator or None,
+            num_processes=env.world_size,
+            process_id=env.rank,
+        )
+    _initialized = True
+    return env
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return ParallelEnv().rank
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.world_size
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return ParallelEnv().world_size
+
+
+def is_initialized():
+    return _initialized

@@ -1,0 +1,3 @@
+from . import io  # noqa: F401
+from ..core.random import seed  # noqa: F401
+from ..core.tensor import Tensor  # noqa: F401

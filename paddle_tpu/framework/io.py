@@ -1,0 +1,74 @@
+"""paddle.save / paddle.load.
+
+Reference: python/paddle/framework/io.py:568,784 — pickled nested containers with a tensor
+protocol. Same contract here: nested dict/list/tuple of Tensors & ndarrays, tensors serialized
+as numpy. Distributed/sharded checkpointing (orbax-style, per-host shards) lives in
+distributed/checkpoint.py.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_PROTO = 4
+
+
+class _TensorPayload:
+    """Pickle-stable tensor wrapper (dtype string survives bfloat16)."""
+
+    def __init__(self, array):
+        self.dtype = str(array.dtype)
+        if array.dtype.name == "bfloat16":
+            self.data = np.asarray(array).astype(np.float32)
+            self.bf16 = True
+        else:
+            self.data = np.asarray(array)
+            self.bf16 = False
+
+    def to_array(self):
+        if self.bf16:
+            from ..core import dtype as dtypes
+
+            return self.data.astype(dtypes.bfloat16)
+        return self.data
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data))
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    import jax.numpy as jnp
+
+    if isinstance(obj, _TensorPayload):
+        arr = obj.to_array()
+        return arr if return_numpy else Tensor(jnp.asarray(arr))
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTO, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,168 @@
+"""paddle.jit equivalent: dygraph -> static (traced XLA program).
+
+The reference converts dygraph to static graphs with a 20-transformer AST transpiler
+(python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:775) and runs the converted
+ProgramDesc via run_program_op. TPU-natively the conversion is *tracing*: `functional_call` swaps
+a Layer's parameters for traced arrays and replays its Python forward under jax tracing, so the
+whole program (and, through jax.vjp, its backward) becomes ONE XLA computation. `to_static`
+packages that as a single dispatch-op so the eager autograd tape differentiates through it —
+static mode *is* the fused fast path, matching the reference's intent (InterpreterCore fusing an
+instruction list) with XLA doing the scheduling.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+_trace_state = threading.local()
+
+
+def in_jit_trace() -> bool:
+    return getattr(_trace_state, "tracing", False)
+
+
+@contextlib.contextmanager
+def _tracing():
+    prev = getattr(_trace_state, "tracing", False)
+    _trace_state.tracing = True
+    try:
+        yield
+    finally:
+        _trace_state.tracing = prev
+
+
+@contextlib.contextmanager
+def _swapped_state(layer, state: Dict[str, Any]):
+    """Temporarily replace the layer's parameter/buffer storage with the given arrays."""
+    named = dict(layer.state_dict(include_non_persistable_buffer=True))
+    originals = {}
+    try:
+        for name, arr in state.items():
+            t = named[name]
+            originals[name] = t._data
+            t._data = arr._data if isinstance(arr, Tensor) else arr
+        yield
+    finally:
+        for name, old in originals.items():
+            named[name]._data = old
+
+
+def functional_call(layer, state: Dict[str, Any], *args, **kwargs):
+    """Run `layer` with its params/buffers taken from `state` (name -> array/Tensor).
+
+    The bridge between eager Layers and traced/pjit execution (torch.func.functional_call
+    analogue). Autograd recording is disabled inside — differentiate with jax.grad around it.
+    """
+    with _swapped_state(layer, state), _tracing(), no_grad():
+        return layer(*args, **kwargs)
+
+
+def _unwrap(out):
+    if isinstance(out, Tensor):
+        return out._data
+    if isinstance(out, (list, tuple)):
+        return type(out)(_unwrap(o) for o in out)
+    return out
+
+
+def _wrap_inputs(args):
+    return [a if isinstance(a, Tensor) else Tensor(jnp.asarray(a)) for a in args]
+
+
+class StaticFunction:
+    """Callable produced by @to_static: runs the layer as one traced XLA computation,
+    differentiable through the eager tape (the computation appears as a single grad node)."""
+
+    def __init__(self, layer=None, function=None, input_spec=None, build_strategy=None):
+        self._layer = layer
+        self._function = function
+        self._jitted = None
+        self._param_names = []
+
+    def _build_kernel(self, n_inputs, kwargs):
+        layer = self._layer
+        function = self._function
+        param_names = self._param_names
+
+        def kernel(*arrays):
+            param_arrays = arrays[:len(param_names)]
+            input_arrays = arrays[len(param_names):]
+            inputs = [Tensor(a, stop_gradient=True) for a in input_arrays]
+            if layer is not None:
+                state = dict(zip(param_names, param_arrays))
+                with _swapped_state(layer, state), _tracing(), no_grad():
+                    out = (function or layer.forward)(*inputs, **kwargs)
+            else:
+                with _tracing(), no_grad():
+                    out = function(*inputs, **kwargs)
+            return _unwrap(out)
+
+        return kernel
+
+    def __call__(self, *args, **kwargs):
+        inputs = _wrap_inputs(args)
+        if self._layer is not None:
+            state = self._layer.state_dict(include_non_persistable_buffer=True)
+            self._param_names = list(state.keys())
+            tensor_args = [state[n] for n in self._param_names] + inputs
+        else:
+            tensor_args = inputs
+        kernel = self._build_kernel(len(inputs), kwargs)
+        return apply("to_static_program", kernel, tensor_args)
+
+
+def to_static(layer_or_function=None, input_spec=None, build_strategy=None, **kwargs):
+    from ..nn.layer import Layer
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            orig_forward = obj.forward
+            obj.forward = StaticFunction(layer=obj, function=orig_forward)
+            return obj
+        bound_self = getattr(obj, "__self__", None)
+        if isinstance(bound_self, Layer):
+            # bound method of a Layer: its parameters must flow through the traced
+            # program as inputs, or gradients silently stop at the jit boundary
+            return StaticFunction(layer=bound_self, function=obj)
+        import functools
+
+        # plain function
+        fn = StaticFunction(function=obj)
+        functools.update_wrapper(fn, obj, updated=[])
+        return fn
+
+    if layer_or_function is None:
+        return decorate
+    return decorate(layer_or_function)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save parity: persist params + a marker (program serialization lands with
+    the static Program IR, static/)."""
+    from ..framework import io as fio
+
+    fio.save(layer.state_dict(), path + ".pdparams")
+
+
+def load(path, **configs):
+    raise NotImplementedError("jit.load: lands with static Program IR")
+
+
+class TranslatedLayer:
+    pass
+
+
+def not_to_static(fn):
+    return fn
+
+
+def ignore_module(modules):
+    pass

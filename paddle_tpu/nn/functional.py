@@ -1,0 +1,5 @@
+"""paddle.nn.functional surface: re-export of the functional op library."""
+from ..ops.activation import *  # noqa: F401,F403
+from ..ops.nn_functional import *  # noqa: F401,F403
+from ..ops.manipulation import pad  # noqa: F401
+from ..ops.creation import diag  # noqa: F401

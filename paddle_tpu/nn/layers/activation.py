@@ -1,0 +1,88 @@
+"""Activation layers. Reference: python/paddle/nn/layer/activation.py."""
+from __future__ import annotations
+
+from ...ops import activation as A
+from .. import initializer as I
+from ..layer import Layer
+
+
+def _simple(name, fn, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed}
+            # capture common scalar args by position/name
+            sig_names = list(kwargs.keys())
+            self._args = args
+            self._kwargs.update({k: v for k, v in kwargs.items() if k != "name"})
+
+        def forward(self, x):
+            return fn(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = name
+    return _Act
+
+
+ReLU = _simple("ReLU", A.relu)
+ReLU6 = _simple("ReLU6", A.relu6)
+Sigmoid = _simple("Sigmoid", A.sigmoid)
+Tanh = _simple("Tanh", A.tanh)
+SiLU = _simple("SiLU", A.silu)
+Swish = _simple("Swish", A.swish)
+Mish = _simple("Mish", A.mish)
+Hardswish = _simple("Hardswish", A.hardswish)
+Hardsigmoid = _simple("Hardsigmoid", A.hardsigmoid)
+Softsign = _simple("Softsign", A.softsign)
+Tanhshrink = _simple("Tanhshrink", A.tanhshrink)
+LogSigmoid = _simple("LogSigmoid", A.log_sigmoid)
+GELU = _simple("GELU", A.gelu)
+ELU = _simple("ELU", A.elu)
+SELU = _simple("SELU", A.selu)
+CELU = _simple("CELU", A.celu)
+LeakyReLU = _simple("LeakyReLU", A.leaky_relu)
+Hardtanh = _simple("Hardtanh", A.hardtanh)
+Hardshrink = _simple("Hardshrink", A.hardshrink)
+Softshrink = _simple("Softshrink", A.softshrink)
+Softplus = _simple("Softplus", A.softplus)
+ThresholdedReLU = _simple("ThresholdedReLU", A.thresholded_relu)
+Maxout = _simple("Maxout", A.maxout)
+GLU = _simple("GLU", A.glu)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return A.softmax(x, self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return A.log_softmax(x, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr, default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return A.prelu(x, self.weight, self.data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return A.rrelu(x, self.lower, self.upper, training=self.training)

@@ -1,0 +1,164 @@
+"""Op namespace assembly + Tensor method attachment.
+
+The reference generates the Tensor method table (`core.eager.ops.*`,
+paddle/fluid/pybind/eager_method.cc + generated python_c functions). Here the same wiring is done
+by attaching the functional ops to `Tensor` at import time.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from ..core import dtype as _dtypes
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .activation import (  # noqa: F401
+    celu, elu, gelu, glu, gumbel_softmax, hardshrink, hardsigmoid, hardswish,
+    hardtanh, leaky_relu, log_sigmoid, log_softmax, maxout, mish, prelu, relu,
+    relu6, rrelu, selu, silu, softmax, softplus, softshrink, softsign, swiglu,
+    swish, tanhshrink, thresholded_relu,
+)
+from . import nn_functional as F  # noqa: F401
+
+from . import creation as _creation
+from . import math as _math_ops
+from . import reduction as _reduction
+from . import manipulation as _manip
+from . import linalg as _linalg
+from . import activation as _activation
+
+
+def _attach_methods():
+    import builtins
+
+    M = _math_ops
+    R = _reduction
+    P = _manip
+    L = _linalg
+
+    def m(name, fn):
+        setattr(Tensor, name, fn)
+
+    # arithmetic dunders
+    m("__add__", lambda s, o: M.add(s, o))
+    m("__radd__", lambda s, o: M.add(o, s))
+    m("__sub__", lambda s, o: M.subtract(s, o))
+    m("__rsub__", lambda s, o: M.subtract(o, s))
+    m("__mul__", lambda s, o: M.multiply(s, o))
+    m("__rmul__", lambda s, o: M.multiply(o, s))
+    m("__truediv__", lambda s, o: M.divide(s, o))
+    m("__rtruediv__", lambda s, o: M.divide(o, s))
+    m("__floordiv__", lambda s, o: M.floor_divide(s, o))
+    m("__rfloordiv__", lambda s, o: M.floor_divide(o, s))
+    m("__mod__", lambda s, o: M.remainder(s, o))
+    m("__rmod__", lambda s, o: M.remainder(o, s))
+    m("__pow__", lambda s, o: M.pow(s, o))
+    m("__rpow__", lambda s, o: M.pow(o, s))
+    m("__neg__", lambda s: M.neg(s))
+    m("__abs__", lambda s: M.abs(s))
+    m("__matmul__", lambda s, o: L.matmul(s, o))
+    m("__rmatmul__", lambda s, o: L.matmul(o, s))
+    m("__eq__", lambda s, o: M.equal(s, o))
+    m("__ne__", lambda s, o: M.not_equal(s, o))
+    m("__lt__", lambda s, o: M.less_than(s, o))
+    m("__le__", lambda s, o: M.less_equal(s, o))
+    m("__gt__", lambda s, o: M.greater_than(s, o))
+    m("__ge__", lambda s, o: M.greater_equal(s, o))
+    m("__invert__", lambda s: M.logical_not(s) if s.dtype == _dtypes.bool_ else M.bitwise_not(s))
+    m("__and__", lambda s, o: M.logical_and(s, o) if s.dtype == _dtypes.bool_ else M.bitwise_and(s, o))
+    m("__or__", lambda s, o: M.logical_or(s, o) if s.dtype == _dtypes.bool_ else M.bitwise_or(s, o))
+    m("__xor__", lambda s, o: M.logical_xor(s, o) if s.dtype == _dtypes.bool_ else M.bitwise_xor(s, o))
+    Tensor.__hash__ = lambda s: id(s)
+
+    # indexing
+    m("__getitem__", lambda s, item: P.getitem(s, item))
+    m("__setitem__", lambda s, item, v: P.setitem(s, item, v))
+
+    # method-style ops (subset of the generated method table; extend freely)
+    method_table = {
+        "add": M.add, "subtract": M.subtract, "multiply": M.multiply,
+        "divide": M.divide, "pow": M.pow, "matmul": L.matmul, "mm": L.mm,
+        "bmm": L.bmm, "dot": L.dot, "maximum": M.maximum, "minimum": M.minimum,
+        "abs": M.abs, "exp": M.exp, "log": M.log, "log2": M.log2, "sqrt": M.sqrt,
+        "rsqrt": M.rsqrt, "square": M.square, "reciprocal": M.reciprocal,
+        "sin": M.sin, "cos": M.cos, "tan": M.tan, "tanh": M.tanh, "erf": M.erf,
+        "sigmoid": M.sigmoid, "floor": M.floor, "ceil": M.ceil, "round": M.round,
+        "trunc": M.trunc, "sign": M.sign, "clip": M.clip, "neg": M.neg,
+        "isnan": M.isnan, "isinf": M.isinf, "isfinite": M.isfinite,
+        "equal": M.equal, "not_equal": M.not_equal, "less_than": M.less_than,
+        "less_equal": M.less_equal, "greater_than": M.greater_than,
+        "greater_equal": M.greater_equal, "logical_and": M.logical_and,
+        "logical_or": M.logical_or, "logical_not": M.logical_not,
+        "logical_xor": M.logical_xor, "allclose": M.allclose, "isclose": M.isclose,
+        "equal_all": M.equal_all, "scale": M.scale, "lerp": M.lerp,
+        "cumsum": M.cumsum, "cumprod": M.cumprod, "trace": M.trace,
+        "remainder": M.remainder, "mod": M.mod, "floor_divide": M.floor_divide,
+        "kron": M.kron, "inner": M.inner, "outer": M.outer, "atan2": M.atan2,
+        # reductions
+        "sum": R.sum, "mean": R.mean, "max": R.max, "min": R.min, "prod": R.prod,
+        "all": R.all, "any": R.any, "argmax": R.argmax, "argmin": R.argmin,
+        "std": R.std, "var": R.var, "logsumexp": R.logsumexp, "median": R.median,
+        "quantile": R.quantile, "count_nonzero": R.count_nonzero,
+        "nansum": R.nansum, "nanmean": R.nanmean, "kthvalue": R.kthvalue,
+        # manipulation
+        "reshape": P.reshape, "reshape_": P.reshape_, "flatten": P.flatten,
+        "transpose": P.transpose, "t": P.t, "moveaxis": P.moveaxis,
+        "swapaxes": P.swapaxes, "squeeze": P.squeeze, "unsqueeze": P.unsqueeze,
+        "expand": P.expand, "expand_as": P.expand_as, "broadcast_to": P.broadcast_to,
+        "tile": P.tile, "flip": P.flip, "roll": P.roll, "gather": P.gather,
+        "gather_nd": P.gather_nd, "scatter": P.scatter,
+        "scatter_nd_add": P.scatter_nd_add, "index_select": P.index_select,
+        "index_sample": P.index_sample, "index_add": P.index_add,
+        "masked_select": P.masked_select, "masked_fill": P.masked_fill,
+        "take_along_axis": P.take_along_axis, "put_along_axis": P.put_along_axis,
+        "sort": P.sort, "argsort": P.argsort, "topk": P.topk, "unique": P.unique,
+        "nonzero": P.nonzero, "where": P.where, "split": P.split, "chunk": P.chunk,
+        "unbind": P.unbind, "cast": P.cast, "astype": P.astype,
+        "repeat_interleave": P.repeat_interleave, "diff": P.diff,
+        "strided_slice": P.strided_slice, "slice": P.slice,
+        # linalg
+        "norm": L.norm, "dist": L.dist, "cross": L.cross, "cholesky": L.cholesky,
+        "inverse": L.inverse, "pinv": L.pinv, "matrix_power": L.matrix_power,
+        "det": L.det, "slogdet": L.slogdet, "histogram": L.histogram,
+        "bincount": L.bincount, "cov": L.cov, "corrcoef": L.corrcoef,
+        # activations
+        "softmax": _activation.softmax, "log_softmax": _activation.log_softmax,
+        "relu": _activation.relu, "gelu": _activation.gelu,
+        # creation-like
+        "tril": _creation.tril, "triu": _creation.triu, "diag": _creation.diag,
+    }
+    import jax.numpy as _jnp
+
+    method_table["fill_"] = lambda s, v: s._replace_data(_jnp.full_like(s._data, v))
+    method_table["zero_"] = lambda s: s._replace_data(_jnp.zeros_like(s._data))
+    for name, fn in method_table.items():
+        m(name, fn)
+
+    # in-place arithmetic helpers (dygraph surface; used under no_grad by optimizers)
+    from .manipulation import _inplace_rebind
+
+    def _inplace(opname, fn):
+        def impl(s, *a, **k):
+            return _inplace_rebind(s, fn, *a, **k)
+
+        m(opname, impl)
+
+    _inplace("add_", M.add)
+    _inplace("subtract_", M.subtract)
+    _inplace("multiply_", M.multiply)
+    _inplace("divide_", M.divide)
+    _inplace("scale_", M.scale)
+    _inplace("clip_", M.clip)
+    _inplace("exp_", M.exp)
+    _inplace("sqrt_", M.sqrt)
+    _inplace("abs_", M.abs)
+    _inplace("tanh_", M.tanh)
+    _inplace("relu_", _activation.relu)
+    _inplace("flatten_", P.flatten)
+    _inplace("squeeze_", P.squeeze)
+    _inplace("unsqueeze_", P.unsqueeze)
+
+
+_attach_methods()

@@ -1,0 +1,63 @@
+"""Op-definition helpers (the analogue of phi's kernel registration macros)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, as_tensor, register_kernel
+from ..core.tensor import Tensor
+
+_SCALARS = (bool, int, float, complex)
+
+
+def unary(name, jfn, differentiable=True):
+    @register_kernel(name)
+    def kernel(x, **attrs):
+        return jfn(x, **attrs)
+
+    def op(x, name_=None, **attrs):
+        return apply(name, kernel, [as_tensor(x)], attrs, differentiable=differentiable)
+
+    op.__name__ = name
+    return op
+
+
+def binary(name, jfn, differentiable=True):
+    """Binary op with weak-typed python-scalar fast path (keeps bf16 under AMP)."""
+
+    @register_kernel(name)
+    def kernel(x, y, **attrs):
+        return jfn(x, y, **attrs)
+
+    def op(x, y, name_=None, **attrs):
+        if isinstance(y, _SCALARS) and isinstance(x, Tensor):
+            return apply(
+                name, lambda a, _s=y, **at: jfn(a, _s, **at), [x], attrs,
+                differentiable=differentiable,
+            )
+        if isinstance(x, _SCALARS) and isinstance(y, Tensor):
+            return apply(
+                name, lambda b, _s=x, **at: jfn(_s, b, **at), [y], attrs,
+                differentiable=differentiable,
+            )
+        return apply(
+            name, kernel, [as_tensor(x), as_tensor(y)], attrs,
+            differentiable=differentiable,
+        )
+
+    op.__name__ = name
+    return op
+
+
+def normalize_axis(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(normalize_axis(a, ndim) for a in axis)
+    axis = int(axis)
+    if axis < 0:
+        axis += ndim
+    return axis
+
+
+def t_(x):
+    return as_tensor(x)

@@ -1,0 +1,172 @@
+"""Activations. Reference: python/paddle/nn/functional/activation.py + phi activation kernels.
+All are single fused XLA expressions (elementwise — fused into neighbors by XLA)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ._helpers import t_, unary
+
+relu = unary("relu", jax.nn.relu)
+relu6 = unary("relu6", jax.nn.relu6)
+sigmoid = unary("sigmoid", jax.nn.sigmoid)
+silu = unary("silu", jax.nn.silu)
+tanh = unary("tanh", jnp.tanh)
+softsign = unary("softsign", jax.nn.soft_sign)
+tanhshrink = unary("tanhshrink", lambda x: x - jnp.tanh(x))
+mish = unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+hardswish = unary("hardswish", jax.nn.hard_swish)
+hardsigmoid = unary("hardsigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+log_sigmoid = unary("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda a, approximate: jax.nn.gelu(a, approximate=approximate),
+                 [t_(x)], {"approximate": bool(approximate)})
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", lambda a, ns: jax.nn.leaky_relu(a, ns), [t_(x)],
+                 {"ns": negative_slope})
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda a, alpha: jax.nn.elu(a, alpha), [t_(x)], {"alpha": alpha})
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply("selu", lambda a, scale, alpha: scale * jnp.where(
+        a > 0, a, alpha * jnp.expm1(a)), [t_(x)], {"scale": scale, "alpha": alpha})
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda a, alpha: jax.nn.celu(a, alpha), [t_(x)], {"alpha": alpha})
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = t_(x), t_(weight)
+
+    def kernel(a, w):
+        if w.size > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, w * a)
+
+    return apply("prelu", kernel, [x, weight])
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    from ..core import random as random_mod
+
+    x = t_(x)
+    if training:
+        key = random_mod.next_key()
+        slope = jax.random.uniform(key, x._data.shape, x._data.dtype, lower, upper)
+    else:
+        slope = (lower + upper) / 2.0
+
+    def kernel(a):
+        return jnp.where(a >= 0, a, slope * a)
+
+    return apply("rrelu", kernel, [x])
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", lambda a, lo, hi: jnp.clip(a, lo, hi), [t_(x)],
+                 {"lo": min, "hi": max})
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink", lambda a, t: jnp.where(jnp.abs(a) > t, a, 0.0), [t_(x)],
+                 {"t": threshold})
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply("softshrink", lambda a, t: jnp.where(
+        a > t, a - t, jnp.where(a < -t, a + t, 0.0)), [t_(x)], {"t": threshold})
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply("thresholded_relu", lambda a, t: jnp.where(a > t, a, 0.0), [t_(x)],
+                 {"t": threshold})
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply("softplus", lambda a, beta, threshold: jnp.where(
+        beta * a > threshold, a, jax.nn.softplus(beta * a) / beta), [t_(x)],
+        {"beta": beta, "threshold": threshold})
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ..core import dtype as dtypes
+
+    d = dtypes.convert_dtype(dtype) if dtype else None
+
+    def kernel(a, axis):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply("softmax", kernel, [t_(x)], {"axis": axis})
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ..core import dtype as dtypes
+
+    d = dtypes.convert_dtype(dtype) if dtype else None
+
+    def kernel(a, axis):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return apply("log_softmax", kernel, [t_(x)], {"axis": axis})
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..core import random as random_mod
+
+    x = t_(x)
+    key = random_mod.next_key()
+    g = -jnp.log(-jnp.log(jax.random.uniform(key, x._data.shape, x._data.dtype, 1e-20, 1.0)))
+
+    def kernel(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            y_hard = (y == y.max(axis=axis, keepdims=True)).astype(y.dtype)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return apply("gumbel_softmax", kernel, [x])
+
+
+def maxout(x, groups, axis=1, name=None):
+    def kernel(a, groups, axis):
+        shape = list(a.shape)
+        c = shape[axis]
+        new_shape = shape[:axis] + [groups, c // groups] + shape[axis + 1:]
+        return jnp.max(a.reshape(new_shape), axis=axis)
+
+    return apply("maxout", kernel, [t_(x)], {"groups": groups, "axis": axis})
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu", lambda a, axis: jax.nn.glu(a, axis=axis), [t_(x)], {"axis": axis})
+
+
+def swiglu(x, y=None, name=None):
+    if y is not None:
+        return apply("swiglu", lambda a, b: jax.nn.silu(a) * b, [t_(x), t_(y)])
+
+    def kernel(a):
+        a, b = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(a) * b
+
+    return apply("swiglu", kernel, [t_(x)])

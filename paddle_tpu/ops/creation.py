@@ -1,0 +1,271 @@
+"""Tensor creation ops.
+
+Reference parity: python/paddle/tensor/creation.py (to_tensor, zeros, ones, full, arange,
+linspace, eye, tril/triu, diag, meshgrid, assign, clone) lowering to phi full/arange kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core import random as random_mod
+from ..core.dispatch import apply, as_tensor
+from ..core.place import get_place, Place
+from ..core.tensor import Tensor
+from ._helpers import t_
+
+
+def _put(data, place=None):
+    if place is not None and isinstance(place, Place):
+        data = jax.device_put(data, place.jax_device())
+    return data
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.item()) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        out = data.astype(dtype) if dtype is not None else Tensor(data._data)
+        out.stop_gradient = stop_gradient
+        return out
+    a = np.asarray(data)
+    if dtype is not None:
+        a = a.astype(dtypes.convert_dtype(dtype))
+    elif a.dtype == np.float64:
+        a = a.astype(dtypes.get_default_dtype())
+    # jnp.array (copy) — asarray may alias the caller's numpy buffer on CPU, and
+    # to_tensor promises an independent copy (reference semantics).
+    return Tensor(_put(jnp.array(a), place), stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    dtype = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+    return Tensor(jnp.zeros(_shape(shape), dtype))
+
+
+def ones(shape, dtype=None, name=None):
+    dtype = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+    return Tensor(jnp.ones(_shape(shape), dtype))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is not None:
+        dtype = dtypes.convert_dtype(dtype)
+        return Tensor(jnp.full(_shape(shape), fill_value, dtype))
+    if isinstance(fill_value, float):
+        return Tensor(jnp.full(_shape(shape), fill_value, dtypes.get_default_dtype()))
+    return Tensor(jnp.full(_shape(shape), fill_value))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = t_(x)
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return Tensor(jnp.zeros_like(x._data, d))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = t_(x)
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return Tensor(jnp.ones_like(x._data, d))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = t_(x)
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=d))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = dtypes.get_default_dtype()
+        else:
+            dtype = dtypes.int64
+    else:
+        dtype = dtypes.convert_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    dtype = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    dtype = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dtype = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=dtype))
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril", lambda a, diagonal: jnp.tril(a, diagonal), [t_(x)], {"diagonal": int(diagonal)})
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("triu", lambda a, diagonal: jnp.triu(a, diagonal), [t_(x)], {"diagonal": int(diagonal)})
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = t_(x)
+    if x.ndim == 1 and padding_value != 0:
+        def k(a, offset, padding_value):
+            d = jnp.diag(a, offset)
+            mask = jnp.eye(d.shape[0], dtype=bool, k=offset) if False else None
+            n = a.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, a.dtype)
+            idx = jnp.arange(a.shape[0])
+            r = idx if offset >= 0 else idx - offset
+            c = idx + offset if offset >= 0 else idx
+            return out.at[r, c].set(a)
+        return apply("diag", k, [x], {"offset": int(offset), "padding_value": padding_value})
+    return apply("diag", lambda a, offset: jnp.diag(a, offset), [x], {"offset": int(offset)})
+
+
+def diagflat(x, offset=0, name=None):
+    return apply("diagflat", lambda a, offset: jnp.diagflat(a, offset), [t_(x)], {"offset": int(offset)})
+
+
+def meshgrid(*args, **kwargs):
+    tensors = [t_(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*[t._data for t in tensors], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    x = t_(x) if not isinstance(x, (np.ndarray, list, tuple, int, float)) else to_tensor(x)
+    out = apply("assign", lambda a: a + 0, [x])
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return apply("clone", lambda a: a + 0, [t_(x)])
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(t_(x).size, dtypes.int64))
+
+
+def tril_indices(row, col, offset=0, dtype=None):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtypes.convert_dtype(dtype or "int64")))
+
+
+def triu_indices(row, col=None, offset=0, dtype=None):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtypes.convert_dtype(dtype or "int64")))
+
+
+def clone_detached(x):
+    return Tensor(t_(x)._data)
+
+
+# ---- random creation (stateful dygraph surface over functional JAX RNG) ----
+
+def rand(shape, dtype=None, name=None):
+    dtype = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+    key = random_mod.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype))
+
+
+def randn(shape, dtype=None, name=None):
+    dtype = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+    key = random_mod.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), dtype))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if shape is None:
+        shape = ()
+    key = random_mod.next_key()
+    out = jax.random.normal(key, _shape(shape) if shape != () else (), dtypes.get_default_dtype())
+    return Tensor(out * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dtype = dtypes.convert_dtype(dtype) if dtype else dtypes.get_default_dtype()
+    key = jax.random.key(seed) if seed else random_mod.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype, minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dtype = dtypes.convert_dtype(dtype) if dtype else dtypes.int64
+    key = random_mod.next_key()
+    return Tensor(jax.random.randint(key, _shape(shape), low, high, dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = t_(x)
+    return randint(low, high, tuple(x.shape), dtype or x.dtype)
+
+
+def randperm(n, dtype=None, name=None):
+    dtype = dtypes.convert_dtype(dtype) if dtype else dtypes.int64
+    key = random_mod.next_key()
+    return Tensor(jax.random.permutation(key, int(n)).astype(dtype))
+
+
+def bernoulli(x, name=None):
+    x = t_(x)
+    key = random_mod.next_key()
+    return Tensor(jax.random.bernoulli(key, x._data).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = t_(x)
+    key = random_mod.next_key()
+    p = x._data / x._data.sum(-1, keepdims=True)
+    if x.ndim == 1:
+        out = jax.random.choice(key, x.shape[0], (num_samples,), replace=replacement, p=p)
+    else:
+        keys = jax.random.split(key, x.shape[0])
+        out = jnp.stack([
+            jax.random.choice(k, x.shape[-1], (num_samples,), replace=replacement, p=p[i])
+            for i, k in enumerate(keys)
+        ])
+    return Tensor(out.astype(dtypes.int64))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def poisson(x, name=None):
+    x = t_(x)
+    key = random_mod.next_key()
+    return Tensor(jax.random.poisson(key, x._data).astype(x.dtype))

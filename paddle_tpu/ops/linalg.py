@@ -1,0 +1,201 @@
+"""Linear algebra ops — the MXU path. Reference: python/paddle/tensor/linalg.py +
+phi matmul kernels (paddle/phi/kernels/gpu/matmul_kernel.cu). matmuls run in the
+flag-selected precision so the MXU is used for f32 inputs unless 'highest' is set."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.flags import flag
+from ..core.tensor import Tensor
+from ._helpers import t_
+
+
+def _prec():
+    return {"default": None, "high": "bfloat16_3x", "highest": "float32"}.get(
+        flag("tpu_matmul_precision"), None)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def kernel(a, b, transpose_x, transpose_y):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=_prec())
+
+    return apply("matmul", kernel, [t_(x), t_(y)],
+                 {"transpose_x": transpose_x, "transpose_y": transpose_y})
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", lambda a, b: jnp.matmul(a, b, precision=_prec()), [t_(x), t_(y)])
+
+
+def mv(x, vec, name=None):
+    return apply("mv", lambda a, v: jnp.matmul(a, v, precision=_prec()), [t_(x), t_(vec)])
+
+
+def dot(x, y, name=None):
+    def kernel(a, b):
+        return jnp.sum(a * b, axis=-1)
+
+    return apply("dot", kernel, [t_(x), t_(y)])
+
+
+def einsum(equation, *operands):
+    tensors = [t_(o) for o in operands]
+    return apply("einsum", lambda *arrays, equation: jnp.einsum(equation, *arrays, precision=_prec()),
+                 tensors, {"equation": equation})
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+
+    def kernel(a, p, axis, keepdim):
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis, keepdims=keepdim))
+        if p == np.inf:
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=keepdim), 1.0 / p)
+
+    return apply("norm", kernel, [t_(x)], {"p": p, "axis": axis, "keepdim": keepdim})
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p, axis, keepdim)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y if isinstance(x, Tensor) else t_(x) - t_(y), p)
+
+
+def cross(x, y, axis=9, name=None):
+    def kernel(a, b, axis):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply("cross", kernel, [t_(x), t_(y)], {"axis": axis})
+
+
+def cholesky(x, upper=False, name=None):
+    def kernel(a, upper):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return apply("cholesky", kernel, [t_(x)], {"upper": upper})
+
+
+def inverse(x, name=None):
+    return apply("inverse", jnp.linalg.inv, [t_(x)])
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda a, rcond: jnp.linalg.pinv(a, rtol=rcond), [t_(x)], {"rcond": rcond})
+
+
+def solve(x, y, name=None):
+    return apply("solve", jnp.linalg.solve, [t_(x), t_(y)])
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def kernel(a, b, upper, transpose, unitriangular):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+    return apply("triangular_solve", kernel, [t_(x), t_(y)],
+                 {"upper": upper, "transpose": transpose, "unitriangular": unitriangular})
+
+
+def qr(x, mode="reduced", name=None):
+    q, r = jnp.linalg.qr(t_(x)._data, mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(t_(x)._data, full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2).conj())
+
+
+def eig(x, name=None):
+    w, v = np.linalg.eig(np.asarray(t_(x)._data))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    w, v = jnp.linalg.eigh(t_(x)._data, UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    w = np.linalg.eigvals(np.asarray(t_(x)._data))
+    return Tensor(jnp.asarray(w))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return Tensor(jnp.linalg.eigvalsh(t_(x)._data, UPLO=UPLO))
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda a, n: jnp.linalg.matrix_power(a, n), [t_(x)], {"n": n})
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(t_(x)._data, rtol=tol))
+
+
+def slogdet(x, name=None):
+    sign, logabsdet = jnp.linalg.slogdet(t_(x)._data)
+    return Tensor(jnp.stack([sign, logabsdet]))
+
+
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, [t_(x)])
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(t_(x)._data)
+    outs = [Tensor(lu_), Tensor((piv + 1).astype(jnp.int32))]
+    if get_infos:
+        outs.append(Tensor(jnp.zeros((), jnp.int32)))
+    return tuple(outs)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(t_(x)._data, t_(y)._data, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return Tensor(jnp.cov(t_(x)._data, rowvar=rowvar, ddof=1 if ddof else 0))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(t_(x)._data, rowvar=rowvar))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = np.asarray(t_(input)._data)
+    if min == 0 and max == 0:
+        min, max = float(a.min()), float(a.max())
+    hist, _ = np.histogram(a, bins=bins, range=(min, max))
+    return Tensor(jnp.asarray(hist.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = t_(weights)._data if weights is not None else None
+    return Tensor(jnp.bincount(t_(x)._data, weights=w, minlength=minlength,
+                               length=None))

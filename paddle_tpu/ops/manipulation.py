@@ -1,0 +1,659 @@
+"""Shape / layout / indexing ops.
+
+Reference parity: python/paddle/tensor/manipulation.py (+ phi reshape/transpose/concat/... kernels).
+Paddle-specific semantics preserved: `transpose(x, perm)` takes a full permutation; `gather`
+selects rows by a 1-D index along `axis`; `scatter` overwrite/add by row index.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.dispatch import apply, as_tensor
+from ..core.tensor import Tensor
+from ._helpers import normalize_axis, t_
+
+
+def _static_shape(shape):
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+def cast(x, dtype):
+    d = dtypes.convert_dtype(dtype)
+    x = t_(x)
+    if x.dtype == d:
+        return x
+    return apply("cast", lambda a, d: a.astype(d), [x], {"d": d},
+                 differentiable=dtypes.is_floating(d) and dtypes.is_floating(x.dtype))
+
+
+astype = cast
+
+
+def reshape(x, shape, name=None):
+    return apply("reshape", lambda a, shape: jnp.reshape(a, shape), [t_(x)],
+                 {"shape": _static_shape(shape)})
+
+
+def _inplace_rebind(x, op, *args, **kwargs):
+    """Run `op` out-of-place on a snapshot of x's autograd identity, then graft the
+    result back onto x. The snapshot (not x itself) becomes the grad node's input, so
+    the graph stays acyclic. Matches torch/paddle semantics: in-place on a leaf that
+    requires grad (outside no_grad) is an error."""
+    from ..core.autograd import is_grad_enabled
+
+    if is_grad_enabled() and not x.stop_gradient and x._node is None:
+        raise RuntimeError(
+            "a leaf Tensor that requires grad is being used in an in-place operation; "
+            "wrap in paddle.no_grad() or operate on a non-leaf result")
+    snap = Tensor(x._data, stop_gradient=x._stop_gradient)
+    snap._node, snap._out_index = x._node, x._out_index
+    out = op(snap, *args, **kwargs)
+    x._data, x._node, x._out_index = out._data, out._node, out._out_index
+    if not out.stop_gradient:
+        x._stop_gradient = False
+    return x
+
+
+def reshape_(x, shape, name=None):
+    return _inplace_rebind(x, reshape, shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = t_(x)
+    nd = builtins.max(x.ndim, 1)
+    sa = normalize_axis(start_axis, nd)
+    ea = normalize_axis(stop_axis, nd)
+    shp = x.shape
+    new_shape = tuple(shp[:sa]) + (-1,) + tuple(shp[ea + 1:])
+    return reshape(x, new_shape)
+
+
+def transpose(x, perm, name=None):
+    return apply("transpose", lambda a, perm: jnp.transpose(a, perm), [t_(x)],
+                 {"perm": tuple(int(p) for p in perm)})
+
+
+def t(x, name=None):
+    x = t_(x)
+    if x.ndim < 2:
+        return x
+    return transpose(x, list(range(x.ndim - 2)) + [x.ndim - 1, x.ndim - 2])
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", lambda a, s, d: jnp.moveaxis(a, s, d), [t_(x)],
+                 {"s": source, "d": destination})
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply("swapaxes", lambda a, x0, x1: jnp.swapaxes(a, x0, x1), [t_(x)],
+                 {"x0": axis0, "x1": axis1})
+
+
+def concat(x, axis=0, name=None):
+    tensors = [t_(a) for a in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    kernel = lambda *arrays, axis: jnp.concatenate(arrays, axis=axis)
+    return apply("concat", kernel, tensors, {"axis": int(axis)})
+
+
+def stack(x, axis=0, name=None):
+    tensors = [t_(a) for a in x]
+    kernel = lambda *arrays, axis: jnp.stack(arrays, axis=axis)
+    return apply("stack", kernel, tensors, {"axis": int(axis)})
+
+
+def vstack(x):
+    return apply("vstack", lambda *a: jnp.vstack(a), [t_(a) for a in x])
+
+
+def hstack(x):
+    return apply("hstack", lambda *a: jnp.hstack(a), [t_(a) for a in x])
+
+
+def dstack(x):
+    return apply("dstack", lambda *a: jnp.dstack(a), [t_(a) for a in x])
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = t_(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = normalize_axis(axis, x.ndim)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {dim} along axis {axis} is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) if not isinstance(s, Tensor) else int(s.item()) for s in num_or_sections]
+        n_unknown = builtins.sum(1 for s in sizes if s == -1)
+        if n_unknown:
+            known = builtins.sum(s for s in sizes if s != -1)
+            sizes = [s if s != -1 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def kernel(a, offsets, sizes, axis):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=axis) for o, s in zip(offsets, sizes))
+
+    outs = apply("split", kernel, [x], {"offsets": offsets, "sizes": sizes, "axis": axis})
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = t_(x)
+    axis = normalize_axis(axis, x.ndim)
+    n = x.shape[axis]
+
+    def kernel(a, axis, n):
+        return tuple(jnp.squeeze(jax.lax.slice_in_dim(a, i, i + 1, axis=axis), axis) for i in range(n))
+
+    return list(apply("unbind", kernel, [x], {"axis": axis, "n": n}))
+
+
+def squeeze(x, axis=None, name=None):
+    x = t_(x)
+    if axis is None:
+        ax = None
+    else:
+        if isinstance(axis, (int, np.integer)):
+            axis = [axis]
+        ax = tuple(a for a in (normalize_axis(tuple(axis), x.ndim)) if x.shape[a] == 1)
+    return apply("squeeze", lambda a, axis: jnp.squeeze(a, axis=axis), [x], {"axis": ax})
+
+
+def unsqueeze(x, axis, name=None):
+    x = t_(x)
+    if isinstance(axis, Tensor):
+        axis = [int(a) for a in axis.numpy().reshape(-1)]
+    if isinstance(axis, (int, np.integer)):
+        axis = [int(axis)]
+    return apply("unsqueeze", lambda a, axis: jnp.expand_dims(a, axis=axis), [x],
+                 {"axis": tuple(axis)})
+
+
+def expand(x, shape, name=None):
+    x = t_(x)
+    shape = _static_shape(shape)
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s == -1 else s for i, s in enumerate(shape))
+    return apply("expand", lambda a, shape: jnp.broadcast_to(a, shape), [x], {"shape": shape})
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return expand(x, t_(y).shape)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def broadcast_tensors(inputs, name=None):
+    arrays = jnp.broadcast_arrays(*[t_(i)._data for i in inputs])
+    return [Tensor(a) for a in arrays]
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = [int(r) for r in repeat_times.numpy().reshape(-1)]
+    return apply("tile", lambda a, reps: jnp.tile(a, reps), [t_(x)],
+                 {"reps": tuple(int(r) if not isinstance(r, Tensor) else int(r.item()) for r in repeat_times)})
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats.numpy())
+        return apply("repeat_interleave", lambda a, reps, axis: jnp.repeat(a, jnp.asarray(reps), axis=axis),
+                     [t_(x)], {"reps": tuple(reps.tolist()), "axis": axis})
+    return apply("repeat_interleave", lambda a, reps, axis: jnp.repeat(a, reps, axis=axis),
+                 [t_(x)], {"reps": int(repeats), "axis": axis})
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return apply("flip", lambda a, axis: jnp.flip(a, axis=axis), [t_(x)], {"axis": tuple(axis)})
+
+
+reverse = flip
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", lambda a, k, axes: jnp.rot90(a, k, axes), [t_(x)], {"k": k, "axes": tuple(axes)})
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply("roll", lambda a, shifts, axis: jnp.roll(a, shifts, axis=axis), [t_(x)],
+                 {"shifts": shifts, "axis": axis})
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = t_(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply("where", lambda c, a, b: jnp.where(c, a, b),
+                 [condition, as_tensor(x), as_tensor(y)],
+                 nondiff_mask=[True, False, False])
+
+
+def nonzero(x, as_tuple=False, name=None):
+    data = np.asarray(t_(x)._data)  # dynamic shape -> host (matches reference sync semantics)
+    nz = np.nonzero(data)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def masked_select(x, mask, name=None):
+    x, mask = t_(x), t_(mask)
+    # host sync for the dynamic output shape; the gather stays differentiable
+    m = np.asarray(jnp.broadcast_to(mask._data, x._data.shape))
+    flat_idx = jnp.asarray(np.nonzero(m.reshape(-1))[0])
+    return apply("masked_select", lambda a: a.reshape(-1)[flat_idx], [x])
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        return apply("masked_fill", lambda a, m, v: jnp.where(m, v.astype(a.dtype), a),
+                     [t_(x), t_(mask), value], nondiff_mask=[False, True, False])
+    return apply("masked_fill", lambda a, m, value: jnp.where(m, value, a),
+                 [t_(x), t_(mask)], {"value": value}, nondiff_mask=[False, True])
+
+
+def gather(x, index, axis=0, name=None):
+    """Paddle gather: select slices along axis by a 1-D (or 0-d) index."""
+    x, index = t_(x), t_(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    return apply("gather", lambda a, i, axis: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=axis),
+                 [x, index], {"axis": axis}, nondiff_mask=[False, True])
+
+
+def gather_nd(x, index, name=None):
+    x, index = t_(x), t_(index)
+
+    def kernel(a, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+
+    return apply("gather_nd", kernel, [x, index], nondiff_mask=[False, True])
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply("take_along_axis", lambda a, i, axis: jnp.take_along_axis(a, i, axis=axis),
+                 [t_(arr), t_(indices)], {"axis": axis}, nondiff_mask=[False, True])
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr, indices = t_(arr), t_(indices)
+    values = as_tensor(values)
+
+    def kernel(a, i, v, axis, reduce):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        dims = list(range(a.ndim))
+        idx = []
+        for d in dims:
+            if d == axis:
+                idx.append(i)
+            else:
+                shape = [1] * a.ndim
+                shape[d] = a.shape[d]
+                base = jnp.arange(a.shape[d]).reshape(shape)
+                idx.append(jnp.broadcast_to(base, i.shape))
+        idx = tuple(idx)
+        if reduce == "assign":
+            return a.at[idx].set(v)
+        if reduce == "add":
+            return a.at[idx].add(v)
+        if reduce == "multiply" or reduce == "mul":
+            return a.at[idx].multiply(v)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    return apply("put_along_axis", kernel, [arr, indices, values],
+                 {"axis": axis, "reduce": reduce}, nondiff_mask=[False, True, False])
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """Paddle scatter: rows of x at `index` replaced (or accumulated) with `updates`."""
+    x, index, updates = t_(x), t_(index), t_(updates)
+
+    def kernel(a, i, u, overwrite):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u.astype(a.dtype))
+        # paddle semantics: zero out target rows then add (handles dup indices by sum)
+        zeroed = a.at[i].set(jnp.zeros_like(u, a.dtype))
+        return zeroed.at[i].add(u.astype(a.dtype))
+
+    return apply("scatter", kernel, [x, index, updates], {"overwrite": bool(overwrite)},
+                 nondiff_mask=[False, True, False])
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = t_(x), t_(index), t_(updates)
+
+    def kernel(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u.astype(a.dtype))
+
+    return apply("scatter_nd_add", kernel, [x, index, updates], nondiff_mask=[False, True, False])
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = t_(index), t_(updates)
+    zeros = Tensor(jnp.zeros(_static_shape(shape), updates._data.dtype))
+    return scatter_nd_add(zeros, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    return take_along_axis(x, index, axis=1)
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = t_(x), t_(index), t_(value)
+
+    def kernel(a, i, v, axis):
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v.astype(a.dtype), axis, 0)
+        out = a_m.at[i].add(v_m)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply("index_add", kernel, [x, index, value], {"axis": axis},
+                 nondiff_mask=[False, True, False])
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = t_(x)
+    value = as_tensor(value)
+    idx = tuple(t_(i)._data for i in indices)
+
+    def kernel(a, v, accumulate):
+        if accumulate:
+            return a.at[idx].add(v.astype(a.dtype))
+        return a.at[idx].set(v.astype(a.dtype))
+
+    return apply("index_put", kernel, [x, value], {"accumulate": accumulate})
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def kernel(a, axis, descending):
+        out = jnp.sort(a, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+
+    return apply("sort", kernel, [t_(x)], {"axis": axis, "descending": descending})
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def kernel(a, axis, descending):
+        out = jnp.argsort(a, axis=axis)
+        return (jnp.flip(out, axis=axis) if descending else out).astype(jnp.int64)
+
+    return apply("argsort", kernel, [t_(x)], {"axis": axis, "descending": descending},
+                 differentiable=False)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = t_(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    axis = normalize_axis(axis if axis is not None else -1, x.ndim)
+
+    def kernel(a, k, axis, largest):
+        a_m = jnp.moveaxis(a, axis, -1)
+        if largest:
+            vals, inds = jax.lax.top_k(a_m, k)
+        else:
+            vals, inds = jax.lax.top_k(-a_m, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(inds.astype(jnp.int64), -1, axis)
+
+    vals, inds = apply("topk", kernel, [x], {"k": k, "axis": axis, "largest": largest})
+    return vals, inds
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    data = np.asarray(t_(x)._data)
+    res = np.unique(data, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    # paddle returns (out, index?, inverse?, counts?)
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64",
+                       name=None):
+    data = np.asarray(t_(x)._data)
+    if axis is None:
+        data = data.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    changed = np.ones(data.shape[ax], bool)
+    if data.shape[ax] > 1:
+        sl = [slice(None)] * data.ndim
+        sl2 = [slice(None)] * data.ndim
+        sl[ax], sl2[ax] = slice(1, None), slice(None, -1)
+        diff = (np.take(data, range(1, data.shape[ax]), ax) != np.take(data, range(0, data.shape[ax] - 1), ax))
+        while diff.ndim > 1:
+            diff = diff.any(axis=-1 if ax == 0 else 0)
+        changed[1:] = diff
+    keep = np.nonzero(changed)[0]
+    out = [Tensor(jnp.asarray(np.take(data, keep, ax)))]
+    if return_inverse:
+        inv = np.cumsum(changed) - 1
+        out.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        counts = np.diff(np.append(keep, data.shape[ax]))
+        out.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return tuple(out) if len(out) > 1 else out[0]
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def kernel(s, v, right):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side)
+        return jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(s, v)
+
+    out = apply("searchsorted", kernel, [t_(sorted_sequence), t_(values)], {"right": right},
+                differentiable=False)
+    return cast(out, "int32" if out_int32 else "int64")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = t_(x)
+    if isinstance(pad, Tensor):
+        pad = [int(p) for p in pad.numpy().reshape(-1)]
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle NCHW/NCL/NCDHW convention: pad applies to spatial dims, last-dim-first
+        n_spatial = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial = list(range(2, 2 + n_spatial))
+        else:
+            spatial = list(range(1, 1 + n_spatial))
+        for j, d in enumerate(reversed(spatial)):
+            width[d] = (pad[2 * j], pad[2 * j + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+
+    def kernel(a, width, jmode, value):
+        if jmode == "constant":
+            return jnp.pad(a, width, mode="constant", constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+
+    return apply("pad", kernel, [x], {"width": tuple(width), "jmode": jmode, "value": value})
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = t_(x)
+
+    def kernel(a, axes, starts, ends, strides):
+        idx = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(s, e, st)
+        return a[tuple(idx)]
+
+    return apply("strided_slice", kernel, [x],
+                 {"axes": tuple(axes), "starts": tuple(starts), "ends": tuple(ends),
+                  "strides": tuple(strides)})
+
+
+def slice(x, axes, starts, ends, name=None):
+    return strided_slice(x, axes, starts, ends, [1] * len(axes))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = t_(x)
+    shape = _static_shape(shape)
+    offsets = [0] * x.ndim if offsets is None else [int(o) for o in offsets]
+    return strided_slice(x, list(range(x.ndim)), offsets,
+                         [o + s for o, s in zip(offsets, shape)])
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def kernel(a, index_num, nshards, shard_id, ignore_value):
+        shard_size = (index_num + nshards - 1) // nshards
+        in_shard = (a // shard_size) == shard_id
+        return jnp.where(in_shard, a % shard_size, ignore_value)
+
+    return apply("shard_index", kernel, [t_(input)],
+                 {"index_num": index_num, "nshards": nshards, "shard_id": shard_id,
+                  "ignore_value": ignore_value}, differentiable=False)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply("tensordot", lambda a, b, axes: jnp.tensordot(a, b, axes), [t_(x), t_(y)],
+                 {"axes": axes})
+
+
+def as_real(x, name=None):
+    return apply("as_real", lambda a: jnp.stack([a.real, a.imag], -1), [t_(x)])
+
+
+def as_complex(x, name=None):
+    return apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), [t_(x)])
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def atleast_1d(*inputs):
+    outs = [Tensor(jnp.atleast_1d(t_(i)._data)) for i in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_2d(*inputs):
+    outs = [Tensor(jnp.atleast_2d(t_(i)._data)) for i in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_3d(*inputs):
+    outs = [Tensor(jnp.atleast_3d(t_(i)._data)) for i in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    tensors = [t_(x)]
+    def kernel(a, n, axis):
+        return jnp.diff(a, n=n, axis=axis)
+    return apply("diff", kernel, tensors, {"n": n, "axis": axis})
+
+
+# ---- __getitem__ / __setitem__ machinery ----
+
+def _convert_index(item):
+    """Convert a python index expression (possibly containing Tensors) to jnp form."""
+    if isinstance(item, tuple):
+        return tuple(_convert_index(i) for i in item)
+    if isinstance(item, Tensor):
+        return item._data
+    if isinstance(item, (list, np.ndarray)):
+        return jnp.asarray(np.asarray(item))
+    return item  # int, slice, None, Ellipsis
+
+
+def _index_has_bool(idx):
+    if isinstance(idx, tuple):
+        return builtins.any(_index_has_bool(i) for i in idx)
+    return (hasattr(idx, "dtype") and idx.dtype == np.bool_) or isinstance(idx, bool)
+
+
+def getitem(x, item):
+    x = t_(x)
+    idx = _convert_index(item)
+    if _index_has_bool(idx):
+        # Dynamic-shape path: the mask is materialized on host (the reference's bool
+        # index also forces a D2H sync), converted to integer indices so the gather
+        # itself stays on-device and DIFFERENTIABLE.
+        def to_int(i):
+            if hasattr(i, "dtype") and i.dtype == np.bool_:
+                nz = np.nonzero(np.asarray(i))
+                return tuple(jnp.asarray(z) for z in nz) if len(nz) > 1 else jnp.asarray(nz[0])
+            return i
+
+        if isinstance(idx, tuple):
+            new_idx = []
+            for i in idx:
+                c = to_int(i)
+                if isinstance(c, tuple):
+                    new_idx.extend(c)
+                else:
+                    new_idx.append(c)
+            idx = tuple(new_idx)
+        else:
+            idx = to_int(idx)
+
+    def kernel(a):
+        return a[idx]
+
+    return apply("getitem", kernel, [x])
+
+
+def setitem(x, item, value):
+    idx = _convert_index(item)
+    value = as_tensor(value)
+
+    def kernel(a, v):
+        return a.at[idx].set(v.astype(a.dtype))
+
+    return _inplace_rebind(x, lambda snap, v: apply("setitem", kernel, [snap, v]), value)

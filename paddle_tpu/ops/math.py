@@ -1,0 +1,268 @@
+"""Elementwise / binary / scalar math ops.
+
+Reference parity: python/paddle/tensor/math.py + phi elementwise kernels
+(paddle/phi/kernels/elementwise_*ized). All lower to jnp/lax, which XLA fuses on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.dispatch import apply, as_tensor
+from ..core.tensor import Tensor
+from ._helpers import binary, unary, t_
+
+# ---- binary arithmetic ----
+add = binary("add", jnp.add)
+subtract = binary("subtract", jnp.subtract)
+multiply = binary("multiply", jnp.multiply)
+divide = binary("divide", jnp.true_divide)
+floor_divide = binary("floor_divide", jnp.floor_divide, differentiable=False)
+remainder = binary("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow = binary("pow", jnp.power)
+maximum = binary("maximum", jnp.maximum)
+minimum = binary("minimum", jnp.minimum)
+fmax = binary("fmax", jnp.fmax)
+fmin = binary("fmin", jnp.fmin)
+atan2 = binary("atan2", jnp.arctan2)
+hypot = binary("hypot", jnp.hypot)
+copysign = binary("copysign", jnp.copysign)
+nextafter = binary("nextafter", jnp.nextafter, differentiable=False)
+ldexp = binary("ldexp", jnp.ldexp)
+logaddexp = binary("logaddexp", jnp.logaddexp)
+heaviside = binary("heaviside", jnp.heaviside)
+gcd = binary("gcd", jnp.gcd, differentiable=False)
+lcm = binary("lcm", jnp.lcm, differentiable=False)
+kron = binary("kron", jnp.kron)
+inner = binary("inner", jnp.inner)
+outer = binary("outer", lambda a, b: jnp.outer(a, b))
+
+# ---- comparisons (never differentiable) ----
+equal = binary("equal", jnp.equal, differentiable=False)
+not_equal = binary("not_equal", jnp.not_equal, differentiable=False)
+less_than = binary("less_than", jnp.less, differentiable=False)
+less_equal = binary("less_equal", jnp.less_equal, differentiable=False)
+greater_than = binary("greater_than", jnp.greater, differentiable=False)
+greater_equal = binary("greater_equal", jnp.greater_equal, differentiable=False)
+logical_and = binary("logical_and", jnp.logical_and, differentiable=False)
+logical_or = binary("logical_or", jnp.logical_or, differentiable=False)
+logical_xor = binary("logical_xor", jnp.logical_xor, differentiable=False)
+bitwise_and = binary("bitwise_and", jnp.bitwise_and, differentiable=False)
+bitwise_or = binary("bitwise_or", jnp.bitwise_or, differentiable=False)
+bitwise_xor = binary("bitwise_xor", jnp.bitwise_xor, differentiable=False)
+bitwise_left_shift = binary("bitwise_left_shift", jnp.left_shift, differentiable=False)
+bitwise_right_shift = binary("bitwise_right_shift", jnp.right_shift, differentiable=False)
+
+
+def logical_not(x, name=None):
+    return apply("logical_not", jnp.logical_not, [t_(x)], differentiable=False)
+
+
+def bitwise_not(x, name=None):
+    return apply("bitwise_not", jnp.bitwise_not, [t_(x)], differentiable=False)
+
+
+# ---- unary ----
+exp = unary("exp", jnp.exp)
+expm1 = unary("expm1", jnp.expm1)
+log = unary("log", jnp.log)
+log2 = unary("log2", jnp.log2)
+log10 = unary("log10", jnp.log10)
+log1p = unary("log1p", jnp.log1p)
+sqrt = unary("sqrt", jnp.sqrt)
+rsqrt = unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+square = unary("square", jnp.square)
+reciprocal = unary("reciprocal", lambda x: 1.0 / x)
+abs = unary("abs", jnp.abs)
+neg = unary("neg", jnp.negative)
+sin = unary("sin", jnp.sin)
+cos = unary("cos", jnp.cos)
+tan = unary("tan", jnp.tan)
+asin = unary("asin", jnp.arcsin)
+acos = unary("acos", jnp.arccos)
+atan = unary("atan", jnp.arctan)
+sinh = unary("sinh", jnp.sinh)
+cosh = unary("cosh", jnp.cosh)
+tanh = unary("tanh", jnp.tanh)
+asinh = unary("asinh", jnp.arcsinh)
+acosh = unary("acosh", jnp.arccosh)
+atanh = unary("atanh", jnp.arctanh)
+erf = unary("erf", jax.scipy.special.erf)
+erfinv = unary("erfinv", jax.scipy.special.erfinv)
+floor = unary("floor", jnp.floor)
+ceil = unary("ceil", jnp.ceil)
+round = unary("round", jnp.round)
+trunc = unary("trunc", jnp.trunc)
+frac = unary("frac", lambda x: x - jnp.trunc(x))
+sign = unary("sign", jnp.sign)
+sgn = sign
+digamma = unary("digamma", jax.scipy.special.digamma)
+lgamma = unary("lgamma", jax.scipy.special.gammaln)
+sigmoid = unary("sigmoid", jax.nn.sigmoid)
+logit = unary("logit", lambda x: jnp.log(x) - jnp.log1p(-x))
+i0 = unary("i0", lambda x: jax.scipy.special.i0(x))
+i1 = unary("i1", lambda x: jax.scipy.special.i1(x))
+isnan = unary("isnan", jnp.isnan, differentiable=False)
+isinf = unary("isinf", jnp.isinf, differentiable=False)
+isfinite = unary("isfinite", jnp.isfinite, differentiable=False)
+conj = unary("conj", jnp.conj)
+real = unary("real", jnp.real)
+imag = unary("imag", jnp.imag)
+angle = unary("angle", jnp.angle)
+deg2rad = unary("deg2rad", jnp.deg2rad)
+rad2deg = unary("rad2deg", jnp.rad2deg)
+exponent = unary("exponent", lambda x: jnp.frexp(x)[1].astype(jnp.int32), differentiable=False)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def k(a, scale, bias, bias_after_scale):
+        if bias_after_scale:
+            return a * scale + bias
+        return (a + bias) * scale
+
+    out = apply("scale", k, [t_(x)],
+                {"scale": float(scale) if not isinstance(scale, Tensor) else scale.item(),
+                 "bias": float(bias), "bias_after_scale": bool(bias_after_scale)})
+    if act:
+        from . import activation as _act
+        out = getattr(_act, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    out = apply("increment", lambda a, value: a + value, [t_(x)], {"value": value})
+    x.set_value(out._data)
+    return x
+
+
+def clip(x, min=None, max=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return apply("clip", lambda a, lo, hi: jnp.clip(a, lo, hi), [t_(x)],
+                 {"lo": _v(min), "hi": _v(max)})
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply("lerp", lambda a, b, w: a + w * (b - a), [t_(x), t_(y), weight])
+    return apply("lerp", lambda a, b, weight: a + weight * (b - a), [t_(x), t_(y)],
+                 {"weight": weight})
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply("nan_to_num", lambda a, nan, posinf, neginf: jnp.nan_to_num(
+        a, nan=nan, posinf=posinf, neginf=neginf), [t_(x)],
+        {"nan": nan, "posinf": posinf, "neginf": neginf})
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda a, scale_a, scale_b: scale_b * jnp.tanh(scale_a * a),
+                 [t_(x)], {"scale_a": scale_a, "scale_b": scale_b})
+
+
+def multiplex(inputs, index, name=None):
+    stacked = jnp.stack([t_(i)._data for i in inputs], 1)  # [N, num_ins, ...]
+    idx = t_(index)._data.reshape(-1)
+    return Tensor(jnp.take_along_axis(
+        stacked, idx.reshape(-1, 1, *([1] * (stacked.ndim - 2))), axis=1).squeeze(1))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(t_(x)._data, t_(y)._data, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("isclose", lambda a, b, rtol, atol, equal_nan: jnp.isclose(
+        a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), [t_(x), t_(y)],
+        {"rtol": rtol, "atol": atol, "equal_nan": equal_nan}, differentiable=False)
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(t_(x)._data, t_(y)._data))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply("addmm", lambda i, a, b, beta, alpha: beta * i + alpha * (a @ b),
+                 [t_(input), t_(x), t_(y)], {"beta": beta, "alpha": alpha})
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace", lambda a, offset, axis1, axis2: jnp.trace(a, offset, axis1, axis2),
+                 [t_(x)], {"offset": offset, "axis1": axis1, "axis2": axis2})
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal", lambda a, offset, axis1, axis2: jnp.diagonal(a, offset, axis1, axis2),
+                 [t_(x)], {"offset": offset, "axis1": axis1, "axis2": axis2})
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return apply("cumsum", lambda a, axis, dtype: jnp.cumsum(
+        a if axis is not None else a.reshape(-1), axis=axis if axis is not None else 0,
+        dtype=dtype), [t_(x)], {"axis": axis, "dtype": d})
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return apply("cumprod", lambda a, dim, dtype: jnp.cumprod(a, axis=dim, dtype=dtype),
+                 [t_(x)], {"dim": dim, "dtype": d})
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = t_(x)
+    a = x._data if axis is not None else x._data.reshape(-1)
+    ax = axis if axis is not None else 0
+    n = a.shape[ax]
+    ar = jnp.arange(n).reshape([-1 if i == ax else 1 for i in range(a.ndim)])
+    ar = jnp.broadcast_to(ar, a.shape)
+
+    def mx(l, r):
+        lv, li = l
+        rv, ri = r
+        take_r = rv >= lv
+        return jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li)
+
+    vals, inds = jax.lax.associative_scan(mx, (a, ar), axis=ax)
+    return Tensor(vals), Tensor(inds.astype(dtypes.convert_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = t_(x)
+    a = x._data if axis is not None else x._data.reshape(-1)
+    ax = axis if axis is not None else 0
+    n = a.shape[ax]
+    ar = jnp.arange(n).reshape([-1 if i == ax else 1 for i in range(a.ndim)])
+    ar = jnp.broadcast_to(ar, a.shape)
+
+    def mn(l, r):
+        lv, li = l
+        rv, ri = r
+        take_r = rv <= lv
+        return jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li)
+
+    vals, inds = jax.lax.associative_scan(mn, (a, ar), axis=ax)
+    return Tensor(vals), Tensor(inds.astype(dtypes.convert_dtype(dtype)))
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    x = t_(x)
+
+    def k(a, axis):
+        if axis is None:
+            a = a.reshape(-1)
+            axis = 0
+        return jax.lax.cumlogsumexp(a, axis=axis)
+
+    return apply("logcumsumexp", k, [x], {"axis": axis})
+
+
+def rsqrt_(x):
+    x.set_value(jax.lax.rsqrt(x._data))
+    return x

@@ -1,0 +1,885 @@
+"""NN functional ops: conv/pool/norm/dropout/embedding/losses/attention.
+
+Reference parity: python/paddle/nn/functional/* lowering to phi conv/pool/norm kernels
+(paddle/phi/kernels/gpu/conv_kernel.cu etc). TPU-native: convs lower to
+`lax.conv_general_dilated` (MXU), pools to `lax.reduce_window`; data_format NCHW (paddle default)
+is accepted and handed to XLA via dimension_numbers — no transposes inserted.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core import random as random_mod
+from ..core.dispatch import apply, as_tensor
+from ..core.tensor import Tensor
+from ._helpers import normalize_axis, t_
+
+
+def _pair(v, n):
+    if isinstance(v, (int, float)):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def linear(x, weight, bias=None, name=None):
+    if bias is None:
+        return apply("linear", lambda a, w: a @ w, [t_(x), t_(weight)])
+    return apply("linear", lambda a, w, b: a @ w + b, [t_(x), t_(weight), t_(bias)])
+
+
+# ---------- convolution ----------
+
+def _conv_dn(ndim, channel_last):
+    if ndim == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if ndim == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv_padding(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    return [tuple(p) for p in padding]
+
+
+def _convnd(name, nd, x, weight, bias, stride, padding, dilation, groups, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    dn = _conv_dn(nd, channel_last)
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    pad = _conv_padding(padding, nd)
+
+    def kernel(a, w, *maybe_bias):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None)
+        if maybe_bias:
+            b = maybe_bias[0]
+            if channel_last:
+                out = out + b
+            else:
+                out = out + b.reshape((1, -1) + (1,) * nd)
+        return out
+
+    args = [t_(x), t_(weight)] + ([t_(bias)] if bias is not None else [])
+    return apply(name, kernel, args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _convnd("conv1d", 1, x, weight, bias, stride, padding, dilation, groups, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _convnd("conv2d", 2, x, weight, bias, stride, padding, dilation, groups, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _convnd("conv3d", 3, x, weight, bias, stride, padding, dilation, groups, data_format)
+
+
+def _conv_transpose(name, nd, x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, data_format, output_size=None):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    dn = _conv_dn(nd, channel_last)
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    pad = _conv_padding(padding, nd)
+    out_pad = _pair(output_padding or 0, nd)
+
+    def kernel(a, w, *maybe_bias):
+        # paddle weight layout for transpose conv: [in, out//groups, *k] ==> grad-conv form.
+        # Use conv_transpose via conv_general_dilated with lhs dilation.
+        k_spatial = w.shape[2:]
+        if isinstance(pad, str):
+            pads = None
+        else:
+            pads = []
+            for i in range(nd):
+                lo = dilation[i] * (k_spatial[i] - 1) - pad[i][0]
+                hi = dilation[i] * (k_spatial[i] - 1) - pad[i][1] + out_pad[i]
+                pads.append((lo, hi))
+        # flip spatial dims and swap in/out channels: [in, out//g, *k] -> [out, in//g, *k]
+        w_t = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+        if groups > 1:
+            # [in, out//g, *k] -> g groups of [in//g, out//g, *k]
+            w_t = w_t.reshape((groups, w.shape[0] // groups) + w_t.shape[1:])
+            w_t = jnp.swapaxes(w_t, 1, 2)  # [g, out//g, in//g, *k]
+            w_t = w_t.reshape((w.shape[1] * groups, w.shape[0] // groups) + k_spatial)
+        else:
+            w_t = jnp.swapaxes(w_t, 0, 1)
+        out = jax.lax.conv_general_dilated(
+            a, w_t, window_strides=(1,) * nd,
+            padding=pads if pads is not None else "SAME",
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        if maybe_bias:
+            b = maybe_bias[0]
+            out = out + (b if channel_last else b.reshape((1, -1) + (1,) * nd))
+        return out
+
+    args = [t_(x), t_(weight)] + ([t_(bias)] if bias is not None else [])
+    return apply(name, kernel, args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose("conv1d_transpose", 1, x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, df)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose("conv2d_transpose", 2, x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose("conv3d_transpose", 3, x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, data_format)
+
+
+# ---------- pooling ----------
+
+def _pool(name, x, kernel_size, stride, padding, nd, reducer, init, data_format,
+          ceil_mode=False, exclusive=True, count_include_pad=False):
+    x = t_(x)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    ks = _pair(kernel_size, nd)
+    st = _pair(stride if stride is not None else kernel_size, nd)
+    pd = _conv_padding(padding, nd)
+    if channel_last:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = [(0, 0)] + (pd if not isinstance(pd, str) else pd) + [(0, 0)] if not isinstance(pd, str) else pd
+    else:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = [(0, 0), (0, 0)] + pd if not isinstance(pd, str) else pd
+
+    def kernel(a):
+        if reducer == "max":
+            return jax.lax.reduce_window(a, -jnp.inf if dtypes.is_floating(a.dtype) else jnp.iinfo(a.dtype).min,
+                                         jax.lax.max, window, strides,
+                                         pads if not isinstance(pads, str) else pads)
+        # avg
+        ones = jnp.ones_like(a)
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides,
+                                  pads if not isinstance(pads, str) else pads)
+        if count_include_pad:
+            denom = float(np.prod(ks))
+            return s / denom
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                    pads if not isinstance(pads, str) else pads)
+        return s / cnt
+
+    return apply(name, kernel, [x])
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool("max_pool1d", x, kernel_size, stride, padding, 1, "max", None, df, ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCHW", name=None):
+    return _pool("max_pool2d", x, kernel_size, stride, padding, 2, "max", None, data_format, ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    return _pool("max_pool3d", x, kernel_size, stride, padding, 3, "max", None, data_format, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
+               data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _pool("avg_pool1d", x, kernel_size, stride, padding, 1, "avg", None, df, ceil_mode,
+                 exclusive, count_include_pad=not exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    return _pool("avg_pool2d", x, kernel_size, stride, padding, 2, "avg", None, data_format,
+                 ceil_mode, exclusive, count_include_pad=not exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    return _pool("avg_pool3d", x, kernel_size, stride, padding, 3, "avg", None, data_format,
+                 ceil_mode, exclusive, count_include_pad=not exclusive)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    x = t_(x)
+    out_hw = _pair(output_size, 2)
+    channel_last = data_format == "NHWC"
+    h_ax, w_ax = (1, 2) if channel_last else (2, 3)
+    in_h, in_w = x.shape[h_ax], x.shape[w_ax]
+    if out_hw[0] is None:
+        out_hw = (in_h, out_hw[1])
+    if out_hw[1] is None:
+        out_hw = (out_hw[0], in_w)
+    if in_h % out_hw[0] == 0 and in_w % out_hw[1] == 0:
+        kh, kw = in_h // out_hw[0], in_w // out_hw[1]
+        return avg_pool2d(x, (kh, kw), (kh, kw), 0, data_format=data_format)
+
+    def kernel(a):
+        # general adaptive: mean over variable windows via cumulative sums
+        def pool_axis(arr, axis, out_sz):
+            in_sz = arr.shape[axis]
+            starts = (np.arange(out_sz) * in_sz) // out_sz
+            ends = ((np.arange(out_sz) + 1) * in_sz + out_sz - 1) // out_sz
+            pieces = [jnp.mean(jax.lax.slice_in_dim(arr, int(s), int(e), axis=axis),
+                               axis=axis, keepdims=True) for s, e in zip(starts, ends)]
+            return jnp.concatenate(pieces, axis=axis)
+
+        return pool_axis(pool_axis(a, h_ax, out_hw[0]), w_ax, out_hw[1])
+
+    return apply("adaptive_avg_pool2d", kernel, [x])
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    x = t_(x)
+    out = adaptive_avg_pool2d(unsq := apply("unsqueeze", lambda a: jnp.expand_dims(a, -1), [x]),
+                              (output_size, 1))
+    return apply("squeeze", lambda a: jnp.squeeze(a, -1), [out])
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    x = t_(x)
+    out_hw = _pair(output_size, 2)
+    in_h, in_w = x.shape[2], x.shape[3]
+    if in_h % out_hw[0] == 0 and in_w % out_hw[1] == 0:
+        kh, kw = in_h // out_hw[0], in_w // out_hw[1]
+        return max_pool2d(x, (kh, kw), (kh, kw), 0)
+
+    def kernel(a):
+        def pool_axis(arr, axis, out_sz):
+            in_sz = arr.shape[axis]
+            starts = (np.arange(out_sz) * in_sz) // out_sz
+            ends = ((np.arange(out_sz) + 1) * in_sz + out_sz - 1) // out_sz
+            pieces = [jnp.max(jax.lax.slice_in_dim(arr, int(s), int(e), axis=axis),
+                              axis=axis, keepdims=True) for s, e in zip(starts, ends)]
+            return jnp.concatenate(pieces, axis=axis)
+
+        return pool_axis(pool_axis(a, 2, out_hw[0]), 3, out_hw[1])
+
+    return apply("adaptive_max_pool2d", kernel, [x])
+
+
+# ---------- normalization ----------
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None):
+    x = t_(x)
+    channel_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
+    ch_axis = x.ndim - 1 if channel_last else (1 if x.ndim > 1 else 0)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    def kernel(a, *params):
+        i = 0
+        if use_batch_stats:
+            m = jnp.mean(a, axis=reduce_axes)
+            v = jnp.var(a, axis=reduce_axes)
+        else:
+            m = running_mean._data
+            v = running_var._data
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        out = (a - m.reshape(shape)) * jax.lax.rsqrt(v.reshape(shape) + epsilon)
+        if weight is not None:
+            out = out * params[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + params[i].reshape(shape)
+        if use_batch_stats:
+            # expose batch stats so the stateful running-stat update reuses this
+            # single reduction (one fused XLA computation, no second pass)
+            return out, m, v
+        return out
+
+    args = [x] + [t_(p) for p in (weight, bias) if p is not None]
+    result = apply("batch_norm", kernel, args)
+    if not use_batch_stats:
+        return result
+    out, bm, bv = result
+    from ..jit import in_jit_trace
+
+    if not in_jit_trace():
+        # stateful running-stat update (the reference's batch_norm op side outputs);
+        # inside a trace, stat updates are the engine's job (functional state)
+        if running_mean is not None:
+            running_mean.set_value(momentum * running_mean._data + (1 - momentum) * bm._data)
+        if running_var is not None:
+            n = x._data.size / x._data.shape[ch_axis]
+            unbiased = bv._data * (n / builtins_max(n - 1, 1))
+            running_var.set_value(momentum * running_var._data + (1 - momentum) * unbiased)
+    return out
+
+
+def builtins_max(a, b):
+    return a if a > b else b
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    x = t_(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    def kernel(a, *params):
+        m = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        v = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - m) * jax.lax.rsqrt(v + epsilon)).astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * params[i]
+            i += 1
+        if bias is not None:
+            out = out + params[i]
+        return out
+
+    args = [x] + [t_(p) for p in (weight, bias) if p is not None]
+    return apply("layer_norm", kernel, args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    x = t_(x)
+
+    def kernel(a, *params):
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if params:
+            out = out * params[0]
+        return out
+
+    args = [x] + ([t_(weight)] if weight is not None else [])
+    return apply("rms_norm", kernel, args)
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW", name=None):
+    x = t_(x)
+    channel_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
+    ch_axis = x.ndim - 1 if channel_last else 1
+    c = x.shape[ch_axis]
+
+    def kernel(a, *params):
+        if channel_last:
+            a_g = jnp.moveaxis(a, -1, 1)
+        else:
+            a_g = a
+        n = a_g.shape[0]
+        g = a_g.reshape((n, num_groups, c // num_groups) + a_g.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        v = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) * jax.lax.rsqrt(v + epsilon)).reshape(a_g.shape)
+        shape = [1] * a_g.ndim
+        shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * params[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + params[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x] + [t_(p) for p in (weight, bias) if p is not None]
+    return apply("group_norm", kernel, args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    x = t_(x)
+    axes = tuple(range(2, x.ndim))
+
+    def kernel(a, *params):
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * params[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + params[i].reshape(shape)
+        return out
+
+    args = [x] + [t_(p) for p in (weight, bias) if p is not None]
+    return apply("instance_norm", kernel, args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def kernel(a):
+        sq = jnp.square(a)
+        half = size // 2
+        pad = [(0, 0)] * a.ndim
+        pad[1] = (half, size - half - 1)
+        sq_p = jnp.pad(sq, pad)
+        win = sum(jax.lax.slice_in_dim(sq_p, i, i + a.shape[1], axis=1) for i in range(size))
+        return a / jnp.power(k + alpha * win, beta)
+
+    return apply("local_response_norm", kernel, [t_(x)])
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def kernel(a, p, axis, epsilon):
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True), 1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return apply("normalize", kernel, [t_(x)], {"p": p, "axis": axis, "epsilon": epsilon})
+
+
+# ---------- dropout / embedding ----------
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = t_(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply("dropout_scale", lambda a: a * (1 - p), [x])
+        return x
+    if p == 1.0:
+        return apply("dropout", lambda a: jnp.zeros_like(a), [x])
+    key = random_mod.next_key()
+    shape = list(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    mask = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+
+    def kernel(a):
+        if mode == "upscale_in_train":
+            return jnp.where(mask, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(mask, a, 0.0).astype(a.dtype)
+
+    return apply("dropout", kernel, [x])
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format == "NCHW" else 3
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ch_axis = 1 if data_format == "NCDHW" else 4
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = t_(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = random_mod.next_key()
+    mask = jax.random.bernoulli(key, 1.0 - p, tuple(x.shape))
+    a_coef = (1.0 - p + p * alpha_p ** 2) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+
+    def kernel(v):
+        return (a_coef * jnp.where(mask, v, alpha_p) + b_coef).astype(v.dtype)
+
+    return apply("alpha_dropout", kernel, [x])
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x, weight = t_(x), t_(weight)
+
+    def kernel(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+        return out
+
+    return apply("embedding", kernel, [x, weight], nondiff_mask=[True, False])
+
+
+def one_hot(x, num_classes, name=None):
+    return apply("one_hot", lambda a, n: jax.nn.one_hot(a, n, dtype=jnp.float32),
+                 [t_(x)], {"n": int(num_classes)}, differentiable=False)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = t_(label)
+
+    def kernel(l, *pd):
+        k = l.shape[-1]
+        if pd:
+            return (1 - epsilon) * l + epsilon * pd[0]
+        return (1 - epsilon) * l + epsilon / k
+
+    args = [label] + ([t_(prior_dist)] if prior_dist is not None else [])
+    return apply("label_smooth", kernel, args)
+
+
+# ---------- losses ----------
+
+def _reduce_loss(loss_t, reduction):
+    from . import reduction as R
+
+    if reduction == "mean":
+        return R.mean(loss_t)
+    if reduction == "sum":
+        return R.sum(loss_t)
+    return loss_t
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    logits, label = t_(logits), t_(label)
+
+    def kernel(lg, lb):
+        lsm = jax.nn.log_softmax(lg.astype(jnp.float32), axis=axis)
+        if soft_label:
+            loss = -jnp.sum(lb * lsm, axis=axis, keepdims=True)
+        else:
+            lb_ = lb
+            if lb_.ndim == lg.ndim:
+                lb_ = jnp.squeeze(lb_, axis)
+            safe = jnp.where(lb_ == ignore_index, 0, lb_)
+            picked = jnp.take_along_axis(lsm, jnp.expand_dims(safe, axis), axis=axis)
+            loss = -picked
+            loss = jnp.where(jnp.expand_dims(lb_ == ignore_index, axis), 0.0, loss)
+        return loss.astype(lg.dtype)
+
+    nondiff = [False, not soft_label]
+    loss = apply("softmax_with_cross_entropy", kernel, [logits, label], nondiff_mask=nondiff)
+    if return_softmax:
+        from .activation import softmax as _softmax
+
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    input, label = t_(input), t_(label)
+    if label_smoothing > 0.0 and not soft_label:
+        num_classes = input.shape[axis]
+        label = one_hot(label, num_classes)
+        label = label_smooth(label, epsilon=label_smoothing)
+        soft_label = True
+
+    if not use_softmax:
+        def kernel(p, lb, *w):
+            logp = jnp.log(jnp.clip(p, 1e-10, 1.0))
+            if soft_label:
+                loss = -jnp.sum(lb * logp, axis=axis, keepdims=True)
+            else:
+                lb_ = lb if lb.ndim < p.ndim else jnp.squeeze(lb, axis)
+                safe = jnp.where(lb_ == ignore_index, 0, lb_)
+                loss = -jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis)
+                loss = jnp.where(jnp.expand_dims(lb_ == ignore_index, axis), 0.0, loss)
+            return loss
+
+        loss = apply("cross_entropy_prob", kernel, [input, label],
+                     nondiff_mask=[False, not soft_label])
+    else:
+        loss = softmax_with_cross_entropy(input, label, soft_label=soft_label,
+                                          ignore_index=ignore_index, axis=axis)
+
+    if weight is not None:
+        weight = t_(weight)
+        if soft_label:
+            raise NotImplementedError("class weight with soft labels deferred")
+        lbl = label._data if label.ndim < input.ndim else jnp.squeeze(label._data, axis)
+        w = Tensor(jnp.take(weight._data, jnp.where(lbl == ignore_index, 0, lbl))[..., None])
+        loss = loss * w
+        if reduction == "mean":
+            from . import reduction as R
+
+            valid = Tensor(jnp.where(lbl == ignore_index, 0.0, 1.0)[..., None])
+            return R.sum(loss) / R.sum(w * valid)
+
+    if reduction == "mean" and not soft_label:
+        # mean over VALID tokens — labels may contain ignore_index (e.g. the default
+        # -100 padding convention); dividing by total N would shrink the loss
+        from . import reduction as R
+
+        lbl = label._data if label.ndim < input.ndim else jnp.squeeze(label._data, axis)
+        denom = jnp.maximum((lbl != ignore_index).sum(), 1)
+        return R.sum(loss) / Tensor(denom.astype(loss._data.dtype))
+    return _reduce_loss(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    input, label = t_(input), t_(label)
+
+    def kernel(lp, lb, *w):
+        safe = jnp.where(lb == ignore_index, 0, lb)
+        picked = -jnp.take_along_axis(lp, safe[..., None] if lp.ndim == lb.ndim + 1 else safe, axis=1 if lp.ndim == 2 else 1)
+        picked = jnp.squeeze(picked, 1) if picked.ndim > lb.ndim else picked
+        if w:
+            picked = picked * jnp.take(w[0], safe)
+        return jnp.where(lb == ignore_index, 0.0, picked)
+
+    args = [input, label] + ([t_(weight)] if weight is not None else [])
+    loss = apply("nll_loss", kernel, args, nondiff_mask=[False, True] + ([True] if weight is not None else []))
+    if reduction == "mean" and weight is not None:
+        from . import reduction as R
+
+        lbl = label._data
+        w_sum = Tensor(jnp.take(t_(weight)._data, jnp.where(lbl == ignore_index, 0, lbl)) *
+                       (lbl != ignore_index))
+        return R.sum(loss) / R.sum(w_sum)
+    return _reduce_loss(loss, reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    loss = apply("mse_loss", lambda a, b: jnp.square(a - b), [t_(input), t_(label)])
+    return _reduce_loss(loss, reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    loss = apply("l1_loss", lambda a, b: jnp.abs(a - b), [t_(input), t_(label)])
+    return _reduce_loss(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def kernel(a, b, delta):
+        d = jnp.abs(a - b)
+        return jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+
+    loss = apply("smooth_l1_loss", kernel, [t_(input), t_(label)], {"delta": delta})
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def kernel(p, l, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(l * jnp.log(p) + (1 - l) * jnp.log1p(-p))
+        if w:
+            loss = loss * w[0]
+        return loss
+
+    args = [t_(input), t_(label)] + ([t_(weight)] if weight is not None else [])
+    loss = apply("binary_cross_entropy", kernel, args)
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def kernel(z, l, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        max_val = jnp.clip(-z, 0, None)
+        if pw is not None:
+            log_w = (pw - 1) * l + 1
+            loss = (1 - l) * z + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val)
+        else:
+            loss = jnp.clip(z, 0, None) - z * l + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if w is not None:
+            loss = loss * w
+        return loss
+
+    args = [t_(logit), t_(label)]
+    if weight is not None:
+        args.append(t_(weight))
+    if pos_weight is not None:
+        args.append(t_(pos_weight))
+    loss = apply("bce_with_logits", kernel, args)
+    return _reduce_loss(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def kernel(lp, t):
+        return t * (jnp.log(jnp.clip(t, 1e-12, None)) - lp)
+
+    loss = apply("kl_div", kernel, [t_(input), t_(label)])
+    if reduction == "batchmean":
+        from . import reduction as R
+
+        return R.sum(loss) / t_(input).shape[0]
+    return _reduce_loss(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def kernel(z, l):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.clip(z, 0, None) - z * l + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * l + (1 - p) * (1 - l)
+        mod = jnp.power(1 - p_t, gamma)
+        a_t = alpha * l + (1 - alpha) * (1 - l)
+        return a_t * mod * ce
+
+    loss = apply("sigmoid_focal_loss", kernel, [t_(logit), t_(label)])
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce_loss(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def kernel(a, b, l, margin):
+        return jnp.clip(-l * (a - b) + margin, 0, None)
+
+    loss = apply("margin_ranking_loss", kernel, [t_(input), t_(other), t_(label)],
+                 {"margin": margin})
+    return _reduce_loss(loss, reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def kernel(a, b, axis, eps):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.sqrt(jnp.sum(a * a, axis=axis)) * jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(den, eps)
+
+    return apply("cosine_similarity", kernel, [t_(x1), t_(x2)], {"axis": axis, "eps": eps})
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    sim = cosine_similarity(input1, input2, axis=1)
+    label = t_(label)
+
+    def kernel(s, l, margin):
+        return jnp.where(l > 0, 1 - s, jnp.clip(s - margin, 0, None))
+
+    loss = apply("cosine_embedding_loss", kernel, [sim, label], {"margin": margin},
+                 nondiff_mask=[False, True])
+    return _reduce_loss(loss, reduction)
+
+
+def square_error_cost(input, label):
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b), [t_(input), t_(label)])
+
+
+# ---------- attention ----------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Inputs [batch, seq, heads, head_dim] (paddle convention)."""
+    q, k, v = t_(query), t_(key), t_(value)
+    args = [q, k, v]
+    if attn_mask is not None:
+        args.append(t_(attn_mask))
+
+    def kernel(q, k, v, *mask):
+        scale = 1.0 / _math.sqrt(q.shape[-1])
+        qt = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+        if mask:
+            m = mask[0]
+            if m.dtype == jnp.bool_:
+                scores = jnp.where(m, scores, -1e9)
+            else:
+                scores = scores + m
+        if is_causal:
+            sq, sk = scores.shape[-2], scores.shape[-1]
+            causal = jnp.tril(jnp.ones((sq, sk), bool))
+            scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+        return jnp.swapaxes(out, 1, 2)
+
+    out = apply("attention", kernel, args,
+                nondiff_mask=[False, False, False] + ([True] * (len(args) - 3)))
+    if dropout_p > 0.0 and training:
+        out = dropout(out, dropout_p)
+    return out
+
+
+# ---------- misc ----------
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    x = t_(x)
+    nd = x.ndim - 2
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    spatial_axes = list(range(1, 1 + nd)) if channel_last else list(range(2, 2 + nd))
+    in_sizes = [x.shape[a] for a in spatial_axes]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.numpy().reshape(-1)]
+        out_sizes = [int(s) if not isinstance(s, Tensor) else int(s.item()) for s in
+                     (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+        out_sizes = [int(s * f) for s, f in zip(in_sizes, sf)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def kernel(a):
+        shape = list(a.shape)
+        for ax, os in zip(spatial_axes, out_sizes):
+            shape[ax] = os
+        return jax.image.resize(a, shape, method=jmode)
+
+    return apply("interpolate", kernel, [x])
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    def kernel(a, r):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+        return a.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply("pixel_shuffle", kernel, [t_(x)], {"r": upscale_factor})
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = t_(x)
+    ks = _pair(kernel_sizes, 2)
+    st = _pair(strides, 2)
+    pd = _pair(paddings, 2)
+    dl = _pair(dilations, 2)
+
+    def kernel(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
+        oh = (h + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (w + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        cols = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                patch = a_p[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                            j * dl[1]: j * dl[1] + ow * st[1]: st[1]]
+                cols.append(patch)
+        out = jnp.stack(cols, 2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return apply("unfold", kernel, [x])

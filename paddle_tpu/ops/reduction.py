@@ -1,0 +1,154 @@
+"""Reduction ops. Reference parity: python/paddle/tensor/math.py reduce_* + stat.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ._helpers import normalize_axis, t_
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.numpy().reshape(-1))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return apply("sum", lambda a, axis, keepdim, dtype: jnp.sum(a, axis=axis, keepdims=keepdim, dtype=dtype),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim), "dtype": d})
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply("mean", lambda a, axis, keepdim: jnp.mean(a, axis=axis, keepdims=keepdim),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply("max", lambda a, axis, keepdim: jnp.max(a, axis=axis, keepdims=keepdim),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply("min", lambda a, axis, keepdim: jnp.min(a, axis=axis, keepdims=keepdim),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return apply("prod", lambda a, axis, keepdim, dtype: jnp.prod(a, axis=axis, keepdims=keepdim, dtype=dtype),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim), "dtype": d})
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply("all", lambda a, axis, keepdim: jnp.all(a, axis=axis, keepdims=keepdim),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim)}, differentiable=False)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply("any", lambda a, axis, keepdim: jnp.any(a, axis=axis, keepdims=keepdim),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim)}, differentiable=False)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+    return apply("argmax", lambda a, axis, keepdim: jnp.argmax(
+        a, axis=axis, keepdims=keepdim if axis is not None else False).astype(d),
+        [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim)}, differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+    return apply("argmin", lambda a, axis, keepdim: jnp.argmin(
+        a, axis=axis, keepdims=keepdim if axis is not None else False).astype(d),
+        [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim)}, differentiable=False)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("std", lambda a, axis, keepdim, ddof: jnp.std(a, axis=axis, keepdims=keepdim, ddof=ddof),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim), "ddof": 1 if unbiased else 0})
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("var", lambda a, axis, keepdim, ddof: jnp.var(a, axis=axis, keepdims=keepdim, ddof=ddof),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim), "ddof": 1 if unbiased else 0})
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    import jax.scipy.special as jss
+
+    return apply("logsumexp", lambda a, axis, keepdim: jss.logsumexp(a, axis=axis, keepdims=keepdim),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def k(a, axis, keepdim):
+        if mode == "min":
+            n = a.shape[axis] if axis is not None else a.size
+            srt = jnp.sort(a.reshape(-1) if axis is None else a, axis=0 if axis is None else axis)
+            return jnp.take(srt, (n - 1) // 2, axis=0 if axis is None else axis)
+        return jnp.median(a, axis=axis, keepdims=keepdim)
+
+    return apply("median", k, [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply("nanmedian", lambda a, axis, keepdim: jnp.nanmedian(a, axis=axis, keepdims=keepdim),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return apply("nansum", lambda a, axis, keepdim, dtype: jnp.nansum(a, axis=axis, keepdims=keepdim, dtype=dtype),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim), "dtype": d})
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply("nanmean", lambda a, axis, keepdim: jnp.nanmean(a, axis=axis, keepdims=keepdim),
+                 [t_(x)], {"axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply("count_nonzero", lambda a, axis, keepdim: jnp.count_nonzero(
+        a, axis=axis, keepdims=keepdim).astype(jnp.int64), [t_(x)],
+        {"axis": _axis(axis), "keepdim": bool(keepdim)}, differentiable=False)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply("quantile", lambda a, q, axis, keepdim, method: jnp.quantile(
+        a, jnp.asarray(q), axis=axis, keepdims=keepdim, method=method), [t_(x)],
+        {"q": q, "axis": _axis(axis), "keepdim": bool(keepdim), "method": interpolation})
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply("nanquantile", lambda a, q, axis, keepdim: jnp.nanquantile(
+        a, jnp.asarray(q), axis=axis, keepdims=keepdim), [t_(x)],
+        {"q": q, "axis": _axis(axis), "keepdim": bool(keepdim)})
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = t_(x)
+    ax = normalize_axis(axis, x.ndim)
+    vals = jnp.sort(x._data, axis=ax)
+    inds = jnp.argsort(x._data, axis=ax)
+    tv = jnp.take(vals, k - 1, axis=ax)
+    ti = jnp.take(inds, k - 1, axis=ax)
+    if keepdim:
+        tv, ti = jnp.expand_dims(tv, ax), jnp.expand_dims(ti, ax)
+    return Tensor(tv), Tensor(ti.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import scipy.stats  # noqa
+
+    raise NotImplementedError("mode: deferred (rare op)")

@@ -1,0 +1,222 @@
+"""Optimizers. Reference: python/paddle/optimizer/optimizer.py + adam.py etc.
+
+The dygraph Optimizer reads `p.grad`, runs the jit-cached functional rule per parameter, and
+swaps `p._data` in place (buffer donation analogue). The same rules run over whole pytrees
+inside the distributed engine's pjit'd train step (optimizer/functional.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from ..nn.layer import Parameter
+from . import functional as funct
+from . import lr  # noqa: F401
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _rule = "sgd"
+    _hyper = {}
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kwargs):
+        if parameters is None:
+            raise ValueError("dygraph optimizer requires `parameters`")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        wd = weight_decay
+        if wd is None:
+            wd = 0.0
+        elif not isinstance(wd, float):
+            # L2Decay object parity
+            wd = float(getattr(wd, "_coeff", getattr(wd, "coeff", wd)))
+        self._weight_decay = wd
+        self._states = {}  # id(param) -> state tuple
+        self._step_count = 0
+        self._apply_decay_param_fun = kwargs.pop("apply_decay_param_fun", None)
+
+    # ---- lr ----
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+
+    def _lr_step(self):
+        pass  # schedulers are stepped by user code (paddle semantics)
+
+    # ---- core ----
+    def _rule_kwargs(self, param):
+        """Static hyperparams for the functional rule; per-param wd exclusion hook."""
+        kw = dict(self._hyper)
+        wd = self._weight_decay
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(param.name):
+            wd = 0.0
+        if self._rule in ("sgd", "momentum", "adam", "adamax", "adagrad", "adadelta",
+                          "rmsprop", "adamw"):
+            kw["weight_decay"] = wd
+        return kw
+
+    @no_grad()
+    def step(self):
+        self._step_count += 1
+        lr_val = self.get_lr()
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        for p, g in params_grads:
+            if g is None:
+                continue
+            st = self._states.get(id(p))
+            if st is None:
+                st = funct.init_state(self._rule, p._data)
+                self._states[id(p)] = (p, st)
+            else:
+                st = st[1]
+            rule = funct.jitted_rule(self._rule, **self._rule_kwargs(p))
+            new_data, new_state = rule(p._data, g._data, st,
+                                       jnp.float32(lr_val), jnp.int32(self._step_count))
+            p._data = new_data
+            self._states[id(p)] = (p, new_state)
+
+    minimize_step = step
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p._grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+    # ---- checkpoint ----
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        for i, p in enumerate(self._parameter_list):
+            entry = self._states.get(id(p))
+            if entry is not None:
+                for j, s in enumerate(entry[1]):
+                    out[f"param{i}_state{j}"] = Tensor(s)
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("_step_count", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            states = []
+            j = 0
+            while f"param{i}_state{j}" in state_dict:
+                s = state_dict[f"param{i}_state{j}"]
+                states.append(s._data if isinstance(s, Tensor) else jnp.asarray(s))
+                j += 1
+            if states:
+                self._states[id(p)] = (p, tuple(states))
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    _rule = "sgd"
+
+
+class Momentum(Optimizer):
+    _rule = "momentum"
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, **kw)
+        self._hyper = {"momentum": momentum, "use_nesterov": use_nesterov}
+
+
+class Adam(Optimizer):
+    _rule = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=True, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, **kw)
+        def _v(b):
+            return float(b.item()) if isinstance(b, Tensor) else float(b)
+        self._hyper = {"beta1": _v(beta1), "beta2": _v(beta2), "epsilon": float(epsilon)}
+
+
+class AdamW(Optimizer):
+    _rule = "adamw"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name,
+                         apply_decay_param_fun=apply_decay_param_fun, **kw)
+        def _v(b):
+            return float(b.item()) if isinstance(b, Tensor) else float(b)
+        self._hyper = {"beta1": _v(beta1), "beta2": _v(beta2), "epsilon": float(epsilon)}
+
+
+class Adamax(Optimizer):
+    _rule = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, **kw)
+        self._hyper = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon}
+
+
+class Adagrad(Optimizer):
+    _rule = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, **kw)
+        self._hyper = {"epsilon": epsilon}
+
+
+class Adadelta(Optimizer):
+    _rule = "adadelta"
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, **kw)
+        self._hyper = {"epsilon": epsilon, "rho": rho}
+
+
+class RMSProp(Optimizer):
+    _rule = "rmsprop"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, **kw)
+        self._hyper = {"rho": rho, "epsilon": epsilon, "momentum": momentum,
+                       "centered": centered}
+
+
+class Lamb(Optimizer):
+    _rule = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name, **kw)
+        self._hyper = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon,
+                       "lamb_weight_decay": lamb_weight_decay}
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _rule_kwargs(self, param):
+        kw = dict(self._hyper)
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            kw["exclude_from_decay"] = True
+        return kw

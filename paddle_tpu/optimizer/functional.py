@@ -1,0 +1,193 @@
+"""Pure functional optimizer update rules.
+
+Design: one pure function per rule, usable (a) eagerly per-parameter by the dygraph Optimizer
+below (jit-cached by shape) and (b) over whole parameter pytrees inside a pjit'd train step by
+the distributed engine — the same math in both worlds, the analogue of phi's adam kernels
+(paddle/phi/kernels/gpu/adam_kernel.cu) without a second implementation.
+
+All rules keep master weights implicitly: state is stored in f32 even for bf16 params.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def init_state(rule: str, param):
+    f32 = jnp.float32
+    z = lambda: jnp.zeros_like(param, f32)
+    if rule == "sgd":
+        return ()
+    if rule == "momentum":
+        return (z(),)
+    if rule in ("adam", "adamw"):
+        return (z(), z())  # m, v
+    if rule == "adamax":
+        return (z(), z())  # m, inf-norm
+    if rule == "adagrad":
+        return (z(),)
+    if rule == "adadelta":
+        return (z(), z())  # avg sq grad, avg sq update
+    if rule == "rmsprop":
+        return (z(), z(), z())  # mean_sq, mean, momentum
+    if rule == "lamb":
+        return (z(), z())
+    raise ValueError(rule)
+
+
+def sgd(param, grad, state, *, lr, weight_decay=0.0):
+    g = grad.astype(jnp.float32)
+    if weight_decay:
+        g = g + weight_decay * param.astype(jnp.float32)
+    new_p = param.astype(jnp.float32) - lr * g
+    return new_p.astype(param.dtype), ()
+
+
+def momentum(param, grad, state, *, lr, momentum=0.9, weight_decay=0.0, use_nesterov=False):
+    (vel,) = state
+    g = grad.astype(jnp.float32)
+    if weight_decay:
+        g = g + weight_decay * param.astype(jnp.float32)
+    vel = momentum * vel + g
+    if use_nesterov:
+        update = g + momentum * vel
+    else:
+        update = vel
+    new_p = param.astype(jnp.float32) - lr * update
+    return new_p.astype(param.dtype), (vel,)
+
+
+def adam(param, grad, state, *, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, step,
+         weight_decay=0.0, lazy_mode=False):
+    m, v = state
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    if weight_decay:  # L2 reg (paddle Adam regularization semantics)
+        g = g + weight_decay * p32
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    bc1 = 1 - beta1 ** step
+    bc2 = 1 - beta2 ** step
+    m_hat = m / bc1
+    v_hat = v / bc2
+    new_p = p32 - lr * m_hat / (jnp.sqrt(v_hat) + epsilon)
+    return new_p.astype(param.dtype), (m, v)
+
+
+def adamw(param, grad, state, *, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, step,
+          weight_decay=0.01, lr_ratio=1.0):
+    m, v = state
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    m_hat = m / (1 - beta1 ** step)
+    v_hat = v / (1 - beta2 ** step)
+    # decoupled decay (AdamW): p -= lr * (m_hat/(sqrt(v_hat)+eps) + wd * p)
+    new_p = p32 - lr * lr_ratio * (m_hat / (jnp.sqrt(v_hat) + epsilon) + weight_decay * p32)
+    return new_p.astype(param.dtype), (m, v)
+
+
+def adamax(param, grad, state, *, lr, beta1=0.9, beta2=0.999, epsilon=1e-8, step,
+           weight_decay=0.0):
+    m, u = state
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    if weight_decay:
+        g = g + weight_decay * p32
+    m = beta1 * m + (1 - beta1) * g
+    u = jnp.maximum(beta2 * u, jnp.abs(g))
+    new_p = p32 - (lr / (1 - beta1 ** step)) * m / (u + epsilon)
+    return new_p.astype(param.dtype), (m, u)
+
+
+def adagrad(param, grad, state, *, lr, epsilon=1e-6, weight_decay=0.0, initial_accumulator_value=0.0):
+    (acc,) = state
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    if weight_decay:
+        g = g + weight_decay * p32
+    acc = acc + jnp.square(g)
+    new_p = p32 - lr * g / (jnp.sqrt(acc) + epsilon)
+    return new_p.astype(param.dtype), (acc,)
+
+
+def adadelta(param, grad, state, *, lr=1.0, rho=0.95, epsilon=1e-6, weight_decay=0.0):
+    avg_sq_grad, avg_sq_update = state
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    if weight_decay:
+        g = g + weight_decay * p32
+    avg_sq_grad = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
+    update = jnp.sqrt(avg_sq_update + epsilon) / jnp.sqrt(avg_sq_grad + epsilon) * g
+    avg_sq_update = rho * avg_sq_update + (1 - rho) * jnp.square(update)
+    new_p = p32 - lr * update
+    return new_p.astype(param.dtype), (avg_sq_grad, avg_sq_update)
+
+
+def rmsprop(param, grad, state, *, lr, rho=0.95, epsilon=1e-6, momentum=0.0,
+            centered=False, weight_decay=0.0):
+    mean_sq, mean_g, mom = state
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    if weight_decay:
+        g = g + weight_decay * p32
+    mean_sq = rho * mean_sq + (1 - rho) * jnp.square(g)
+    if centered:
+        mean_g = rho * mean_g + (1 - rho) * g
+        denom = jnp.sqrt(mean_sq - jnp.square(mean_g) + epsilon)
+    else:
+        denom = jnp.sqrt(mean_sq + epsilon)
+    mom = momentum * mom + lr * g / denom
+    new_p = p32 - mom
+    return new_p.astype(param.dtype), (mean_sq, mean_g, mom)
+
+
+def lamb(param, grad, state, *, lr, beta1=0.9, beta2=0.999, epsilon=1e-6, step,
+         lamb_weight_decay=0.01, exclude_from_decay=False):
+    m, v = state
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    m_hat = m / (1 - beta1 ** step)
+    v_hat = v / (1 - beta2 ** step)
+    r = m_hat / (jnp.sqrt(v_hat) + epsilon)
+    if not exclude_from_decay:
+        r = r + lamb_weight_decay * p32
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where(w_norm > 0, jnp.where(r_norm > 0, w_norm / r_norm, 1.0), 1.0)
+    new_p = p32 - lr * trust * r
+    return new_p.astype(param.dtype), (m, v)
+
+
+RULES = {
+    "sgd": sgd, "momentum": momentum, "adam": adam, "adamw": adamw,
+    "adamax": adamax, "adagrad": adagrad, "adadelta": adadelta,
+    "rmsprop": rmsprop, "lamb": lamb,
+}
+
+_NEEDS_STEP = {"adam", "adamw", "adamax", "lamb"}
+
+# jit-cached per (rule, static hyperparams); lr and step stay dynamic so LR schedules
+# don't retrigger compilation — the eager fast path.
+_jitted_cache = {}
+
+
+def jitted_rule(rule: str, **static_kwargs):
+    key = (rule, tuple(sorted(static_kwargs.items())))
+    if key not in _jitted_cache:
+        fn = RULES[rule]
+        needs_step = rule in _NEEDS_STEP
+
+        def wrapped(param, grad, state, lr, step):
+            kw = dict(static_kwargs)
+            if needs_step:
+                kw["step"] = step
+            return fn(param, grad, state, lr=lr, **kw)
+
+        _jitted_cache[key] = jax.jit(wrapped)
+    return _jitted_cache[key]

@@ -1,0 +1,199 @@
+"""Profiler. Reference: python/paddle/profiler/profiler.py:270 (state-scheduler-driven Profiler,
+chrome-trace export) + profiler/timer.py Benchmark (ips).
+
+TPU-native: wraps jax.profiler (XPlane -> TensorBoard/perfetto) behind the same API; RecordEvent
+maps to jax.profiler.TraceAnnotation so host markers interleave with device timelines.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import time
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        period = closed + ready + record
+        if repeat and s >= period * repeat:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        # jax.profiler writes xplane/perfetto under its own dir during stop
+        prof._export_dir = dir_name
+
+    return handler
+
+
+class RecordEvent:
+    """RAII marker (reference RecordEvent, platform/profiler/event_tracing.h)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ta = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def begin(self):
+        try:
+            import jax.profiler
+
+            self._ta = jax.profiler.TraceAnnotation(self.name)
+            self._ta.__enter__()
+        except Exception:
+            self._ta = None
+
+    def end(self):
+        if self._ta is not None:
+            self._ta.__exit__(None, None, None)
+            self._ta = None
+
+
+class Profiler:
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False):
+        self._scheduler = scheduler if callable(scheduler) else None
+        if isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self._scheduler = make_scheduler(closed=start, ready=0, record=end - start)
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._active = False
+        self._export_dir = os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+        self._benchmark = Benchmark()
+
+    def start(self):
+        self._benchmark.begin()
+        self._transition()
+
+    def stop(self):
+        if self._active:
+            self._stop_trace()
+        self._benchmark.end()
+
+    def step(self, num_samples=None):
+        self._benchmark.step(num_samples)
+        self._step += 1
+        self._transition()
+
+    def _transition(self):
+        if self._timer_only or self._scheduler is None:
+            return
+        new_state = self._scheduler(self._step)
+        recording = new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if recording and not self._active:
+            self._start_trace()
+        ret = new_state == ProfilerState.RECORD_AND_RETURN
+        if self._active and (not recording or ret):
+            self._stop_trace()
+
+    def _start_trace(self):
+        try:
+            import jax.profiler
+
+            os.makedirs(self._export_dir, exist_ok=True)
+            jax.profiler.start_trace(self._export_dir)
+            self._active = True
+        except Exception:
+            self._active = False
+
+    def _stop_trace(self):
+        try:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        self._active = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def export(self, path=None, format="json"):
+        pass  # traces already exported by stop_trace
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        info = self._benchmark.report()
+        print(f"ips: {info.get('ips', 0.0):.2f} steps/s  reader_cost: "
+              f"{info.get('reader_cost', 0.0) * 1000:.3f} ms")
+
+
+class Benchmark:
+    """Throughput meter (reference profiler/timer.py:110)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._steps = 0
+        self._samples = 0
+        self._start = None
+        self._last = None
+
+    def begin(self):
+        self._start = self._last = time.perf_counter()
+
+    def step(self, num_samples=None):
+        self._steps += 1
+        if num_samples:
+            self._samples += num_samples
+        self._last = time.perf_counter()
+
+    def end(self):
+        self._last = time.perf_counter()
+
+    def report(self):
+        if self._start is None or self._steps == 0:
+            return {"ips": 0.0, "reader_cost": 0.0}
+        elapsed = max(self._last - self._start, 1e-9)
+        ips = (self._samples or self._steps) / elapsed
+        return {"ips": ips, "reader_cost": 0.0, "steps": self._steps,
+                "elapsed": elapsed}
+
+
+def load_profiler_result(path):
+    raise NotImplementedError

@@ -1,0 +1,81 @@
+"""paddle.static equivalent (round-1 slice).
+
+Reference: python/paddle/static + fluid/framework.py Program/Block + executor.py:619.
+TPU-native plan (SURVEY.md §7 step 4): a Program IR whose Executor *traces the whole program to
+one XLA computation* — the InterpreterCore instruction list becomes a jitted function. The
+round-1 slice gives the user-facing Program/data/Executor API running on the traced path; the
+protobuf-style IR + passes land next.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from ..core.place import CPUPlace, TPUPlace  # noqa: F401
+
+from . import nn  # noqa: F401
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+class Program:
+    """Placeholder IR container — filled by the static-graph milestone."""
+
+    def __init__(self):
+        self.ops = []
+        self.vars = {}
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+
+        return copy.copy(self)
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        raise NotImplementedError(
+            "static Executor lands with the Program IR milestone; use dygraph or "
+            "paddle_tpu.jit.to_static (whole-program XLA tracing) meanwhile")
+
+
+def program_guard(main_program, startup_program=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield
+
+    return guard()

@@ -1,0 +1,2 @@
+"""static.nn placeholder — functional layers shared with nn.functional."""
+from ..ops.nn_functional import *  # noqa: F401,F403

@@ -1,0 +1,4 @@
+full_version = "0.1.0"
+major = 0
+minor = 1
+patch = 0

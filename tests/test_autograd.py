@@ -1,0 +1,161 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * 3
+    z = y * y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 36.0)  # d(9x^2)/dx = 18x
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    c = (a + b).sum()
+    c.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_shared_input_twice():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x * x).sum()  # x used twice in same op
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y = y.detach()
+    z = (y * 3).sum()
+    assert z.stop_gradient
+
+
+def test_backward_through_matmul():
+    a = paddle.to_tensor(np.random.RandomState(0).rand(3, 4).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.random.RandomState(1).rand(4, 5).astype(np.float32),
+                         stop_gradient=False)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.asarray(b.numpy()).sum(1)[None, :].repeat(3, 0),
+                               rtol=1e-5)
+
+
+def test_multi_output_op_backward():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3), stop_gradient=False)
+    parts = paddle.split(x, 3, axis=1)
+    loss = (parts[0] * 1 + parts[1] * 2 + parts[2] * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 2, 3], [1, 2, 3]])
+
+
+def test_partial_output_use():
+    x = paddle.to_tensor(np.ones((2, 4), np.float32), stop_gradient=False)
+    a, b = paddle.split(x, 2, axis=1)
+    loss = (a * 5).sum()  # b unused
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[5, 5, 0, 0], [5, 5, 0, 0]])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_double_backward_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_non_scalar_backward_needs_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.ones([2]))
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+
+def test_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 10
+
+    x.register_hook(hook)
+    (x * 2).sum().backward()
+    assert seen and seen[0][0] == 2.0
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    assert x.grad is None  # functional API must not pollute .grad
+
+
+def test_pylayer():
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [6.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_int_inputs_skipped():
+    ids = paddle.to_tensor([0, 1], dtype="int64")
+    table = paddle.to_tensor(np.eye(3, dtype=np.float32), stop_gradient=False)
+    out = paddle.nn.functional.embedding(ids, table)
+    out.sum().backward()
+    g = table.grad.numpy()
+    np.testing.assert_allclose(g, [[1, 1, 1], [1, 1, 1], [0, 0, 0]])

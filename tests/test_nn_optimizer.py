@@ -1,0 +1,188 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_linear_layer():
+    paddle.seed(0)
+    l = nn.Linear(4, 3)
+    assert l.weight.shape == [4, 3]
+    assert l.bias.shape == [3]
+    out = l(paddle.ones([2, 4]))
+    assert out.shape == [2, 3]
+    np.testing.assert_allclose(out.numpy(),
+                               np.ones((2, 4)) @ l.weight.numpy() + l.bias.numpy(),
+                               rtol=1e-5)
+
+
+def test_parameters_traversal():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    params = model.parameters()
+    assert len(params) == 4
+    names = [n for n, _ in model.named_parameters()]
+    assert "0.weight" in names and "2.bias" in names
+
+
+def test_state_dict_roundtrip(tmp_path):
+    model = nn.Linear(3, 3)
+    sd = model.state_dict()
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(sd, path)
+    model2 = nn.Linear(3, 3)
+    model2.set_state_dict(paddle.load(path))
+    np.testing.assert_allclose(model.weight.numpy(), model2.weight.numpy())
+
+
+def test_train_eval_mode():
+    model = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    model.eval()
+    assert not model[1].training
+    model.train()
+    assert model[1].training
+
+
+def test_sublayer_buffers():
+    bn = nn.BatchNorm2D(4)
+    buf_names = [n for n, _ in bn.named_buffers()]
+    assert "_mean" in buf_names and "_variance" in buf_names
+    sd = bn.state_dict()
+    assert "_mean" in sd
+
+
+def test_sgd_step():
+    p = nn.Parameter(np.asarray([1.0, 2.0], np.float32))
+    import jax.numpy as jnp
+
+    p._data = jnp.asarray([1.0, 2.0], jnp.float32)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    (p * 3.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.7, 1.7], rtol=1e-6)
+    opt.clear_grad()
+    assert p.grad is None
+
+
+def test_adam_converges_quadratic():
+    paddle.seed(0)
+    x = nn.Parameter(np.asarray([5.0], np.float32))
+    opt = paddle.optimizer.Adam(learning_rate=0.3, parameters=[x])
+    for _ in range(200):
+        loss = (x * x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert abs(x.numpy()[0]) < 0.1
+
+
+@pytest.mark.parametrize("cls,kwargs", [
+    (paddle.optimizer.SGD, {}),
+    (paddle.optimizer.Momentum, {"momentum": 0.9}),
+    (paddle.optimizer.Adam, {}),
+    (paddle.optimizer.AdamW, {"weight_decay": 0.01}),
+    (paddle.optimizer.Adamax, {}),
+    (paddle.optimizer.Adagrad, {}),
+    (paddle.optimizer.Adadelta, {}),
+    (paddle.optimizer.RMSProp, {}),
+    (paddle.optimizer.Lamb, {}),
+])
+def test_all_optimizers_decrease_loss(cls, kwargs):
+    paddle.seed(1)
+    model = nn.Linear(4, 1)
+    opt = cls(learning_rate=0.05, parameters=model.parameters(), **kwargs)
+    xs = paddle.to_tensor(np.random.RandomState(0).rand(16, 4).astype(np.float32))
+    ys = paddle.to_tensor(np.random.RandomState(1).rand(16, 1).astype(np.float32))
+    losses = []
+    for _ in range(20):
+        loss = nn.functional.mse_loss(model(xs), ys)
+        losses.append(float(loss.item()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0]
+
+
+def test_grad_clip_global_norm():
+    p1 = nn.Parameter(np.asarray([3.0], np.float32))
+    p2 = nn.Parameter(np.asarray([4.0], np.float32))
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p1, p2], grad_clip=clip)
+    (p1 * 3.0 + p2 * 4.0).sum().backward()  # grads 3, 4 -> global norm 5
+    opt.step()
+    np.testing.assert_allclose(p1.numpy(), [3.0 - 3.0 / 5], rtol=1e-5)
+    np.testing.assert_allclose(p2.numpy(), [4.0 - 4.0 / 5], rtol=1e-5)
+
+
+def test_lr_scheduler():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    model = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=model.parameters())
+    lrs = []
+    for _ in range(6):
+        lrs.append(opt.get_lr())
+        sched.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025, 0.025])
+
+
+def test_linear_warmup():
+    sched = paddle.optimizer.lr.LinearWarmup(learning_rate=0.1, warmup_steps=4,
+                                             start_lr=0.0, end_lr=0.1)
+    vals = []
+    for _ in range(6):
+        vals.append(sched())
+        sched.step()
+    np.testing.assert_allclose(vals[:4], [0.0, 0.025, 0.05, 0.075])
+    np.testing.assert_allclose(vals[4:], [0.1, 0.1])
+
+
+def test_optimizer_state_dict():
+    model = nn.Linear(2, 2)
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=model.parameters())
+    (model(paddle.ones([1, 2]))).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    assert sd["_step_count"] == 1
+    opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=model.parameters())
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+
+
+def test_amp_autocast_bf16():
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        a = paddle.ones([4, 4])
+        b = paddle.ones([4, 4])
+        c = paddle.matmul(a, b)
+    assert c.dtype == paddle.bfloat16
+    # black-listed op stays f32
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        s = paddle.nn.functional.softmax(paddle.ones([4, 4]))
+    assert s.dtype == paddle.float32
+
+
+def test_grad_scaler():
+    model = nn.Linear(2, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    loss = model(paddle.ones([4, 2])).mean()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    assert scaler.get_loss_scaling().item() == 1024.0
+
+
+def test_grad_scaler_inf_skips_step():
+    import jax.numpy as jnp
+
+    model = nn.Linear(2, 1)
+    w_before = model.weight.numpy().copy()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    loss = model(paddle.ones([4, 2])).mean()
+    scaler.scale(loss).backward()
+    model.weight.grad = paddle.to_tensor(np.full((2, 1), np.inf, np.float32))
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(model.weight.numpy(), w_before)
+    assert scaler._scale == 4.0  # decreased

@@ -1,0 +1,224 @@
+"""Numpy-referenced op tests via the OpTest harness (reference op_test.py pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad, check_output
+
+class _RNG:
+    """Order-independent determinism: fresh stream per access."""
+
+    def __getattr__(self, name):
+        return getattr(np.random.RandomState(42), name)
+
+
+rng = _RNG()
+
+
+@pytest.mark.parametrize("op,ref", [
+    (paddle.add, np.add), (paddle.subtract, np.subtract),
+    (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+    (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+    (paddle.atan2, np.arctan2),
+])
+def test_binary_elementwise(op, ref):
+    a = rng.rand(3, 4).astype(np.float32) + 0.5
+    b = rng.rand(3, 4).astype(np.float32) + 0.5
+    check_output(op, ref, [a, b])
+
+
+def test_broadcasting():
+    a = rng.rand(3, 1, 4).astype(np.float32)
+    b = rng.rand(1, 5, 4).astype(np.float32)
+    check_output(paddle.add, np.add, [a, b])
+
+
+@pytest.mark.parametrize("op,ref", [
+    (paddle.exp, np.exp), (paddle.log, np.log), (paddle.sqrt, np.sqrt),
+    (paddle.abs, np.abs), (paddle.sin, np.sin), (paddle.cos, np.cos),
+    (paddle.tanh, np.tanh), (paddle.floor, np.floor), (paddle.ceil, np.ceil),
+    (paddle.square, np.square), (paddle.sign, np.sign),
+])
+def test_unary(op, ref):
+    a = rng.rand(3, 4).astype(np.float32) + 0.5
+    check_output(op, ref, [a])
+
+
+def test_reductions():
+    a = rng.rand(3, 4, 5).astype(np.float32)
+    check_output(paddle.sum, lambda x: x.sum(), [a])
+    check_output(lambda x: paddle.sum(x, axis=1), lambda x: x.sum(1), [a])
+    check_output(lambda x: paddle.sum(x, axis=[0, 2], keepdim=True),
+                 lambda x: x.sum((0, 2), keepdims=True), [a])
+    check_output(paddle.mean, lambda x: x.mean(), [a])
+    check_output(lambda x: paddle.max(x, axis=-1), lambda x: x.max(-1), [a])
+    check_output(lambda x: paddle.min(x, axis=0), lambda x: x.min(0), [a])
+    check_output(lambda x: paddle.prod(x, axis=1), lambda x: x.prod(1), [a], rtol=1e-4)
+    check_output(lambda x: paddle.argmax(x, axis=1), lambda x: x.argmax(1), [a])
+    check_output(lambda x: paddle.std(x, axis=1), lambda x: x.std(1, ddof=1), [a])
+    check_output(lambda x: paddle.var(x, axis=1), lambda x: x.var(1, ddof=1), [a])
+    check_output(paddle.logsumexp, lambda x: np.log(np.exp(x).sum()), [a])
+
+
+def test_matmul_variants():
+    a = rng.rand(3, 4).astype(np.float32)
+    b = rng.rand(4, 5).astype(np.float32)
+    check_output(paddle.matmul, np.matmul, [a, b])
+    check_output(lambda x, y: paddle.matmul(x, y, transpose_y=True),
+                 lambda x, y: x @ y.T, [a, rng.rand(5, 4).astype(np.float32)])
+    batch_a = rng.rand(2, 3, 4).astype(np.float32)
+    batch_b = rng.rand(2, 4, 5).astype(np.float32)
+    check_output(paddle.bmm, np.matmul, [batch_a, batch_b])
+    check_output(lambda x, y: paddle.einsum("ij,jk->ik", x, y),
+                 lambda x, y: x @ y, [a, b])
+
+
+def test_softmax_ops():
+    x = rng.rand(4, 7).astype(np.float32)
+
+    def np_softmax(v):
+        e = np.exp(v - v.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    check_output(paddle.nn.functional.softmax, np_softmax, [x])
+    check_output(paddle.nn.functional.log_softmax, lambda v: np.log(np_softmax(v)), [x])
+
+
+def test_activations_numeric():
+    x = (rng.rand(3, 4).astype(np.float32) - 0.5) * 4
+    check_output(F.relu, lambda v: np.maximum(v, 0), [x])
+    check_output(F.sigmoid, lambda v: 1 / (1 + np.exp(-v)), [x])
+    check_output(F.silu, lambda v: v / (1 + np.exp(-v)), [x], rtol=1e-4)
+    check_output(lambda t: F.leaky_relu(t, 0.1),
+                 lambda v: np.where(v > 0, v, 0.1 * v), [x])
+    import math
+
+    check_output(lambda t: F.gelu(t),
+                 lambda v: 0.5 * v * (1 + np.vectorize(math.erf)(v / np.sqrt(2))),
+                 [x], rtol=1e-4)
+
+
+# ---- gradient checks (numeric vs analytic through the tape) ----
+
+@pytest.mark.parametrize("op", [
+    paddle.exp, paddle.tanh, paddle.square,
+    lambda x: paddle.nn.functional.softmax(x),
+    lambda x: F.gelu(x),
+])
+def test_grad_unary(op):
+    x = rng.rand(3, 4).astype(np.float64) + 0.3
+    check_grad(op, [x])
+
+
+def test_grad_matmul():
+    a = rng.rand(3, 4).astype(np.float64)
+    b = rng.rand(4, 2).astype(np.float64)
+    check_grad(paddle.matmul, [a, b], input_idx=0)
+    check_grad(paddle.matmul, [a, b], input_idx=1)
+
+
+def test_grad_reduction():
+    x = rng.rand(4, 5).astype(np.float64) * 10  # well-separated so max() is not tied
+    check_grad(lambda t: paddle.mean(t, axis=1), [x])
+    check_grad(lambda t: paddle.max(t, axis=1), [x], eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_grad_conv2d():
+    x = rng.rand(2, 3, 8, 8).astype(np.float64)
+    w = rng.rand(4, 3, 3, 3).astype(np.float64)
+    check_grad(lambda a, b: F.conv2d(a, b, padding=1), [x, w], input_idx=0,
+               rtol=2e-2, atol=2e-3)
+    check_grad(lambda a, b: F.conv2d(a, b, padding=1), [x, w], input_idx=1,
+               rtol=2e-2, atol=2e-3)
+
+
+def test_grad_layer_norm():
+    x = rng.rand(4, 6).astype(np.float64)
+    check_grad(lambda t: F.layer_norm(t, 6), [x], rtol=2e-2, atol=2e-3)
+
+
+def test_grad_cross_entropy():
+    logits = rng.rand(4, 5).astype(np.float64)
+    labels = np.array([0, 1, 2, 3])
+
+    def op(lg):
+        return F.cross_entropy(lg, paddle.to_tensor(labels))
+
+    check_grad(op, [logits])
+
+
+def test_cross_entropy_value():
+    logits = rng.rand(4, 5).astype(np.float32)
+    labels = np.array([0, 1, 2, 3])
+    loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    # numpy reference
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(loss.numpy(), expect, rtol=1e-5)
+
+
+def test_conv2d_value_vs_scipy():
+    try:
+        from scipy import signal
+    except ImportError:
+        pytest.skip("scipy missing")
+    x = rng.rand(1, 1, 6, 6).astype(np.float32)
+    w = rng.rand(1, 1, 3, 3).astype(np.float32)
+    out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+    expect = signal.correlate2d(x[0, 0], w[0, 0], mode="valid")[None, None]
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_pool_values():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+    out = F.avg_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(out[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_batch_norm_train_eval():
+    x = rng.rand(8, 3, 4, 4).astype(np.float32)
+    bn = paddle.nn.BatchNorm2D(3)
+    bn.train()
+    out = bn(paddle.to_tensor(x))
+    got = out.numpy()
+    m = x.mean((0, 2, 3), keepdims=True)
+    v = x.var((0, 2, 3), keepdims=True)
+    np.testing.assert_allclose(got, (x - m) / np.sqrt(v + 1e-5), rtol=1e-4, atol=1e-4)
+    # running stats moved toward batch stats
+    assert abs(bn._mean.numpy().mean()) > 0
+    bn.eval()
+    out2 = bn(paddle.to_tensor(x))
+    assert not np.allclose(out2.numpy(), got)
+
+
+def test_dropout_train_eval():
+    paddle.seed(0)
+    x = paddle.ones([1000])
+    y = F.dropout(x, 0.5, training=True)
+    kept = (y.numpy() > 0).mean()
+    assert 0.35 < kept < 0.65
+    np.testing.assert_allclose(y.numpy()[y.numpy() > 0], 2.0)
+    y_eval = F.dropout(x, 0.5, training=False)
+    np.testing.assert_allclose(y_eval.numpy(), 1.0)
+
+
+def test_embedding_and_one_hot():
+    table = rng.rand(10, 4).astype(np.float32)
+    ids = np.array([[1, 2], [3, 4]])
+    out = F.embedding(paddle.to_tensor(ids), paddle.to_tensor(table))
+    np.testing.assert_allclose(out.numpy(), table[ids])
+    oh = F.one_hot(paddle.to_tensor([1, 3]), 5).numpy()
+    np.testing.assert_allclose(oh, np.eye(5)[[1, 3]])
+
+
+def test_attention_causal():
+    q = rng.rand(2, 6, 2, 8).astype(np.float32)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q), is_causal=True)
+    assert out.shape == [2, 6, 2, 8]
+    # first position output must equal v at first position (causal)
+    np.testing.assert_allclose(out.numpy()[:, 0], q[:, 0], rtol=1e-5)

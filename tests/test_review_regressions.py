@@ -1,0 +1,92 @@
+"""Regression tests for code-review findings (round 1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_setitem_on_nonleaf_backwardable():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = x * 2
+    y[0] = 5.0
+    y.sum().backward()  # must not raise "cycle detected"
+    np.testing.assert_allclose(x.grad.numpy(), [0, 2, 2])
+
+
+def test_inplace_on_leaf_requires_grad_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with pytest.raises(RuntimeError, match="in-place"):
+        x.add_(1.0)
+    with paddle.no_grad():
+        x.add_(1.0)  # fine under no_grad (optimizer pattern)
+    np.testing.assert_allclose(x.numpy(), [2.0])
+
+
+def test_adamw_explicit_zero_weight_decay():
+    p = nn.Parameter(np.asarray([1.0], np.float32))
+    opt = paddle.optimizer.AdamW(learning_rate=0.0, parameters=[p], weight_decay=0.0)
+    assert opt._rule_kwargs(p)["weight_decay"] == 0.0
+    opt2 = paddle.optimizer.AdamW(learning_rate=0.0, parameters=[p],
+                                  apply_decay_param_fun=lambda n: False)
+    assert opt2._rule_kwargs(p)["weight_decay"] == 0.0
+    opt3 = paddle.optimizer.AdamW(learning_rate=0.0, parameters=[p])
+    assert opt3._rule_kwargs(p)["weight_decay"] == 0.01  # default
+
+
+def test_grad_api_does_not_pollute_other_leaves():
+    w = paddle.to_tensor([3.0], stop_gradient=False)
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    (gx,) = paddle.grad((w * x).sum(), [x])
+    np.testing.assert_allclose(gx.numpy(), [3.0])
+    assert w.grad is None and x.grad is None
+
+
+def test_bool_mask_getitem_differentiable():
+    a = paddle.to_tensor(np.arange(4, dtype=np.float32), stop_gradient=False)
+    b = a * 2
+    mask = paddle.to_tensor([True, False, True, False])
+    sel = b[mask]
+    assert not sel.stop_gradient
+    sel.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [2, 0, 2, 0])
+
+
+def test_masked_select_differentiable():
+    a = paddle.to_tensor(np.arange(4, dtype=np.float32), stop_gradient=False)
+    sel = paddle.masked_select(a * 3, paddle.to_tensor([False, True, True, False]))
+    np.testing.assert_allclose(sel.numpy(), [3, 6])
+    sel.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [0, 3, 3, 0])
+
+
+def test_split_indivisible_raises():
+    with pytest.raises(ValueError, match="not divisible"):
+        paddle.split(paddle.ones([5]), 2)
+
+
+def test_cross_entropy_ignore_index_default_mean():
+    logits = paddle.to_tensor(np.random.RandomState(0).rand(4, 3).astype(np.float32))
+    labels_pad = paddle.to_tensor(np.array([0, 1, -100, -100], np.int64))
+    labels_valid = paddle.to_tensor(np.array([0, 1], np.int64))
+    loss_pad = F.cross_entropy(logits, labels_pad)
+    loss_valid = F.cross_entropy(logits[paddle.to_tensor([0, 1])], labels_valid)
+    np.testing.assert_allclose(loss_pad.numpy(), loss_valid.numpy(), rtol=1e-5)
+
+
+def test_non_persistable_buffer_excluded_from_state_dict():
+    layer = nn.Linear(2, 2)
+    layer.register_buffer("scratch", paddle.ones([1]), persistable=False)
+    layer.register_buffer("kept", paddle.ones([1]), persistable=True)
+    sd = layer.state_dict()
+    assert "kept" in sd and "scratch" not in sd
+
+
+def test_reshape_inplace_on_nonleaf():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+    y = x * 2
+    y.reshape_([6])
+    assert y.shape == [6]
+    y.sum().backward()
+    assert x.grad.shape == [2, 3]

@@ -1,0 +1,147 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_default_dtypes():
+    assert paddle.to_tensor(1).dtype == paddle.int64
+    assert paddle.to_tensor(1.5).dtype == paddle.float32
+    assert paddle.to_tensor(np.float64(1.5)).dtype == paddle.float32
+    assert paddle.to_tensor(True).dtype == paddle.bool
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([4]).numpy().sum() == 4
+    assert paddle.full([2, 2], 7).numpy()[0, 0] == 7
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+    assert paddle.eye(3).numpy()[1, 1] == 1
+
+
+def test_arithmetic_dunders():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    np.testing.assert_allclose((2 + a).numpy(), [3, 4])
+    np.testing.assert_allclose((1 - a).numpy(), [0, -1])
+
+
+def test_scalar_keeps_dtype():
+    a = paddle.to_tensor([1.0, 2.0], dtype="bfloat16")
+    assert (a * 2.0).dtype == paddle.bfloat16
+    assert (a + 1).dtype == paddle.bfloat16
+
+
+def test_comparison():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    m = a > 1.5
+    assert m.dtype == paddle.bool
+    np.testing.assert_array_equal(m.numpy(), [False, True, True])
+
+
+def test_indexing():
+    a = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(a[0].numpy(), [0, 1, 2, 3])
+    np.testing.assert_allclose(a[1, 2].numpy(), 6)
+    np.testing.assert_allclose(a[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(a[0:2, ::2].numpy(), [[0, 2], [4, 6]])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(a[idx].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+    mask = paddle.to_tensor([True, False, True])
+    assert a[mask].shape == [2, 4]
+
+
+def test_setitem():
+    a = paddle.zeros([3, 3])
+    a[1, 1] = 5.0
+    assert a.numpy()[1, 1] == 5.0
+    a[0] = paddle.ones([3])
+    np.testing.assert_allclose(a.numpy()[0], [1, 1, 1])
+
+
+def test_item_and_conversions():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == 3.5
+    assert float(t) == 3.5
+    assert int(paddle.to_tensor(7)) == 7
+    assert len(paddle.zeros([5, 2])) == 5
+
+
+def test_astype_cast():
+    a = paddle.to_tensor([1.7, 2.3])
+    b = a.astype("int32")
+    assert b.dtype == paddle.int32
+    c = paddle.cast(a, "float64")
+    assert str(c.dtype) in ("float64", "float32")  # f64 may be demoted without x64
+
+
+def test_set_value_and_clone():
+    a = paddle.ones([2, 2])
+    a.set_value(np.zeros((2, 2), np.float32))
+    assert a.numpy().sum() == 0
+    b = paddle.clone(a)
+    b.set_value(np.ones((2, 2), np.float32))
+    assert a.numpy().sum() == 0
+
+
+def test_shape_ops():
+    a = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert paddle.reshape(a, [6, 4]).shape == [6, 4]
+    assert paddle.reshape(a, [-1]).shape == [24]
+    assert paddle.transpose(a, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(a, 1).shape == [2, 12]
+    assert paddle.unsqueeze(a, 0).shape == [1, 2, 3, 4]
+    assert paddle.squeeze(paddle.unsqueeze(a, 0), 0).shape == [2, 3, 4]
+    assert paddle.concat([a, a], axis=1).shape == [2, 6, 4]
+    assert paddle.stack([a, a]).shape == [2, 2, 3, 4]
+    parts = paddle.split(a, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts = paddle.split(a, [1, -1], axis=1)
+    assert parts[1].shape == [2, 2, 4]
+    assert paddle.tile(a, [1, 2, 1]).shape == [2, 6, 4]
+    assert paddle.expand(paddle.ones([1, 3]), [5, 3]).shape == [5, 3]
+
+
+def test_where_nonzero():
+    a = paddle.to_tensor([1.0, -1.0, 2.0])
+    out = paddle.where(a > 0, a, paddle.zeros_like(a))
+    np.testing.assert_allclose(out.numpy(), [1, 0, 2])
+    nz = paddle.nonzero(a > 0)
+    np.testing.assert_array_equal(nz.numpy(), [[0], [2]])
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    g = paddle.gather(x, paddle.to_tensor([0, 2]))
+    np.testing.assert_allclose(g.numpy(), [[0, 1, 2], [6, 7, 8]])
+    upd = paddle.to_tensor(np.ones((2, 3), np.float32))
+    s = paddle.scatter(x, paddle.to_tensor([1, 3]), upd)
+    np.testing.assert_allclose(s.numpy()[1], [1, 1, 1])
+    np.testing.assert_allclose(s.numpy()[3], [1, 1, 1])
+
+
+def test_topk_sort():
+    x = paddle.to_tensor([3.0, 1.0, 4.0, 1.0, 5.0])
+    v, i = paddle.topk(x, 2)
+    np.testing.assert_allclose(v.numpy(), [5, 4])
+    np.testing.assert_array_equal(i.numpy(), [4, 2])
+    np.testing.assert_allclose(paddle.sort(x).numpy(), [1, 1, 3, 4, 5])
+
+
+def test_repr():
+    t = paddle.ones([2, 2])
+    assert "Tensor" in repr(t)
