@@ -1,0 +1,88 @@
+"""Flagship benchmark: GPT causal-LM training throughput on the available chip(s).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+The reference publishes no numbers (BASELINE.md) so vs_baseline is reported against the
+driver-tracked north-star metric (tokens/sec/chip); null baseline -> vs_baseline = None.
+
+Model size adapts to the platform: a real TPU chip runs a ~124M-param GPT (768h/12L,
+seq 1024, bf16 matmuls); the CPU fallback runs gpt_tiny so the script always completes.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import GPTConfig, GPTForPretraining, gpt_tiny
+
+    on_tpu = jax.default_backend() != "cpu"
+    n_dev = jax.device_count()
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
+                        max_seq_len=1024)
+        batch, seq, steps, warmup = 8, 1024, 20, 3
+    else:
+        cfg = gpt_tiny()
+        batch, seq, steps, warmup = 8, 128, 5, 1
+
+    paddle.seed(0)
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": n_dev, "mp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    model = GPTForPretraining(cfg)
+    n_params = sum(p.size for p in model.parameters())
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                                 weight_decay=0.01)
+    engine = fleet.distributed_engine(model, opt)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    labels = np.roll(ids, -1, 1)
+    t_ids, t_labels = paddle.to_tensor(ids), paddle.to_tensor(labels)
+
+    # bf16 matmuls on the MXU (params stay f32, master math in the optimizer is f32)
+    with paddle.amp.auto_cast(enable=on_tpu, dtype="bfloat16"):
+        for _ in range(warmup):
+            loss = engine.step(t_ids, t_labels)
+        float(loss.item())  # D2H sync: drains the dispatch queue (block_until_ready
+        #                     can return early through the remote PJRT tunnel)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.step(t_ids, t_labels)
+        final_loss = float(loss.item())  # sync point ends the timed region
+        dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * batch * seq / dt
+    tokens_per_sec_chip = tokens_per_sec / n_dev
+    # MFU on v5e (197 TFLOPs bf16): 6 * params * tokens/sec
+    flops_per_tok = 6 * n_params
+    mfu = (flops_per_tok * tokens_per_sec_chip) / 197e12 if on_tpu else None
+
+    print(json.dumps({
+        "metric": "gpt_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,  # reference publishes no numbers (BASELINE.md)
+        "extra": {
+            "model_params": int(n_params),
+            "hidden": cfg.hidden_size, "layers": cfg.num_layers,
+            "batch": batch, "seq": seq, "steps": steps,
+            "final_loss": round(final_loss, 4),
+            "platform": jax.default_backend(), "devices": n_dev,
+            "mfu_vs_v5e_bf16_peak": round(mfu, 4) if mfu else None,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,11 @@ def seed(s: int) -> Generator:
 
 
 def next_key():
+    stack = _trace_stack()
+    if stack:
+        new_key, sub = jax.random.split(stack[-1])
+        stack[-1] = new_key
+        return sub
     return default_generator().next_key()
 
 
@@ -80,6 +85,29 @@ def set_rng_state(state):
     default_generator().set_state(state["default"])
     for k, s in state.get("named", {}).items():
         named_generator(k).set_state(s)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def trace_key_scope(key):
+    """Functional RNG for traced programs: while active, next_key() splits from `key`
+    (a traced jax PRNG key) instead of the stateful host generator — so dropout etc.
+    inside a pjit train step varies per step and per shard correctly."""
+    stack = getattr(_state, "trace_keys", None)
+    if stack is None:
+        stack = []
+        _state.trace_keys = stack
+    stack.append(key)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def _trace_stack():
+    return getattr(_state, "trace_keys", None)
 
 
 def named_generator(name: str) -> Generator:

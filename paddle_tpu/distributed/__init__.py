@@ -1,9 +1,33 @@
 """paddle.distributed equivalent — the TPU-native distributed stack.
 
-Round-1 milestone ordering (SURVEY.md §7): env contract + mesh/topology first, then the
-collective API (xccl = XLA collectives over ICI/DCN), fleet facade, and meta_parallel
-strategies. See distributed/mesh.py for the HybridCommunicateGroup analogue.
+Layer map (SURVEY.md §2 #20-42 TPU equivalents):
+- env.py           — launcher env contract + multi-controller bootstrap (TCPStore ≙ PJRT coordination)
+- mesh.py          — HybridCommunicateGroup ≙ jax.sharding.Mesh with named axes
+- collective.py    — xccl: allreduce/allgather/reducescatter/broadcast/alltoall over mesh axes
+- fleet/           — Fleet facade, DistributedStrategy, recompute, HybridParallelOptimizer
+- meta_parallel/   — TP layers, DataParallel, PipelineLayer, GroupSharded (ZeRO), MoE
+- engine.py        — the fused pjit train step (forward+backward+clip+update, one XLA program)
 """
 from .env import (  # noqa: F401
     ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
 )
+from .collective import (  # noqa: F401
+    ReduceOp, all_gather, all_reduce, all_to_all, alltoall, barrier, broadcast,
+    irecv, isend, new_group, recv, reduce, reduce_scatter, scatter, send, wait,
+)
+from .mesh import (  # noqa: F401
+    CommGroup, HybridCommunicateGroup, build_mesh, get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from .engine import TrainStepEngine, parallelize  # noqa: F401
+from . import fleet  # noqa: F401
+from .fleet.distributed_strategy import DistributedStrategy  # noqa: F401
+from .meta_parallel.mp_layers import split  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from .spawn import spawn  # noqa: F401
+
+
+def get_group(gid=0):
+    from .collective import get_group as _g
+
+    return _g(gid)

@@ -1,0 +1,323 @@
+"""Collective communication API — the `xccl` backend.
+
+Reference: python/paddle/distributed/collective.py (all_reduce/all_gather/... over
+ProcessGroupNCCL, #20/#27 in SURVEY.md §2) and the static-graph c_* op family (#22).
+
+TPU-native semantics: a communicator is a named mesh axis; collectives lower to
+`jax.lax.{psum, all_gather, psum_scatter, ppermute, all_to_all}` inside `shard_map`.
+Two call modes, mirroring the reference's eager-vs-graph split:
+
+1. **Eager on sharded data**: the tensor is a global array sharded over the group axis
+   ("each shard = one rank's tensor"); the collective runs one compiled shard_map program.
+2. **Traced** (inside a pjit/shard_map program built by the engine): the same functions
+   detect they are under a mesh trace and emit the lax collective directly.
+
+Single-process single-device groups (world_size 1) are identity — matching the
+reference's fast path when a group has one rank.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .mesh import CommGroup, fleet_default_mesh, get_hybrid_communicate_group
+
+# Reference ReduceOp enum (distributed/collective/Types.h)
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+_group_counter = [0]
+_group_registry = {}
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """Reference collective.py:325 — on TPU a subgroup over explicit ranks maps to a
+    sub-axis when the ranks align with one; arbitrary subsets keep the rank list and
+    use gather-style emulation (sufficient for the CPU-mesh test harness)."""
+    _group_counter[0] += 1
+    mesh = fleet_default_mesh()
+    if ranks is None:
+        ranks = list(range(int(np.prod(list(mesh.shape.values())))))
+    g = CommGroup(None, list(ranks), mesh, id=_group_counter[0])
+    _group_registry[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    if gid == 0 and gid not in _group_registry:
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None:
+            return hcg.get_check_parallel_group()
+    return _group_registry.get(gid)
+
+
+def _in_trace(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis_in_scope(axis: str) -> bool:
+    """True when `axis` is a bound axis name in the current trace (inside shard_map)."""
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except Exception:
+        return False
+
+
+def _sharded_over(data, axis_name):
+    """Check if a global array is sharded over the given mesh axis."""
+    sharding = getattr(data, "sharding", None)
+    if sharding is None or not hasattr(sharding, "spec"):
+        return False
+    flat = []
+    for e in sharding.spec:
+        if e is None:
+            continue
+        if isinstance(e, tuple):
+            flat.extend(e)
+        else:
+            flat.append(e)
+    return axis_name in flat
+
+
+def _eager_axis_collective(x, axis, fn_traced):
+    """Run a collective over a mesh axis on an axis-sharded global array via shard_map."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = fleet_default_mesh()
+    spec = x.sharding.spec if hasattr(x.sharding, "spec") else P()
+    f = shard_map(fn_traced, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    return f(x)
+
+
+def _resolve(tensor, group, op_name):
+    """Common preamble: unwrap, decide identity/traced/eager-sharded path."""
+    x = tensor._data if isinstance(tensor, Tensor) else tensor
+    axis = getattr(group, "axis", None) if group is not None else None
+    if axis is None:
+        hcg = get_hybrid_communicate_group()
+        if hcg is None or hcg.nranks == 1:
+            return x, None, "identity"
+        raise ValueError(
+            f"{op_name}: pass a CommGroup bound to a mesh axis (e.g. "
+            f"hcg.get_model_parallel_group()) — arbitrary-rank groups only support "
+            f"point-to-point emulation")
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and hcg.degrees.get(axis, 1) == 1:
+        return x, axis, "identity"
+    if _in_trace(x):
+        return x, axis, "traced"
+    return x, axis, "eager"
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    x, axis, mode = _resolve(tensor, group, "all_reduce")
+    if mode == "identity":
+        return tensor
+    def _pprod(v, a):
+        # no pprod primitive in lax: gather then multiply (rare op; fine off hot path)
+        return jnp.prod(jax.lax.all_gather(v, a, axis=0), axis=0)
+
+    red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+           ReduceOp.MIN: jax.lax.pmin, ReduceOp.PROD: _pprod,
+           ReduceOp.AVG: lambda v, a: jax.lax.pmean(v, a)}[op]
+    if mode == "traced":
+        out = red(x, axis)
+    else:
+        out = _eager_axis_collective(x, axis, lambda v: red(v, axis))
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    x, ax, mode = _resolve(tensor, group, "all_gather")
+    if mode == "identity":
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+            return tensor_list
+        return tensor
+    if mode == "traced":
+        out = jax.lax.all_gather(x, ax, axis=0, tiled=False)
+    else:
+        out = _eager_axis_collective(x, ax, lambda v: jax.lax.all_gather(v, ax, axis=0))
+    if tensor_list is not None:
+        n = out.shape[0] if mode == "traced" else get_hybrid_communicate_group().degrees[ax]
+        for i in range(n):
+            tensor_list.append(Tensor(out[i]))
+        return tensor_list
+    return Tensor(out) if isinstance(tensor, Tensor) else out
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Eager contract (rank-major): input global [n, n*k, ...] sharded over the axis —
+    row i is rank i's tensor; output global [n, k, ...] — row i is rank i's reduced
+    shard. Traced: plain lax.psum_scatter on the local value."""
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from ..ops.manipulation import concat
+
+        src = concat(list(src), axis=0)
+    x, ax, mode = _resolve(src, group, "reduce_scatter")
+    if mode == "identity":
+        out = x
+    elif mode == "traced":
+        out = jax.lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)
+    else:
+        def rs(v):  # v local [1, n*k, ...]
+            red = jax.lax.psum_scatter(v[0], ax, scatter_dimension=0, tiled=True)
+            return red[None]
+
+        out = _eager_axis_collective(x, ax, rs)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return Tensor(out)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    x, ax, mode = _resolve(tensor, group, "broadcast")
+    if mode == "identity":
+        return tensor
+    src_local = group.get_group_rank(src) if group is not None and src in group.ranks else src
+
+    def bcast(v):
+        return jax.lax.all_gather(v, ax, axis=0)[src_local]
+
+    if mode == "traced":
+        out = bcast(x)
+    else:
+        out = _eager_axis_collective(x, ax, bcast)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # on a mesh axis, reduce == all_reduce (every shard gets the result; the dst
+    # distinction is meaningless under SPMD — reference ranks other than dst simply
+    # ignore their copy)
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    x, ax, mode = _resolve(tensor, group, "scatter")
+    if mode == "identity":
+        if tensor_list:
+            tensor._data = tensor_list[0]._data
+        return tensor
+    if tensor_list is not None:
+        stacked = jnp.stack([t._data if isinstance(t, Tensor) else t for t in tensor_list])
+
+        def sc(v):
+            return stacked[jax.lax.axis_index(ax)]
+
+        if mode == "traced":
+            out = sc(x)
+        else:
+            out = _eager_axis_collective(x, ax, sc)
+        tensor._data = out
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """MoE dispatch primitive (reference global_scatter/global_gather use this)."""
+    from ..ops.manipulation import concat
+
+    src = in_tensor_list
+    if isinstance(src, (list, tuple)):
+        src = concat(list(src), axis=0)
+    x, ax, mode = _resolve(src, group, "all_to_all")
+    if mode == "identity":
+        if out_tensor_list is not None and isinstance(in_tensor_list, (list, tuple)):
+            out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    n = get_hybrid_communicate_group().degrees[ax]
+
+    def a2a_local(v):  # v: one rank's tensor [n*chunk, ...]
+        chunk = v.shape[0] // n
+        vr = v.reshape((n, chunk) + v.shape[1:])
+        return jax.lax.all_to_all(vr, ax, split_axis=0, concat_axis=0, tiled=False).reshape(
+            (n * chunk,) + v.shape[1:])
+
+    if mode == "traced":
+        out = a2a_local(x)
+    else:
+        out = _eager_axis_collective(x, ax, lambda v: a2a_local(v[0])[None])
+    if out_tensor_list is not None:
+        chunk = out.shape[0] // n
+        for i in range(n):
+            out_tensor_list.append(Tensor(out[i * chunk:(i + 1) * chunk]))
+        return out_tensor_list
+    return Tensor(out)
+
+
+alltoall = all_to_all
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "p2p send/recv map to ppermute inside pipeline schedules "
+        "(meta_parallel/pp_layers); standalone eager p2p lands with multi-controller")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "p2p send/recv map to ppermute inside pipeline schedules "
+        "(meta_parallel/pp_layers); standalone eager p2p lands with multi-controller")
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+def barrier(group=None):
+    # single-controller: all local devices are driven by this process; only
+    # multi-host needs an actual sync
+    import jax as _j
+
+    try:
+        from jax.experimental import multihost_utils
+
+        if _j.process_count() > 1:
+            multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    except Exception:
+        pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor._data.block_until_ready()
+    return tensor
+
+
+# ---- traced-mode helpers used by meta_parallel layers ----
+
+def p_split(x, axis_name: str, dim: int):
+    """c_split analogue: take this shard's slice along `dim` (traced mode)."""
+    idx = jax.lax.axis_index(axis_name)
+    hcg = get_hybrid_communicate_group()
+    n = hcg.degrees[axis_name]
+    size = x.shape[dim] // n
+    return jax.lax.dynamic_slice_in_dim(x, idx * size, size, axis=dim)
+
+
+def p_concat(x, axis_name: str, dim: int):
+    """c_concat analogue: all_gather along `dim` (traced mode)."""
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)

@@ -1,0 +1,252 @@
+"""Distributed train-step engine: the pjit execution path.
+
+This is the TPU-native replacement for the reference's whole static-graph distributed
+machinery (meta-optimizers rewriting programs + InterpreterCore + NCCL rings, SURVEY.md §3.4):
+the forward, backward, grad sync, clip, and optimizer update become ONE jitted XLA program
+over the hcg mesh. Parallelism is expressed as shardings:
+
+- dp / sharding(ZeRO data axis): batch dims sharded; XLA turns the mean-loss grad into an
+  allreduce (the Reducer/fuse_all_reduce_ops analogue — one fused collective per step).
+- mp (tensor parallel): parameters carry PartitionSpec dist_attrs from the mp_layers;
+  GSPMD inserts the c_identity/c_allreduce/c_concat collectives the reference codes by hand.
+- sharding stage1/2 (ZeRO-1/2): optimizer states sharded over the sharding axis — the
+  weight update runs 1/N-sized per device and XLA all-gathers updated params
+  (= reference GroupShardedOptimizerStage2, group_sharded_optimizer_stage2.py:48).
+- sp: sequence dims of activations sharded; attention gathers as needed.
+- parameters are donated: the update is in-place in HBM (buffer donation ≙ the
+  reference's in-place optimizer ops).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from ..jit import functional_call
+from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from ..optimizer import functional as opt_funct
+from .mesh import HybridCommunicateGroup, get_hybrid_communicate_group
+
+
+def _divides(n, d):
+    return d > 0 and n % d == 0
+
+
+def _param_spec(p, shape, hcg) -> P:
+    if getattr(p, "dist_attr", None) is not None:
+        return p.dist_attr if isinstance(p.dist_attr, P) else P(*p.dist_attr)
+    return P()
+
+
+def _opt_state_spec(param_spec: P, shape, hcg, use_sharding: bool) -> P:
+    """Shard optimizer state over the 'sharding' axis in the first divisible unsharded
+    dim (ZeRO-1 weight-update sharding, arXiv:2004.13336 style)."""
+    entries = list(param_spec) + [None] * (len(shape) - len(param_spec))
+    if not use_sharding:
+        return P(*entries) if any(e is not None for e in entries) else P()
+    deg = hcg.degrees["sharding"]
+    if deg <= 1:
+        return P(*entries) if any(e is not None for e in entries) else P()
+    for i, (e, s) in enumerate(zip(entries, shape)):
+        if e is None and _divides(s, deg):
+            entries[i] = "sharding"
+            break
+    return P(*entries)
+
+
+def _default_input_spec(shape, hcg) -> P:
+    batch_axes = tuple(a for a in ("dp", "sharding") if hcg.degrees[a] > 1)
+    first = batch_axes if len(batch_axes) > 1 else (batch_axes[0] if batch_axes else None)
+    entries = [first]
+    if len(shape) >= 2 and hcg.degrees["sp"] > 1 and _divides(shape[1], hcg.degrees["sp"]):
+        entries.append("sp")
+    return P(*entries)
+
+
+class TrainStepEngine:
+    """Fused distributed train step.
+
+    model: an nn.Layer whose forward returns the scalar loss given the batch
+           (or pass loss_fn to combine model outputs + labels).
+    optimizer: a paddle_tpu.optimizer.Optimizer (its functional rule is reused).
+    """
+
+    def __init__(self, model, optimizer, loss_fn: Optional[Callable] = None,
+                 hcg: Optional[HybridCommunicateGroup] = None, strategy=None,
+                 input_specs: Optional[List[P]] = None, donate: bool = True):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.hcg = hcg or get_hybrid_communicate_group() or HybridCommunicateGroup()
+        self.mesh: Mesh = self.hcg.mesh
+        self.strategy = strategy
+        self.input_specs = input_specs
+        self._donate = donate
+        use_sharding = bool(strategy and getattr(strategy, "sharding", False)) or \
+            self.hcg.degrees["sharding"] > 1
+
+        state = model.state_dict(include_non_persistable_buffer=True)
+        self._param_names = [n for n, t in state.items() if not t.stop_gradient]
+        self._buffer_names = [n for n, t in state.items() if t.stop_gradient]
+        self._state_refs = state
+
+        # build sharded global arrays for params + opt state
+        self.param_specs = {}
+        self.params = {}
+        for n in self._param_names:
+            p = state[n]
+            spec = _param_spec(p, p.shape, self.hcg)
+            self.param_specs[n] = spec
+            self.params[n] = jax.device_put(p._data, NamedSharding(self.mesh, spec))
+        self.buffers = {n: state[n]._data for n in self._buffer_names}
+
+        rule = optimizer._rule
+        self.opt_specs = {}
+        self.opt_state = {}
+        for n in self._param_names:
+            st = opt_funct.init_state(rule, self.params[n])
+            spec = _opt_state_spec(self.param_specs[n], state[n].shape, self.hcg,
+                                   use_sharding)
+            self.opt_specs[n] = spec
+            self.opt_state[n] = tuple(
+                jax.device_put(s, NamedSharding(self.mesh, spec)) for s in st)
+
+        self._step_fn = None
+        self._step_count = optimizer._step_count
+        self._key = jax.random.key(random_mod.default_generator().initial_seed() or 0)
+        self.last_loss = None
+
+    # ---- step function construction ----
+    def _build(self, batch_avals):
+        rule_name = self.optimizer._rule
+        hyper = dict(self.optimizer._hyper)
+        wd = self.optimizer._weight_decay
+        _WD_RULES = ("sgd", "momentum", "adam", "adamw", "adamax", "adagrad",
+                     "adadelta", "rmsprop")  # lamb uses lamb_weight_decay instead
+        if rule_name in _WD_RULES:
+            hyper.setdefault("weight_decay", wd)
+        rule = opt_funct.RULES[rule_name]
+        needs_step = rule_name in opt_funct._NEEDS_STEP
+        clip = self.optimizer._grad_clip
+        model = self.model
+        loss_fn = self.loss_fn
+        buffer_names = self._buffer_names
+        buffers = self.buffers
+
+        def step(params, opt_state, lr, step_i, key, *batch):
+            def compute_loss(ps):
+                state = dict(ps)
+                for bn in buffer_names:
+                    state[bn] = buffers[bn]
+                with random_mod.trace_key_scope(key):
+                    inputs = [Tensor(b, stop_gradient=True) for b in batch]
+                    out = functional_call(model, state, *inputs)
+                if loss_fn is not None:
+                    out = loss_fn(out) if not isinstance(out, (tuple, list)) else loss_fn(*out)
+                loss = out[0] if isinstance(out, (tuple, list)) else out
+                return loss._data if isinstance(loss, Tensor) else loss
+
+            loss, grads = jax.value_and_grad(compute_loss)(params)
+
+            if isinstance(clip, ClipGradByGlobalNorm):
+                gn_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                            for g in grads.values())
+                gn = jnp.sqrt(gn_sq)
+                scale = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
+                grads = {n: (g * scale).astype(g.dtype) for n, g in grads.items()}
+            elif isinstance(clip, ClipGradByNorm):
+                grads = {
+                    n: (g * jnp.minimum(
+                        clip.clip_norm / jnp.maximum(
+                            jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32)))), 1e-12),
+                        1.0)).astype(g.dtype)
+                    for n, g in grads.items()}
+            elif isinstance(clip, ClipGradByValue):
+                grads = {n: jnp.clip(g, clip.min, clip.max) for n, g in grads.items()}
+
+            new_params = {}
+            new_opt = {}
+            for n, p in params.items():
+                kw = dict(hyper)
+                if needs_step:
+                    kw["step"] = step_i
+                np_, ns_ = rule(p, grads[n], opt_state[n], lr=lr, **kw)
+                new_params[n] = np_
+                new_opt[n] = ns_
+            return loss, new_params, new_opt
+
+        param_shardings = {n: NamedSharding(self.mesh, s) for n, s in self.param_specs.items()}
+        opt_shardings = {
+            n: tuple(NamedSharding(self.mesh, self.opt_specs[n]) for _ in self.opt_state[n])
+            for n in self._param_names}
+        if self.input_specs is not None:
+            batch_shardings = tuple(NamedSharding(self.mesh, s) for s in self.input_specs)
+        else:
+            batch_shardings = tuple(
+                NamedSharding(self.mesh, _default_input_spec(a.shape, self.hcg))
+                for a in batch_avals)
+        scalar = NamedSharding(self.mesh, P())
+
+        self._batch_shardings = batch_shardings
+        return jax.jit(
+            step,
+            in_shardings=(param_shardings, opt_shardings, scalar, scalar, scalar)
+            + batch_shardings,
+            out_shardings=(scalar, param_shardings, opt_shardings),
+            donate_argnums=(0, 1) if self._donate else (),
+        )
+
+    # ---- public API ----
+    def step(self, *batch) -> Tensor:
+        arrays = []
+        for b in batch:
+            a = b._data if isinstance(b, Tensor) else jnp.asarray(b)
+            arrays.append(a)
+        batch_axes = self.hcg.degrees["dp"] * self.hcg.degrees["sharding"]
+        for a in arrays:
+            if a.ndim >= 1 and a.shape[0] % batch_axes != 0:
+                raise ValueError(
+                    f"batch dim {a.shape[0]} is not divisible by "
+                    f"dp*sharding = {batch_axes}; pad or resize the batch "
+                    f"(topology: {self.hcg.topology()})")
+        if self._step_fn is None:
+            self._step_fn = self._build(arrays)
+        # place batch according to specs (host->device with the right sharding)
+        arrays = [jax.device_put(a, s) for a, s in zip(arrays, self._batch_shardings)]
+        self._step_count += 1
+        self.optimizer._step_count = self._step_count  # keep ckpt/resume consistent
+        lr = jnp.float32(self.optimizer.get_lr())
+        self._key, sub = jax.random.split(self._key)
+        loss, self.params, self.opt_state = self._step_fn(
+            self.params, self.opt_state, lr, jnp.int32(self._step_count), sub, *arrays)
+        self.last_loss = Tensor(loss)
+        return self.last_loss
+
+    train_batch = step
+
+    def sync_to_model(self):
+        """Write engine-owned (possibly sharded) params back into the eager Layer."""
+        for n in self._param_names:
+            # np.asarray gathers a sharded global array to host, then re-uploads dense
+            self._state_refs[n]._data = jnp.asarray(np.asarray(self.params[n]))
+        return self.model
+
+    def state_dict(self):
+        out = {}
+        for n in self._param_names:
+            out[n] = Tensor(jnp.asarray(np.asarray(self.params[n])))
+        for n in self._buffer_names:
+            out[n] = Tensor(self.buffers[n])
+        return out
+
+
+def parallelize(model, optimizer, loss_fn=None, hcg=None, strategy=None, **kw):
+    """Sugar: fleet-style entry returning a ready TrainStepEngine."""
+    return TrainStepEngine(model, optimizer, loss_fn=loss_fn, hcg=hcg,
+                           strategy=strategy, **kw)

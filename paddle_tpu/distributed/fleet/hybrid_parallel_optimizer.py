@@ -1,0 +1,38 @@
+"""HybridParallelOptimizer.
+
+Reference: fleet/meta_parallel/dygraph_optimizer/hybrid_parallel_optimizer.py:170 —
+wraps the inner optimizer so global-norm grad clip spans mp/pp groups (:51) and grads
+are fused-allreduced across dp before step.
+
+TPU-native: inside the engine's pjit step, clipping already sees the full global grads
+(single program), so this wrapper only matters for the eager multi-process path and for
+API parity.
+"""
+from __future__ import annotations
+
+from ...core.autograd import no_grad
+from .utils import fused_allreduce_gradients
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    @no_grad()
+    def step(self):
+        if self._hcg is not None and self._hcg.get_data_parallel_world_size() > 1:
+            fused_allreduce_gradients(self._inner_opt._parameter_list, self._hcg)
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **kw):
+        self._inner_opt.clear_grad(*a, **kw)
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, []

@@ -1,0 +1,133 @@
+"""fleet.utils: recompute (activation checkpointing) + hybrid parallel helpers.
+
+Reference: fleet/utils/recompute.py:199 (RecomputeFunction — PyLayer that stashes RNG
+state and inputs, replays the forward under grad in backward) and
+fleet/utils/hybrid_parallel_util.py:128,142 (param broadcast, fused grad allreduce).
+
+TPU-native: in traced mode (inside the engine's pjit step) recompute IS `jax.checkpoint`
+— XLA rematerializes the segment in backward, the exact hardware analogue. Eagerly it is
+the reference's replay strategy on the vjp tape.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core import random as random_mod
+from ...core.autograd import Node, enable_grad, is_grad_enabled, no_grad
+from ...core.autograd import grad as grad_api
+from ...core.tensor import Tensor
+from ...jit import in_jit_trace
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+    if in_jit_trace():
+        # traced: lower to jax.checkpoint (remat). Closure tracers (layer params from
+        # functional_call) are differentiated through correctly by jax.
+        def f(*arrays):
+            wrapped = []
+            it = iter(arrays)
+            for a in args:
+                wrapped.append(Tensor(next(it)) if isinstance(a, Tensor) else a)
+            out = function(*wrapped, **kwargs)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+            return out._data if isinstance(out, Tensor) else out
+
+        ck = jax.checkpoint(f)
+        out = ck(*[t._data for t in tensor_args])
+        if isinstance(out, tuple):
+            return tuple(Tensor(o) for o in out)
+        return Tensor(out)
+
+    # eager: replay-in-backward on the vjp tape
+    if not is_grad_enabled() or not any(not t.stop_gradient for t in tensor_args):
+        return function(*args, **kwargs)
+
+    rng_state = random_mod.get_rng_state() if preserve_rng_state else None
+
+    with no_grad():
+        outputs = function(*args, **kwargs)
+
+    multi = isinstance(outputs, (tuple, list))
+    outs = list(outputs) if multi else [outputs]
+    out_tensors = [o for o in outs if isinstance(o, Tensor)]
+
+    import numpy as np
+
+    def vjp_fn(cotangents):
+        cots = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+        if rng_state is not None:
+            saved = random_mod.get_rng_state()
+            random_mod.set_rng_state(rng_state)
+        try:
+            detached = []
+            for a in args:
+                if isinstance(a, Tensor):
+                    d = a.detach()
+                    d.stop_gradient = a.stop_gradient
+                    detached.append(d)
+                else:
+                    detached.append(a)
+            with enable_grad():
+                replay = function(*detached, **kwargs)
+            replay_list = list(replay) if isinstance(replay, (tuple, list)) else [replay]
+            replay_t = [o for o in replay_list if isinstance(o, Tensor)
+                        and not o.stop_gradient]
+            # Real backward over the replayed segment: deposits grads directly into the
+            # captured parameters' .grad (the reference RecomputeFunction's backward
+            # does exactly this) and into the detached inputs, whose grads we return
+            # as cotangents for the outer tape.
+            from ...core.autograd import run_backward
+
+            run_backward(replay_t, [Tensor(c) for c in cots[:len(replay_t)]])
+        finally:
+            if rng_state is not None:
+                random_mod.set_rng_state(saved)
+        result = []
+        di = iter([d for d in detached if isinstance(d, Tensor)])
+        for t in tensor_args:
+            d = next(di)
+            if t.stop_gradient or d._grad is None:
+                result.append(None)
+            else:
+                result.append(d._grad._data)
+        return tuple(result)
+
+    node = Node(vjp_fn, tensor_args,
+                [(tuple(o.shape), np.dtype(o.dtype)) for o in out_tensors],
+                name="recompute")
+    for i, o in enumerate(out_tensors):
+        o._stop_gradient = False
+        o._node = node
+        o._out_index = i
+    return outputs
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Reference hybrid_parallel_util.py:142 — under the pjit engine this is the XLA
+    allreduce from batch-sharded grads; eagerly (multi-process) allreduce per param."""
+    from .. import collective
+
+    group = hcg.get_data_parallel_group() if hcg else None
+    if group is None or group.nranks <= 1:
+        return
+    for p in parameter_list:
+        if p.grad is not None:
+            collective.all_reduce(p.grad, op=collective.ReduceOp.AVG, group=group)
+
+
+def broadcast_mp_parameters(model, hcg):
+    pass  # single-controller: replicas identical by construction
+
+
+def broadcast_dp_parameters(model, hcg):
+    pass
+
+
+def broadcast_sharding_parameters(model, hcg):
+    pass

@@ -1,0 +1,198 @@
+"""Device mesh & hybrid-parallel topology.
+
+Reference: `HybridCommunicateGroup` builds a 4-D rank grid (dp/pp/mp/sharding) and carves an
+NCCL communicator per sub-group (python/paddle/distributed/fleet/base/topology.py:133,155-165).
+
+TPU-native: the grid *is* a `jax.sharding.Mesh` whose named axes (dp, pp, mp, sharding, sp, ep)
+are the communicators — a "ring id" becomes an axis name, and collectives over a group become
+XLA collectives over that axis (SURVEY.md §5.8 north star). Sub-groups need no setup: any axis
+subset of the mesh is already a valid communication scope for psum/all_gather/ppermute.
+
+Multi-host: the same Mesh spans all processes' devices (multi-controller JAX); ICI carries
+intra-slice axes, DCN the inter-slice ones (put dp outermost for that).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional
+
+import numpy as np
+
+AXES_ORDER = ("pp", "dp", "sharding", "sp", "ep", "mp")
+# mp (tensor parallel) innermost: its collectives are the most latency-sensitive and
+# should ride the fastest ICI links; pp outermost: only p2p crosses it.
+
+
+class CommGroup:
+    """A communicator = a named mesh axis (or explicit rank list for new_group)."""
+
+    def __init__(self, axis: Optional[str], ranks: List[int], mesh=None, id: int = 0):
+        self.axis = axis
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.world_size = self.nranks
+        self.mesh = mesh
+        self.id = id
+
+    @property
+    def rank(self):
+        from .env import get_rank
+
+        g = get_rank()
+        return self.ranks.index(g) if g in self.ranks else -1
+
+    def get_group_rank(self, global_rank):
+        return self.ranks.index(global_rank) if global_rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"CommGroup(axis={self.axis}, ranks={self.ranks})"
+
+
+def build_mesh(degrees: Dict[str, int], devices=None):
+    """Create a jax Mesh with the given axis degrees (1-degree axes kept for uniformity)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = np.array(jax.devices())
+    else:
+        devices = np.array(devices)
+    shape = [int(degrees.get(a, 1)) for a in AXES_ORDER]
+    total = int(np.prod(shape))
+    if total != devices.size:
+        raise ValueError(
+            f"mesh degrees {dict(zip(AXES_ORDER, shape))} require {total} devices, "
+            f"have {devices.size}")
+    return Mesh(devices.reshape(shape), AXES_ORDER)
+
+
+class HybridCommunicateGroup:
+    """Topology facade with the reference's accessor surface (topology.py:209)."""
+
+    def __init__(self, dp_degree=-1, mp_degree=1, pp_degree=1, sharding_degree=1,
+                 sp_degree=1, ep_degree=1, devices=None, order=None):
+        import jax
+
+        avail = list(devices) if devices is not None else list(jax.devices())
+        n = len(avail)
+        degrees = {"dp": dp_degree, "mp": mp_degree, "pp": pp_degree,
+                   "sharding": sharding_degree, "sp": sp_degree, "ep": ep_degree}
+        others = int(np.prod([max(1, d) for k, d in degrees.items() if k != "dp"]))
+        if degrees["dp"] is None or degrees["dp"] <= 0:
+            # auto-fill dp to use every device (reference launcher behavior)
+            if n % others != 0:
+                raise ValueError(f"degrees {degrees} do not partition {n} devices")
+            degrees["dp"] = n // others
+        total = others * max(1, degrees["dp"])
+        if total > n:
+            raise ValueError(
+                f"mesh degrees {degrees} need {total} devices, only {n} available")
+        self.degrees = {k: max(1, int(v)) for k, v in degrees.items()}
+        # explicit degrees may use a subset of the devices (e.g. a 1-chip debug mesh
+        # on an 8-device host)
+        self.mesh = build_mesh(self.degrees, avail[:total])
+        self.nranks = total
+        self._groups = {}
+        for i, axis in enumerate(AXES_ORDER):
+            self._groups[axis] = CommGroup(axis, list(range(self.degrees[axis])),
+                                           self.mesh, id=i)
+        self.global_rank = 0  # single-controller: logical rank of this process
+
+    # ---- reference accessor surface ----
+    def get_parallel_mode(self):
+        if self.degrees["pp"] > 1:
+            return "pipeline"
+        if self.degrees["sharding"] > 1:
+            return "sharding_parallel"
+        if self.degrees["mp"] > 1:
+            return "tensor_parallel"
+        return "data_parallel"
+
+    def topology(self):
+        return self.degrees
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    def get_data_parallel_world_size(self):
+        return self.degrees["dp"]
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_group(self):
+        return self._groups["dp"]
+
+    def get_model_parallel_world_size(self):
+        return self.degrees["mp"]
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        return self._groups["mp"]
+
+    def get_pipe_parallel_world_size(self):
+        return self.degrees["pp"]
+
+    def get_stage_id(self):
+        return 0
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pp"]
+
+    def get_sharding_parallel_world_size(self):
+        return self.degrees["sharding"]
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_world_size(self):
+        return self.degrees["sp"]
+
+    def get_sep_parallel_group(self):
+        return self._groups["sp"]
+
+    def get_expert_parallel_world_size(self):
+        return self.degrees["ep"]
+
+    def get_expert_parallel_group(self):
+        return self._groups["ep"]
+
+    def get_check_parallel_group(self):
+        return CommGroup(None, list(range(self.nranks)), self.mesh)
+
+    # ---- TPU-native additions ----
+    def axis_size(self, axis: str) -> int:
+        return self.degrees[axis]
+
+    def data_spec(self, extra_batch_axes=("sharding",)):
+        """PartitionSpec for a [batch, ...] input: batch sharded over dp (+sharding)."""
+        from jax.sharding import PartitionSpec as P
+
+        axes = tuple(a for a in ("dp",) + tuple(extra_batch_axes) if self.degrees[a] > 1)
+        return P(axes if len(axes) > 1 else (axes[0] if axes else None))
+
+
+_global_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _global_hcg
+    _global_hcg = hcg
+    return hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _global_hcg
+
+
+def fleet_default_mesh():
+    """The mesh in effect: the fleet hcg's, else a trivial all-dp mesh."""
+    global _global_hcg
+    if _global_hcg is None:
+        _global_hcg = HybridCommunicateGroup()
+    return _global_hcg.mesh

@@ -1,0 +1,78 @@
+"""DataParallel layer wrapper.
+
+Reference: python/paddle/fluid/dygraph/parallel.py:413 — broadcasts initial params,
+builds the C++ Reducer (bucketed grad allreduce, reducer.cc), exposes no_sync.
+
+TPU-native: under the engine's pjit step, dp-grad sync IS the XLA allreduce that
+jax.grad of the batch-sharded mean loss produces — already maximally fused (one
+collective for all grads, the fuse_all_reduce_ops end-state). This wrapper therefore
+(1) keeps the API (forward passthrough, no_sync, scale_loss), and (2) in eager
+multi-process mode syncs grads per-bucket through the collective API after backward.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ... import nn
+from ...core.tensor import Tensor
+from .. import collective
+from ..env import get_world_size
+from ..mesh import get_hybrid_communicate_group
+
+
+class DataParallel(nn.Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self.add_sublayer("_layers", layers)
+        object.__setattr__(self, "_layers", layers)
+        self.find_unused_parameters = find_unused_parameters
+        self.comm_buffer_size = comm_buffer_size
+        self._grads_synced = True
+        self._enable_sync = True
+        hcg = get_hybrid_communicate_group()
+        self.group = group or (hcg.get_data_parallel_group() if hcg else None)
+        self._world = self.group.nranks if self.group else get_world_size()
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layers(*inputs, **kwargs)
+        if self._world > 1 and self._enable_sync:
+            self._grads_synced = False
+        return out
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        prev = self._enable_sync
+        self._enable_sync = False
+        try:
+            yield
+        finally:
+            self._enable_sync = prev
+
+    def sync_gradients(self):
+        """Bucketed grad allreduce (the Reducer's job). Called by optimizer glue or
+        explicitly after backward in eager multi-rank mode."""
+        if self._world <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                collective.all_reduce(p.grad, op=collective.ReduceOp.AVG, group=self.group)
+        self._grads_synced = True
+
+    def scale_loss(self, loss):
+        return loss
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+
+def sync_params_buffers(model, comm_group=None, src_rank=0, is_model_parallel=False):
+    """Reference parallel.py:369 — broadcast initial params within a group. Under a
+    single controller all replicas are born identical; multi-controller broadcasts."""
+    if get_world_size() <= 1:
+        return
+    for p in model.parameters():
+        collective.broadcast(p, src=src_rank, group=comm_group)

@@ -1,0 +1,57 @@
+"""Model-parallel RNG state trees.
+
+Reference: fleet/meta_parallel/parallel_layers/random.py — a tracker holding named RNG
+states so dropout inside mp regions uses a local (per-mp-rank) seed while other randomness
+stays globally synced. TPU-native: named generators from core.random; inside pjit, per-shard
+variation comes from folding the axis index into the traced key (jax.random.fold_in).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ...core import random as random_mod
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def add(self, name, seed):
+        gen = random_mod.named_generator(name)
+        gen.manual_seed(seed)
+        self.states_[name] = gen
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            self.add(name, random_mod.default_generator().initial_seed() + 1024)
+        # temporarily make the named generator the default draw source
+        saved = random_mod._state.gen if hasattr(random_mod._state, "gen") else None
+        random_mod._state.gen = self.states_[name]
+        try:
+            yield
+        finally:
+            random_mod._state.gen = saved
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+
+    seed = seed or (pyrandom.Random().randint(0, 2 ** 31))
+    global_seed = seed
+    local_seed = seed + 1024
+    _tracker.reset()
+    random_mod.seed(global_seed)
+    _tracker.add(MODEL_PARALLEL_RNG, local_seed)

@@ -1,0 +1,37 @@
+"""paddle.distributed.spawn — single-node multiprocess entry (reference spawn.py).
+
+On TPU, a single controller already drives all local chips, so nprocs>1 maps to
+multi-host multi-controller launches (one process per host) via the launcher CLI;
+spawn with nprocs=1 (or default) simply runs the function.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+
+def _worker(func, args, env):
+    os.environ.update(env)
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return None
+
+    # fork: the worker closure (user func + env) is inherited, not pickled
+    ctx = mp.get_context("fork")
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+        }
+        p = ctx.Process(target=_worker, args=(func, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs

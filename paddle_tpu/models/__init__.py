@@ -1,0 +1,1 @@
+from .gpt import GPTConfig, GPTForPretraining, GPTModel, gpt_tiny, gpt_1p3b, gpt_345m  # noqa: F401

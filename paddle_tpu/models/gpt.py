@@ -1,0 +1,166 @@
+"""GPT family — the flagship model for the distributed benchmarks.
+
+Reference analogue: the ERNIE/GPT models fleet's hybrid-parallel examples train
+(hybrid_parallel_mp_layers.py / GPT-3 config in BASELINE.json). Built from the
+meta_parallel TP layers so every parameter carries its PartitionSpec dist_attr —
+under the TrainStepEngine pjit step this yields Megatron-style tensor parallelism
+(column→row pairs, vocab-parallel embedding + loss) with GSPMD inserting the
+collectives; dp/sharding/sp come from batch & optimizer-state shardings.
+
+bf16-first: matmul inputs autocast under amp; layernorm/softmax/loss stay f32.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed.fleet.utils import recompute
+from ..distributed.meta_parallel.mp_layers import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..ops import creation as C
+from ..ops import manipulation as P
+from ..nn import functional as F
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
+                 ffn_hidden_size=None, max_seq_len=1024, dropout=0.0,
+                 attention_dropout=0.0, use_recompute=False, dtype="float32",
+                 tie_word_embeddings=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.attention_dropout = attention_dropout
+        self.use_recompute = use_recompute
+        self.dtype = dtype
+        self.tie_word_embeddings = tie_word_embeddings
+
+
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+                     max_seq_len=128, **kw)
+
+
+def gpt_345m(**kw):
+    return GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24, num_heads=16,
+                     max_seq_len=1024, **kw)
+
+
+def gpt_1p3b(**kw):
+    """GPT-3 1.3B (BASELINE config 4)."""
+    return GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16,
+                     max_seq_len=2048, **kw)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        self.hidden_size = config.hidden_size
+        self.qkv_proj = ColumnParallelLinear(config.hidden_size, 3 * config.hidden_size,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(config.hidden_size, config.hidden_size,
+                                          input_is_parallel=True)
+        self.attn_dropout = config.attention_dropout
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)  # [b, s, 3h] (h sharded over mp)
+        qkv = P.reshape(qkv, (b, s, 3, self.num_heads, self.head_dim))
+        q, k, v = P.unbind(qkv, axis=2)  # heads dim sharded over mp under pjit
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.attn_dropout, training=self.training)
+        out = P.reshape(out, (b, s, self.hidden_size))
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.fc1 = ColumnParallelLinear(config.hidden_size, config.ffn_hidden_size,
+                                        gather_output=False)
+        self.fc2 = RowParallelLinear(config.ffn_hidden_size, config.hidden_size,
+                                     input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(config.hidden_size)
+        self.attn = GPTAttention(config)
+        self.ln2 = nn.LayerNorm(config.hidden_size)
+        self.mlp = GPTMLP(config)
+        self.dropout = config.dropout
+        self.use_recompute = config.use_recompute
+
+    def _forward(self, x):
+        h = x + F.dropout(self.attn(self.ln1(x)), self.dropout, training=self.training)
+        return h + F.dropout(self.mlp(self.ln2(h)), self.dropout, training=self.training)
+
+    def forward(self, x):
+        if self.use_recompute and self.training:
+            return recompute(self._forward, x)
+        return self._forward(x)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_seq_len, config.hidden_size)
+        self.drop = nn.Dropout(config.dropout)
+        self.blocks = nn.LayerList([GPTBlock(config) for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = C.arange(0, s, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForPretraining(nn.Layer):
+    """forward(input_ids, labels) -> scalar LM loss (the engine's expected signature)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+        if config.tie_word_embeddings:
+            self.lm_head = None  # reuse wte.weight (vocab-parallel)
+        else:
+            self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
+                                                has_bias=False, gather_output=False)
+        self.loss_fn = ParallelCrossEntropy()
+
+    def logits(self, input_ids):
+        h = self.gpt(input_ids)
+        if self.lm_head is None:
+            from ..ops import linalg as L
+
+            return L.matmul(h, self.gpt.wte.weight, transpose_y=True)
+        return self.lm_head(h)
+
+    def forward(self, input_ids, labels=None):
+        logits = self.logits(input_ids)
+        if labels is None:
+            return logits
+        loss = self.loss_fn(logits, labels)
+        from ..ops import reduction as R
+
+        return R.mean(loss)
