@@ -51,4 +51,5 @@ define_flag("benchmark", False, "synchronize after each op for timing")
 define_flag("allocator_strategy", "xla", "kept for parity; XLA/PJRT owns device memory")
 define_flag("eager_op_jit", True, "jit-cache per-op computations in dygraph")
 define_flag("tpu_matmul_precision", "default", "default|high|highest for MXU matmuls")
+define_flag("use_flash_attention", True, "route attention to the Pallas flash kernel on TPU")
 define_flag("seed", 0, "global random seed")

@@ -59,9 +59,17 @@ def seed(s: int) -> Generator:
     g = default_generator().manual_seed(s)
     named = getattr(_state, "named", None)
     if named:
-        for i, (name, gen) in enumerate(sorted(named.items())):
-            gen.manual_seed(s + 100003 * (i + 1))
+        for name, gen in named.items():
+            gen.manual_seed(s + _name_offset(name))
     return g
+
+
+def _name_offset(name: str) -> int:
+    """Stable per-name seed offset — must not depend on creation order or on
+    Python's randomized str hash, or reseeding wouldn't be reproducible."""
+    import hashlib
+
+    return int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "little") % 99991 + 1
 
 
 def next_key():
@@ -117,5 +125,5 @@ def named_generator(name: str) -> Generator:
         named = {}
         _state.named = named
     if name not in named:
-        named[name] = Generator(_DEFAULT_SEED + (hash(name) % 99991))
+        named[name] = Generator(default_generator().initial_seed() + _name_offset(name))
     return named[name]

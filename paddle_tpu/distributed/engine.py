@@ -139,12 +139,22 @@ class TrainStepEngine:
         buffer_names = self._buffer_names
         buffers = self.buffers
 
+        import contextlib
+
+        from .meta_parallel.sequence_parallel import sequence_parallel_scope
+
+        sp_deg = self.hcg.degrees["sp"]
+        sp_impl = getattr(self.strategy, "sep_impl", "ring") if self.strategy else "ring"
+        mesh = self.mesh
+
         def step(params, opt_state, lr, step_i, key, *batch):
             def compute_loss(ps):
                 state = dict(ps)
                 for bn in buffer_names:
                     state[bn] = buffers[bn]
-                with random_mod.trace_key_scope(key):
+                sp_ctx = (sequence_parallel_scope(mesh, "sp", sp_impl)
+                          if sp_deg > 1 else contextlib.nullcontext())
+                with sp_ctx, random_mod.trace_key_scope(key):
                     inputs = [Tensor(b, stop_gradient=True) for b in batch]
                     out = functional_call(model, state, *inputs)
                 if loss_fn is not None:

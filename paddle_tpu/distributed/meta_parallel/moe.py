@@ -99,8 +99,16 @@ class MoELayer(nn.Layer):
             # top-k routing with capacity (GShard dense dispatch)
             topv, topi = jax.lax.top_k(probs, self.top_k)          # [T, K]
             onehot = jax.nn.one_hot(topi, self.num_experts, dtype=jnp.float32)  # [T,K,E]
-            # position of each token within its expert's queue
-            pos = jnp.cumsum(onehot, axis=0) - 1.0                  # [T,K,E]
+            # position of each (token, k) slot within its expert's queue: one shared
+            # counter per expert across ALL k ranks (k-major order, so every 1st
+            # choice outranks every 2nd choice — the GShard priority rule). A
+            # per-k-column cumsum would hand the same capacity slot to a 1st-choice
+            # and a 2nd-choice token and silently sum their embeddings.
+            oh_k = jnp.swapaxes(onehot, 0, 1).reshape(self.top_k * onehot.shape[0],
+                                                      self.num_experts)  # [K*T, E]
+            pos_k = jnp.cumsum(oh_k, axis=0) - 1.0
+            pos = jnp.swapaxes(
+                pos_k.reshape(self.top_k, onehot.shape[0], self.num_experts), 0, 1)
             keep = (pos < capacity).astype(jnp.float32) * onehot
             gates = topv[..., None] * keep                          # [T,K,E]
             pos_idx = jnp.einsum("tke,tke->tk", pos, keep).astype(jnp.int32)

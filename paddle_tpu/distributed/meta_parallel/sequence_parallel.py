@@ -1,0 +1,202 @@
+"""Sequence/context parallelism: ring attention + Ulysses (all-to-all) attention.
+
+The reference has NO sequence parallelism (SURVEY.md §2: "SP ... ABSENT in the
+reference"); this is a first-class addition mirroring how dp/mp/pp compose via
+HybridCommunicateGroup. The 'sp' mesh axis shards the sequence dimension of
+activations; attention — the only op that mixes positions — is computed either by:
+
+- **ring attention** (Liu et al., arXiv:2310.01889): each shard keeps its query
+  block and rotates KV blocks around the ring with `jax.lax.ppermute` (ICI
+  neighbor exchange), merging partial results with online-softmax (running max +
+  logsumexp) so the full [s, s] score matrix never exists anywhere; or
+- **Ulysses** (arXiv:2309.14509): `jax.lax.all_to_all` re-shards from
+  sequence-split to head-split, runs dense local attention (the Pallas flash
+  kernel), and re-shards back. Needs num_heads % sp == 0.
+
+Both run inside `jax.shard_map` manual regions over ONLY the 'sp' axis
+(`axis_names={'sp'}`) so dp/mp/sharding stay under GSPMD auto-sharding — the
+TPU-native analogue of composing a new communicator into the 4-D topology.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import math
+import threading
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+_state = threading.local()
+
+
+def active() -> bool:
+    """True when a sequence-parallel scope is installed (engine sets it when sp>1)."""
+    return getattr(_state, "ctx", None) is not None
+
+
+@contextlib.contextmanager
+def sequence_parallel_scope(mesh, axis: str = "sp", impl: str = "ring"):
+    """Route scaled_dot_product_attention to ring/Ulysses attention over `axis`."""
+    prev = getattr(_state, "ctx", None)
+    _state.ctx = (mesh, axis, impl)
+    try:
+        yield
+    finally:
+        _state.ctx = prev
+
+
+def apply_ring_attention(q, k, v, causal: bool):
+    """Entry used by ops.nn_functional when a scope is active. q,k,v: Tensors
+    [b, s_global, h, d] (traced global arrays inside pjit)."""
+    from ...core.dispatch import apply
+
+    mesh, axis, impl = _state.ctx
+    fn = ring_attention if impl == "ring" else ulysses_attention
+
+    @jax.jit  # partial-manual shard_map must run under jit (inlined when already traced)
+    def kernel(qa, ka, va):
+        return fn(qa, ka, va, mesh=mesh, axis=axis, causal=causal)
+
+    return apply("ring_attention", kernel, [q, k, v])
+
+
+# ------------------------------------------------------------------- ring ----
+
+def _chunk_attn(q, k, v, sm_scale, mask):
+    """One KV-chunk attention returning unnormalized accum + row stats.
+
+    q: [b, sq, h, d], k/v: [b, sk, h, d], mask: [sq, sk] bool or None.
+    Returns (acc [b,h,sq,d] f32, m [b,h,sq] f32, l [b,h,sq] f32).
+    """
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [b,h,sq,d]
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                              # [b,h,sq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return acc, m, l
+
+
+def _ring_shard(q, k, v, *, axis, causal, sm_scale):
+    """Per-shard ring attention body (runs under shard_map, manual over `axis`).
+
+    q,k,v: [b, s_local, h, d] — this rank's sequence shard.
+    """
+    p_size = jax.lax.axis_size(axis)
+    my_idx = jax.lax.axis_index(axis)
+    b, s_loc, h, d = q.shape
+
+    qpos = jnp.arange(s_loc)
+    kpos = jnp.arange(s_loc)
+
+    def body(t, carry):
+        o_acc, m_acc, l_acc, kc, vc = carry
+
+        def merge(stats, mask):
+            o_acc, m_acc, l_acc = stats
+            acc, m, l = _chunk_attn(q, kc, vc, sm_scale, mask)
+            m_new = jnp.maximum(m_acc, m)
+            a1 = jnp.exp(m_acc - m_new)
+            a2 = jnp.exp(m - m_new)
+            return (o_acc * a1[..., None] + acc * a2[..., None],
+                    m_new, l_acc * a1 + l * a2)
+
+        stats = (o_acc, m_acc, l_acc)
+        if causal:
+            kv_idx = (my_idx - t) % p_size  # whose block we currently hold
+            qg = my_idx * s_loc + qpos[:, None]
+            kg = kv_idx * s_loc + kpos[None, :]
+            # 3-way block dispatch: entirely-future blocks skip compute, the
+            # diagonal block masks within, past blocks run unmasked
+            stats = jax.lax.cond(
+                kv_idx > my_idx,
+                lambda s: s,
+                lambda s: jax.lax.cond(
+                    kv_idx == my_idx,
+                    lambda s2: merge(s2, qg >= kg),
+                    lambda s2: merge(s2, None),
+                    s),
+                stats)
+        else:
+            stats = merge(stats, None)
+        o_acc, m_acc, l_acc = stats
+        # rotate kv to the next rank (neighbor exchange on the ICI ring)
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        kc = jax.lax.ppermute(kc, axis, perm)
+        vc = jax.lax.ppermute(vc, axis, perm)
+        return o_acc, m_acc, l_acc, kc, vc
+
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    o, m, l, _, _ = jax.lax.fori_loop(
+        0, p_size, body, (o0, m0, l0, k, v), unroll=True)
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (o / l[..., None]).astype(q.dtype)            # [b,h,sq,d]
+    return jnp.swapaxes(out, 1, 2)                      # [b,sq,h,d]
+
+
+def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
+                   sm_scale: float | None = None):
+    """Global-view ring attention: q,k,v [b, s, h, d] with s sharded over `axis`."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = P(None, axis, None, None)
+    fn = functools.partial(_ring_shard, axis=axis, causal=causal, sm_scale=sm_scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={axis},
+                         check_vma=False)(q, k, v)
+
+
+# ---------------------------------------------------------------- ulysses ----
+
+def _ulysses_shard(q, k, v, *, axis, causal, sm_scale):
+    """Per-shard Ulysses: seq-sharded [b, s/P, h, d] -> all_to_all ->
+    head-sharded [b, s, h/P, d] -> dense local attention -> back."""
+    p_size = jax.lax.axis_size(axis)
+
+    def scatter_heads(x):
+        # tiled all_to_all: heads scattered across ranks, sequence gathered
+        # [b, s_loc, h, d] -> [b, s_loc * P, h / P, d]
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    def gather_heads(x, s_loc):
+        # inverse: [b, s, h/P, d] -> [b, s_loc, h, d]
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    s_loc = q.shape[1]
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    from ...ops.pallas.flash_attention import supported as flash_ok
+
+    if jax.default_backend() != "cpu" and flash_ok(qg.shape[1], kg.shape[1], qg.shape[-1]):
+        from ...ops.pallas.flash_attention import flash_attention
+
+        out = flash_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale)
+    else:
+        mask = None
+        if causal:
+            sq = qg.shape[1]
+            mask = jnp.arange(sq)[:, None] >= jnp.arange(sq)[None, :]
+        acc, m, l = _chunk_attn(qg, kg, vg, sm_scale, mask)
+        out = jnp.swapaxes((acc / l[..., None]), 1, 2).astype(q.dtype)
+    return gather_heads(out, s_loc)
+
+
+def ulysses_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
+                      sm_scale: float | None = None):
+    """DeepSpeed-Ulysses-style attention; requires num_heads % axis_size == 0."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = P(None, axis, None, None)
+    fn = functools.partial(_ulysses_shard, axis=axis, causal=causal, sm_scale=sm_scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={axis},
+                         check_vma=False)(q, k, v)

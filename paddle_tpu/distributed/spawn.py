@@ -20,8 +20,10 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
         func(*args)
         return None
 
-    # fork: the worker closure (user func + env) is inherited, not pickled
-    ctx = mp.get_context("fork")
+    # spawn (not fork): the parent has initialized JAX, which is multithreaded —
+    # forking a multithreaded process can deadlock children on PJRT/threadpool
+    # locks. spawn requires func/args to be picklable (same contract as torch).
+    ctx = mp.get_context("spawn")
     procs = []
     for rank in range(nprocs):
         env = {

@@ -789,8 +789,22 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     if attn_mask is not None:
         args.append(t_(attn_mask))
 
+    # attention-weight dropout (paddle semantics) is only supported by the dense
+    # path — with it active, flash/ring must not be used
+    attn_dropout = dropout_p if training else 0.0
+
+    # Sequence-parallel: ring attention over the 'sp' mesh axis (SURVEY.md §5.7)
+    from ..distributed.meta_parallel import sequence_parallel as _sp
+
+    if attn_mask is None and attn_dropout == 0.0 and _sp.active():
+        return _sp.apply_ring_attention(q, k, v, causal=is_causal)
+
     def kernel(q, k, v, *mask):
         scale = 1.0 / _math.sqrt(q.shape[-1])
+        if not mask and attn_dropout == 0.0 and _use_flash(q, k):
+            from .pallas import flash_attention as _flash
+
+            return _flash(q, k, v, causal=is_causal, sm_scale=scale)
         qt = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
         kt = jnp.swapaxes(k, 1, 2)
         vt = jnp.swapaxes(v, 1, 2)
@@ -806,14 +820,34 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             causal = jnp.tril(jnp.ones((sq, sk), bool))
             scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        if attn_dropout > 0.0:
+            # dropout on the attention WEIGHTS (paddle semantics), not the output
+            keep = 1.0 - attn_dropout
+            drop_mask = jax.random.bernoulli(drop_key, keep, probs.shape)
+            probs = jnp.where(drop_mask, probs / keep, 0.0).astype(probs.dtype)
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
         return jnp.swapaxes(out, 1, 2)
 
-    out = apply("attention", kernel, args,
-                nondiff_mask=[False, False, False] + ([True] * (len(args) - 3)))
-    if dropout_p > 0.0 and training:
-        out = dropout(out, dropout_p)
-    return out
+    drop_key = random_mod.next_key() if attn_dropout > 0.0 else None
+    return apply("attention", kernel, args,
+                 nondiff_mask=[False, False, False] + ([True] * (len(args) - 3)))
+
+
+def _use_flash(q, k) -> bool:
+    """Route to the Pallas flash kernel: TPU only (interpret mode is test-only),
+    long-enough sequences, supported tiling."""
+    import jax as _jax
+
+    from ..core import flags as _flags
+    if not _flags.flag("use_flash_attention"):
+        return False
+    if _jax.default_backend() == "cpu":
+        return False
+    from .pallas.flash_attention import supported
+
+    sq, sk, d = q.shape[1], k.shape[1], q.shape[-1]
+    return sq >= 128 and sk >= 128 and supported(sq, sk, d) and \
+        q.dtype in (jnp.float32, jnp.bfloat16)
 
 
 # ---------- misc ----------

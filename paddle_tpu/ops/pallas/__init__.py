@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for the hot ops (the analogue of the reference's hand-written
+CUDA kernels under paddle/fluid/operators/fused/). Registered behind the same
+functional surface (ops.nn_functional) with XLA fallbacks off-TPU."""
+from .flash_attention import flash_attention, supported as flash_supported  # noqa: F401

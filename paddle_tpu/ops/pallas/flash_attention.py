@@ -1,0 +1,323 @@
+"""Pallas TPU flash-attention kernel (forward + backward).
+
+This is the TPU-native replacement for the reference's fused CUDA attention
+(`paddle/fluid/operators/fused/fused_attention_op.cu`, `fmha` kernels): an
+online-softmax tiled attention that never materializes the [s, s] score matrix,
+keeping the working set in VMEM and the two matmuls per tile on the MXU.
+
+Layout: [b, h, s, d] inside the kernels (batch*heads collapsed into one grid
+dim). The public entry `flash_attention` takes paddle's [b, s, h, d].
+
+Backward follows the FlashAttention-2 scheme: forward saves per-row
+logsumexp; backward recomputes P tile-by-tile, with one kernel producing
+dK/dV (kv-block outer loop) and one producing dQ (q-block outer loop).
+
+On CPU (tests) the kernels run in Pallas interpret mode.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+# Index-map constants must be i32: the framework enables jax_enable_x64 (paddle's
+# int64 default), and a weak `0` literal would trace to i64, which Mosaic rejects.
+_I0 = np.int32(0)
+
+NEG_INF = -1e30  # finite (not -inf): keeps exp() and Mosaic happy
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _pick_block(s: int, preferred: int = 512) -> int:
+    for b in (preferred, 256, 128, 64, 32, 16, 8):
+        if s % b == 0 and b <= s:
+            return b
+    return s
+
+
+def supported(seq_q: int, seq_k: int, head_dim: int) -> bool:
+    """Shapes the kernel handles; callers fall back to the XLA path otherwise.
+
+    The picked block is the sublane dim of the q/k tiles, so it must be a
+    multiple of 8 (f32 tiling) — _pick_block falls back to the raw length for
+    primes/unaligned lengths, which Mosaic would reject at compile time.
+    """
+    return (
+        seq_q >= 8
+        and seq_k >= 8
+        and _pick_block(seq_q) % 8 == 0
+        and _pick_block(seq_k) % 8 == 0
+        and head_dim % 8 == 0
+    )
+
+
+# ---------------------------------------------------------------- forward ----
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale, causal, block_q, block_k, kv_blocks):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: a kv block strictly above the diagonal contributes nothing
+    run = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)  # [bk, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale  # [bq, bk]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qpos >= kpos, s, jnp.float32(NEG_INF))
+
+        m_prev = m_scr[...][:, :1]                      # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)       # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                          # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)                 # [bq, 1]
+        l_new = alpha * l_scr[...][:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == kv_blocks - 1)
+    def _finalize():
+        l = l_scr[...][:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros, not NaN
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        # lse broadcast across the 128-lane dim (TPU block layout for row stats)
+        lse_ref[0] = jnp.broadcast_to(m_scr[...][:, :1] + jnp.log(l), lse_ref.shape[1:])
+
+
+def _fwd(q, k, v, sm_scale, causal):
+    """q,k,v: [bh, s, d] -> (o [bh, sq, d], lse [bh, sq] f32)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _pick_block(sq), _pick_block(sk)
+    kv_blocks = sk // bk
+    grid = (bh, sq // bq, kv_blocks)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=bq, block_k=bk, kv_blocks=kv_blocks)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _I0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _I0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, _I0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[_vmem((bq, 128)), _vmem((bq, 128)), _vmem((bq, d))],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+def _vmem(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+# --------------------------------------------------------------- backward ----
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_scr, dv_scr,
+                     *, sm_scale, causal, block_q, block_k, q_blocks):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)        # [bq, d]
+        k = k_ref[0].astype(jnp.float32)        # [bk, d]
+        v = v_ref[0].astype(jnp.float32)        # [bk, d]
+        do = do_ref[0].astype(jnp.float32)      # [bq, d]
+        lse = lse_ref[0][:, :1]                 # [bq, 1]
+        delta = delta_ref[0][:, :1]             # [bq, 1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = ki * 0 + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qpos >= kpos, s, jnp.float32(NEG_INF))
+        p = jnp.exp(s - lse)                    # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr,
+                   *, sm_scale, causal, block_q, block_k, kv_blocks):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qpos >= kpos, s, jnp.float32(NEG_INF))
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == kv_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd(res, g, sm_scale, causal):
+    q, k, v, o, lse = res
+    do = g
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _pick_block(sq), _pick_block(sk)
+    q_blocks, kv_blocks = sq // bq, sk // bk
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, sq, 128))  # lane-broadcast layout
+
+    dkdv_kernel = functools.partial(
+        _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=bq, block_k=bk, q_blocks=q_blocks)
+    dk, dv = pl.pallas_call(
+        dkdv_kernel,
+        grid=(bh, kv_blocks, q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, _I0)),   # q
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _I0)),   # k
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _I0)),   # v
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, _I0)),   # do
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, _I0)),  # lse
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, _I0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _I0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, _I0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[_vmem((bk, d)), _vmem((bk, d))],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=bq, block_k=bk, kv_blocks=kv_blocks)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, q_blocks, kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _I0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, _I0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, _I0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, _I0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[_vmem((bq, d))],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- public API ----
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bhsd(q, k, v, sm_scale, causal):
+    o, _ = _fwd(q, k, v, sm_scale, causal)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, sm_scale, causal):
+    o, lse = _fwd(q, k, v, sm_scale, causal)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(sm_scale, causal, res, g):
+    return _bwd(res, g, sm_scale, causal)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = False, sm_scale: float | None = None):
+    """q,k,v: [b, s, h, d] (paddle layout). Returns [b, sq, h, d]."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    # [b, s, h, d] -> [b*h, s, d]
+    def to_bhsd(x):
+        s = x.shape[1]
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, s, x.shape[-1])
+
+    o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), float(sm_scale), bool(causal))
+    return jnp.swapaxes(o.reshape(b, h, sq, d), 1, 2)

@@ -280,6 +280,38 @@ def test_moe_layer_eager():
     assert moe.gate.gate.weight.grad is not None
 
 
+def test_moe_dispatch_matches_dense_routing():
+    """With capacity ample enough that no token drops, top-k dense dispatch must equal
+    the per-token weighted sum of expert outputs (regression: 1st- and 2nd-choice
+    tokens once collided in the same capacity slot and got summed together)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.meta_parallel import MoELayer
+
+    paddle.seed(0)
+    moe = MoELayer(d_model=8, d_hidden=16, num_experts=2, top_k=2,
+                   capacity_factor=4.0, activation="relu")
+    x = paddle.rand([1, 6, 8])
+    out = np.asarray(moe(x).numpy())
+
+    # dense reference: every token goes to its top-2 experts, gated by softmax probs
+    tok = jnp.asarray(x.numpy().reshape(6, 8))
+    logits = tok @ jnp.asarray(moe.gate.gate.weight.numpy()) + \
+        jnp.asarray(moe.gate.gate.bias.numpy())
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, 2)
+    w1, b1 = jnp.asarray(moe.experts.w1.numpy()), jnp.asarray(moe.experts.b1.numpy())
+    w2, b2 = jnp.asarray(moe.experts.w2.numpy()), jnp.asarray(moe.experts.b2.numpy())
+    ref = np.zeros((6, 8), np.float32)
+    for t in range(6):
+        for kk in range(2):
+            e = int(topi[t, kk])
+            h = jnp.maximum(tok[t] @ w1[e] + b1[e, 0], 0.0)
+            ref[t] += float(topv[t, kk]) * np.asarray(h @ w2[e] + b2[e, 0])
+    np.testing.assert_allclose(out.reshape(6, 8), ref, rtol=1e-4, atol=1e-4)
+
+
 def test_pipeline_layer_segmentation():
     from paddle_tpu.distributed.meta_parallel import LayerDesc, PipelineLayer
 
@@ -368,15 +400,16 @@ def test_all_reduce_prod_and_get_group():
     assert get_group(g.id) is g
 
 
+def _spawn_check():
+    import os
+
+    assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
+
+
 def test_spawn_multiprocess():
+    # spawn start method (fork deadlocks under multithreaded JAX), so the target
+    # must be picklable: a module-level function
     import paddle_tpu.distributed as pdist
 
-    results = []
-
-    def fn():
-        import os
-
-        assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
-
-    procs = pdist.spawn(fn, nprocs=2, join=True)
+    procs = pdist.spawn(_spawn_check, nprocs=2, join=True)
     assert all(p.exitcode == 0 for p in procs)

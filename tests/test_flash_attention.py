@@ -1,0 +1,57 @@
+"""Pallas flash-attention kernel vs the dense XLA reference (interpret mode on CPU;
+the same kernel Mosaic-compiles on a real chip — exercised by bench.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.flash_attention import flash_attention, supported
+
+
+def dense_ref(q, k, v, causal):
+    qt, kt, vt = [jnp.swapaxes(x, 1, 2) for x in (q, k, v)]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(q.shape[-1])
+    if causal:
+        m = jnp.tril(jnp.ones(s.shape[-2:], bool))
+        s = jnp.where(m, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward(causal):
+    rng = np.random.RandomState(0)
+    q, k, v = [jnp.asarray(rng.randn(2, 128, 2, 32).astype(np.float32))
+               for _ in range(3)]
+    out = flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, dense_ref(q, k, v, causal), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads(causal):
+    rng = np.random.RandomState(1)
+    q, k, v = [jnp.asarray(rng.randn(1, 64, 2, 16).astype(np.float32))
+               for _ in range(3)]
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal=causal) * v),
+                  argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: jnp.sum(dense_ref(q, k, v, causal) * v),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(a, b, atol=5e-5)
+
+
+def test_flash_cross_attention_lengths():
+    # sq != sk (cross attention / unequal blocks)
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(1, 32, 2, 16).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 128, 2, 16).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 128, 2, 16).astype(np.float32))
+    out = flash_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(out, dense_ref(q, k, v, False), atol=2e-5)
+
+
+def test_supported_predicate():
+    assert supported(512, 512, 64)
+    assert not supported(7, 512, 64)     # too short
+    assert not supported(512, 512, 63)   # head_dim not 8-aligned
