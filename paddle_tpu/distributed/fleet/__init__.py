@@ -97,14 +97,19 @@ class Fleet:
 
     # ---- model/optimizer wrapping (fleet_base.py:1038-1061) ----
     def distributed_model(self, model):
-        from ..meta_parallel import DataParallel, PipelineLayer
+        from ..meta_parallel import DataParallel, PipelineLayer, PipelineParallel
 
         if not self._is_initialized:
             self.init()
         hcg = self._hcg
-        if hcg.get_pipe_parallel_world_size() > 1 and not isinstance(model, PipelineLayer):
-            raise RuntimeError(
-                "pp_degree > 1 requires the model to be a PipelineLayer")
+        if hcg.get_pipe_parallel_world_size() > 1:
+            if isinstance(model, PipelineLayer):
+                return PipelineParallel(model, hcg, self._strategy)
+            if not getattr(model, "_pipeline_stacked", False):
+                # pipeline-stacked models (e.g. GPTForPretrainingPipe) run the SPMD
+                # schedule inside the engine and need no wrapper
+                raise RuntimeError(
+                    "pp_degree > 1 requires a PipelineLayer or a pipeline-stacked model")
         if hcg.get_parallel_mode() == "data_parallel" and hcg.nranks > 1:
             return DataParallel(model)
         # tensor/sharding/pipeline models execute through TrainStepEngine shardings;

@@ -5,6 +5,9 @@ from .mp_layers import (  # noqa: F401
 from .parallel_layers import get_rng_state_tracker, model_parallel_random_seed  # noqa: F401
 from .data_parallel import DataParallel  # noqa: F401
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .pipeline_parallel import (  # noqa: F401
+    PipelineParallel, PipelineParallelWithInterleave,
+)
 from .sharding import (  # noqa: F401
     GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
     group_sharded_parallel,

@@ -1,0 +1,95 @@
+"""SPMD pipeline-parallel schedule: scan + ppermute over the 'pp' mesh axis.
+
+Reference analogue: fleet/meta_parallel/pipeline_parallel.py:31 (PipelineParallel,
+forward_backward_pipeline:81 — host-driven 1F1B over NCCL p2p with SendRecvMeta shape
+negotiation, p2p_communication.py:26,39,217) and the static-graph SectionWorker
+(device_worker.h:615) running micro-batch sections in per-device threads.
+
+TPU-native redesign: the whole pipeline is ONE XLA computation. Each pp rank holds its
+stage's parameters (leading stage dim sharded over 'pp'); micro-batches rotate through
+the stages with `jax.lax.ppermute` (ICI neighbor hop) inside a `lax.scan` over
+M + S - 1 "clock ticks" (GPipe fill/steady/drain). There is no Python scheduler, no
+shape handshake (shapes are static in the traced program), and no separate comm stream
+(XLA overlaps the permute with the next tick's compute). The backward schedule is not
+hand-written: `jax.vjp` through scan+ppermute replays the ring in reverse, which is
+exactly the reference's backward pass ordering, and XLA pipelines it the same way.
+
+Cost model: bubble fraction = (S-1)/(M+S-1), same as GPipe/1F1B; activation working set
+is one micro-batch per stage plus the scan residuals (use jax.checkpoint in the body to
+trade FLOPs for HBM, the recompute_interval analogue).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def spmd_pipeline(body_fn, stage_params, x_mb, mesh, axis: str = "pp"):
+    """Run a homogeneous pipeline over the `axis` mesh dimension.
+
+    body_fn(stage_params_local, x) -> y
+        one stage's compute; x and y must share shape/dtype (activation shape is
+        uniform across stages, as in the reference's SendRecvMeta contract).
+    stage_params: pytree whose leaves have leading dim S (= mesh.shape[axis]); leaf i
+        along that dim is stage i's parameters. Sharded over `axis` by this call.
+    x_mb: [M, micro_batch, ...] micro-batched activations, replicated over `axis`
+        (other mesh axes — dp/mp/sp — stay under GSPMD auto sharding).
+    Returns [M, micro_batch, ...] outputs of the last stage, replicated over `axis`.
+
+    Differentiable: reverse-mode AD through the scan gives the backward pipeline.
+    """
+    S = int(mesh.shape[axis])
+    if S == 1:
+        squeezed = jax.tree.map(lambda l: jnp.squeeze(l, 0), stage_params)
+        return jax.vmap(lambda x: body_fn(squeezed, x))(x_mb)
+
+    param_specs = jax.tree.map(lambda _: P(axis), stage_params)
+    xspec = P()
+
+    def local(params, mb):
+        params = jax.tree.map(lambda l: jnp.squeeze(l, 0), params)
+        stage = jax.lax.axis_index(axis)
+        M = mb.shape[0]
+        n_ticks = M + S - 1
+        state = jnp.zeros_like(mb[0])
+        out = jnp.zeros_like(mb)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, out = carry
+            # stage 0 ingests micro-batch t (clamped reads past the end are
+            # discarded: their outputs never land in a valid out slot)
+            inp = jax.lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, M - 1), 0,
+                                               keepdims=False)
+            cur = jnp.where(stage == 0, inp, state)
+            y = body_fn(params, cur)
+            # last stage emits micro-batch t-(S-1) once the pipe is full
+            oidx = t - (S - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                out, y.astype(out.dtype), jnp.clip(oidx, 0, M - 1), 0)
+            out = jnp.where(jnp.logical_and(stage == S - 1, oidx >= 0), upd, out)
+            # rotate activations one hop along the ring (stage s -> s+1)
+            state = jax.lax.ppermute(y, axis, perm)
+            return (state, out), None
+
+        (_, out), _ = jax.lax.scan(tick, (state, out), jnp.arange(n_ticks))
+        # replicate the result over the pp axis (only the last stage holds it)
+        return jax.lax.psum(jnp.where(stage == S - 1, out, jnp.zeros_like(out)), axis)
+
+    return jax.shard_map(local, mesh=mesh, in_specs=(param_specs, xspec),
+                         out_specs=xspec, axis_names={axis},
+                         check_vma=False)(stage_params, x_mb)
+
+
+def microbatch_split(x, num_micro: int):
+    """[B, ...] -> [M, B/M, ...]; B must divide by num_micro."""
+    b = x.shape[0]
+    if b % num_micro != 0:
+        raise ValueError(f"batch {b} not divisible by {num_micro} micro-batches")
+    return x.reshape((num_micro, b // num_micro) + tuple(x.shape[1:]))
+
+
+def microbatch_merge(x):
+    """[M, mb, ...] -> [M*mb, ...]."""
+    return x.reshape((x.shape[0] * x.shape[1],) + tuple(x.shape[2:]))

@@ -1,1 +1,4 @@
-from .gpt import GPTConfig, GPTForPretraining, GPTModel, gpt_tiny, gpt_1p3b, gpt_345m  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTForPretraining, GPTForPretrainingPipe, GPTModel, gpt_tiny,
+    gpt_1p3b, gpt_345m,
+)

@@ -134,6 +134,160 @@ class GPTModel(nn.Layer):
         return self.ln_f(x)
 
 
+class GPTForPretrainingPipe(nn.Layer):
+    """Pipeline-parallel GPT (the reference's GPTForPretrainingPipe/PipelineLayer
+    analogue, fleet/meta_parallel/pp_layers.py:159 + pipeline_parallel.py:31).
+
+    The transformer body is stored as stacked per-stage parameters with leading dims
+    [S, L/S, ...] where S = pp degree: the 'pp' mesh axis shards the stage dim, 'mp'
+    shards the Megatron dims, and the body executes as an SPMD scan+ppermute pipeline
+    (distributed/pipeline_schedule.py). Embedding / final-LN / loss are replicated over
+    pp (computed identically on every pp rank — they are outside the bubble), matching
+    the reference's shared-embedding stages without the p2p tie-grad allreduce.
+
+    forward(input_ids, labels) -> scalar LM loss, same engine signature as
+    GPTForPretraining; with pp degree 1 it degrades to a plain scan over all layers.
+    """
+
+    def __init__(self, config: GPTConfig, num_stages=None, num_microbatches=None):
+        super().__init__()
+        from jax.sharding import PartitionSpec as PS
+
+        from ..distributed.mesh import get_hybrid_communicate_group
+        from ..nn import initializer as I
+
+        hcg = get_hybrid_communicate_group()
+        self.config = config
+        if config.dropout or config.attention_dropout:
+            raise ValueError(
+                "GPTForPretrainingPipe does not support dropout yet (needs per-stage "
+                "RNG plumbing through the SPMD schedule); set dropout=0")
+        self.num_stages = int(num_stages or (hcg.degrees["pp"] if hcg else 1))
+        if config.num_layers % self.num_stages != 0:
+            raise ValueError(
+                f"num_layers {config.num_layers} not divisible by pp {self.num_stages}")
+        self.layers_per_stage = config.num_layers // self.num_stages
+        self.num_microbatches = int(num_microbatches or max(1, self.num_stages))
+
+        H, FF = config.hidden_size, config.ffn_hidden_size
+        S, Lp = self.num_stages, self.layers_per_stage
+        self.wte = VocabParallelEmbedding(config.vocab_size, H)
+        self.wpe = nn.Embedding(config.max_seq_len, H)
+        self.ln_f = nn.LayerNorm(H)
+        self.loss_fn = ParallelCrossEntropy()
+
+        def mk(name, shape, spec, init):
+            p = self.create_parameter(shape, default_initializer=init)
+            p.dist_attr = spec
+            self.add_parameter(name, p)
+
+        w = I.Normal(std=0.02)
+        zeros, ones = I.Constant(0.0), I.Constant(1.0)
+        mk("qkv_w", (S, Lp, H, 3 * H), PS("pp", None, None, "mp"), w)
+        mk("qkv_b", (S, Lp, 3 * H), PS("pp", None, "mp"), zeros)
+        mk("proj_w", (S, Lp, H, H), PS("pp", None, "mp", None), w)
+        mk("proj_b", (S, Lp, H), PS("pp"), zeros)
+        mk("ln1_s", (S, Lp, H), PS("pp"), ones)
+        mk("ln1_b", (S, Lp, H), PS("pp"), zeros)
+        mk("ln2_s", (S, Lp, H), PS("pp"), ones)
+        mk("ln2_b", (S, Lp, H), PS("pp"), zeros)
+        mk("fc1_w", (S, Lp, H, FF), PS("pp", None, None, "mp"), w)
+        mk("fc1_b", (S, Lp, FF), PS("pp", None, "mp"), zeros)
+        mk("fc2_w", (S, Lp, FF, H), PS("pp", None, "mp", None), w)
+        mk("fc2_b", (S, Lp, H), PS("pp"), zeros)
+        if not config.tie_word_embeddings:
+            mk("lm_head_w", (H, config.vocab_size), PS(None, "mp"), w)
+
+    _STACKED = ("qkv_w", "qkv_b", "proj_w", "proj_b", "ln1_s", "ln1_b",
+                "ln2_s", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b")
+    _pipeline_stacked = True  # fleet.distributed_model pp-mode marker
+
+    def forward(self, input_ids, labels=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.dispatch import apply
+        from ..distributed.mesh import get_hybrid_communicate_group
+        from ..distributed.pipeline_schedule import (
+            microbatch_merge, microbatch_split, spmd_pipeline)
+        from ..jit import in_jit_trace
+
+        cfg = self.config
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        s = input_ids.shape[1]
+        pos = C.arange(0, s, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+
+        hcg = get_hybrid_communicate_group()
+        use_spmd = (in_jit_trace() and hcg is not None
+                    and hcg.degrees["pp"] == self.num_stages)
+        mesh = hcg.mesh if use_spmd else None
+        n_micro = self.num_microbatches
+
+        use_recompute = cfg.use_recompute
+
+        def kernel(xa, *flat):
+            params = dict(zip(self._STACKED, flat))
+            def body(lp, h):
+                def one(h, layer):
+                    return _pipe_block_fwd(h, layer, nh, hd), None
+                if use_recompute:  # recompute_interval analogue: checkpoint each block
+                    one = jax.checkpoint(one)
+                h, _ = jax.lax.scan(one, h, lp)
+                return h
+            if mesh is not None:
+                mb = microbatch_split(xa, n_micro)
+                return microbatch_merge(spmd_pipeline(body, params, mb, mesh, "pp"))
+            # single-program fallback: same math, all stages scanned in sequence
+            merged = jax.tree.map(
+                lambda l: l.reshape((l.shape[0] * l.shape[1],) + l.shape[2:]), params)
+            return body(merged, xa)
+
+        h = apply("gpt_pipe_body", kernel, [x] + [getattr(self, n) for n in self._STACKED])
+        h = self.ln_f(h)
+        from ..ops import linalg as L
+        from ..ops import reduction as R
+
+        if cfg.tie_word_embeddings:
+            logits = L.matmul(h, self.wte.weight, transpose_y=True)
+        else:
+            logits = L.matmul(h, self.lm_head_w)
+        if labels is None:
+            return logits
+        return R.mean(self.loss_fn(logits, labels))
+
+
+def _pipe_block_fwd(x, p, nh, hd):
+    """One transformer block in plain jnp (runs inside shard_map/scan).
+
+    LayerNorm/softmax in f32, matmuls in the input dtype (bf16 under amp) — the same
+    numerics as GPTBlock's ops-path forward.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def ln(h, scale, bias):
+        hf = h.astype(jnp.float32)
+        mu = jnp.mean(hf, -1, keepdims=True)
+        var = jnp.mean(jnp.square(hf - mu), -1, keepdims=True)
+        return ((hf - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias).astype(h.dtype)
+
+    b, s, H = x.shape
+    h = ln(x, p["ln1_s"], p["ln1_b"])
+    qkv = h @ p["qkv_w"] + p["qkv_b"]
+    qkv = qkv.reshape(b, s, 3, nh, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(hd)
+    mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    attn = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(b, s, nh * hd)
+    x = x + o @ p["proj_w"] + p["proj_b"]
+    h2 = ln(x, p["ln2_s"], p["ln2_b"])
+    m = jax.nn.gelu(h2 @ p["fc1_w"] + p["fc1_b"], approximate=True)
+    return x + m @ p["fc2_w"] + p["fc2_b"]
+
+
 class GPTForPretraining(nn.Layer):
     """forward(input_ids, labels) -> scalar LM loss (the engine's expected signature)."""
 
