@@ -29,6 +29,15 @@ from .tensor import Tensor
 
 KERNELS: Dict[str, Callable] = {}
 
+# static-graph capture hook (installed by paddle_tpu.static.framework): when an op
+# input is a symbolic Variable the op is recorded as an OpDesc, not executed
+_symbolic_handler = None
+
+
+def set_symbolic_handler(fn):
+    global _symbolic_handler
+    _symbolic_handler = fn
+
 _amp_state = threading.local()
 
 # AMP op lists: the analogue of the reference's black/white lists
@@ -111,6 +120,9 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
     differentiable=False: never record (comparisons, int-valued ops).
     """
     attrs = attrs or {}
+    if _symbolic_handler is not None and any(
+            getattr(t, "is_symbolic", False) for t in tensor_args):
+        return _symbolic_handler(name, kernel, tensor_args, attrs, differentiable)
     arrays = [t._data for t in tensor_args]
 
     cast_to = _autocast_dtype_for(name, arrays)

@@ -29,7 +29,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core import random as random_mod
 from ..core.tensor import Tensor
 from ..jit import functional_call
-from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 from ..optimizer import functional as opt_funct
 from .mesh import HybridCommunicateGroup, get_hybrid_communicate_group
 
@@ -163,22 +162,7 @@ class TrainStepEngine:
                 return loss._data if isinstance(loss, Tensor) else loss
 
             loss, grads = jax.value_and_grad(compute_loss)(params)
-
-            if isinstance(clip, ClipGradByGlobalNorm):
-                gn_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                            for g in grads.values())
-                gn = jnp.sqrt(gn_sq)
-                scale = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
-                grads = {n: (g * scale).astype(g.dtype) for n, g in grads.items()}
-            elif isinstance(clip, ClipGradByNorm):
-                grads = {
-                    n: (g * jnp.minimum(
-                        clip.clip_norm / jnp.maximum(
-                            jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32)))), 1e-12),
-                        1.0)).astype(g.dtype)
-                    for n, g in grads.items()}
-            elif isinstance(clip, ClipGradByValue):
-                grads = {n: jnp.clip(g, clip.min, clip.max) for n, g in grads.items()}
+            grads = opt_funct.clip_grads(grads, clip)
 
             new_params = {}
             new_opt = {}

@@ -23,9 +23,9 @@ class Optimizer:
 
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, **kwargs):
-        if parameters is None:
-            raise ValueError("dygraph optimizer requires `parameters`")
-        self._parameter_list = list(parameters)
+        # parameters=None is legal in static mode (minimize binds the program's
+        # captured Parameters at lowering); dygraph step() requires them
+        self._parameter_list = list(parameters) if parameters is not None else []
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         wd = weight_decay
@@ -65,6 +65,10 @@ class Optimizer:
 
     @no_grad()
     def step(self):
+        if not self._parameter_list:
+            raise ValueError(
+                "optimizer has no parameters; pass `parameters=` for dygraph use "
+                "(parameters=None is only valid with static-mode minimize)")
         self._step_count += 1
         lr_val = self.get_lr()
         params_grads = [(p, p.grad) for p in self._parameter_list
@@ -96,6 +100,11 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        if getattr(loss, "is_symbolic", False):
+            # static mode: attach the train spec; Executor lowers backward +
+            # update via jax.grad at compile time (append_backward analogue)
+            loss.block.program._train = (loss.name, self)
+            return None, []
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._parameter_list]

@@ -37,6 +37,33 @@ def init_state(rule: str, param):
     raise ValueError(rule)
 
 
+def clip_grads(grads: dict, clip):
+    """Apply a paddle grad-clip rule over a name->grad dict (traced-safe).
+
+    The analogue of ClipGradByGlobalNorm/_ByNorm/_ByValue application inside the
+    fused step (reference python/paddle/fluid/clip.py); shared by the pjit engine
+    and the static Executor lowering."""
+    from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+
+    if clip is None:
+        return grads
+    if isinstance(clip, ClipGradByGlobalNorm):
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for g in grads.values()))
+        scale = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
+        return {n: (g * scale).astype(g.dtype) for n, g in grads.items()}
+    if isinstance(clip, ClipGradByNorm):
+        return {
+            n: (g * jnp.minimum(
+                clip.clip_norm / jnp.maximum(
+                    jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32)))), 1e-12),
+                1.0)).astype(g.dtype)
+            for n, g in grads.items()}
+    if isinstance(clip, ClipGradByValue):
+        return {n: jnp.clip(g, clip.min, clip.max) for n, g in grads.items()}
+    return grads
+
+
 def sgd(param, grad, state, *, lr, weight_decay=0.0):
     g = grad.astype(jnp.float32)
     if weight_decay:

@@ -1,20 +1,27 @@
-"""paddle.static equivalent (round-1 slice).
+"""paddle.static equivalent: Program IR + Executor over one jitted XLA computation.
 
-Reference: python/paddle/static + fluid/framework.py Program/Block + executor.py:619.
-TPU-native plan (SURVEY.md §7 step 4): a Program IR whose Executor *traces the whole program to
-one XLA computation* — the InterpreterCore instruction list becomes a jitted function. The
-round-1 slice gives the user-facing Program/data/Executor API running on the traced path; the
-protobuf-style IR + passes land next.
+Reference: python/paddle/static + fluid/framework.py (Program/Block/Operator,
+executor.py:619). See framework.py / executor.py here for the TPU-native design notes
+(ops recorded at the dispatch seam; InterpreterCore ≙ jit cache; backward appended by
+AD at lowering).
 """
 from __future__ import annotations
 
-from ..core.tensor import Tensor
 from ..core.place import CPUPlace, TPUPlace  # noqa: F401
-
+from .framework import (  # noqa: F401
+    Block, OpDesc, Program, Variable, data, default_main_program,
+    default_startup_program, program_guard,
+)
+from .executor import (  # noqa: F401
+    BuildStrategy, CompiledProgram, ExecutionStrategy, Executor, Scope,
+    global_scope, scope_guard,
+)
 from . import nn  # noqa: F401
 
 
 class InputSpec:
+    """Shape/dtype declaration for jit.to_static (paddle.static.InputSpec)."""
+
     def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
         self.shape = tuple(shape)
         self.dtype = dtype
@@ -29,53 +36,17 @@ class InputSpec:
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
 
 
-def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape, dtype, name)
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Mark `loss` for training; grads materialize inside the Executor lowering
+    (jax.grad over the replayed program) rather than as explicit grad OpDescs.
+    Pair with Optimizer.minimize(loss), which installs the optimizer rule."""
+    prog = loss.block.program
+    if prog._train is None:
+        prog._train = (loss.name, None)
+    return []
 
 
-class Program:
-    """Placeholder IR container — filled by the static-graph milestone."""
-
-    def __init__(self):
-        self.ops = []
-        self.vars = {}
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        import copy
-
-        return copy.copy(self)
-
-
-_default_main = Program()
-_default_startup = Program()
-
-
-def default_main_program():
-    return _default_main
-
-
-def default_startup_program():
-    return _default_startup
-
-
-class Executor:
-    def __init__(self, place=None):
-        self.place = place
-
-    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        raise NotImplementedError(
-            "static Executor lands with the Program IR milestone; use dygraph or "
-            "paddle_tpu.jit.to_static (whole-program XLA tracing) meanwhile")
-
-
-def program_guard(main_program, startup_program=None):
+def name_scope(prefix=None):
     import contextlib
 
-    @contextlib.contextmanager
-    def guard():
-        yield
-
-    return guard()
+    return contextlib.nullcontext()

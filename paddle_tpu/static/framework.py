@@ -1,0 +1,320 @@
+"""Static-graph IR: Program / Block / OpDesc / Variable + op capture.
+
+Reference: the ProgramDesc protobuf IR (paddle/fluid/framework/framework.proto,
+program_desc.h, python/paddle/fluid/framework.py Program/Block/Operator) built by the
+Python API appending OpDescs, then executed by Executor/InterpreterCore
+(SURVEY.md §3.4).
+
+TPU-native redesign: the IR records (op name, kernel, inputs, attrs, outputs) at the
+SAME dispatch point every eager op goes through (core/dispatch.apply) — when an op sees
+a symbolic Variable input it appends an OpDesc instead of executing. The Executor then
+lowers the whole op list into ONE jitted XLA computation (the InterpreterCore
+instruction list becomes a single compiled program; XLA does the stream analysis,
+scheduling and memory planning the reference's interpreter does by hand). Concrete
+Tensors touched by captured ops (parameters, constants) are recorded as program
+captures; trainable Parameters become differentiable leaves of the lowered step.
+
+Shape inference (the infermeta analogue) is jax.eval_shape over the recorded kernel —
+exact by construction — and degrades to unknown (-1) dims when inputs carry dynamic
+batch dims; unknown shapes resolve at first Executor.run when real feeds arrive.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core import dispatch as _dispatch
+from ..core.tensor import Tensor
+
+_state = threading.local()
+
+
+def _cur_program() -> Optional["Program"]:
+    return getattr(_state, "program", None)
+
+
+def _cur_startup() -> Optional["Program"]:
+    return getattr(_state, "startup", None)
+
+
+class Variable(Tensor):
+    """Symbolic tensor living in a Block (VarDesc + Variable in the reference)."""
+
+    is_symbolic = True
+
+    def __init__(self, block, name, shape, dtype, stop_gradient=True, persistable=False):
+        # deliberately NOT calling Tensor.__init__: there is no concrete data
+        self.block = block
+        self.name = name
+        self._shape = [(-1 if s is None else int(s)) for s in shape]
+        self._dtype = dtypes.convert_dtype(dtype)
+        self._stop_gradient = stop_gradient
+        self.persistable = persistable
+        self._grad = None
+        self._node = None
+        self._out_index = 0
+        self._hooks = []
+        self._retain_grads = False
+
+    @property
+    def _data(self):
+        if all(d >= 0 for d in self._shape):
+            return jax.ShapeDtypeStruct(tuple(self._shape), self._dtype)
+        raise RuntimeError(
+            f"symbolic Variable '{self.name}' with dynamic shape {self._shape} has no "
+            "concrete value; run it through paddle.static.Executor")
+
+    @_data.setter
+    def _data(self, v):  # pragma: no cover - assignment is a usage error
+        raise RuntimeError(f"cannot assign data to symbolic Variable '{self.name}'")
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' is symbolic; fetch it via Executor.run")
+
+    def __repr__(self):
+        return f"Variable(name={self.name}, shape={self._shape}, dtype={self._dtype})"
+
+
+class OpDesc:
+    """One recorded op: the kernel IS the lowering (phi-kernel handle analogue)."""
+
+    __slots__ = ("type", "kernel", "input_names", "output_names", "attrs")
+
+    def __init__(self, type: str, kernel: Callable, input_names: List[str],
+                 output_names: List[str], attrs: Dict):
+        self.type = type
+        self.kernel = kernel
+        self.input_names = input_names
+        self.output_names = output_names
+        self.attrs = attrs
+
+    def __repr__(self):
+        return (f"{', '.join(self.output_names)} = {self.type}"
+                f"({', '.join(self.input_names)})")
+
+
+class Block:
+    def __init__(self, program, idx=0):
+        self.program = program
+        self.idx = idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[OpDesc] = []
+
+    def create_var(self, name=None, shape=(), dtype="float32", stop_gradient=True,
+                   persistable=False):
+        name = name or self.program._unique_name("tmp")
+        v = Variable(self, name, shape, dtype, stop_gradient, persistable)
+        self.vars[name] = v
+        return v
+
+    def var(self, name):
+        return self.vars[name]
+
+    def __repr__(self):
+        return "\n".join(repr(op) for op in self.ops)
+
+
+class Program:
+    """The IR container (ProgramDesc analogue). One global block in round 1 —
+    control flow lowers to lax.cond/scan inside kernels, not to sub-blocks."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.random_seed = 0
+        self._counter = 0
+        self._captures: Dict[str, Tensor] = {}   # concrete tensors used by ops
+        self._capture_ids: Dict[int, str] = {}
+        self._train = None                        # (loss_name, optimizer)
+        self._version = 0                         # bumped per recorded op
+        self._opt_state = {}                      # param name -> optimizer state
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[-1]
+
+    def _unique_name(self, stem):
+        self._counter += 1
+        return f"{stem}_{self._counter}"
+
+    def capture(self, t: Tensor) -> str:
+        """Register a concrete Tensor consumed by a recorded op; returns its name."""
+        key = id(t)
+        if key in self._capture_ids:
+            return self._capture_ids[key]
+        trainable = (not t.stop_gradient)
+        stem = "param" if trainable else "const"
+        name = self._unique_name(f"@{stem}")
+        self._captures[name] = t
+        self._capture_ids[key] = name
+        return name
+
+    def parameters(self):
+        return {n: t for n, t in self._captures.items() if not t.stop_gradient}
+
+    def list_vars(self):
+        return list(self.global_block().vars.values())
+
+    def clone(self, for_test=False):
+        import copy
+
+        p = copy.copy(self)
+        p.blocks = []
+        for b in self.blocks:
+            nb = Block(p, b.idx)
+            nb.vars = dict(b.vars)
+            nb.ops = list(b.ops)
+            p.blocks.append(nb)
+        p._captures = dict(self._captures)
+        p._capture_ids = dict(self._capture_ids)
+        p._opt_state = {}
+        if for_test:
+            p._train = None
+        return p
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        return "\n".join(repr(b) for b in self.blocks)
+
+    __repr__ = to_string
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return getattr(_state, "default_main", None) or _default_main
+
+
+def default_startup_program() -> Program:
+    return getattr(_state, "default_startup", None) or _default_startup
+
+
+class program_guard:
+    """`with program_guard(main, startup):` — ops record into `main`."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        self._prev = (_cur_program(), _cur_startup(),
+                      getattr(_state, "default_main", None),
+                      getattr(_state, "default_startup", None))
+        _state.program = self.main
+        _state.startup = self.startup
+        _state.default_main = self.main
+        _state.default_startup = self.startup or _default_startup
+        return self
+
+    def __exit__(self, *exc):
+        (_state.program, _state.startup,
+         _state.default_main, _state.default_startup) = self._prev
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0) -> Variable:
+    """Declare a feed Variable in the current (or default) main program."""
+    prog = _cur_program() or default_main_program()
+    block = prog.current_block()
+    if name in block.vars:
+        raise ValueError(f"feed var '{name}' already exists")
+    v = Variable(block, name, shape, dtype, stop_gradient=True)
+    block.vars[name] = v
+    return v
+
+
+# ---- the dispatch hook: record instead of execute -------------------------------
+
+def _infer_meta(kernel, in_vars, attrs):
+    """eval_shape when every input shape is static; unknown otherwise."""
+    known = all(
+        all(d >= 0 for d in (v.shape if isinstance(v, Variable) else v.shape))
+        for v in in_vars)
+    if not known:
+        return None
+    ins = [jax.ShapeDtypeStruct(tuple(v.shape), v.dtype if isinstance(v, Variable)
+                                else v._data.dtype) for v in in_vars]
+    try:
+        out = jax.eval_shape(lambda *a: kernel(*a, **attrs), *ins)
+    except Exception:
+        return None
+    return out
+
+
+def _record_op(name, kernel, tensor_args, attrs, differentiable):
+    prog = None
+    for t in tensor_args:
+        if isinstance(t, Variable):
+            prog = t.block.program
+            break
+    assert prog is not None
+    block = prog.current_block()
+
+    in_names = []
+    for t in tensor_args:
+        if isinstance(t, Variable):
+            in_names.append(t.name)
+        else:
+            in_names.append(prog.capture(t))
+
+    meta = _infer_meta(kernel, tensor_args, attrs)
+    if meta is not None:
+        multi = isinstance(meta, (tuple, list))
+        metas = [(m.shape, m.dtype) for m in (meta if multi else [meta])]
+    else:
+        # dynamic input dims: probe twice (unknown dims -> 1 then 2); output dims
+        # that differ between probes depend on the dynamic dims and stay -1
+        def probe_with(fill):
+            ins = [jax.ShapeDtypeStruct(
+                tuple(fill if d < 0 else d for d in v.shape),
+                v.dtype if isinstance(v, Variable) else v._data.dtype)
+                for v in tensor_args]
+            return jax.eval_shape(lambda *a: kernel(*a, **attrs), *ins)
+
+        try:
+            m1, m2 = probe_with(1), probe_with(2)
+        except Exception as e:
+            raise RuntimeError(
+                f"cannot record op '{name}' with dynamic input shapes: shape "
+                f"probe failed ({e}); declare static shapes in static.data") from e
+        multi = isinstance(m1, (tuple, list))
+        pairs = zip(m1 if multi else [m1], m2 if multi else [m2])
+        metas = [
+            (tuple(a if a == b else -1 for a, b in zip(s1.shape, s2.shape)), s1.dtype)
+            for s1, s2 in pairs]
+
+    # grads can flow to any float output when any differentiable input requires grad
+    any_grad = differentiable and any(
+        not t.stop_gradient for t in tensor_args)
+
+    outs = []
+    for shape, dt in metas:
+        v = block.create_var(prog._unique_name(name), shape, dt,
+                             stop_gradient=not any_grad)
+        outs.append(v)
+    block.ops.append(OpDesc(name, lambda *a, _k=kernel, _at=dict(attrs): _k(*a, **_at),
+                            in_names, [o.name for o in outs], dict(attrs)))
+    prog._version += 1
+    return tuple(outs) if multi else outs[0]
+
+
+_dispatch.set_symbolic_handler(_record_op)

@@ -1,2 +1,58 @@
-"""static.nn placeholder — functional layers shared with nn.functional."""
-from ..ops.nn_functional import *  # noqa: F401,F403
+"""paddle.static.nn: layer-functions that record into the current Program.
+
+Reference: python/paddle/static/nn/common.py (fc, embedding, conv2d, ...) which append
+configured OpDescs + create persistable parameter vars. TPU-native: each call
+instantiates the corresponding eager nn.Layer ONCE per call site (parameters concrete,
+captured by the program as trainable leaves) and runs it on the symbolic input — the
+ops record through the normal dispatch seam.
+
+Note: batch_norm's running-stat mutation is dygraph-only; use nn.BatchNorm under
+jit.to_static for that behavior.
+"""
+from __future__ import annotations
+
+from ..ops.nn_functional import *  # noqa: F401,F403 (functional parity surface)
+
+from .. import nn as _nn
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    in_features = 1
+    for d in x.shape[num_flatten_dims:]:
+        if d < 0:
+            raise ValueError("fc needs static feature dims")
+        in_features *= d
+    layer = _nn.Linear(in_features, size, weight_attr=weight_attr, bias_attr=bias_attr)
+    if len(x.shape) > num_flatten_dims + 1:
+        from ..ops import manipulation as M
+
+        x = M.reshape(x, tuple(x.shape[:num_flatten_dims]) + (in_features,))
+    out = layer(x)
+    if activation:
+        from .. import nn
+
+        out = getattr(nn.functional, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype="float32"):
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          weight_attr=param_attr)
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, data_format="NCHW"):
+    in_channels = input.shape[3] if data_format == "NHWC" else input.shape[1]
+    layer = _nn.Conv2D(in_channels, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    out = layer(input)
+    if act:
+        from .. import nn
+
+        out = getattr(nn.functional, act)(out)
+    return out

@@ -1,0 +1,168 @@
+"""Static-graph Program IR + Executor tests (SURVEY.md §3.4 path).
+
+Covers: op capture into OpDescs, shape inference, Executor forward lowering,
+Optimizer.minimize training through the lowered step (loss parity with dygraph),
+program_guard isolation, clone(for_test), and static.nn layers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+import paddle_tpu.nn as nn
+
+
+def test_capture_and_infer():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 8], "float32")
+        y = x * 2.0 + 1.0
+        z = paddle.matmul(y, paddle.to_tensor(np.ones((8, 3), np.float32)))
+    assert isinstance(y, static.Variable)
+    assert z.shape == [4, 3]
+    assert len(main.global_block().ops) == 3
+    assert main.global_block().ops[0].type in ("multiply", "scale")
+
+
+def test_executor_forward():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 3], "float32")
+        out = paddle.nn.functional.relu(x - 1.0)
+    exe = static.Executor()
+    xs = np.array([[0.5, 1.5, 2.0], [-1.0, 1.0, 3.0]], np.float32)
+    (res,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(res, np.maximum(xs - 1.0, 0.0), rtol=1e-6)
+
+
+def test_static_linear_regression_trains():
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 4).astype(np.float32)
+    w_true = np.array([[2.0], [-1.0], [0.5], [3.0]], np.float32)
+    ys = xs @ w_true + 1.0
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [64, 4], "float32")
+        y = static.data("y", [64, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss = paddle.mean((pred - y) ** 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(60):
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 0.01, losses[::10]
+    assert losses[-1] < losses[0] / 100
+
+
+def test_static_matches_dygraph_loss():
+    """First-step loss of a static fc must equal the dygraph Linear with the same
+    params — the dygraph_to_static parity contract (SURVEY.md §4)."""
+    rng = np.random.RandomState(1)
+    xs = rng.randn(8, 5).astype(np.float32)
+    ys = rng.randn(8, 2).astype(np.float32)
+
+    paddle.seed(42)
+    lin = nn.Linear(5, 2)
+    eager_loss = float(paddle.mean((lin(paddle.to_tensor(xs)) -
+                                    paddle.to_tensor(ys)) ** 2).item())
+
+    paddle.seed(42)
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [8, 5], "float32")
+        y = static.data("y", [8, 2], "float32")
+        pred = static.nn.fc(x, 2)
+        loss = paddle.mean((pred - y) ** 2)
+    exe = static.Executor()
+    (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    np.testing.assert_allclose(float(lv), eager_loss, rtol=1e-5)
+
+
+def test_clone_for_test_drops_train():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [4, 2], "float32")
+        loss = paddle.mean(static.nn.fc(x, 1))
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    assert main._train is not None
+    test_prog = main.clone(for_test=True)
+    assert test_prog._train is None
+    assert len(test_prog.global_block().ops) == len(main.global_block().ops)
+
+
+def test_program_guard_isolation():
+    p1, p2 = static.Program(), static.Program()
+    with static.program_guard(p1, static.Program()):
+        a = static.data("a", [2], "float32")
+        _ = a + 1.0
+        with static.program_guard(p2, static.Program()):
+            b = static.data("b", [2], "float32")
+            _ = b * 3.0
+        _ = a - 1.0
+    assert len(p1.global_block().ops) == 2
+    assert len(p2.global_block().ops) == 1
+
+
+def test_default_program_survives_guard():
+    # regression: guard exit must not poison default_main_program (review r2)
+    with static.program_guard(static.Program(), static.Program()):
+        pass
+    v = static.data("x_guard_regress", [2, 2])
+    assert v.block.program is static.default_main_program()
+
+
+def test_clone_is_isolated():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [2, 2])
+        _ = x + 1.0
+    clone = main.clone(for_test=True)
+    with static.program_guard(main, static.Program()):
+        _ = x * 2.0
+    assert len(main.global_block().ops) == 2
+    assert len(clone.global_block().ops) == 1
+
+
+def test_dynamic_batch_dim():
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [None, 3], "float32")
+        out = paddle.nn.functional.relu(x * 2.0)
+        assert out.shape[0] == -1 and out.shape[1] == 3
+    exe = static.Executor()
+    for b in (2, 5):  # two batch sizes through the same program
+        xs = np.ones((b, 3), np.float32)
+        (res,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        assert res.shape == (b, 3)
+
+
+def test_dygraph_optimizer_without_params_raises():
+    opt = paddle.optimizer.SGD(learning_rate=0.1)
+    with pytest.raises(ValueError):
+        opt.step()
+
+
+def test_param_updates_visible_in_dygraph():
+    """Static training updates the SAME Parameter objects the layer owns."""
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [16, 3], "float32")
+        y = static.data("y", [16, 1], "float32")
+        lin = nn.Linear(3, 1)
+        before = lin.weight.numpy().copy()
+        loss = paddle.mean((lin(x) - y) ** 2)
+        paddle.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = static.Executor()
+    rng = np.random.RandomState(0)
+    exe.run(main, feed={"x": rng.randn(16, 3).astype(np.float32),
+                        "y": rng.randn(16, 1).astype(np.float32)},
+            fetch_list=[loss])
+    after = lin.weight.numpy()
+    assert not np.allclose(before, after)
