@@ -49,6 +49,8 @@ from . import jit  # noqa: E402
 from . import static  # noqa: E402
 from . import metric  # noqa: E402
 from . import profiler  # noqa: E402
+from . import hapi  # noqa: E402
+from .hapi import Model  # noqa: E402
 from .framework import io as _fw_io  # noqa: E402
 from .framework.io import load, save  # noqa: E402
 from .jit import to_static  # noqa: E402
@@ -77,8 +79,7 @@ def is_grad_enabled_():  # legacy alias
     return is_grad_enabled()
 
 
-def summary(net, input_size=None, dtypes=None):
-    n_params = sum(p.size for p in net.parameters())
-    trainable = sum(p.size for p in net.parameters() if not p.stop_gradient)
-    print(f"Total params: {n_params}\nTrainable params: {trainable}")
-    return {"total_params": n_params, "trainable_params": trainable}
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+
+    return _summary(net, input_size, dtypes=dtypes, input=input)

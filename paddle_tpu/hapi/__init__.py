@@ -1,0 +1,14 @@
+"""High-level API (hapi): Keras-like Model.prepare/fit/evaluate/predict.
+
+Reference: python/paddle/hapi/model.py:907 (Model), :1557 (fit); callbacks at
+python/paddle/hapi/callbacks.py. The reference wraps both dygraph and static graph
+adapters; TPU-natively there is one adapter — the eager path, whose hot train step is
+already a fused XLA computation via the optimizer/autograd stack.
+"""
+from .model import Model
+from .callbacks import (Callback, CallbackList, EarlyStopping, LRScheduler,
+                        ModelCheckpoint, ProgBarLogger, VisualDL)
+from .summary import summary
+
+__all__ = ["Model", "Callback", "CallbackList", "EarlyStopping", "LRScheduler",
+           "ModelCheckpoint", "ProgBarLogger", "VisualDL", "summary"]

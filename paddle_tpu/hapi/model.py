@@ -1,0 +1,289 @@
+"""hapi Model: the Keras-like training facade.
+
+Reference: python/paddle/hapi/model.py:907 (Model), :1486 (evaluate), :1557 (fit).
+The reference dispatches to a DynamicGraphAdapter or StaticGraphAdapter; here the
+eager engine is the single adapter — its loss.backward()/opt.step() path is already
+one fused XLA computation, so there is nothing to gain from a separate static path.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_tensors(xs):
+    out = []
+    for x in _to_list(xs):
+        out.append(x if isinstance(x, Tensor) else Tensor(np.asarray(x)))
+    return out
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._save_dir = None
+        self.stop_training = False
+
+    # ---- configuration ----
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss must be callable (a loss Layer or function)")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} is not a paddle.metric.Metric")
+        self._amp_configs = amp_configs or {}
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    # ---- single-batch primitives ----
+    def train_batch(self, inputs, labels=None, update=True):
+        assert self._optimizer is not None, "call prepare() with an optimizer first"
+        self.network.train()
+        inputs, labels = _to_tensors(inputs), _to_tensors(labels)
+        outputs = _to_list(self.network(*inputs))
+        losses = self._compute_loss(outputs, labels)
+        total = losses[0]
+        for extra in losses[1:]:
+            total = total + extra
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        loss_vals = [float(l.item()) for l in losses]
+        return (loss_vals, metrics) if metrics else loss_vals
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs, labels = _to_tensors(inputs), _to_tensors(labels)
+        with no_grad():
+            outputs = _to_list(self.network(*inputs))
+            losses = self._compute_loss(outputs, labels) if self._loss else []
+        metrics = self._update_metrics(outputs, labels)
+        loss_vals = [float(l.item()) for l in losses]
+        return (loss_vals, metrics) if metrics else loss_vals
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = _to_tensors(inputs)
+        with no_grad():
+            outputs = _to_list(self.network(*inputs))
+        return [o.numpy() for o in outputs]
+
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            # network returns the loss directly
+            return [outputs[0]]
+        return _to_list(self._loss(*(outputs + labels)))
+
+    def _update_metrics(self, outputs, labels):
+        vals = []
+        for m in self._metrics:
+            state = m.compute(*(outputs + labels))
+            m.update(*[s.numpy() if isinstance(s, Tensor) else s for s in _to_list(state)])
+            res = m.accumulate()
+            vals.append(res)
+        return vals
+
+    # ---- loops ----
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last=False):
+        from ..io import DataLoader, Dataset, IterableDataset
+
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, (Dataset, IterableDataset)):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        # any other iterable of ready-made batches: materialize so a generator
+        # survives re-iteration across epochs
+        return data if hasattr(data, "__getitem__") else list(data)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        assert train_data is not None, "train_data must be given"
+        self._save_dir = save_dir
+        loader = self._make_loader(train_data, batch_size, shuffle, num_workers,
+                                   drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs, steps=steps,
+                                batch_size=batch_size, verbose=verbose,
+                                log_freq=log_freq, save_freq=save_freq,
+                                save_dir=save_dir, metrics=self._metrics_name())
+        self.stop_training = False
+        cbks.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            pending_update = False
+            for step, batch in enumerate(loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                out = self.train_batch(ins, labs, update=update)
+                pending_update = not update
+                logs = self._pack_logs(out, batch_size)
+                cbks.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            if pending_update:
+                # flush tail gradients when the epoch length is not divisible by
+                # accumulate_grad_batches, so nothing leaks into the next epoch
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            if eval_loader is not None and epoch % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            history.append(logs)
+        cbks.on_train_end(logs if history else {})
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
+                                verbose=verbose, log_freq=log_freq,
+                                metrics=self._metrics_name())
+        return self._run_eval(loader, cbks, num_iters=num_iters)
+
+    def _run_eval(self, loader, cbks, num_iters=None):
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin({"steps": self._safe_len(loader)})
+        logs, samples = {}, 0
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            cbks.on_eval_batch_begin(step)
+            ins, labs = self._split_batch(batch)
+            out = self.eval_batch(ins, labs)
+            logs = self._pack_logs(out, None)
+            samples += len(ins[0]) if ins and hasattr(ins[0], "__len__") else 0
+            cbks.on_eval_batch_end(step, logs)
+        logs["samples"] = samples
+        cbks.on_eval_end(logs)
+        logs.pop("samples", None)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
+                                verbose=verbose)
+        cbks.on_predict_begin()
+        outputs: List[List[np.ndarray]] = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            ins, _ = self._split_batch(batch, has_labels=False)
+            outs = self.predict_batch(ins)
+            outputs.append(outs)
+            cbks.on_predict_batch_end(step)
+        cbks.on_predict_end()
+        # transpose: list over batches of list over outputs -> list over outputs
+        n_out = len(outputs[0]) if outputs else 0
+        result = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            result = [np.concatenate(r, axis=0) for r in result]
+        return result
+
+    def _split_batch(self, batch, has_labels=True):
+        batch = _to_list(batch)
+        if self._inputs:
+            n_in = len(self._inputs)
+        elif self._loss is None and not self._metrics:
+            # network computes its own loss from the full batch
+            n_in = len(batch)
+        elif len(batch) == 1:
+            n_in = 1
+        else:
+            n_in = max(1, len(batch) - 1)
+        return batch[:n_in], batch[n_in:] if has_labels else []
+
+    def _pack_logs(self, out, batch_size):
+        logs = {}
+        if self._metrics:
+            losses, metrics = out
+        else:
+            losses, metrics = out, []
+        logs["loss"] = losses if len(losses) > 1 else losses[0]
+        for m, v in zip(self._metrics, metrics):
+            names = m.name() if isinstance(m.name(), (list, tuple)) else [m.name()]
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for n, val in zip(names, vals):
+                logs[n] = val
+        if batch_size:
+            logs["batch_size"] = batch_size
+        return logs
+
+    @staticmethod
+    def _safe_len(loader):
+        try:
+            return len(loader)
+        except TypeError:
+            return None
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, (list, tuple)) else [n])
+        return names
+
+    # ---- persistence ----
+    def save(self, path, training=True):
+        from ..framework import io as fio
+
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import io as fio
+
+        state = fio.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None:
+            import os
+
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(fio.load(path + ".pdopt"))
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)

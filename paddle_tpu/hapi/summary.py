@@ -1,0 +1,61 @@
+"""paddle.summary: layer-by-layer output shapes + parameter counts via forward hooks.
+Reference: python/paddle/hapi/model_summary.py."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        multi = (isinstance(input_size, (list, tuple)) and len(input_size) > 0
+                 and isinstance(input_size[0], (list, tuple)))
+        sizes = list(input_size) if multi else [input_size]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else [dtypes] * len(sizes)
+        input = [Tensor(np.zeros([d if d and d > 0 else 1 for d in s],
+                                 dtype=dt or "float32"))
+                 for s, dt in zip(sizes, dts)]
+    elif not isinstance(input, (list, tuple)):
+        input = [input]
+
+    rows, hooks = [], []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else []
+            n_params = sum(int(np.prod(p.shape)) for p in lyr.parameters(
+                include_sublayers=False))
+            rows.append((name or lyr.__class__.__name__, shape, n_params))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if not list(sub.children()):  # leaves only
+            hooks.append(sub.register_forward_post_hook(make_hook(name, sub)))
+    was_training = net.training
+    net.eval()
+    try:
+        net(*input)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    w1 = max([len(r[0]) for r in rows] + [10]) + 2
+    print(f"{'Layer':<{w1}}{'Output Shape':<24}{'Param #':>12}")
+    print("=" * (w1 + 36))
+    for name, shape, n in rows:
+        print(f"{name:<{w1}}{str(shape):<24}{n:>12,}")
+    print("=" * (w1 + 36))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

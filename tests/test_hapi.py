@@ -1,0 +1,162 @@
+"""hapi Model.fit/evaluate/predict + callbacks.
+
+Mirrors reference tests python/paddle/tests/test_model.py (fit on LeNet/MNIST-style
+data, evaluate/predict round-trips, callbacks, save/load)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi import EarlyStopping, Model, ModelCheckpoint
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class RandomClassDataset(Dataset):
+    def __init__(self, n=64, dim=8, classes=4):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, dim).astype("float32")
+        self.y = rng.randint(0, classes, (n, 1)).astype("int64")
+        # make it learnable: class determined by argmax of first `classes` features
+        self.y = np.argmax(self.x[:, :classes], axis=1, keepdims=True).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def make_model():
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 4))
+    model = Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(), Accuracy())
+    return model
+
+
+def test_fit_reduces_loss_and_tracks_accuracy(capsys):
+    paddle.seed(0)
+    model = make_model()
+    ds = RandomClassDataset()
+    history = model.fit(ds, epochs=3, batch_size=16, verbose=0)
+    assert len(history) == 3
+    assert history[-1]["loss"] < history[0]["loss"]
+    assert history[-1]["acc"] > 0.5
+
+
+def test_evaluate_and_predict():
+    paddle.seed(0)
+    model = make_model()
+    ds = RandomClassDataset()
+    model.fit(ds, epochs=2, batch_size=16, verbose=0)
+    res = model.evaluate(ds, batch_size=16, verbose=0)
+    assert "loss" in res and "acc" in res
+    preds = model.predict(ds, batch_size=16, stack_outputs=True, verbose=0)
+    assert preds[0].shape == (64, 4)
+    acc = (preds[0].argmax(-1) == ds.y[:, 0]).mean()
+    assert acc == pytest.approx(res["acc"], abs=1e-6)
+
+
+def test_fit_with_eval_data_and_early_stopping():
+    paddle.seed(0)
+    model = make_model()
+    ds = RandomClassDataset()
+    es = EarlyStopping(monitor="acc", mode="max", patience=0, save_best_model=False,
+                       verbose=0)
+    history = model.fit(ds, eval_data=ds, epochs=30, batch_size=32, verbose=0,
+                        callbacks=[es])
+    # stops once eval accuracy plateaus (it saturates at 1.0) -> fewer than 30 epochs
+    assert len(history) < 30
+    assert any("eval_loss" in h for h in history)
+
+
+def test_save_load_roundtrip(tmp_path):
+    paddle.seed(0)
+    model = make_model()
+    ds = RandomClassDataset()
+    model.fit(ds, epochs=1, batch_size=16, verbose=0)
+    path = str(tmp_path / "ckpt")
+    model.save(path)
+    model2 = make_model()
+    model2.load(path)
+    p1 = model.predict(ds, batch_size=64, stack_outputs=True, verbose=0)[0]
+    p2 = model2.predict(ds, batch_size=64, stack_outputs=True, verbose=0)[0]
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_model_checkpoint_callback(tmp_path):
+    paddle.seed(0)
+    model = make_model()
+    ds = RandomClassDataset(n=32)
+    model.fit(ds, epochs=2, batch_size=16, verbose=0,
+              save_dir=str(tmp_path), save_freq=1)
+    assert (tmp_path / "0.pdparams").exists()
+    assert (tmp_path / "final.pdparams").exists()
+
+
+def test_train_batch_and_eval_batch():
+    paddle.seed(0)
+    model = make_model()
+    x = np.random.randn(4, 8).astype("float32")
+    y = np.zeros((4, 1), dtype="int64")
+    losses, metrics = model.train_batch([x], [y])
+    assert np.isfinite(losses[0])
+    losses2, _ = model.eval_batch([x], [y])
+    assert np.isfinite(losses2[0])
+
+
+def test_summary(capsys):
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    info = paddle.summary(net, (1, 8))
+    out = capsys.readouterr().out
+    assert info["total_params"] == 8 * 32 + 32 + 32 * 4 + 4
+    assert "Total params" in out
+
+
+def test_accumulate_grad_tail_flush():
+    """Epoch length not divisible by accumulate_grad_batches: tail grads are
+    flushed at epoch end, nothing leaks into the next epoch."""
+    paddle.seed(0)
+    model = make_model()
+    ds = RandomClassDataset(n=48)  # 3 batches of 16
+    model.fit(ds, epochs=1, batch_size=16, verbose=0, accumulate_grad_batches=2)
+    assert all(p.grad is None for p in model.parameters())
+
+
+def test_fit_with_generator_train_data():
+    paddle.seed(0)
+    model = make_model()
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 8).astype("float32")
+    y = np.argmax(x[:, :4], axis=1, keepdims=True).astype("int64")
+    gen = ((x[i:i + 16], y[i:i + 16]) for i in range(0, 32, 16))
+    history = model.fit(gen, epochs=3, batch_size=16, verbose=0)
+    assert all("loss" in h for h in history)
+
+
+def test_self_loss_network_with_metrics():
+    """Network computes its own loss; metrics still receive labels."""
+    class SelfLoss(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(8, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    paddle.seed(0)
+    net = SelfLoss()
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss(), Accuracy())
+    ds = RandomClassDataset(n=32)
+    history = model.fit(ds, epochs=1, batch_size=16, verbose=0)
+    assert "acc" in history[0]
+
+
+def test_summary_tuple_of_shapes():
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+    info = paddle.summary(net, ((1, 8),))
+    assert info["total_params"] == 8 * 4 + 4
