@@ -67,6 +67,11 @@ class Model:
         total = losses[0]
         for extra in losses[1:]:
             total = total + extra
+        accumulate = getattr(self, "_accumulate", 1)
+        if accumulate > 1:
+            # average grads over the accumulation window so the effective step
+            # matches a single large batch (reference model.py scales final_loss)
+            total = total * (1.0 / accumulate)
         total.backward()
         if update:
             self._optimizer.step()
@@ -80,7 +85,10 @@ class Model:
         inputs, labels = _to_tensors(inputs), _to_tensors(labels)
         with no_grad():
             outputs = _to_list(self.network(*inputs))
-            losses = self._compute_loss(outputs, labels) if self._loss else []
+            # loss=None with no metrics means the network computes its own loss;
+            # loss=None with metrics means metrics-only evaluation
+            losses = (self._compute_loss(outputs, labels)
+                      if self._loss is not None or not self._metrics else [])
         metrics = self._update_metrics(outputs, labels)
         loss_vals = [float(l.item()) for l in losses]
         return (loss_vals, metrics) if metrics else loss_vals
@@ -133,6 +141,7 @@ class Model:
             steps = len(loader)
         except TypeError:
             steps = None
+        self._accumulate = max(1, accumulate_grad_batches)
         cbks = config_callbacks(callbacks, model=self, epochs=epochs, steps=steps,
                                 batch_size=batch_size, verbose=verbose,
                                 log_freq=log_freq, save_freq=save_freq,
@@ -240,7 +249,8 @@ class Model:
             losses, metrics = out
         else:
             losses, metrics = out, []
-        logs["loss"] = losses if len(losses) > 1 else losses[0]
+        if losses:
+            logs["loss"] = losses if len(losses) > 1 else losses[0]
         for m, v in zip(self._metrics, metrics):
             names = m.name() if isinstance(m.name(), (list, tuple)) else [m.name()]
             vals = v if isinstance(v, (list, tuple)) else [v]

@@ -1,0 +1,174 @@
+"""MobileNetV3 (small/large). Reference: python/paddle/vision/models/mobilenetv3.py."""
+from __future__ import annotations
+
+from ... import nn
+from .mobilenetv2 import _make_divisible
+
+
+class SqueezeExcitation(nn.Layer):
+    def __init__(self, input_channels, squeeze_channels):
+        super().__init__()
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(input_channels, squeeze_channels, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze_channels, input_channels, 1)
+        self.hardsigmoid = nn.Hardsigmoid()
+
+    def forward(self, x):
+        scale = self.avgpool(x)
+        scale = self.relu(self.fc1(scale))
+        scale = self.hardsigmoid(self.fc2(scale))
+        return x * scale
+
+
+class ConvNormActivation(nn.Sequential):
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, activation_layer=nn.ReLU):
+        if padding is None:
+            padding = (kernel_size - 1) // 2
+        layers = [
+            nn.Conv2D(in_channels, out_channels, kernel_size, stride, padding,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(out_channels),
+        ]
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)
+
+
+class InvertedResidualConfig:
+    def __init__(self, in_channels, kernel, expanded_channels, out_channels, use_se,
+                 activation, stride, scale=1.0):
+        self.in_channels = self.adjust_channels(in_channels, scale)
+        self.kernel = kernel
+        self.expanded_channels = self.adjust_channels(expanded_channels, scale)
+        self.out_channels = self.adjust_channels(out_channels, scale)
+        self.use_se = use_se
+        self.use_hs = activation == "HS"
+        self.stride = stride
+
+    @staticmethod
+    def adjust_channels(channels, scale):
+        return _make_divisible(channels * scale)
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, cfg: InvertedResidualConfig):
+        super().__init__()
+        self.use_res_connect = cfg.stride == 1 and cfg.in_channels == cfg.out_channels
+        act = nn.Hardswish if cfg.use_hs else nn.ReLU
+        layers = []
+        if cfg.expanded_channels != cfg.in_channels:
+            layers.append(ConvNormActivation(cfg.in_channels, cfg.expanded_channels,
+                                             kernel_size=1, activation_layer=act))
+        layers.append(ConvNormActivation(
+            cfg.expanded_channels, cfg.expanded_channels, kernel_size=cfg.kernel,
+            stride=cfg.stride, groups=cfg.expanded_channels, activation_layer=act))
+        if cfg.use_se:
+            layers.append(SqueezeExcitation(
+                cfg.expanded_channels, _make_divisible(cfg.expanded_channels // 4)))
+        layers.append(ConvNormActivation(cfg.expanded_channels, cfg.out_channels,
+                                         kernel_size=1, activation_layer=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        if self.use_res_connect:
+            out = x + out
+        return out
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        firstconv_out = config[0].in_channels
+        lastconv_in = config[-1].out_channels
+        lastconv_out = 6 * lastconv_in
+
+        layers = [ConvNormActivation(3, firstconv_out, kernel_size=3, stride=2,
+                                     activation_layer=nn.Hardswish)]
+        layers.extend(InvertedResidual(cfg) for cfg in config)
+        layers.append(ConvNormActivation(lastconv_in, lastconv_out, kernel_size=1,
+                                         activation_layer=nn.Hardswish))
+        self.features = nn.Sequential(*layers)
+
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(lastconv_out, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+def _small_config(scale):
+    C = InvertedResidualConfig
+    return [
+        C(16, 3, 16, 16, True, "RE", 2, scale),
+        C(16, 3, 72, 24, False, "RE", 2, scale),
+        C(24, 3, 88, 24, False, "RE", 1, scale),
+        C(24, 5, 96, 40, True, "HS", 2, scale),
+        C(40, 5, 240, 40, True, "HS", 1, scale),
+        C(40, 5, 240, 40, True, "HS", 1, scale),
+        C(40, 5, 120, 48, True, "HS", 1, scale),
+        C(48, 5, 144, 48, True, "HS", 1, scale),
+        C(48, 5, 288, 96, True, "HS", 2, scale),
+        C(96, 5, 576, 96, True, "HS", 1, scale),
+        C(96, 5, 576, 96, True, "HS", 1, scale),
+    ]
+
+
+def _large_config(scale):
+    C = InvertedResidualConfig
+    return [
+        C(16, 3, 16, 16, False, "RE", 1, scale),
+        C(16, 3, 64, 24, False, "RE", 2, scale),
+        C(24, 3, 72, 24, False, "RE", 1, scale),
+        C(24, 5, 72, 40, True, "RE", 2, scale),
+        C(40, 5, 120, 40, True, "RE", 1, scale),
+        C(40, 5, 120, 40, True, "RE", 1, scale),
+        C(40, 3, 240, 80, False, "HS", 2, scale),
+        C(80, 3, 200, 80, False, "HS", 1, scale),
+        C(80, 3, 184, 80, False, "HS", 1, scale),
+        C(80, 3, 184, 80, False, "HS", 1, scale),
+        C(80, 3, 480, 112, True, "HS", 1, scale),
+        C(112, 3, 672, 112, True, "HS", 1, scale),
+        C(112, 5, 672, 160, True, "HS", 2, scale),
+        C(160, 5, 960, 160, True, "HS", 1, scale),
+        C(160, 5, 960, 160, True, "HS", 1, scale),
+    ]
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_small_config(scale),
+                         last_channel=_make_divisible(1024 * scale),
+                         scale=scale, num_classes=num_classes, with_pool=with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_large_config(scale),
+                         last_channel=_make_divisible(1280 * scale),
+                         scale=scale, num_classes=num_classes, with_pool=with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled (zero-egress image)"
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled (zero-egress image)"
+    return MobileNetV3Large(scale=scale, **kwargs)

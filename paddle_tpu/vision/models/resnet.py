@@ -118,6 +118,7 @@ class ResNet(nn.Layer):
 
 
 def _resnet(block, depth, pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled (zero-egress image)"
     return ResNet(block, depth, **kwargs)
 
 
@@ -139,3 +140,53 @@ def resnet101(pretrained=False, **kwargs):
 
 def resnet152(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
+
+
+def wide_resnet50_2(pretrained=False, **kwargs):
+    kwargs["width"] = 64 * 2
+    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    kwargs["width"] = 64 * 2
+    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+
+
+class ResNeXt(ResNet):
+    """Aggregated residual transformations: ResNet bottlenecks with grouped 3x3 convs.
+    Reference: python/paddle/vision/models/resnext.py."""
+
+    def __init__(self, depth=50, cardinality=32, base_width=4, num_classes=1000,
+                 with_pool=True):
+        super().__init__(BottleneckBlock, depth, width=base_width,
+                         num_classes=num_classes, with_pool=with_pool,
+                         groups=cardinality)
+
+
+def _resnext(depth, cardinality, base_width, pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled (zero-egress image)"
+    return ResNeXt(depth, cardinality, base_width, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnext(50, 32, 4, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext(50, 64, 4, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnext(101, 32, 4, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnext(101, 64, 4, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext(152, 32, 4, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext(152, 64, 4, pretrained, **kwargs)

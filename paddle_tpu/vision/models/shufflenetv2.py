@@ -1,0 +1,133 @@
+"""ShuffleNetV2. Reference: python/paddle/vision/models/shufflenetv2.py."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops import concat, reshape, split, transpose
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+_ACTS = {"relu": nn.ReLU, "swish": nn.Swish}
+
+
+def _conv_bn_act(in_c, out_c, k, stride=1, groups=1, act="relu"):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=(k - 1) // 2,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act is not None:
+        layers.append(_ACTS[act]())
+    return nn.Sequential(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_channels, out_channels, stride, act="relu"):
+        super().__init__()
+        self._stride = stride
+        act_layer = _ACTS[act]
+        branch_features = out_channels // 2
+        if stride == 1:
+            assert in_channels == branch_features * 2
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_channels, in_channels, 3, stride=stride, padding=1,
+                          groups=in_channels, bias_attr=False),
+                nn.BatchNorm2D(in_channels),
+                nn.Conv2D(in_channels, branch_features, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_features), act_layer())
+        b2_in = in_channels if stride > 1 else branch_features
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_features, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_features), act_layer(),
+            nn.Conv2D(branch_features, branch_features, 3, stride=stride, padding=1,
+                      groups=branch_features, bias_attr=False),
+            nn.BatchNorm2D(branch_features),
+            nn.Conv2D(branch_features, branch_features, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_features), act_layer())
+
+    def forward(self, x):
+        if self._stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        arch = {0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+                0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+                1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048]}
+        assert scale in arch, f"supported scales: {sorted(arch)}, got {scale}"
+        stage_out = arch[scale]
+
+        self.conv1 = _conv_bn_act(3, stage_out[0], 3, stride=2, act=act)
+        self.max_pool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+        in_c = stage_out[0]
+        stages = []
+        for i, repeats in enumerate(stage_repeats):
+            out_c = stage_out[i + 1]
+            blocks = [InvertedResidual(in_c, out_c, 2, act=act)]
+            blocks.extend(InvertedResidual(out_c, out_c, 1, act=act)
+                          for _ in range(repeats - 1))
+            stages.append(nn.Sequential(*blocks))
+            in_c = out_c
+        self.stages = nn.LayerList(stages)
+        self.conv_last = _conv_bn_act(in_c, stage_out[-1], 1, act=act)
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        for stage in self.stages:
+            x = stage(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _shufflenet(scale, pretrained=False, **kwargs):
+    assert not pretrained, "pretrained weights are not bundled (zero-egress image)"
+    return ShuffleNetV2(scale=scale, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, pretrained, act="swish", **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, pretrained, **kwargs)

@@ -160,3 +160,40 @@ def test_summary_tuple_of_shapes():
     net = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
     info = paddle.summary(net, ((1, 8),))
     assert info["total_params"] == 8 * 4 + 4
+
+
+def test_eval_without_loss_metrics_only():
+    """prepare(opt, loss=None, metrics=[Accuracy()]): metrics-only evaluation."""
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(parameters=net.parameters()),
+                  loss=None, metrics=Accuracy())
+    res = model.evaluate(RandomClassDataset(n=32), batch_size=16, verbose=0)
+    assert "acc" in res and "loss" not in res
+
+
+def test_accumulate_scales_gradients():
+    """Accumulated grads over k micro-batches of the same data equal the grads of
+    one batch (loss is scaled by 1/k)."""
+    import numpy as np
+
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 2)
+    x = np.random.RandomState(0).randn(8, 4).astype("float32")
+    y = np.zeros((8, 1), dtype="int64")
+    loss_fn = paddle.nn.CrossEntropyLoss()
+
+    m1 = Model(net)
+    m1.prepare(paddle.optimizer.SGD(parameters=net.parameters()), loss_fn)
+    m1._accumulate = 2
+    m1.train_batch([x], [y], update=False)
+    m1.train_batch([x], [y], update=False)
+    g_acc = net.weight.grad.numpy().copy()
+    net.clear_gradients() if hasattr(net, "clear_gradients") else None
+    for p in net.parameters():
+        p.grad = None
+    del m1._accumulate
+    m1.train_batch([x], [y], update=False)
+    g_one = net.weight.grad.numpy()
+    np.testing.assert_allclose(g_acc, g_one, rtol=1e-5, atol=1e-6)

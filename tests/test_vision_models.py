@@ -1,0 +1,131 @@
+"""Model zoo forward-shape + grad smoke tests.
+
+Mirrors reference python/paddle/tests/test_vision_models.py (instantiate each arch,
+forward a small batch, check the logits shape)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _check(model, num_classes=10, size=64, in_ch=3, tuple_out=False):
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, in_ch, size, size)
+                         .astype("float32"))
+    model.eval()
+    out = model(x)
+    if tuple_out:
+        out = out[0]
+    assert tuple(out.shape) == (2, num_classes)
+    return out
+
+
+@pytest.mark.parametrize("factory", [
+    models.resnet18, models.resnet34, models.resnet50,
+    models.resnext50_32x4d, models.wide_resnet50_2,
+])
+def test_resnet_family(factory):
+    _check(factory(num_classes=10), size=64)
+
+
+@pytest.mark.parametrize("factory,bn", [(models.vgg11, False), (models.vgg16, True)])
+def test_vgg(factory, bn):
+    _check(factory(batch_norm=bn, num_classes=10), size=224)
+
+
+def test_mobilenet_v1():
+    _check(models.mobilenet_v1(num_classes=10), size=64)
+
+
+def test_mobilenet_v2():
+    _check(models.mobilenet_v2(num_classes=10), size=64)
+
+
+@pytest.mark.parametrize("factory", [models.mobilenet_v3_small,
+                                     models.mobilenet_v3_large])
+def test_mobilenet_v3(factory):
+    _check(factory(num_classes=10), size=64)
+
+
+def test_densenet():
+    _check(models.densenet121(num_classes=10), size=64)
+
+
+def test_alexnet():
+    _check(models.alexnet(num_classes=10), size=224)
+
+
+def test_squeezenet():
+    _check(models.squeezenet1_1(num_classes=10), size=224)
+
+
+def test_googlenet_returns_aux_heads():
+    model = models.googlenet(num_classes=10)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 224, 224)
+                         .astype("float32"))
+    model.eval()
+    out, aux1, aux2 = model(x)
+    assert tuple(out.shape) == (2, 10)
+    assert tuple(aux1.shape) == (2, 10)
+    assert tuple(aux2.shape) == (2, 10)
+
+
+def test_inception_v3():
+    _check(models.inception_v3(num_classes=10), size=299)
+
+
+def test_shufflenet_v2():
+    _check(models.shufflenet_v2_x0_25(num_classes=10), size=64)
+
+
+def test_scaled_variants_build():
+    models.mobilenet_v1(scale=0.5, num_classes=4)
+    models.mobilenet_v2(scale=0.5, num_classes=4)
+    models.shufflenet_v2_x0_5(num_classes=4)
+
+
+def test_mobilenet_v2_grads_flow():
+    paddle.seed(0)
+    model = models.mobilenet_v2(scale=0.25, num_classes=4)
+    model.train()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 32, 32)
+                         .astype("float32"))
+    y = paddle.to_tensor(np.array([0, 1], dtype="int64"))
+    loss = paddle.nn.CrossEntropyLoss()(model(x), y)
+    loss.backward()
+    grads = [p.grad for p in model.parameters()]
+    n_with_grad = sum(g is not None for g in grads)
+    assert n_with_grad == len(grads), f"{len(grads) - n_with_grad} params missing grads"
+
+
+def test_with_pool_false_and_no_classifier():
+    model = models.resnet18(num_classes=0, with_pool=False)
+    x = paddle.to_tensor(np.zeros((1, 3, 64, 64), dtype="float32"))
+    out = model(x)
+    assert out.ndim == 4  # raw feature map
+
+
+def test_pretrained_asserts_everywhere():
+    for factory in [models.resnet18, models.wide_resnet50_2, models.vgg11,
+                    models.mobilenet_v1, models.alexnet, models.googlenet]:
+        with pytest.raises(AssertionError):
+            factory(pretrained=True)
+
+
+def test_googlenet_aux_heads_without_pool():
+    model = models.GoogLeNet(num_classes=5, with_pool=False)
+    assert hasattr(model, "_pool_o1")  # aux pools exist even when with_pool=False
+
+
+def test_shufflenet_swish_activation():
+    from paddle_tpu import nn
+
+    model = models.shufflenet_v2_swish(num_classes=4)
+    acts = [type(s).__name__ for s in model.sublayers()]
+    assert "Swish" in acts and "ReLU" not in acts
+
+
+def test_squeezenet_feature_map_contract():
+    model = models.SqueezeNet(version="1.1", num_classes=0, with_pool=False)
+    x = paddle.to_tensor(np.zeros((1, 3, 64, 64), dtype="float32"))
+    assert model(x).ndim == 4
