@@ -1,0 +1,70 @@
+"""Native (C++) runtime components, built lazily with the system toolchain.
+
+The reference implements its runtime core in C++ (TCPStore at
+paddle/fluid/distributed/store/tcp_store.h, allocator stats, data feed). The TPU
+build keeps that split: JAX/XLA/Pallas is the compute path, these C++ pieces are
+the runtime around it. Sources compile once per machine into a cache directory;
+pure-Python fallbacks keep everything working where no compiler exists.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+import threading
+
+_lock = threading.Lock()
+_libs = {}
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _cache_dir() -> str:
+    d = os.environ.get("PADDLE_TPU_NATIVE_CACHE",
+                       os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                                    "native"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def build_library(name: str, sources=None, extra_flags=()) -> str:
+    """Compile `<name>.cc` (plus extra sources) into a cached shared library and
+    return its path. Raises RuntimeError if the toolchain is missing/fails."""
+    sources = sources or [os.path.join(_SRC_DIR, f"{name}.cc")]
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(extra_flags).encode())
+    out = os.path.join(_cache_dir(), f"{name}-{h.hexdigest()[:16]}.so")
+    if os.path.exists(out):
+        return out
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           *extra_flags, *sources, "-o", out + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except FileNotFoundError as e:
+        raise RuntimeError(f"no C++ toolchain: {e}") from e
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(f"native build failed:\n{e.stderr}") from e
+    os.replace(out + ".tmp", out)
+    return out
+
+
+def load_library(name: str):
+    """ctypes.CDLL for a native component, building it on first use. Returns None
+    when the toolchain is unavailable (callers fall back to Python)."""
+    import ctypes
+
+    with _lock:
+        if name in _libs:
+            return _libs[name]
+        try:
+            lib = ctypes.CDLL(build_library(name))
+        except (RuntimeError, OSError) as e:
+            print(f"paddle_tpu: native {name} unavailable ({e}); using Python "
+                  f"fallback", file=sys.stderr)
+            lib = None
+        _libs[name] = lib
+        return lib

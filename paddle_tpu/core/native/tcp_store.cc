@@ -1,0 +1,438 @@
+// TCPStore: rendezvous key-value store (master socket + clients).
+//
+// TPU-native equivalent of the reference's paddle/fluid/distributed/store/tcp_store.h:91
+// (set/get/wait/add over a length-prefixed TCP protocol). Built as a shared library and
+// bound via ctypes (paddle_tpu/distributed/store.py). The multi-controller JAX bootstrap
+// and the launcher/elastic/PS subsystems rendezvous through this store the way the
+// reference exchanges NCCL unique ids through its TCPStore (ProcessGroupNCCL.cc:113).
+//
+// Protocol (client -> server): u8 cmd | u32 klen | key | [u32 vlen | value] | [i64 delta]
+//   cmd: 0=SET 1=GET(blocking) 2=ADD 3=WAIT 4=NUM_KEYS 5=DELETE 6=GET_NOWAIT 7=LIST_PREFIX
+// Reply: i64 status/value | [u32 vlen | value]
+#include <algorithm>
+#include <arpa/inet.h>
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t {
+  kSet = 0, kGet = 1, kAdd = 2, kWait = 3, kNumKeys = 4, kDelete = 5,
+  kGetNoWait = 6, kListPrefix = 7,
+};
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_u32(int fd, uint32_t v) { uint32_t n = htonl(v); return send_all(fd, &n, 4); }
+bool recv_u32(int fd, uint32_t* v) {
+  uint32_t n;
+  if (!recv_all(fd, &n, 4)) return false;
+  *v = ntohl(n);
+  return true;
+}
+bool send_i64(int fd, int64_t v) {
+  uint64_t n = htobe64(static_cast<uint64_t>(v));
+  return send_all(fd, &n, 8);
+}
+bool recv_i64(int fd, int64_t* v) {
+  uint64_t n;
+  if (!recv_all(fd, &n, 8)) return false;
+  *v = static_cast<int64_t>(be64toh(n));
+  return true;
+}
+bool send_bytes(int fd, const std::string& s) {
+  return send_u32(fd, static_cast<uint32_t>(s.size())) &&
+         (s.empty() || send_all(fd, s.data(), s.size()));
+}
+bool recv_bytes(int fd, std::string* s) {
+  uint32_t len;
+  if (!recv_u32(fd, &len)) return false;
+  s->resize(len);
+  return len == 0 || recv_all(fd, &(*s)[0], len);
+}
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port) {}
+
+  int Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -errno;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return -errno;
+    if (port_ == 0) {  // ephemeral port: report what the OS picked
+      socklen_t len = sizeof(addr);
+      ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+      port_ = ntohs(addr.sin_port);
+    }
+    if (::listen(listen_fd_, 128) < 0) return -errno;
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return port_;
+  }
+
+  void Stop() {
+    if (stopping_.exchange(true)) return;
+    {
+      // taking mu_ closes the lost-wakeup window: no waiter can be between its
+      // predicate check and cv_.wait while we hold the mutex
+      std::lock_guard<std::mutex> lk(mu_);
+    }
+    cv_.notify_all();
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<std::thread> workers;
+    {
+      std::lock_guard<std::mutex> lk(workers_mu_);
+      // unblock Serve() threads parked in recv() on live client connections
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+      workers.swap(workers_);
+    }
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+
+  ~StoreServer() { Stop(); }
+
+ private:
+  void AcceptLoop() {
+    while (true) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (errno == EINTR) continue;
+        return;  // listen socket closed -> shutting down
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(workers_mu_);
+      if (stopping_) { ::close(fd); return; }
+      conn_fds_.push_back(fd);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (true) {
+      uint8_t cmd;
+      if (!recv_all(fd, &cmd, 1)) break;
+      std::string key;
+      if (!recv_bytes(fd, &key)) break;
+      bool ok = true;
+      switch (cmd) {
+        case kSet: {
+          std::string val;
+          if (!(ok = recv_bytes(fd, &val))) break;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            data_[key] = std::move(val);
+          }
+          cv_.notify_all();
+          ok = send_i64(fd, 0);
+          break;
+        }
+        case kGet: case kGetNoWait: {
+          std::string val;
+          bool found = false;
+          {
+            std::unique_lock<std::mutex> lk(mu_);
+            if (cmd == kGet)
+              cv_.wait(lk, [&] { return stopping_ || data_.count(key); });
+            auto it = data_.find(key);
+            if (it != data_.end()) { val = it->second; found = true; }
+          }
+          ok = send_i64(fd, found ? 0 : -1) && (!found || send_bytes(fd, val));
+          break;
+        }
+        case kAdd: {
+          int64_t delta, result;
+          if (!(ok = recv_i64(fd, &delta))) break;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            int64_t cur = 0;
+            auto it = data_.find(key);
+            if (it != data_.end()) cur = strtoll(it->second.c_str(), nullptr, 10);
+            result = cur + delta;
+            data_[key] = std::to_string(result);
+          }
+          cv_.notify_all();
+          ok = send_i64(fd, result);
+          break;
+        }
+        case kWait: {
+          int64_t timeout_ms;
+          if (!(ok = recv_i64(fd, &timeout_ms))) break;
+          bool found;
+          {
+            std::unique_lock<std::mutex> lk(mu_);
+            auto pred = [&] { return stopping_ || data_.count(key); };
+            if (timeout_ms < 0) {
+              cv_.wait(lk, pred);
+              found = data_.count(key) > 0;
+            } else {
+              found = cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred) &&
+                      data_.count(key) > 0;
+            }
+          }
+          ok = send_i64(fd, found ? 0 : -1);
+          break;
+        }
+        case kNumKeys: {
+          int64_t n;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            n = static_cast<int64_t>(data_.size());
+          }
+          ok = send_i64(fd, n);
+          break;
+        }
+        case kDelete: {
+          int64_t n;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            n = static_cast<int64_t>(data_.erase(key));
+          }
+          ok = send_i64(fd, n);
+          break;
+        }
+        case kListPrefix: {
+          // returns newline-joined keys with the given prefix (elastic membership)
+          std::string joined;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            for (auto it = data_.lower_bound(key);
+                 it != data_.end() && it->first.compare(0, key.size(), key) == 0; ++it) {
+              joined += it->first;
+              joined += '\n';
+            }
+          }
+          ok = send_i64(fd, 0) && send_bytes(fd, joined);
+          break;
+        }
+        default:
+          ok = false;
+      }
+      if (!ok) break;
+    }
+    ::close(fd);
+    std::lock_guard<std::mutex> lk(workers_mu_);
+    conn_fds_.erase(std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
+                    conn_fds_.end());
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::mutex workers_mu_;
+  std::vector<std::thread> workers_;
+  std::vector<int> conn_fds_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+};
+
+class StoreClient {
+ public:
+  int Connect(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    // resolve once: numeric IPv4 or a hostname (getaddrinfo handles both)
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr)
+      return -EINVAL;
+    sockaddr_in addr = *reinterpret_cast<sockaddr_in*>(res->ai_addr);
+    ::freeaddrinfo(res);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    while (true) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd_ < 0) return -errno;
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return 0;
+      }
+      ::close(fd_);
+      fd_ = -1;
+      if (std::chrono::steady_clock::now() >= deadline) return -ETIMEDOUT;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  std::mutex mu_;  // one request in flight per client connection
+  int fd_ = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ts_server_start(int port, int* out_port) {
+  auto* s = new StoreServer(port);
+  int got = s->Start();
+  if (got < 0) {
+    delete s;
+    return nullptr;
+  }
+  if (out_port) *out_port = got;
+  return s;
+}
+
+void ts_server_stop(void* server) {
+  delete static_cast<StoreServer*>(server);
+}
+
+void* ts_client_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient();
+  if (c->Connect(host, port, timeout_ms) != 0) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void ts_client_free(void* client) {
+  delete static_cast<StoreClient*>(client);
+}
+
+// returns 0 on success
+int ts_set(void* client, const char* key, const char* val, int vlen) {
+  auto* c = static_cast<StoreClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  uint8_t cmd = kSet;
+  if (!send_all(c->fd_, &cmd, 1) || !send_bytes(c->fd_, key) ||
+      !send_bytes(c->fd_, std::string(val, vlen)))
+    return -EPIPE;
+  int64_t status;
+  return recv_i64(c->fd_, &status) ? static_cast<int>(status) : -EPIPE;
+}
+
+// blocking get; returns value length, or <0 on error. Caller buffer must hold cap bytes;
+// if the value is larger, returns -ENOSPC with required length in *needed.
+int ts_get(void* client, const char* key, char* out, int cap, int* needed, int nowait) {
+  auto* c = static_cast<StoreClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  uint8_t cmd = nowait ? kGetNoWait : kGet;
+  if (!send_all(c->fd_, &cmd, 1) || !send_bytes(c->fd_, key)) return -EPIPE;
+  int64_t status;
+  if (!recv_i64(c->fd_, &status)) return -EPIPE;
+  if (status != 0) return -ENOENT;
+  std::string val;
+  if (!recv_bytes(c->fd_, &val)) return -EPIPE;
+  if (needed) *needed = static_cast<int>(val.size());
+  if (static_cast<int>(val.size()) > cap) return -ENOSPC;
+  memcpy(out, val.data(), val.size());
+  return static_cast<int>(val.size());
+}
+
+// returns the post-increment value, or INT64_MIN on error
+int64_t ts_add(void* client, const char* key, int64_t delta) {
+  auto* c = static_cast<StoreClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  uint8_t cmd = kAdd;
+  if (!send_all(c->fd_, &cmd, 1) || !send_bytes(c->fd_, key) ||
+      !send_i64(c->fd_, delta))
+    return INT64_MIN;
+  int64_t result;
+  return recv_i64(c->fd_, &result) ? result : INT64_MIN;
+}
+
+// returns 0 when the key exists, -1 on timeout, <-1 on error
+int ts_wait(void* client, const char* key, int64_t timeout_ms) {
+  auto* c = static_cast<StoreClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  uint8_t cmd = kWait;
+  if (!send_all(c->fd_, &cmd, 1) || !send_bytes(c->fd_, key) ||
+      !send_i64(c->fd_, timeout_ms))
+    return -EPIPE;
+  int64_t status;
+  return recv_i64(c->fd_, &status) ? static_cast<int>(status) : -EPIPE;
+}
+
+int64_t ts_num_keys(void* client) {
+  auto* c = static_cast<StoreClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  uint8_t cmd = kNumKeys;
+  if (!send_all(c->fd_, &cmd, 1) || !send_bytes(c->fd_, "")) return -1;
+  int64_t n;
+  return recv_i64(c->fd_, &n) ? n : -1;
+}
+
+int ts_delete(void* client, const char* key) {
+  auto* c = static_cast<StoreClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  uint8_t cmd = kDelete;
+  if (!send_all(c->fd_, &cmd, 1) || !send_bytes(c->fd_, key)) return -EPIPE;
+  int64_t n;
+  return recv_i64(c->fd_, &n) ? static_cast<int>(n) : -EPIPE;
+}
+
+// newline-joined keys with prefix; same buffer contract as ts_get
+int ts_list_prefix(void* client, const char* prefix, char* out, int cap, int* needed) {
+  auto* c = static_cast<StoreClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  uint8_t cmd = kListPrefix;
+  if (!send_all(c->fd_, &cmd, 1) || !send_bytes(c->fd_, prefix)) return -EPIPE;
+  int64_t status;
+  if (!recv_i64(c->fd_, &status)) return -EPIPE;
+  std::string val;
+  if (!recv_bytes(c->fd_, &val)) return -EPIPE;
+  if (needed) *needed = static_cast<int>(val.size());
+  if (static_cast<int>(val.size()) > cap) return -ENOSPC;
+  memcpy(out, val.data(), val.size());
+  return static_cast<int>(val.size());
+}
+
+}  // extern "C"

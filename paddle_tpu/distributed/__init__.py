@@ -20,6 +20,7 @@ from .mesh import (  # noqa: F401
     set_hybrid_communicate_group,
 )
 from .engine import TrainStepEngine, parallelize  # noqa: F401
+from .store import FileStore, TCPStore  # noqa: F401
 from . import fleet  # noqa: F401
 from .fleet.distributed_strategy import DistributedStrategy  # noqa: F401
 from .meta_parallel.mp_layers import split  # noqa: F401

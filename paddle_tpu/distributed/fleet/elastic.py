@@ -1,0 +1,148 @@
+"""Elastic training manager: fault tolerance + scale in/out over the TCPStore.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:130 — etcd node
+registry under a job prefix with TTL lease heartbeat (:247-257), prefix watches
+for join/leave (:245), endpoint re-layout, launcher restart. TPU equivalent: the
+same registry over our C++ TCPStore (keys `<job>/nodes/<id>` holding the last
+heartbeat timestamp; staleness > ttl ≙ lease expiry — the store has no server-side
+TTL so the watcher applies it on read), plus hooks for slice preemption notices.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store, job_id: str, np: int, host: str,
+                 heartbeat_interval: float = 2.0, ttl: float = 10.0,
+                 min_np: Optional[int] = None, max_np: Optional[int] = None):
+        """np: target node count; min_np/max_np bound the scale in/out window
+        (reference parses `np` ranges like "2:4" the same way)."""
+        self.store = store
+        self.job_id = job_id
+        self.np = np
+        self.min_np = min_np or np
+        self.max_np = max_np or np
+        self.host = host
+        self.heartbeat_interval = heartbeat_interval
+        self.ttl = ttl
+        self._prefix = f"{job_id}/nodes/"
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._watch_thread: Optional[threading.Thread] = None
+        self._callbacks: List[Callable[[List[str]], None]] = []
+        self._last_members: List[str] = []
+        self._beat_seq = 0
+        # node -> (last seen heartbeat seq, local monotonic time it changed);
+        # liveness is judged by seq *progress* against the reader's own clock, so
+        # cross-node wall-clock skew cannot expire a healthy node's lease
+        self._seen: Dict[str, tuple] = {}
+
+    # ---- membership registry (reference manager.py:247 lease/heartbeat) ----
+    def register(self) -> None:
+        self._beat()
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+
+    def _beat(self) -> None:
+        self._beat_seq += 1
+        self.store.set(self._prefix + self.host,
+                       json.dumps({"seq": self._beat_seq, "host": self.host}))
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self._beat()
+            except Exception:
+                pass  # store briefly unreachable; next beat retries
+
+    def alive_nodes(self) -> List[str]:
+        """Nodes whose heartbeat seq advanced within the last ttl seconds (as
+        measured on THIS node's monotonic clock — no cross-node clock compare)."""
+        now = time.monotonic()
+        alive = []
+        present = set()
+        for key in self.store.list_keys(self._prefix):
+            try:
+                rec = json.loads(self.store.get(key, wait=False))
+            except (KeyError, ValueError):
+                continue
+            node = key[len(self._prefix):]
+            present.add(node)
+            seen = self._seen.get(node)
+            if seen is None or seen[0] != rec["seq"]:
+                self._seen[node] = (rec["seq"], now)
+                alive.append(node)
+            elif now - seen[1] <= self.ttl:
+                alive.append(node)
+            else:
+                self.store.delete_key(key)  # lease expired: no progress for > ttl
+        for gone in set(self._seen) - present:
+            del self._seen[gone]
+        return sorted(alive)
+
+    # ---- watch (reference manager.py:245 etcd watch -> callbacks) ----
+    def watch(self, callback: Callable[[List[str]], None]) -> None:
+        self._callbacks.append(callback)
+        if self._watch_thread is None:
+            self._last_members = self.alive_nodes()
+            self._watch_thread = threading.Thread(target=self._watch_loop,
+                                                  daemon=True)
+            self._watch_thread.start()
+
+    def _watch_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                members = self.alive_nodes()
+            except Exception:
+                continue
+            if members != self._last_members:
+                self._last_members = members
+                for cb in self._callbacks:
+                    cb(members)
+
+    # ---- scale decisions (reference manager.py exit/restart logic) ----
+    def health_status(self) -> str:
+        n = len(self.alive_nodes())
+        if n == self.np:
+            return ElasticStatus.COMPLETED
+        if self.min_np <= n < self.np:
+            return ElasticStatus.RESTART  # scale-in: relaunch with fewer nodes
+        if n < self.min_np:
+            return ElasticStatus.HOLD  # wait for nodes to rejoin
+        return ElasticStatus.RESTART  # scale-out
+
+    def wait_for_np(self, np: Optional[int] = None, timeout: float = 60.0) -> bool:
+        target = np or self.np
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.alive_nodes()) >= target:
+                return True
+            time.sleep(self.heartbeat_interval / 2)
+        return False
+
+    def endpoints_layout(self) -> Dict[str, int]:
+        """Deterministic node -> rank assignment after membership change
+        (reference re-writes PADDLE_TRAINER_ENDPOINTS the same way)."""
+        return {h: i for i, h in enumerate(self.alive_nodes())}
+
+    def exit(self) -> None:
+        self._stop.set()
+        try:
+            self.store.delete_key(self._prefix + self.host)
+        except Exception:
+            pass
+        for t in (self._hb_thread, self._watch_thread):
+            if t is not None:
+                t.join(timeout=2 * self.heartbeat_interval)

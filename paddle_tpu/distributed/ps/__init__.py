@@ -1,0 +1,14 @@
+"""Parameter-server runtime (Python surface over the C++ tables/service).
+
+Reference: paddle/fluid/distributed/ps/ (#24) + python TheOnePSRuntime
+(python/paddle/distributed/ps/the_one_ps.py:816, #39). The C++ side lives in
+core/native/ps_table.cc: sharded sparse/dense tables with server-side optimizers
+behind a TCP service (brpc in the reference). Ids shard across server instances
+by `id % num_servers` exactly like the reference's key-hash table partitioning.
+"""
+from .service import PSClient, PSServer, SparseTableConfig, DenseTableConfig
+from .runtime import TheOnePSRuntime
+from .layers import DistributedEmbedding, distributed_lookup_table
+
+__all__ = ["PSClient", "PSServer", "SparseTableConfig", "DenseTableConfig",
+           "TheOnePSRuntime", "DistributedEmbedding", "distributed_lookup_table"]

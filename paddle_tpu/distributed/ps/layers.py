@@ -1,0 +1,63 @@
+"""PS-backed layers: distributed embedding lookup with push-on-backward.
+
+Reference: operators/pscore/distributed_lookup_table_op.cc (trainer-side op whose
+forward pulls rows from the PS and whose grad op pushes row gradients back) and
+`paddle.static.nn.sparse_embedding`. TPU-native: the pull happens on host (table
+RPC), the dense compute stays on device; the lookup records a custom grad Node
+whose vjp aggregates per-id gradients (duplicate ids sum — the reference's
+SelectedRows merge-add) and pushes them to the server-side optimizer. The table
+is *not* a trainer parameter, so the node has no differentiable inputs; the
+backward is a pure side effect, exactly like the reference's push op.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.autograd import Node, is_grad_enabled
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+
+
+def distributed_lookup_table(ids: Tensor, client, table_id: int, dim: int) -> Tensor:
+    """Pull embedding rows for `ids` from the PS; gradients push back on backward."""
+    ids_np = np.asarray(ids.numpy(), dtype=np.uint64)
+    rows = client.pull_sparse(table_id, ids_np, dim).astype(np.float32)
+    out = Tensor(rows)
+    if is_grad_enabled():
+        out_shape, out_dtype = tuple(rows.shape), np.dtype(np.float32)
+
+        def vjp_fn(cotangent):
+            g = np.asarray(cotangent, dtype=np.float32)
+            flat_ids = ids_np.reshape(-1)
+            flat_g = g.reshape(flat_ids.size, dim)
+            uniq, inv = np.unique(flat_ids, return_inverse=True)
+            merged = np.zeros((uniq.size, dim), dtype=np.float32)
+            np.add.at(merged, inv, flat_g)
+            client.push_sparse(table_id, uniq, merged, dim)
+            return ()  # no differentiable inputs; the push IS the gradient
+
+        out._stop_gradient = False
+        out._node = Node(vjp_fn, [], [(out_shape, out_dtype)],
+                         name="distributed_lookup_table")
+        out._out_index = 0
+    return out
+
+
+class DistributedEmbedding(Layer):
+    """Embedding whose table lives on the parameter server (reference
+    sparse_embedding); the trainer holds no weights for it."""
+
+    def __init__(self, table_id: int, embedding_dim: int, client=None):
+        super().__init__()
+        self.table_id = table_id
+        self.embedding_dim = embedding_dim
+        self._client = client
+
+    def set_client(self, client):
+        self._client = client
+
+    def forward(self, ids: Tensor) -> Tensor:
+        assert self._client is not None, \
+            "DistributedEmbedding needs a PSClient (fleet.init_worker wires it)"
+        return distributed_lookup_table(ids, self._client, self.table_id,
+                                        self.embedding_dim)

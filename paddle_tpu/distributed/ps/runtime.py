@@ -1,0 +1,124 @@
+"""TheOnePSRuntime: role-aware PS bootstrap facade.
+
+Reference: python/paddle/distributed/ps/the_one_ps.py:816 — _init_server builds
+C++ tables from the program's table configs (:1049), _init_worker creates the
+brpc client (:903), run_server blocks, stop_worker tears down, barriers keep
+sync-mode trainers aligned. Env contract comes from the launcher's PS controller
+(TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST / PADDLE_PORT / PADDLE_PSERVER_ID,
+launch/main.py ps mode).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .service import DenseTableConfig, PSClient, PSServer, SparseTableConfig
+
+
+class TheOnePSRuntime:
+    def __init__(self, sparse_tables: Sequence[SparseTableConfig] = (),
+                 dense_tables: Sequence[DenseTableConfig] = ()):
+        self.sparse_tables = list(sparse_tables)
+        self.dense_tables = list(dense_tables)
+        self.role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        self.server_endpoints = [e for e in os.environ.get(
+            "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+        self.trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._server: Optional[PSServer] = None
+        self._client: Optional[PSClient] = None
+        self._stop_evt = threading.Event()
+
+    def is_server(self) -> bool:
+        return self.role == "PSERVER"
+
+    def is_worker(self) -> bool:
+        return not self.is_server()
+
+    # ---- server side (the_one_ps.py:1049 _init_server) ----
+    def init_server(self) -> PSServer:
+        port = int(os.environ.get("PADDLE_PORT", "0"))
+        self._server = PSServer(port, self.sparse_tables, self.dense_tables)
+        return self._server
+
+    def run_server(self) -> None:
+        """Block serving until a client sends stop (reference fleet.run_server)."""
+        assert self._server is not None, "call init_server() first"
+        while not self._server.stop_requested() and not self._stop_evt.wait(0.2):
+            pass
+        self._server.stop()
+
+    # ---- worker side (the_one_ps.py:903 _init_worker) ----
+    def init_worker(self, model=None) -> PSClient:
+        assert self.server_endpoints, \
+            "PADDLE_PSERVERS_IP_PORT_LIST is empty — launch with --run_mode ps"
+        self._client = PSClient(self.server_endpoints)
+        for t in self.sparse_tables + self.dense_tables:
+            self._client.register_table_dim(t.table_id, t.dim)
+        if model is not None:
+            self.bind_model(model)
+        return self._client
+
+    def bind_model(self, model) -> None:
+        """Wire every DistributedEmbedding sublayer to the PS client."""
+        from .layers import DistributedEmbedding
+
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, DistributedEmbedding):
+                layer.set_client(self._client)
+
+    def barrier_worker(self, generation: int = 0) -> None:
+        if self._client is not None and self.trainers_num > 1:
+            self._client.barrier(generation, self.trainers_num)
+
+    def stop_worker(self) -> None:
+        if self._client is not None and self.trainer_id == 0:
+            self._client.stop_servers()
+
+    # ---- persistence (fleet.save_persistables -> table dump, the_one_ps.py) ----
+    def save_persistables(self, path: str) -> None:
+        assert self._client is not None
+        self._client.save(path)
+
+    def load_persistables(self, path: str) -> None:
+        assert self._client is not None
+        self._client.load(path)
+
+
+class DenseSync:
+    """Async/sync dense-parameter flow for PS training: trainer pushes dense
+    grads to the server-side optimizer and pulls fresh params back (reference
+    Communicator send/recv threads, ps/service/communicator/). `pull_interval`
+    > 1 approximates geo-async: params refresh every k steps."""
+
+    def __init__(self, client: PSClient, params: Dict[int, "object"],
+                 pull_interval: int = 1):
+        # params: table_id -> Parameter tensor (trainer-side mirror)
+        self.client = client
+        self.params = params
+        self.pull_interval = pull_interval
+        self._step = 0
+        for tid, p in params.items():
+            self.client.register_table_dim(tid, int(np.prod(p.shape)))
+            self.client.push_dense_param(tid, p.numpy().reshape(-1))
+
+    def step(self) -> None:
+        """Push this step's dense grads; pull params on the refresh interval."""
+        self._step += 1
+        for tid, p in self.params.items():
+            if p.grad is not None:
+                self.client.push_dense(tid, np.asarray(p.grad.numpy()).reshape(-1))
+                p.clear_grad() if hasattr(p, "clear_grad") else None
+        if self._step % self.pull_interval == 0:
+            self.pull()
+
+    def pull(self) -> None:
+        from ...core.tensor import Tensor
+
+        for tid, p in self.params.items():
+            vals = self.client.pull_dense(tid).reshape(p.shape)
+            p._data = Tensor(vals.astype(p.numpy().dtype))._data

@@ -1,0 +1,248 @@
+"""PSServer/PSClient ctypes bindings over core/native/ps_table.cc.
+
+Reference: PSClient::PullSparse/PushSparse (ps/service/ps_client.h:128+),
+BrpcPsServer (ps/service/brpc_ps_server.cc). The client fans requests out across
+all server instances (ids partitioned by id % n_servers; dense tables replicated
+config-wise but each lives on server `table_id % n_servers`).
+"""
+from __future__ import annotations
+
+import ctypes
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...core.native import load_library
+
+_OPTS = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+
+def _lib():
+    lib = load_library("ps_table")
+    if lib is None:
+        raise RuntimeError("parameter server requires the native ps_table library "
+                           "(g++ not available)")
+    lib.ps_server_start.restype = ctypes.c_void_p
+    lib.ps_server_start.argtypes = [ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    lib.ps_server_add_sparse_table.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
+        ctypes.c_float, ctypes.c_float, ctypes.c_int]
+    lib.ps_server_add_dense_table.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
+        ctypes.c_float]
+    lib.ps_server_sparse_size.restype = ctypes.c_int64
+    lib.ps_server_sparse_size.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.ps_server_stop.argtypes = [ctypes.c_void_p]
+    lib.ps_server_stop_requested.restype = ctypes.c_int
+    lib.ps_server_stop_requested.argtypes = [ctypes.c_void_p]
+    lib.ps_client_connect.restype = ctypes.c_void_p
+    lib.ps_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.ps_client_free.argtypes = [ctypes.c_void_p]
+    for name, argtypes in [
+        ("ps_pull_sparse", [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+                            ctypes.c_int, ctypes.c_void_p, ctypes.c_int]),
+        ("ps_push_sparse", [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+                            ctypes.c_int, ctypes.c_void_p, ctypes.c_int]),
+        ("ps_pull_dense", [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+                           ctypes.c_int]),
+        ("ps_push_dense", [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+                           ctypes.c_int]),
+        ("ps_push_dense_param", [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+                                 ctypes.c_int]),
+        ("ps_save", [ctypes.c_void_p, ctypes.c_char_p]),
+        ("ps_load", [ctypes.c_void_p, ctypes.c_char_p]),
+        ("ps_barrier", [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int]),
+        ("ps_stop_server", [ctypes.c_void_p]),
+    ]:
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int
+        fn.argtypes = argtypes
+    return lib
+
+
+@dataclass
+class SparseTableConfig:
+    table_id: int
+    dim: int
+    optimizer: str = "sgd"     # server-side sparse SGD rule (reference sparse_sgd_rule.cc)
+    learning_rate: float = 0.01
+    initial_range: float = 0.1
+    shard_num: int = 8
+
+
+@dataclass
+class DenseTableConfig:
+    table_id: int
+    dim: int
+    optimizer: str = "sgd"
+    learning_rate: float = 0.01
+
+
+class PSServer:
+    """One PS server instance hosting its shard of every configured table."""
+
+    def __init__(self, port: int = 0,
+                 sparse_tables: Sequence[SparseTableConfig] = (),
+                 dense_tables: Sequence[DenseTableConfig] = ()):
+        self._lib = _lib()
+        got = ctypes.c_int(0)
+        self._handle = self._lib.ps_server_start(port, ctypes.byref(got))
+        if not self._handle:
+            raise RuntimeError(f"PSServer: cannot bind port {port}")
+        self.port = got.value
+        for t in sparse_tables:
+            self.add_sparse_table(t)
+        for t in dense_tables:
+            self.add_dense_table(t)
+
+    def add_sparse_table(self, cfg: SparseTableConfig):
+        self._lib.ps_server_add_sparse_table(
+            self._handle, cfg.table_id, cfg.dim, _OPTS[cfg.optimizer],
+            cfg.learning_rate, cfg.initial_range, cfg.shard_num)
+
+    def add_dense_table(self, cfg: DenseTableConfig):
+        self._lib.ps_server_add_dense_table(
+            self._handle, cfg.table_id, cfg.dim, _OPTS[cfg.optimizer],
+            cfg.learning_rate)
+
+    def sparse_size(self, table_id: int) -> int:
+        return int(self._lib.ps_server_sparse_size(self._handle, table_id))
+
+    def stop_requested(self) -> bool:
+        """True once a client sent the stop command (fleet.stop_worker)."""
+        return bool(self._handle and
+                    self._lib.ps_server_stop_requested(self._handle))
+
+    def stop(self):
+        if self._handle:
+            self._lib.ps_server_stop(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class PSClient:
+    """Client fanning out over all servers; ids partitioned by id % n_servers."""
+
+    def __init__(self, endpoints: List[str], timeout: float = 60.0):
+        self._lib = _lib()
+        self._conns = []
+        for ep in endpoints:
+            host, port = ep.rsplit(":", 1)
+            h = self._lib.ps_client_connect(host.encode(), int(port),
+                                            int(timeout * 1000))
+            if not h:
+                raise TimeoutError(f"PSClient: cannot connect to {ep}")
+            self._conns.append(h)
+        self.n_servers = len(self._conns)
+        self._dims: Dict[int, int] = {}
+
+    def register_table_dim(self, table_id: int, dim: int):
+        self._dims[table_id] = dim
+
+    def _dim(self, table_id: int, dim: Optional[int]) -> int:
+        d = dim or self._dims.get(table_id)
+        assert d, f"dim unknown for table {table_id}; call register_table_dim"
+        return d
+
+    # ---- sparse (reference ps_client.h PullSparse/PushSparse) ----
+    def pull_sparse(self, table_id: int, ids: np.ndarray,
+                    dim: Optional[int] = None) -> np.ndarray:
+        d = self._dim(table_id, dim)
+        flat = np.ascontiguousarray(ids, dtype=np.uint64).reshape(-1)
+        out = np.empty((flat.size, d), dtype=np.float32)
+        for s in range(self.n_servers):
+            mask = (flat % self.n_servers) == s
+            if not mask.any():
+                continue
+            sub = np.ascontiguousarray(flat[mask])
+            rows = np.empty((sub.size, d), dtype=np.float32)
+            rc = self._lib.ps_pull_sparse(
+                self._conns[s], table_id, sub.ctypes.data, sub.size,
+                rows.ctypes.data, d)
+            if rc != 0:
+                raise RuntimeError(f"pull_sparse(table={table_id}) rc={rc}")
+            out[mask] = rows
+        return out.reshape(*ids.shape, d)
+
+    def push_sparse(self, table_id: int, ids: np.ndarray, grads: np.ndarray,
+                    dim: Optional[int] = None) -> None:
+        d = self._dim(table_id, dim)
+        flat = np.ascontiguousarray(ids, dtype=np.uint64).reshape(-1)
+        g = np.ascontiguousarray(grads, dtype=np.float32).reshape(flat.size, d)
+        for s in range(self.n_servers):
+            mask = (flat % self.n_servers) == s
+            if not mask.any():
+                continue
+            sub = np.ascontiguousarray(flat[mask])
+            gsub = np.ascontiguousarray(g[mask])
+            rc = self._lib.ps_push_sparse(
+                self._conns[s], table_id, sub.ctypes.data, sub.size,
+                gsub.ctypes.data, d)
+            if rc != 0:
+                raise RuntimeError(f"push_sparse(table={table_id}) rc={rc}")
+
+    # ---- dense: table lives on server table_id % n ----
+    def _dense_conn(self, table_id: int):
+        return self._conns[table_id % self.n_servers]
+
+    def pull_dense(self, table_id: int, dim: Optional[int] = None) -> np.ndarray:
+        d = self._dim(table_id, dim)
+        out = np.empty(d, dtype=np.float32)
+        rc = self._lib.ps_pull_dense(self._dense_conn(table_id), table_id,
+                                     out.ctypes.data, d)
+        if rc != 0:
+            raise RuntimeError(f"pull_dense(table={table_id}) rc={rc}")
+        return out
+
+    def push_dense(self, table_id: int, grads: np.ndarray) -> None:
+        g = np.ascontiguousarray(grads, dtype=np.float32).reshape(-1)
+        rc = self._lib.ps_push_dense(self._dense_conn(table_id), table_id,
+                                     g.ctypes.data, g.size)
+        if rc != 0:
+            raise RuntimeError(f"push_dense(table={table_id}) rc={rc}")
+
+    def push_dense_param(self, table_id: int, values: np.ndarray) -> None:
+        v = np.ascontiguousarray(values, dtype=np.float32).reshape(-1)
+        rc = self._lib.ps_push_dense_param(self._dense_conn(table_id), table_id,
+                                           v.ctypes.data, v.size)
+        if rc != 0:
+            raise RuntimeError(f"push_dense_param(table={table_id}) rc={rc}")
+
+    # ---- control ----
+    def save(self, path: str) -> None:
+        for s, conn in enumerate(self._conns):
+            rc = self._lib.ps_save(conn, f"{path}.part{s}".encode())
+            if rc != 0:
+                raise RuntimeError(f"save rc={rc}")
+
+    def load(self, path: str) -> None:
+        for s, conn in enumerate(self._conns):
+            rc = self._lib.ps_load(conn, f"{path}.part{s}".encode())
+            if rc != 0:
+                raise RuntimeError(f"load rc={rc}")
+
+    def barrier(self, generation: int, world: int) -> None:
+        rc = self._lib.ps_barrier(self._conns[0], generation, world)
+        if rc != 0:
+            raise RuntimeError(f"barrier rc={rc}")
+
+    def stop_servers(self) -> None:
+        for conn in self._conns:
+            self._lib.ps_stop_server(conn)
+
+    def close(self):
+        for conn in self._conns:
+            self._lib.ps_client_free(conn)
+        self._conns = []
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,192 @@
+"""Launcher CLI + elastic manager.
+
+Mirrors reference launcher tests (spawn local pods with env contract, watch,
+restart) and elastic manager tests (membership, lease expiry, watch callbacks —
+reference mocks etcd; we use the real C++ TCPStore)."""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.store import TCPStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_launch(tmp_path, script_body, extra_args=(), returncode=0):
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent(script_body))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--log_dir", str(tmp_path / "log"), *extra_args, str(script)]
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=180, cwd=REPO)
+    assert res.returncode == returncode, (res.stdout, res.stderr)
+    return res, tmp_path / "log"
+
+
+def test_launch_single_proc_env_contract(tmp_path):
+    res, log = _run_launch(tmp_path, """
+        import os
+        assert os.environ["PADDLE_TRAINER_ID"] == "0"
+        assert os.environ["PADDLE_TRAINERS_NUM"] == "1"
+        assert os.environ["PADDLE_CURRENT_ENDPOINT"]
+        assert os.environ["TRAINING_ROLE"] == "TRAINER"
+        print("env ok")
+    """)
+    assert "all 1 processes finished" in res.stdout
+    assert "env ok" in (log / "workerlog.0.log").read_text()
+
+
+def test_launch_multi_proc_ranks(tmp_path):
+    res, log = _run_launch(tmp_path, """
+        import os
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        assert len(eps) == 4
+        assert os.environ["PADDLE_CURRENT_ENDPOINT"] == eps[int(rank)]
+        print(f"rank {rank} ok")
+    """, extra_args=["--nproc_per_node", "4"])
+    seen = set()
+    for i in range(4):
+        text = (log / f"workerlog.{i}.log").read_text()
+        for r in range(4):
+            if f"rank {r} ok" in text:
+                seen.add(r)
+    assert seen == {0, 1, 2, 3}
+
+
+def test_launch_failure_terminates_pod(tmp_path):
+    res, log = _run_launch(tmp_path, """
+        import os, sys, time
+        if os.environ["PADDLE_TRAINER_ID"] == "1":
+            sys.exit(7)
+        time.sleep(60)
+    """, extra_args=["--nproc_per_node", "2"], returncode=7)
+    assert "failed rc=7" in res.stderr
+
+
+def test_launch_elastic_restart(tmp_path):
+    marker = tmp_path / "attempts"
+    res, log = _run_launch(tmp_path, f"""
+        import os, sys
+        p = {str(marker)!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        sys.exit(0 if n >= 1 else 3)  # fail on first attempt, succeed on retry
+    """, extra_args=["--elastic_level", "1", "--max_restarts", "2"])
+    assert int(marker.read_text()) == 2
+    assert "restart 1/2" in res.stdout
+
+
+def test_launch_ps_mode_roles(tmp_path):
+    res, log = _run_launch(tmp_path, """
+        import os
+        role = os.environ["TRAINING_ROLE"]
+        if role == "PSERVER":
+            assert os.environ["PADDLE_PORT"]
+            assert os.environ["PADDLE_PSERVER_ID"] in ("0", "1")
+        else:
+            assert len(os.environ["PADDLE_PSERVERS_IP_PORT_LIST"].split(",")) == 2
+        print(role, "ok")
+    """, extra_args=["--run_mode", "ps", "--server_num", "2",
+                     "--trainer_num", "2"])
+    texts = [(log / n).read_text() for n in
+             ["server.0.log", "server.1.log", "trainer.0.log", "trainer.1.log"]]
+    assert sum("PSERVER ok" in t for t in texts) == 2
+    assert sum("TRAINER ok" in t for t in texts) == 2
+
+
+# ---- elastic manager ----
+
+@pytest.fixture()
+def store():
+    return TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10.0)
+
+
+def test_elastic_membership_and_lease_expiry(store):
+    m1 = ElasticManager(store, "job1", np=2, host="node-a",
+                        heartbeat_interval=0.1, ttl=0.5)
+    m2 = ElasticManager(store, "job1", np=2, host="node-b",
+                        heartbeat_interval=0.1, ttl=0.5)
+    m1.register()
+    m2.register()
+    assert m1.wait_for_np(2, timeout=5.0)
+    assert m1.alive_nodes() == ["node-a", "node-b"]
+    assert m1.health_status() == ElasticStatus.COMPLETED
+
+    # node-b dies (heartbeat stops) -> lease expires -> scale-in restart
+    m2.exit()
+    time.sleep(1.0)
+    assert m1.alive_nodes() == ["node-a"]
+    m1.min_np = 1
+    assert m1.health_status() == ElasticStatus.RESTART
+    assert m1.endpoints_layout() == {"node-a": 0}
+    m1.exit()
+
+
+def test_elastic_watch_callback(store):
+    events = []
+    m1 = ElasticManager(store, "job2", np=1, host="w-0",
+                        heartbeat_interval=0.1, ttl=1.0)
+    m1.register()
+    m1.watch(lambda members: events.append(list(members)))
+    m2 = ElasticManager(store, "job2", np=1, host="w-1",
+                        heartbeat_interval=0.1, ttl=1.0)
+    m2.register()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not any("w-1" in e for e in events):
+        time.sleep(0.05)
+    assert any(e == ["w-0", "w-1"] for e in events), events
+    m1.exit()
+    m2.exit()
+
+
+def test_elastic_hold_below_min(store):
+    m = ElasticManager(store, "job3", np=4, min_np=2, host="solo",
+                       heartbeat_interval=0.1, ttl=1.0)
+    m.register()
+    time.sleep(0.2)
+    assert m.health_status() == ElasticStatus.HOLD  # 1 < min_np=2
+    m.exit()
+
+
+def test_multinode_endpoint_consistency(tmp_path):
+    """Two launcher invocations (--nnodes 2) must hand every worker the SAME
+    endpoint list and a worker MASTER_PORT distinct from the store port."""
+    import socket as socket_mod
+
+    with socket_mod.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "t.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        print("EPS=" + os.environ["PADDLE_TRAINER_ENDPOINTS"])
+        print("MP=" + os.environ["MASTER_PORT"])
+    """))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    cmds = [[sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "2", "--node_rank", str(nr), "--master",
+             f"127.0.0.1:{port}", "--nproc_per_node", "2",
+             "--job_id", "epjob", "--log_dir", str(tmp_path / f"log{nr}"),
+             str(script)] for nr in range(2)]
+    procs = [subprocess.Popen(c, env=env, cwd=REPO, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True) for c in cmds]
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, out
+    eps, mports = set(), set()
+    for nr in range(2):
+        for i in range(2):
+            text = (tmp_path / f"log{nr}" / f"workerlog.{i}.log").read_text()
+            eps.add([l for l in text.splitlines() if l.startswith("EPS=")][0])
+            mports.add([l for l in text.splitlines() if l.startswith("MP=")][0])
+    assert len(eps) == 1, f"endpoint lists disagree: {eps}"
+    assert len(next(iter(eps)).removeprefix("EPS=").split(",")) == 4
+    assert len(mports) == 1
+    assert next(iter(mports)) != f"MP={port}", "worker MASTER_PORT = store port"

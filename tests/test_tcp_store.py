@@ -1,0 +1,196 @@
+"""TCPStore (C++ native + Python fallback): single- and multi-process semantics.
+
+Mirrors reference tests for distributed/store (set/get/wait/add, cross-process
+rendezvous on localhost ports; reference test_dist_base.py spawns subprocess
+clusters the same way)."""
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import FileStore, TCPStore
+
+
+@pytest.fixture(scope="module")
+def store():
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=20.0)
+    yield s
+
+
+def test_native_library_builds():
+    from paddle_tpu.core.native import load_library
+
+    assert load_library("tcp_store") is not None, "C++ TCPStore must build here"
+
+
+def test_set_get_roundtrip(store):
+    store.set("k1", b"hello")
+    assert store.get("k1") == b"hello"
+    store.set("k1", "overwritten")  # str values are encoded
+    assert store.get("k1") == b"overwritten"
+
+
+def test_large_value_grows_buffer(store):
+    big = os.urandom(300_000)
+    store.set("big", big)
+    assert store.get("big") == big
+
+
+def test_add_counter(store):
+    assert store.add("ctr", 1) == 1
+    assert store.add("ctr", 5) == 6
+    assert store.add("ctr", -2) == 4
+    assert store.get("ctr") == b"4"
+
+
+def test_get_nowait_missing_raises(store):
+    with pytest.raises(KeyError):
+        store.get("missing-key", wait=False)
+
+
+def test_wait_timeout(store):
+    with pytest.raises(TimeoutError):
+        store.wait(["never-set"], timeout=0.3)
+
+
+def test_num_keys_and_delete(store):
+    before = store.num_keys()
+    store.set("del-me", b"x")
+    assert store.num_keys() == before + 1
+    assert store.delete_key("del-me")
+    assert not store.delete_key("del-me")
+    assert store.num_keys() == before
+
+
+def test_list_prefix(store):
+    store.set("nodes/0", b"a")
+    store.set("nodes/1", b"b")
+    store.set("other", b"c")
+    keys = store.list_keys("nodes/")
+    assert sorted(keys) == ["nodes/0", "nodes/1"]
+
+
+_WORKER = textwrap.dedent("""
+    import sys, time
+    from paddle_tpu.distributed.store import TCPStore
+
+    rank, world, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    store = TCPStore("127.0.0.1", port, is_master=(rank == 0), world_size=world,
+                     timeout=30.0)
+    store.set(f"rank/{rank}", str(rank))
+    # everyone reads everyone (get blocks until the key appears)
+    total = sum(int(store.get(f"rank/{r}")) for r in range(world))
+    assert total == sum(range(world)), total
+    n = store.add("joined", 1)
+    store.barrier("end", world)
+    print(f"rank{rank} OK total={total}")
+""")
+
+
+def test_multiprocess_rendezvous(tmp_path):
+    """4 processes rendezvous through rank-0's server, cross-set keys, barrier."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    world = 4
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = [subprocess.Popen([sys.executable, str(script), str(r), str(world),
+                               str(port)], env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(world)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        outs.append(out)
+        assert p.returncode == 0, f"worker failed:\n{out}"
+    assert all("OK" in o for o in outs)
+
+
+def test_python_fallback_parity(monkeypatch, tmp_path):
+    """Force the fallback path and run the same semantics."""
+    import paddle_tpu.distributed.store as store_mod
+
+    monkeypatch.setattr(store_mod, "_lib", lambda: None)
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10.0)
+    s.set("k", b"v")
+    assert s.get("k") == b"v"
+    assert s.add("c", 3) == 3
+    s.wait(["k"], timeout=1.0)
+    with pytest.raises(TimeoutError):
+        s.wait(["nope"], timeout=0.3)
+    assert sorted(s.list_keys("")) == ["c", "k"]
+    assert s.delete_key("k")
+
+
+def test_file_store(tmp_path):
+    fs = FileStore(str(tmp_path / "fs"), world_size=2)
+    fs.set("a", b"1")
+    assert fs.get("a") == b"1"
+    assert fs.add("cnt", 2) == 2
+    assert fs.add("cnt", 1) == 3
+    fs.wait(["a"], timeout=1.0)
+    with pytest.raises(TimeoutError):
+        fs.wait(["zz"], timeout=0.2)
+
+
+def test_hostname_resolution():
+    """Native client resolves hostnames (getaddrinfo), not just numeric IPv4."""
+    s = TCPStore("localhost", 0, is_master=True, world_size=1, timeout=10.0)
+    s.set("h", b"1")
+    assert s.get("h") == b"1"
+
+
+def test_server_stop_with_connected_clients_returns():
+    """Stop() must unblock Serve threads parked in recv on live connections."""
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10.0)
+    extra = TCPStore("127.0.0.1", s.port, is_master=False, world_size=1,
+                     timeout=10.0)
+    extra.set("x", b"y")
+    t0 = time.time()
+    s.__del__()  # server teardown with `extra`'s connection still open
+    assert time.time() - t0 < 5.0, "server stop hung on live client connections"
+
+
+def test_get_wait_honors_timeout():
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=0.5)
+    with pytest.raises(TimeoutError):
+        s.get("never-set-key")
+
+
+def test_store_barrier_reusable():
+    """Same barrier name synchronizes repeatedly (round-scoped done keys)."""
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=2, timeout=10.0)
+    c = TCPStore("127.0.0.1", s.port, is_master=False, world_size=2, timeout=10.0)
+    import threading
+
+    for _ in range(3):
+        t = threading.Thread(target=lambda: c.barrier("step", 2))
+        t.start()
+        s.barrier("step", 2)
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+
+def test_server_stop_unblocks_waiting_get():
+    """Teardown must not hang on a Serve thread parked in a blocking wait."""
+    import threading
+
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=30.0)
+    waiter = TCPStore("127.0.0.1", s.port, is_master=False, world_size=1,
+                      timeout=30.0)
+    t = threading.Thread(
+        target=lambda: pytest.raises(Exception, waiter.wait, ["never"], 25.0))
+    t.start()
+    time.sleep(0.3)  # let the wait park server-side
+    t0 = time.time()
+    s.__del__()
+    assert time.time() - t0 < 5.0, "Stop() hung on a parked waiter"
+    t.join(timeout=10)
