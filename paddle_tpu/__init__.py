@@ -47,6 +47,7 @@ from . import vision  # noqa: E402
 from . import distributed  # noqa: E402
 from . import jit  # noqa: E402
 from . import static  # noqa: E402
+from . import inference  # noqa: E402
 from . import metric  # noqa: E402
 from . import profiler  # noqa: E402
 from . import hapi  # noqa: E402

@@ -21,6 +21,8 @@ from .mesh import (  # noqa: F401
 )
 from .engine import TrainStepEngine, parallelize  # noqa: F401
 from .store import FileStore, TCPStore  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import ProcessMesh, shard_tensor  # noqa: F401
 from . import fleet  # noqa: F401
 from .fleet.distributed_strategy import DistributedStrategy  # noqa: F401
 from .meta_parallel.mp_layers import split  # noqa: F401

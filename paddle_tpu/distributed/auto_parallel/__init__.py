@@ -1,0 +1,22 @@
+"""Semi-auto parallel: ProcessMesh + shard annotations + Engine.
+
+Reference: python/paddle/distributed/auto_parallel/ (#38) — the user annotates a
+few tensors with (ProcessMesh, shard_spec); `completion.py` (973 LoC) propagates
+dist attrs over the whole graph, `partitioner.py` slices the program per rank and
+`reshard.py` (1501 LoC) inserts cross-mesh communication; `engine.py` wraps it in
+fit/evaluate/predict.
+
+TPU-native: annotation maps to `jax.sharding.PartitionSpec` over a named Mesh,
+and the ENTIRE completion/partition/reshard pipeline collapses into XLA's GSPMD
+pass — pjit propagates shardings to every intermediate (completion), emits the
+per-device program (partitioner), and inserts collectives where specs change
+(reshard). The Engine here builds that pjit train step; `reshard()` is
+`jax.device_put` with a new NamedSharding.
+"""
+from .process_mesh import ProcessMesh
+from .api import shard_tensor, shard_op, reshard
+from .engine import Engine
+from .strategy import Strategy
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "reshard", "Engine",
+           "Strategy"]

@@ -1,0 +1,69 @@
+"""Annotation API: shard_tensor / shard_op / reshard.
+
+Reference: python/paddle/distributed/auto_parallel/interface.py (shard_tensor
+attaches a DistAttr {process_mesh, dims_mapping}); reshard.py inserts comm ops
+when attrs disagree. TPU-native: the attr is a PartitionSpec naming mesh dims
+(None = replicated along that tensor dim); reshard is jax.device_put."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh
+
+
+def _to_spec(shard_spec: Optional[Sequence[Optional[str]]]) -> P:
+    if shard_spec is None:
+        return P()
+    return P(*[s if s else None for s in shard_spec])
+
+
+def shard_tensor(x, process_mesh: ProcessMesh = None, shard_spec=None):
+    """Annotate a tensor/parameter: dim i of x is split over mesh dim
+    shard_spec[i] (None = replicated). The annotation rides into the Engine's
+    pjit step; GSPMD completes every un-annotated tensor from these seeds."""
+    assert isinstance(x, Tensor), f"shard_tensor expects a Tensor, got {type(x)}"
+    if shard_spec is not None:
+        assert len(shard_spec) <= x.ndim, \
+            f"shard_spec {shard_spec} longer than tensor rank {x.ndim}"
+    x.dist_attr = _to_spec(shard_spec)
+    x.process_mesh = process_mesh
+    return x
+
+
+def shard_op(op_fn, process_mesh: ProcessMesh = None, in_shard_specs=None,
+             out_shard_specs=None):
+    """Annotate an op call's inputs/outputs (reference interface.py shard_op).
+    Inputs are constraint-annotated via jax.lax.with_sharding_constraint inside
+    traced code; eagerly it annotates the output tensors' dist_attr."""
+
+    def wrapper(*args, **kwargs):
+        if in_shard_specs is not None:
+            for a, spec in zip(args, in_shard_specs):
+                if isinstance(a, Tensor) and spec is not None:
+                    shard_tensor(a, process_mesh, spec)
+        out = op_fn(*args, **kwargs)
+        if out_shard_specs is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o, spec in zip(outs, out_shard_specs):
+                if isinstance(o, Tensor) and spec is not None:
+                    shard_tensor(o, process_mesh, spec)
+        return out
+
+    return wrapper
+
+
+def reshard(x: Tensor, process_mesh: ProcessMesh, shard_spec) -> Tensor:
+    """Materialize x with a new sharding (reference reshard.py's cross-mesh comm
+    insertion — here one device_put, XLA emits the collective)."""
+    import jax
+
+    mesh = process_mesh.to_jax_mesh()
+    sharding = NamedSharding(mesh, _to_spec(shard_spec))
+    out = Tensor(jax.device_put(x._data, sharding),
+                 stop_gradient=x.stop_gradient)
+    out.dist_attr = _to_spec(shard_spec)
+    out.process_mesh = process_mesh
+    return out

@@ -1,0 +1,62 @@
+"""ProcessMesh. Reference: python/paddle/distributed/auto_parallel/process_mesh.py
+(an N-D array of ranks + dim names). TPU-native it materializes as a
+jax.sharding.Mesh over the same device grid."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None):
+        self._mesh = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(self._mesh.ndim)]
+        assert len(dim_names) == self._mesh.ndim, \
+            f"{len(dim_names)} dim names for a {self._mesh.ndim}-D mesh"
+        self._dim_names = list(dim_names)
+
+    @property
+    def shape(self):
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self):
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._mesh.reshape(-1).tolist()
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def get_dim_size(self, name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(name)]
+
+    def to_jax_mesh(self, devices=None):
+        """Materialize as a jax Mesh: rank ids index into the device list."""
+        import jax
+        from jax.sharding import Mesh
+
+        devices = devices if devices is not None else jax.devices()
+        flat_ids = self._mesh.reshape(-1)
+        assert flat_ids.max() < len(devices), \
+            f"mesh references rank {flat_ids.max()} but only " \
+            f"{len(devices)} devices exist"
+        grid = np.asarray([devices[i] for i in flat_ids]).reshape(self._mesh.shape)
+        return Mesh(grid, axis_names=tuple(self._dim_names))
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"

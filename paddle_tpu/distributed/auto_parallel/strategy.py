@@ -1,0 +1,22 @@
+"""auto_parallel.Strategy: the Engine's config bundle.
+
+Reference: python/paddle/distributed/auto_parallel/strategy.py (amp/recompute/
+sharding/gradient_merge sub-configs mirroring DistributedStrategy)."""
+from __future__ import annotations
+
+
+class _Config:
+    def __init__(self, **defaults):
+        self.enable = False
+        for k, v in defaults.items():
+            setattr(self, k, v)
+
+
+class Strategy:
+    def __init__(self):
+        self.auto_mode = "semi"  # semi-auto: user seeds, GSPMD completes
+        self.seed = None
+        self.amp = _Config(dtype="bfloat16", level="O1")
+        self.recompute = _Config()
+        self.sharding = _Config(stage=1, degree=-1)
+        self.gradient_merge = _Config(k_steps=1, avg=True)

@@ -123,15 +123,8 @@ class TrainStepEngine:
 
     # ---- step function construction ----
     def _build(self, batch_avals):
-        rule_name = self.optimizer._rule
-        hyper = dict(self.optimizer._hyper)
-        wd = self.optimizer._weight_decay
-        _WD_RULES = ("sgd", "momentum", "adam", "adamw", "adamax", "adagrad",
-                     "adadelta", "rmsprop")  # lamb uses lamb_weight_decay instead
-        if rule_name in _WD_RULES:
-            hyper.setdefault("weight_decay", wd)
-        rule = opt_funct.RULES[rule_name]
-        needs_step = rule_name in opt_funct._NEEDS_STEP
+        update = opt_funct.make_tree_update(
+            self.optimizer, {n: self._state_refs[n] for n in self._param_names})
         clip = self.optimizer._grad_clip
         model = self.model
         loss_fn = self.loss_fn
@@ -163,16 +156,7 @@ class TrainStepEngine:
 
             loss, grads = jax.value_and_grad(compute_loss)(params)
             grads = opt_funct.clip_grads(grads, clip)
-
-            new_params = {}
-            new_opt = {}
-            for n, p in params.items():
-                kw = dict(hyper)
-                if needs_step:
-                    kw["step"] = step_i
-                np_, ns_ = rule(p, grads[n], opt_state[n], lr=lr, **kw)
-                new_params[n] = np_
-                new_opt[n] = ns_
+            new_params, new_opt = update(params, grads, opt_state, lr, step_i)
             return loss, new_params, new_opt
 
         param_shardings = {n: NamedSharding(self.mesh, s) for n, s in self.param_specs.items()}

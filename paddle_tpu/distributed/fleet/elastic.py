@@ -44,12 +44,32 @@ class ElasticManager:
         self._callbacks: List[Callable[[List[str]], None]] = []
         self._last_members: List[str] = []
         self._beat_seq = 0
+        self._hb_store_obj = None
         # node -> (last seen heartbeat seq, local monotonic time it changed);
         # liveness is judged by seq *progress* against the reader's own clock, so
         # cross-node wall-clock skew cannot expire a healthy node's lease
         self._seen: Dict[str, tuple] = {}
 
     # ---- membership registry (reference manager.py:247 lease/heartbeat) ----
+    def _hb_store(self):
+        """Heartbeats get their OWN store connection: the main connection
+        serializes requests, so a long blocking wait/barrier there would starve
+        the lease and peers would declare this healthy node dead."""
+        if self._hb_store_obj is None:
+            from ..store import TCPStore
+
+            s = self.store
+            if isinstance(s, TCPStore) and not s.is_master:
+                try:
+                    self._hb_store_obj = TCPStore(s.host, s.port, is_master=False,
+                                                  world_size=s.world_size,
+                                                  timeout=s.timeout)
+                except Exception:
+                    self._hb_store_obj = s
+            else:
+                self._hb_store_obj = s
+        return self._hb_store_obj
+
     def register(self) -> None:
         self._beat()
         self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
@@ -57,8 +77,9 @@ class ElasticManager:
 
     def _beat(self) -> None:
         self._beat_seq += 1
-        self.store.set(self._prefix + self.host,
-                       json.dumps({"seq": self._beat_seq, "host": self.host}))
+        self._hb_store().set(self._prefix + self.host,
+                             json.dumps({"seq": self._beat_seq,
+                                         "host": self.host}))
 
     def _hb_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_interval):

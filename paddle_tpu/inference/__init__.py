@@ -1,0 +1,130 @@
+"""paddle.inference equivalent: Config + Predictor over jit.save artifacts.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.h:93 — AnalysisPredictor
+loads a ProgramDesc, runs an IR pass pipeline, executes via NaiveExecutor with
+zero-copy in/out tensors. TPU-native: the artifact is serialized StableHLO
+(already optimized by XLA at export; the pass pipeline role), execution is the
+compiled XLA program; handles expose the same copy_from_cpu/copy_to_cpu API.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Config:
+    """paddle.inference.Config parity (api/paddle_analysis_config.h)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # accept either the artifact prefix or the explicit .pdmodel path
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self.model_path = prog_file
+        self.params_file = params_file
+        self._device = "tpu"
+        self._device_id = 0
+
+    def set_prog_file(self, path: str):
+        self.model_path = path[:-len(".pdmodel")] if path.endswith(".pdmodel") \
+            else path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device, self._device_id = "gpu", device_id
+
+    def enable_tpu(self, device_id=0):
+        self._device, self._device_id = "tpu", device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self):
+        pass  # XLA already optimizes the exported program
+
+    def switch_ir_optim(self, enable=True):
+        pass
+
+    def prog_file(self):
+        return self.model_path
+
+
+class _IOHandle:
+    """Zero-copy-style tensor handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._array: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, data: np.ndarray):
+        self._array = np.asarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        assert self._array is not None, f"output {self.name!r}: run() first"
+        return np.asarray(self._array)
+
+    def reshape(self, shape):
+        if self._array is not None:
+            self._array = self._array.reshape(shape)
+
+    @property
+    def shape(self):
+        return None if self._array is None else tuple(self._array.shape)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        assert config.model_path, "Config needs the model path prefix"
+        self._layer = jit_load(config.model_path)
+        n_in = len(self._layer._input_specs)
+        self._input_names = [f"input_{i}" for i in range(n_in)]
+        self._inputs: Dict[str, _IOHandle] = {
+            n: _IOHandle(n) for n in self._input_names}
+        self._outputs: List[_IOHandle] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """AnalysisPredictor::Run: execute the loaded program. Either feed
+        through handles (copy_from_cpu) or pass arrays directly."""
+        if inputs is not None:
+            if len(inputs) != len(self._input_names):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs; the model has "
+                    f"{len(self._input_names)} ({self._input_names})")
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        args = [self._inputs[n]._array for n in self._input_names]
+        assert all(a is not None for a in args), \
+            "feed every input via copy_from_cpu before run()"
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = []
+        for i, o in enumerate(outs):
+            h = _IOHandle(f"output_{i}")
+            h.copy_from_cpu(o.numpy())
+            self._outputs.append(h)
+        if inputs is not None:
+            return [h.copy_to_cpu() for h in self._outputs]
+
+    def get_output_names(self) -> List[str]:
+        return [h.name for h in self._outputs]
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        for h in self._outputs:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+__all__ = ["Config", "Predictor", "create_predictor"]

@@ -65,6 +65,19 @@ def functional_call(layer, state: Dict[str, Any], *args, **kwargs):
         return layer(*args, **kwargs)
 
 
+def functional_call_with_state(layer, state: Dict[str, Any], *args, **kwargs):
+    """functional_call that also returns the post-forward state arrays, capturing
+    in-place buffer mutations the forward performed (BatchNorm running stats).
+    Returns (out, new_state: name -> array)."""
+    named = dict(layer.state_dict(include_non_persistable_buffer=True))
+    with _swapped_state(layer, state), _tracing(), no_grad():
+        out = layer(*args, **kwargs)
+        # read BEFORE _swapped_state restores the originals: the layer's tensors
+        # currently hold the traced (possibly updated) arrays
+        new_state = {name: t._data for name, t in named.items()}
+    return out, new_state
+
+
 def _unwrap(out):
     if isinstance(out, Tensor):
         return out._data
@@ -145,19 +158,140 @@ def to_static(layer_or_function=None, input_spec=None, build_strategy=None, **kw
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save parity: persist params + a marker (program serialization lands with
-    the static Program IR, static/)."""
-    from ..framework import io as fio
+    """Serialize a Layer to a self-contained inference artifact.
 
-    fio.save(layer.state_dict(), path + ".pdparams")
+    Reference: paddle.jit.save exports a ProgramDesc + params
+    (python/paddle/fluid/dygraph/jit.py). TPU-native: the "program" is portable
+    serialized StableHLO (jax.export) of the traced forward — loadable and
+    runnable with NO model code, the same contract as the reference's saved
+    inference model. Writes `{path}.pdmodel` (StableHLO), `{path}.pdiparams`
+    (state dict), `{path}.pdmodel.meta` (json: input specs + param order).
+    """
+    import json
+
+    from jax import export as jax_export
+
+    from ..framework import io as fio
+    from ..static import InputSpec
+
+    if input_spec is None:
+        raise ValueError("paddle.jit.save needs input_spec=[InputSpec(shape, "
+                         "dtype)] (or example Tensors) to trace the forward")
+    specs = []  # (shape with None preserved, dtype) — meta keeps the user intent
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            shape = tuple(None if (d is None or d < 0) else d for d in s.shape)
+            specs.append((shape, str(s.dtype)))
+        elif isinstance(s, Tensor):
+            specs.append((tuple(s.shape), str(s.dtype)))
+        else:
+            a = jnp.asarray(s)
+            specs.append((tuple(a.shape), str(a.dtype)))
+
+    state = layer.state_dict(include_non_persistable_buffer=True)
+    param_names = list(state.keys())
+    was_training = layer.training
+    layer.eval()
+
+    def fn(params_seq, *input_arrays):
+        inner = dict(zip(param_names, params_seq))
+        out = functional_call(layer, inner,
+                              *[Tensor(a, stop_gradient=True)
+                                for a in input_arrays])
+        return _unwrap(out)
+
+    try:
+        from ..core.dtype import convert_dtype
+
+        # dynamic dims (None/-1 in InputSpec) export as symbolic dimensions so
+        # the artifact serves any size along them (paddle's variable-batch idiom)
+        n_dyn = sum(d is None for sh, _ in specs for d in sh)
+        sym_dims = iter(jax_export.symbolic_shape(
+            ",".join(f"_dyn{i}" for i in range(n_dyn))) if n_dyn else ())
+        arg_structs = [
+            jax.ShapeDtypeStruct(
+                tuple(next(sym_dims) if d is None else d for d in sh),
+                convert_dtype(dt))
+            for sh, dt in specs]
+        param_structs = [jax.ShapeDtypeStruct(tuple(t.shape),
+                                              convert_dtype(str(t.dtype)))
+                         for t in state.values()]
+        exported = jax_export.export(jax.jit(fn))(param_structs, *arg_structs)
+    finally:
+        if was_training:
+            layer.train()
+
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    fio.save(state, path + ".pdiparams")
+    with open(path + ".pdmodel.meta", "w") as f:
+        json.dump({"param_names": param_names, "input_specs": specs}, f)
 
 
 def load(path, **configs):
-    raise NotImplementedError("jit.load: lands with static Program IR")
+    """Load a jit.save artifact into a TranslatedLayer (inference-only, like the
+    reference's paddle.jit.load of an inference model)."""
+    return TranslatedLayer._load(path)
 
 
 class TranslatedLayer:
-    pass
+    """Runs a serialized StableHLO program with its params. No model code needed;
+    the analogue of the reference TranslatedLayer (dygraph/io.py)."""
+
+    def __init__(self, exported, params, param_names, input_specs):
+        self._exported = exported
+        self._params = params  # name -> Tensor
+        self._param_names = param_names
+        self._input_specs = input_specs
+        self.training = False
+
+    @classmethod
+    def _load(cls, path):
+        import json
+
+        from jax import export as jax_export
+
+        from ..framework import io as fio
+
+        with open(path + ".pdmodel", "rb") as f:
+            exported = jax_export.deserialize(f.read())
+        params = fio.load(path + ".pdiparams")
+        with open(path + ".pdmodel.meta") as f:
+            meta = json.load(f)
+        params = {k: (v if isinstance(v, Tensor) else Tensor(jnp.asarray(v)))
+                  for k, v in params.items()}
+        return cls(exported, params, meta["param_names"], meta["input_specs"])
+
+    def __call__(self, *inputs):
+        arrays = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                  for i in inputs]
+        param_seq = [self._params[n]._data for n in self._param_names]
+        out = self._exported.call(param_seq, *arrays)
+        if isinstance(out, (list, tuple)):
+            return type(out)(Tensor(o, stop_gradient=True) for o in out)
+        return Tensor(out, stop_gradient=True)
+
+    forward = __call__
+
+    def parameters(self, include_sublayers=True):
+        return list(self._params.values())
+
+    def state_dict(self, *a, **k):
+        return dict(self._params)
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        for k, v in state_dict.items():
+            if k in self._params:
+                self._params[k] = v if isinstance(v, Tensor) else Tensor(
+                    jnp.asarray(v))
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only (the exported "
+                           "StableHLO program has no backward)")
 
 
 def not_to_static(fn):

@@ -328,17 +328,16 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
     if not use_batch_stats:
         return result
     out, bm, bv = result
-    from ..jit import in_jit_trace
-
-    if not in_jit_trace():
-        # stateful running-stat update (the reference's batch_norm op side outputs);
-        # inside a trace, stat updates are the engine's job (functional state)
-        if running_mean is not None:
-            running_mean.set_value(momentum * running_mean._data + (1 - momentum) * bm._data)
-        if running_var is not None:
-            n = x._data.size / x._data.shape[ch_axis]
-            unbiased = bv._data * (n / builtins_max(n - 1, 1))
-            running_var.set_value(momentum * running_var._data + (1 - momentum) * unbiased)
+    # stateful running-stat update (the reference's batch_norm op side outputs).
+    # Inside a trace this stores traced arrays into the (swapped) buffer tensors;
+    # functional_call_with_state reads them out as the step's new buffer state,
+    # and _swapped_state restores the eager originals afterwards.
+    if running_mean is not None:
+        running_mean.set_value(momentum * running_mean._data + (1 - momentum) * bm._data)
+    if running_var is not None:
+        n = x._data.size / x._data.shape[ch_axis]
+        unbiased = bv._data * (n / builtins_max(n - 1, 1))
+        running_var.set_value(momentum * running_var._data + (1 - momentum) * unbiased)
     return out
 
 

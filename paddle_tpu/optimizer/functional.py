@@ -218,3 +218,25 @@ def jitted_rule(rule: str, **static_kwargs):
 
         _jitted_cache[key] = jax.jit(wrapped)
     return _jitted_cache[key]
+
+
+def make_tree_update(optimizer, param_objs):
+    """Build update(params, grads, opt_state, lr, step_i) -> (new_params, new_opt)
+    for a dict of named parameters, honoring the optimizer's per-param rule
+    kwargs (weight-decay exclusion via apply_decay_param_fun, lamb exclusions).
+    Shared by TrainStepEngine and auto_parallel.Engine so the traced update
+    logic exists exactly once."""
+    rule = RULES[optimizer._rule]
+    needs_step = optimizer._rule in _NEEDS_STEP
+    kwargs_by_name = {n: optimizer._rule_kwargs(p) for n, p in param_objs.items()}
+
+    def update(params, grads, opt_state, lr, step_i):
+        new_params, new_opt = {}, {}
+        for n, p in params.items():
+            kw = dict(kwargs_by_name[n])
+            if needs_step:
+                kw["step"] = step_i
+            new_params[n], new_opt[n] = rule(p, grads[n], opt_state[n], lr=lr, **kw)
+        return new_params, new_opt
+
+    return update

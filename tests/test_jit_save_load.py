@@ -1,0 +1,111 @@
+"""jit.save/load (StableHLO artifact) + inference Predictor.
+
+Mirrors reference tests test_jit_save_load.py / inference api tests (save an
+inference model, load WITHOUT model code, outputs match eager)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.static import InputSpec
+
+
+def make_net():
+    paddle.seed(7)
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(8, 32), paddle.nn.ReLU(),
+        paddle.nn.LayerNorm(32), paddle.nn.Linear(32, 5))
+
+
+def test_save_load_output_parity(tmp_path):
+    net = make_net()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([4, 8], "float32")])
+
+    loaded = paddle.jit.load(path)
+    x = np.random.RandomState(0).randn(4, 8).astype("float32")
+    eager = net(paddle.to_tensor(x)).numpy()
+    out = loaded(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, eager, rtol=2e-5, atol=1e-6)
+
+
+def test_loaded_layer_needs_no_model_code(tmp_path):
+    """The artifact must run via a fresh TranslatedLayer with no Layer class."""
+    net = make_net()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+    del net
+
+    loaded = paddle.jit.load(path)
+    out = loaded(np.zeros((2, 8), dtype="float32"))
+    assert tuple(out.shape) == (2, 5)
+    assert len(loaded.parameters()) > 0
+    with pytest.raises(RuntimeError, match="inference-only"):
+        loaded.train()
+
+
+def test_save_respects_eval_mode_dropout(tmp_path):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 4), paddle.nn.Dropout(0.9))
+    net.train()  # jit.save must trace in eval mode regardless
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([3, 4], "float32")])
+    assert net.training  # restored
+    loaded = paddle.jit.load(path)
+    x = np.ones((3, 4), dtype="float32")
+    o1, o2 = loaded(x).numpy(), loaded(x).numpy()
+    np.testing.assert_array_equal(o1, o2)  # no dropout randomness in the artifact
+
+
+def test_predictor_handle_api(tmp_path):
+    net = make_net()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([4, 8], "float32")])
+
+    config = Config(path + ".pdmodel")
+    predictor = create_predictor(config)
+    names = predictor.get_input_names()
+    assert names == ["input_0"]
+    x = np.random.RandomState(1).randn(4, 8).astype("float32")
+    predictor.get_input_handle("input_0").copy_from_cpu(x)
+    predictor.run()
+    out = predictor.get_output_handle("output_0").copy_to_cpu()
+    np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
+                               rtol=2e-5, atol=1e-6)
+
+
+def test_predictor_run_with_inputs_shortcut(tmp_path):
+    net = make_net()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([1, 8], "float32")])
+    predictor = create_predictor(Config(path))
+    outs = predictor.run([np.zeros((1, 8), dtype="float32")])
+    assert outs[0].shape == (1, 5)
+
+
+def test_save_requires_input_spec(tmp_path):
+    with pytest.raises(ValueError, match="input_spec"):
+        paddle.jit.save(make_net(), str(tmp_path / "m"))
+
+
+def test_dynamic_batch_dim_exports_symbolically(tmp_path):
+    """InputSpec([None, 8]) must serve ANY batch size, not freeze batch=1."""
+    net = make_net()
+    path = str(tmp_path / "dyn")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    for bs in (1, 4, 7):
+        x = np.random.RandomState(bs).randn(bs, 8).astype("float32")
+        out = loaded(x)
+        assert tuple(out.shape) == (bs, 5)
+        np.testing.assert_allclose(out.numpy(), net(paddle.to_tensor(x)).numpy(),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_predictor_rejects_wrong_input_count(tmp_path):
+    net = make_net()
+    path = str(tmp_path / "m2")
+    paddle.jit.save(net, path, input_spec=[InputSpec([1, 8], "float32")])
+    predictor = create_predictor(Config(path))
+    with pytest.raises(ValueError, match="got 2 inputs"):
+        predictor.run([np.zeros((1, 8), "float32"), np.zeros((1, 8), "float32")])
