@@ -48,6 +48,11 @@ from . import distributed  # noqa: E402
 from . import jit  # noqa: E402
 from . import static  # noqa: E402
 from . import inference  # noqa: E402
+from . import fft  # noqa: E402
+from . import distribution  # noqa: E402
+from . import sparse  # noqa: E402
+from . import text  # noqa: E402
+from . import incubate  # noqa: E402
 from . import metric  # noqa: E402
 from . import profiler  # noqa: E402
 from . import hapi  # noqa: E402

@@ -90,6 +90,12 @@ def _is_float_array(x):
     return dtypes.is_floating(x.dtype)
 
 
+def _is_inexact_array(x):
+    """Differentiable dtypes: floats AND complex (fft ops). Autocast keeps using
+    _is_float_array — complex must never be cast to bf16."""
+    return dtypes.is_floating(x.dtype) or np.dtype(x.dtype).kind == "c"
+
+
 def _autocast_dtype_for(name: str, arrays):
     ctx = amp_ctx()
     if ctx is None:
@@ -128,7 +134,7 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
     cast_to = _autocast_dtype_for(name, arrays)
 
     if nondiff_mask is None:
-        nondiff_mask = [not _is_float_array(a) for a in arrays]
+        nondiff_mask = [not _is_inexact_array(a) for a in arrays]
 
     diff_idx = [i for i, nd in enumerate(nondiff_mask) if not nd]
     aux_idx = [i for i, nd in enumerate(nondiff_mask) if nd]

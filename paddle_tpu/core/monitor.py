@@ -1,0 +1,86 @@
+"""Runtime counters/stats registry.
+
+Reference: paddle/fluid/platform/monitor.h:77 (StatRegistry/StatValue,
+DEFINE_INT_STATUS) + memory/stats.h (STAT_* memory high-water marks), exposed
+to Python via global_value_getter_setter.cc. TPU-native: the registry is
+in-process; device memory stats come from PJRT's memory_stats().
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+
+class StatValue:
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0
+        self._max = 0
+        self._lock = threading.Lock()
+
+    def increase(self, n: int = 1) -> int:
+        with self._lock:
+            self._v += n
+            self._max = max(self._max, self._v)
+            return self._v
+
+    def decrease(self, n: int = 1) -> int:
+        with self._lock:
+            self._v -= n
+            return self._v
+
+    def set(self, v: int) -> None:
+        with self._lock:
+            self._v = v
+            self._max = max(self._max, v)
+
+    def get(self) -> int:
+        return self._v
+
+    def peak(self) -> int:
+        return self._max
+
+
+class StatRegistry:
+    def __init__(self):
+        self._stats: Dict[str, StatValue] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str) -> StatValue:
+        with self._lock:
+            if name not in self._stats:
+                self._stats[name] = StatValue(name)
+            return self._stats[name]
+
+    def names(self) -> List[str]:
+        return sorted(self._stats)
+
+    def report(self) -> Dict[str, Dict[str, int]]:
+        return {n: {"value": s.get(), "peak": s.peak()}
+                for n, s in self._stats.items()}
+
+
+_registry = StatRegistry()
+
+
+def stat(name: str) -> StatValue:
+    """DEFINE_INT_STATUS equivalent: auto-registered named counter."""
+    return _registry.get(name)
+
+
+def registry() -> StatRegistry:
+    return _registry
+
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    """Device memory stats via PJRT (the reference's STAT_GPU_MEM hwm family,
+    memory/stats.h). Keys depend on the backend; bytes_in_use/peak_bytes_in_use
+    are present on TPU and GPU, absent on CPU (returns {})."""
+    import jax
+
+    dev = device or jax.devices()[0]
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    return dict(stats) if stats else {}

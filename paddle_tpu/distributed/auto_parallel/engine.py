@@ -145,8 +145,14 @@ class Engine:
             self.prepare()
         from ...io import DataLoader, Dataset
 
-        loader = train_data if not isinstance(train_data, Dataset) else \
-            DataLoader(train_data, batch_size=batch_size, shuffle=True)
+        if isinstance(train_data, Dataset):
+            # drop the indivisible tail batch, but never drop EVERYTHING: a
+            # dataset smaller than batch_size trains on its single batch
+            drop_last = len(train_data) >= batch_size
+            loader = DataLoader(train_data, batch_size=batch_size, shuffle=True,
+                                drop_last=drop_last)
+        else:
+            loader = train_data
         if self._step_fn is None:
             self._step_fn = self._build(train=True)
         history = []
@@ -156,7 +162,11 @@ class Engine:
                 if steps_per_epoch is not None and i >= steps_per_epoch:
                     break
                 losses.append(self._run_step(batch))
-            avg = float(np.mean(losses)) if losses else float("nan")
+            if not losses:
+                raise ValueError(
+                    "Engine.fit: the data loader yielded no batches "
+                    f"(dataset smaller than batch_size={batch_size}?)")
+            avg = float(np.mean(losses))
             history.append(avg)
             if verbose:
                 print(f"epoch {epoch + 1}/{epochs} loss={avg:.4f}", flush=True)
@@ -166,9 +176,16 @@ class Engine:
 
     def _run_step(self, batch) -> float:
         batch = batch if isinstance(batch, (list, tuple)) else [batch]
+        dp_axis = self._process_mesh.dim_names[0]
+        dp_size = self._process_mesh.get_dim_size(dp_axis)
         arrays = []
         for b in batch:
             a = b._data if isinstance(b, Tensor) else np.asarray(b)
+            if getattr(a, "ndim", 0) >= 1 and a.shape[0] % dp_size != 0:
+                raise ValueError(
+                    f"batch dim {a.shape[0]} not divisible by the '{dp_axis}' "
+                    f"mesh dim ({dp_size}); use a divisible batch_size and "
+                    f"drop_last=True (partial last batch)")
             arrays.append(jax.device_put(
                 a, NamedSharding(self.mesh,
                                  self._data_spec(getattr(a, "ndim", 0)))))
@@ -184,6 +201,8 @@ class Engine:
     def evaluate(self, eval_data, batch_size: int = 1):
         if not self._prepared:
             self.prepare()
+        was_training = self.model.training
+        self.model.eval()  # before tracing: eval-mode dropout/BN bake into the jit
         if self._eval_fn is None:
             self._eval_fn = jax.jit(self._build(train=False))
         from ...io import DataLoader, Dataset
@@ -198,6 +217,8 @@ class Engine:
             self._key, sub = jax.random.split(self._key)
             losses.append(float(self._eval_fn(self.params, self.buffers, sub,
                                               *arrays)))
+        if was_training:
+            self.model.train()
         return {"loss": float(np.mean(losses))}
 
     def predict(self, data, batch_size: int = 1):

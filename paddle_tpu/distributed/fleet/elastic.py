@@ -59,7 +59,9 @@ class ElasticManager:
             from ..store import TCPStore
 
             s = self.store
-            if isinstance(s, TCPStore) and not s.is_master:
+            if isinstance(s, TCPStore):
+                # also on the master node: connect a second CLIENT to its own
+                # server, so its heartbeats never queue behind a blocking wait
                 try:
                     self._hb_store_obj = TCPStore(s.host, s.port, is_master=False,
                                                   world_size=s.world_size,

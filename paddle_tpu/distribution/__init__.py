@@ -1,0 +1,268 @@
+"""paddle.distribution equivalent.
+
+Reference: python/paddle/distribution/ (Distribution base, Normal, Uniform,
+Beta, Dirichlet, Categorical, Multinomial, ExponentialFamily, Independent,
+TransformedDistribution, kl_divergence registry). TPU-native: sampling uses the
+framework RNG (jax.random under the hood), densities are jnp/jax.scipy.stats.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, dtype=jnp.float32) if not hasattr(x, "dtype") else jnp.asarray(x)
+
+
+def _key():
+    return random_mod.next_key()
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(_key(), shape, dtype=jnp.float32)
+        return Tensor(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _t(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale) + jnp.zeros(self.batch_shape))
+
+    def cdf(self, value):
+        v = _t(value)
+        return Tensor(0.5 * (1 + jax.scipy.special.erf(
+            (v - self.loc) / (self.scale * math.sqrt(2)))))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_key(), shape, dtype=jnp.float32)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _t(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low) + jnp.zeros(self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        assert (probs is None) != (logits is None), "give exactly one of probs/logits"
+        if probs is not None:
+            self.probs = _t(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _t(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(_key(), self.probs, shape)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return Tensor(v * jax.nn.log_sigmoid(self.logits)
+                      + (1 - v) * jax.nn.log_sigmoid(-self.logits))
+
+    def entropy(self):
+        p = self.probs
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        self._log_norm = jax.nn.log_softmax(self.logits, axis=-1)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.categorical(_key(), self.logits, shape=shape)
+                      .astype(jnp.int64))
+
+    def log_prob(self, value):
+        v = jnp.asarray(_t(value), jnp.int32)
+        return Tensor(jnp.take_along_axis(self._log_norm, v[..., None],
+                                          axis=-1)[..., 0])
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        p = jnp.exp(self._log_norm)
+        return Tensor(-(p * self._log_norm).sum(-1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.beta(_key(), self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        v = _t(value)
+        lbeta = (jax.scipy.special.gammaln(self.alpha)
+                 + jax.scipy.special.gammaln(self.beta)
+                 - jax.scipy.special.gammaln(self.alpha + self.beta))
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(_key(), self.concentration, shape))
+
+    def log_prob(self, value):
+        v = _t(value)
+        c = self.concentration
+        lnorm = (jax.scipy.special.gammaln(c).sum(-1)
+                 - jax.scipy.special.gammaln(c.sum(-1)))
+        return Tensor(((c - 1) * jnp.log(v)).sum(-1) - lnorm)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        logits = jnp.log(self.probs)
+        draws = jax.random.categorical(
+            _key(), logits, shape=(self.total_count,) + shape)
+        k = self.probs.shape[-1]
+        return Tensor(jax.nn.one_hot(draws, k).sum(0))
+
+    def log_prob(self, value):
+        v = _t(value)
+        logits = jnp.log(self.probs)
+        return Tensor(jax.scipy.special.gammaln(self.total_count + 1)
+                      - jax.scipy.special.gammaln(v + 1).sum(-1)
+                      + (v * logits).sum(-1))
+
+
+# ---- kl registry (reference python/paddle/distribution/kl.py) ----
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence not registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    pp = jnp.exp(p._log_norm)
+    return Tensor((pp * (p._log_norm - q._log_norm)).sum(-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    pr, qr = p.probs, q.probs
+    return Tensor(pr * (jnp.log(pr) - jnp.log(qr))
+                  + (1 - pr) * (jnp.log1p(-pr) - jnp.log1p(-qr)))
+
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Beta", "Dirichlet", "Multinomial", "kl_divergence", "register_kl"]

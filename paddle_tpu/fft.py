@@ -1,0 +1,79 @@
+"""paddle.fft equivalent. Reference: python/paddle/fft.py (~1.6k LoC of
+wrappers over fft C++ ops). TPU-native: jnp.fft lowers to XLA's FFT HLO; grads
+come from jax's fft differentiation rules through the eager tape."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply
+from .core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _norm(norm):
+    # paddle uses "backward"/"forward"/"ortho" like numpy
+    return norm if norm in ("backward", "forward", "ortho") else "backward"
+
+
+def _wrap1(op_name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(op_name, lambda a: fn(a, n=n, axis=axis, norm=_norm(norm)),
+                     [_t(x)])
+    op.__name__ = op_name
+    return op
+
+
+def _wrap2(op_name, fn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply(op_name, lambda a: fn(a, s=s, axes=axes, norm=_norm(norm)),
+                     [_t(x)])
+    op.__name__ = op_name
+    return op
+
+
+def _wrapn(op_name, fn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply(op_name, lambda a: fn(a, s=s, axes=axes, norm=_norm(norm)),
+                     [_t(x)])
+    op.__name__ = op_name
+    return op
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+fft2 = _wrap2("fft2", jnp.fft.fft2)
+ifft2 = _wrap2("ifft2", jnp.fft.ifft2)
+rfft2 = _wrap2("rfft2", jnp.fft.rfft2)
+irfft2 = _wrap2("irfft2", jnp.fft.irfft2)
+fftn = _wrapn("fftn", jnp.fft.fftn)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), [_t(x)])
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), [_t(x)])
+
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
+           "rfftfreq", "fftshift", "ifftshift"]

@@ -1,0 +1,6 @@
+"""paddle.incubate extras. Reference: python/paddle/incubate/ (#54) —
+ASP (2:4 structured sparsity), LookAhead and ModelAverage optimizers."""
+from . import asp
+from .optimizer import LookAhead, ModelAverage
+
+__all__ = ["asp", "LookAhead", "ModelAverage"]

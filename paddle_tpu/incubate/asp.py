@@ -1,0 +1,95 @@
+"""ASP: automatic structured (n:m) sparsity.
+
+Reference: python/paddle/fluid/contrib/sparsity/ + fleet asp_optimizer.py —
+create 2:4 masks over FC/conv weights, prune, and re-apply masks after each
+optimizer step so training stays on the sparse support. On TPU there is no
+sparse-tensor-core datapath; the win is the same training recipe (masked
+weights) with XLA folding the elementwise mask into the matmul epilogue.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+_masks: Dict[int, np.ndarray] = {}  # id(param) -> mask
+
+
+def calculate_density(x) -> float:
+    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def check_sparsity(x, n=2, m=4) -> bool:
+    """True if every group of m consecutive weights (last axis) has <= n nonzeros."""
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    if arr.shape[-1] % m != 0:
+        return False
+    groups = arr.reshape(-1, m)
+    return bool(((groups != 0).sum(1) <= n).all())
+
+
+def create_mask(x, n=2, m=4) -> np.ndarray:
+    """Keep the n largest-|w| entries in each group of m along the last axis
+    (the reference's MaskAlgo_MASK_1D)."""
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    orig_shape = arr.shape
+    assert orig_shape[-1] % m == 0, \
+        f"last dim {orig_shape[-1]} not divisible by m={m}"
+    groups = np.abs(arr.reshape(-1, m))
+    keep = np.argsort(-groups, axis=1)[:, :n]
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, keep, 1.0, axis=1)
+    return mask.reshape(orig_shape).astype(arr.dtype)
+
+
+def _prunable_params(model: Layer):
+    from ..nn.layers.common import Linear
+    from ..nn.layers.conv_pool import _ConvNd
+
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, (Linear, _ConvNd)):
+            w = getattr(layer, "weight", None)
+            if w is not None and w.ndim >= 2 and w.shape[-1] % 4 == 0:
+                yield w
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d",
+                with_mask=True) -> Dict[str, float]:
+    """Apply n:m masks to every FC/conv weight (reference sparsity.prune_model).
+    Returns name->density after pruning. Masks are remembered so
+    decorate()d optimizers re-apply them after each step."""
+    import jax.numpy as jnp
+
+    densities = {}
+    for w in _prunable_params(model):
+        mask = create_mask(w, n, m)
+        _masks[id(w)] = mask
+        w._data = w._data * jnp.asarray(mask)
+        densities[w.name or str(id(w))] = calculate_density(w)
+    return densities
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply the stored masks after the update
+    (reference OptimizerWithSparsityGuarantee / asp_optimizer.py)."""
+    import jax.numpy as jnp
+
+    inner_step = optimizer.step
+
+    def step():
+        inner_step()
+        for p in optimizer._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._data = p._data * jnp.asarray(mask)
+
+    optimizer.step = step
+    return optimizer
+
+
+def reset_excluded_layers(model=None):
+    _masks.clear()

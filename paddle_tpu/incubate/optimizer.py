@@ -1,0 +1,100 @@
+"""LookAhead + ModelAverage. Reference: python/paddle/incubate/optimizer/
+lookahead.py and modelaverage.py."""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+
+
+class LookAhead:
+    """k steps forward, 1 step back (arXiv:1907.08610). Wraps an inner optimizer;
+    every k steps the slow weights interpolate toward the fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._steps = 0
+        self._slow: Dict[int, object] = {
+            id(p): p._data for p in inner_optimizer._parameter_list}
+
+    @property
+    def _parameters(self):
+        return self.inner_optimizer._parameter_list
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for p in self.inner_optimizer._parameter_list:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_steps"] = self._steps
+        return sd
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Maintains a running average of parameters; apply()/restore() swaps the
+    averaged weights in for evaluation (reference modelaverage.py with
+    min/max_average_window semantics simplified to a cumulative mean)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000, name=None):
+        assert parameters is not None, "ModelAverage needs the parameter list"
+        self._parameters = list(parameters)
+        self._sum = {id(p): jnp.zeros_like(p._data) for p in self._parameters}
+        self._count = 0
+        self._backup = None
+
+    @no_grad()
+    def step(self):
+        for p in self._parameters:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        assert self._count > 0, "ModelAverage.step() never ran"
+        self._backup = {id(p): p._data for p in self._parameters}
+        for p in self._parameters:
+            p._data = self._sum[id(p)] / self._count
+        return _RestoreCtx(self) if need_restore else None
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p in self._parameters:
+                p._data = self._backup[id(p)]
+            self._backup = None
+
+
+class _RestoreCtx:
+    def __init__(self, ma):
+        self._ma = ma
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._ma.restore()
+        return False

@@ -1,0 +1,165 @@
+"""paddle.sparse equivalent (COO/CSR tensors + ops).
+
+Reference: paddle/phi sparse kernels (phi/core/sparse_coo_tensor.h,
+sparse_csr_tensor.h) + python/paddle/sparse API (v2.3 incubate.sparse).
+TPU-native: SparseCooTensor wraps jax.experimental.sparse.BCOO — XLA lowers
+its matmuls to gather/scatter-fused dense ops, the TPU-appropriate execution
+of sparsity (the MXU has no sparse datapath; structured masking is what wins).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+
+class SparseCooTensor(Tensor):
+    """COO sparse tensor. Dense fallback semantics mirror the reference: any
+    generic Tensor op densifies first via the lazy `_data` property (phi falls
+    back to dense kernels the same way)."""
+
+    def __init__(self, bcoo: jsparse.BCOO, stop_gradient=True):
+        self._bcoo = bcoo
+        self._dense_cache = None
+        super().__init__(jnp.zeros((), jnp.float32), stop_gradient=stop_gradient)
+
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._bcoo.todense()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, v):
+        if v is not None and v.ndim == 0 and self._bcoo is not None:
+            return  # Tensor.__init__'s scalar placeholder: keep the sparse view
+        # in-place mutation (set_value etc.): re-sparsify so values()/indices()/
+        # to_dense() stay consistent with the dense contents
+        self._dense_cache = v
+        if v is not None and self._bcoo is not None:
+            self._bcoo = jsparse.BCOO.fromdense(jnp.asarray(v))
+
+    # Tensor protocol pieces
+    @property
+    def shape(self):
+        return tuple(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return str(self._bcoo.dtype)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)  # paddle: [sparse_dim, nnz]
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """Build a COO tensor from [sparse_dim, nnz] indices + [nnz, ...] values
+    (reference paddle.sparse.sparse_coo_tensor)."""
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor) else indices)
+    vals = jnp.asarray(values._data if isinstance(values, Tensor) else values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    idx = idx.T  # BCOO wants [nnz, sparse_dim]
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(0))
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx)), shape=tuple(shape))
+    return SparseCooTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    """CSR input surface; stored as BCOO (XLA has one sparse path)."""
+    crows_np = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
+    cols_np = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    return sparse_coo_tensor(np.stack([rows, cols_np]), values, shape, dtype,
+                             stop_gradient)
+
+
+def _as_bcoo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+# ---- ops (reference python/paddle/incubate/sparse/*) ----
+def add(x, y):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor((_as_bcoo(x) + _as_bcoo(y)).sum_duplicates())
+    xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
+    return xd + yd
+
+
+def matmul(x, y) -> Tensor:
+    """sparse @ dense -> dense (the hot op: embedding-style gathers on TPU)."""
+    y_arr = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor(_as_bcoo(x) @ y_arr)
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask: SparseCooTensor):
+    """dense@dense evaluated only at mask's nonzeros (reference masked_matmul)."""
+    out = (x._data @ y._data)
+    bcoo = _as_bcoo(mask)
+    idx = bcoo.indices
+    vals = out[tuple(idx[:, d] for d in range(idx.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=bcoo.shape))
+
+
+def _unary(name, fn):
+    def op(x):
+        b = _as_bcoo(x)
+        return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                            shape=b.shape))
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+
+
+def transpose(x, perm):
+    return SparseCooTensor(_as_bcoo(x).transpose(tuple(perm)))
+
+
+__all__ = ["SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor", "add",
+           "matmul", "masked_matmul", "relu", "sin", "tanh", "sqrt", "abs",
+           "neg", "transpose"]

@@ -181,3 +181,42 @@ def test_predict_restores_train_mode():
     engine.fit(ds, epochs=1, batch_size=16)
     engine.predict(ds, batch_size=16)
     assert net.training, "predict() leaked eval mode into the model"
+
+
+def test_evaluate_runs_in_eval_mode():
+    """Dropout must be off during evaluate(); repeated evals are deterministic."""
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 16), paddle.nn.Dropout(0.9),
+                               paddle.nn.Linear(16, 1))
+    net.train()
+    engine = Engine(model=net, loss=paddle.nn.MSELoss(),
+                    optimizer=paddle.optimizer.SGD(
+                        learning_rate=0.0, parameters=net.parameters()))
+    ds = RegDataset(n=32)
+    r1 = engine.evaluate(ds, batch_size=16)
+    r2 = engine.evaluate(ds, batch_size=16)
+    assert r1["loss"] == pytest.approx(r2["loss"], rel=1e-6)
+    assert net.training  # restored
+
+
+def test_partial_batch_raises_clear_error():
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 1))
+    engine = Engine(model=net, loss=paddle.nn.MSELoss(),
+                    optimizer=paddle.optimizer.SGD(
+                        learning_rate=0.1, parameters=net.parameters()))
+    engine.prepare()
+    engine._step_fn = engine._build(train=True)
+    bad = [np.zeros((4, 16), "float32"), np.zeros((4, 1), "float32")]  # 4 % 8 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        engine._run_step(bad)
+
+
+def test_fit_drops_partial_last_batch():
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 1))
+    engine = Engine(model=net, loss=paddle.nn.MSELoss(),
+                    optimizer=paddle.optimizer.SGD(
+                        learning_rate=0.1, parameters=net.parameters()))
+    history = engine.fit(RegDataset(n=40), epochs=1, batch_size=16)  # 40 = 2x16 + 8
+    assert np.isfinite(history[0])

@@ -1,0 +1,88 @@
+"""incubate: ASP 2:4 sparsity, LookAhead, ModelAverage; core.monitor stats.
+
+Mirrors reference tests under unittests/asp/ and incubate optimizer tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor
+from paddle_tpu.incubate import LookAhead, ModelAverage, asp
+
+
+def test_asp_mask_2of4():
+    w = np.random.RandomState(0).randn(8, 16).astype("float32")
+    mask = asp.create_mask(w, n=2, m=4)
+    assert mask.shape == w.shape
+    groups = mask.reshape(-1, 4)
+    assert (groups.sum(1) == 2).all()
+    # mask keeps the largest-magnitude entries
+    pruned = w * mask
+    assert asp.check_sparsity(pruned, 2, 4)
+    assert asp.calculate_density(pruned) == pytest.approx(0.5)
+
+
+def test_asp_prune_model_and_decorate():
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    densities = asp.prune_model(net)
+    assert len(densities) == 2
+    assert all(d == pytest.approx(0.5) for d in densities.values())
+
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=net.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(1).randn(4, 16).astype("float32"))
+    y = paddle.to_tensor(np.zeros((4,), "int64"))
+    loss = paddle.nn.CrossEntropyLoss()(net(x), y)
+    loss.backward()
+    opt.step()
+    # sparsity survives the dense gradient update
+    assert asp.check_sparsity(net[0].weight, 2, 4)
+    assert asp.check_sparsity(net[2].weight, 2, 4)
+    asp.reset_excluded_layers()
+
+
+def test_lookahead_interpolates_slow_weights():
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 2)
+    w0 = lin.weight.numpy().copy()
+    inner = paddle.optimizer.SGD(learning_rate=0.5, parameters=lin.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    for i in range(2):
+        loss = lin(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # after k=2 steps: fast took 2 sgd steps, slow = w0 + 0.5*(fast - w0)
+    fast_expected = w0 - 0.5 * 2 * np.ones_like(w0) * 2  # dL/dw = sum over batch = 2
+    np.testing.assert_allclose(lin.weight.numpy(),
+                               w0 + 0.5 * (fast_expected - w0), rtol=1e-5)
+
+
+def test_model_average_apply_restore():
+    paddle.seed(0)
+    lin = paddle.nn.Linear(3, 1)
+    ma = ModelAverage(parameters=lin.parameters())
+    vals = []
+    for v in (1.0, 3.0):
+        lin.weight._data = lin.weight._data * 0 + v
+        ma.step()
+        vals.append(lin.weight.numpy().copy())
+    with ma.apply():
+        np.testing.assert_allclose(lin.weight.numpy(),
+                                   (vals[0] + vals[1]) / 2, rtol=1e-6)
+    np.testing.assert_allclose(lin.weight.numpy(), vals[1], rtol=1e-6)  # restored
+
+
+def test_monitor_stats():
+    s = monitor.stat("test_counter")
+    s.set(0)
+    s.increase(5)
+    s.increase(3)
+    s.decrease(2)
+    assert s.get() == 6
+    assert s.peak() == 8
+    assert "test_counter" in monitor.registry().report()
+    # CPU has no PJRT memory stats; must degrade to {} not raise
+    assert isinstance(monitor.device_memory_stats(), dict)
