@@ -207,9 +207,18 @@ def launch(argv=None) -> int:
     else:
         script_cmd = [sys.executable, args.training_script]
 
+    # Children run `python script.py`, which puts the script's dir (not our cwd)
+    # on sys.path — make the framework importable from a source checkout by
+    # exporting its package root on PYTHONPATH (reference launcher relies on an
+    # installed package; launch/controllers/collective.py:23).
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    child_pythonpath = os.pathsep.join(
+        p for p in [pkg_root, os.environ.get("PYTHONPATH", "")] if p)
+
     def worker_env(local_rank: int, role: str = "TRAINER") -> Dict[str, str]:
         global_rank = node_rank * nproc + local_rank
-        env = {**os.environ}
+        env = {**os.environ, "PYTHONPATH": child_pythonpath}
         env.update({
             "PADDLE_TRAINER_ID": str(global_rank),
             "PADDLE_TRAINERS_NUM": str(world),
