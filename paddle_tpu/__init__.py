@@ -22,10 +22,18 @@ from .core.dtype import (
     set_default_dtype, uint8,
 )
 from .core.place import (
-    CPUPlace, CUDAPinnedPlace, CUDAPlace, Place, TPUPlace, device_count,
-    get_device, is_compiled_with_cuda, is_compiled_with_tpu, set_device,
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, IPUPlace, MLUPlace,
+    NPUPlace, NPUPinnedPlace, Place, TPUPlace, XPUPlace, device_count,
+    get_device, is_compiled_with_cinn, is_compiled_with_cuda,
+    is_compiled_with_distribute, is_compiled_with_ipu, is_compiled_with_mlu,
+    is_compiled_with_npu, is_compiled_with_rocm, is_compiled_with_tpu,
+    is_compiled_with_xpu, set_device,
 )
 from .core.random import get_rng_state, seed, set_rng_state
+
+# the reference's CUDA RNG state API maps onto the single device RNG here
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
 from .core.flags import get_flags, set_flags
 from .core.tensor import Tensor
 from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled
@@ -89,3 +97,77 @@ def summary(net, input_size=None, dtypes=None, input=None):
     from .hapi.summary import summary as _summary
 
     return _summary(net, input_size, dtypes=dtypes, input=input)
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None, print_detail=False):
+    from .hapi.dynamic_flops import flops as _flops
+
+    return _flops(net, input_size, inputs=inputs, custom_ops=custom_ops,
+                  print_detail=print_detail)
+
+
+# ---- remaining top-level parity surface ----
+from .nn.layer import ParamAttr, create_parameter  # noqa: E402
+from .distributed.meta_parallel.data_parallel import DataParallel  # noqa: E402
+
+import numpy as _np  # noqa: E402
+
+dtype = _np.dtype  # paddle.dtype: dtypes here ARE numpy dtypes (see core/dtype.py)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    from .core import tensor as _tensor_mod
+
+    opts = _tensor_mod._print_options
+    if precision is not None:
+        opts["precision"] = precision
+    if threshold is not None:
+        opts["threshold"] = threshold
+    if edgeitems is not None:
+        opts["edgeitems"] = edgeitems
+    if linewidth is not None:
+        opts["max_line_width"] = linewidth
+    if sci_mode is not None:
+        opts["suppress_small"] = not sci_mode
+
+
+def disable_signal_handler():
+    """No-op: unlike the reference (platform/init.cc SignalHandle) no custom
+    signal handlers are installed, so there is nothing to disable."""
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample reader into a batch reader (reference: python/paddle/batch.py)."""
+
+    def batch_reader():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def tanh_(x):
+    return x.tanh_()
+
+
+def squeeze_(x, axis=None, name=None):
+    return x.squeeze_(axis)
+
+
+def unsqueeze_(x, axis, name=None):
+    return x.unsqueeze_(axis)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x.scatter_(index, updates, overwrite)

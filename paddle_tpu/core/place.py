@@ -24,13 +24,18 @@ class Place:
             isinstance(other, Place)
             and self.device_type == other.device_type
             and self.device_id == other.device_id
+            and getattr(self, "custom_device_type", None)
+            == getattr(other, "custom_device_type", None)
         )
 
     def __hash__(self):
-        return hash((self.device_type, self.device_id))
+        return hash((self.device_type, self.device_id,
+                     getattr(self, "custom_device_type", None)))
 
     def __repr__(self):
-        return f"Place({self.device_type}:{self.device_id})"
+        custom = getattr(self, "custom_device_type", None)
+        kind = f"{self.device_type}/{custom}" if custom else self.device_type
+        return f"Place({kind}:{self.device_id})"
 
     def jax_device(self):
         import jax
@@ -67,6 +72,36 @@ class CUDAPlace(Place):  # accepted for API parity; maps onto the accelerator
 
 class CUDAPinnedPlace(CPUPlace):
     pass
+
+
+# Vendor places accepted for API parity; this framework targets TPU, so
+# accelerator-flavored places map onto the accelerator and the rest onto host.
+class NPUPlace(Place):
+    device_type = "tpu"
+
+
+class XPUPlace(Place):
+    device_type = "tpu"
+
+
+class MLUPlace(Place):
+    device_type = "tpu"
+
+
+class IPUPlace(Place):
+    device_type = "tpu"
+
+
+class NPUPinnedPlace(CPUPlace):
+    pass
+
+
+class CustomPlace(Place):
+    device_type = "tpu"
+
+    def __init__(self, device_type="custom", device_id=0):
+        super().__init__(device_id)
+        self.custom_device_type = device_type
 
 
 def _default_place() -> Place:
@@ -116,6 +151,34 @@ def get_place() -> Place:
 
 def is_compiled_with_cuda() -> bool:  # API parity; TPU build has no CUDA
     return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
 
 
 def is_compiled_with_tpu() -> bool:

@@ -217,7 +217,7 @@ class Tensor:
         grad_txt = f", stop_gradient={self._stop_gradient}"
         try:
             value = np.array2string(
-                np.asarray(self._data), precision=6, separator=", ", threshold=64
+                np.asarray(self._data), separator=", ", **_print_options
             )
         except Exception:
             value = "<unmaterialized>"
@@ -229,6 +229,10 @@ class Tensor:
     # Arithmetic dunders, indexing, and method-style ops are attached by
     # paddle_tpu.ops at import time (the analogue of the generated
     # `core.eager.ops` method table, pybind/eager_method.cc).
+
+
+# repr formatting knobs, mutated by paddle.set_printoptions
+_print_options = {"precision": 6, "threshold": 64}
 
 
 def _parse_place(device):

@@ -1,0 +1,116 @@
+"""paddle.flops: per-layer FLOPs estimation via forward hooks.
+Reference: python/paddle/hapi/dynamic_flops.py (op-type handler table driven by hooks)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def _numel(x):
+    return int(np.prod(x.shape)) if len(x.shape) else 1
+
+
+def _count_conv(layer, inputs, output):
+    out = _numel(output)
+    kernel_ops = int(np.prod(layer.kernel_size)) * (layer.in_channels // layer.groups)
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    return out * (kernel_ops + bias_ops)
+
+
+def _count_linear(layer, inputs, output):
+    mul = int(layer.weight.shape[0])
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    return _numel(output) // max(int(output.shape[-1]), 1) * (
+        mul * int(output.shape[-1]) + bias_ops * int(output.shape[-1]))
+
+
+def _count_norm(layer, inputs, output):
+    return 2 * _numel(inputs[0])
+
+
+def _count_act(layer, inputs, output):
+    return _numel(output)
+
+
+def _count_pool(layer, inputs, output):
+    return _numel(output)
+
+
+def _handlers():
+    from .. import nn
+
+    table = {}
+    for cls_name, fn in [
+        ("Conv1D", _count_conv), ("Conv2D", _count_conv), ("Conv3D", _count_conv),
+        ("Linear", _count_linear),
+        ("BatchNorm", _count_norm), ("BatchNorm1D", _count_norm),
+        ("BatchNorm2D", _count_norm), ("BatchNorm3D", _count_norm),
+        ("LayerNorm", _count_norm), ("GroupNorm", _count_norm),
+        ("InstanceNorm2D", _count_norm), ("SyncBatchNorm", _count_norm),
+        ("ReLU", _count_act), ("ReLU6", _count_act), ("GELU", _count_act),
+        ("Sigmoid", _count_act), ("Tanh", _count_act), ("LeakyReLU", _count_act),
+        ("Hardswish", _count_act), ("Hardsigmoid", _count_act), ("Swish", _count_act),
+        ("AvgPool1D", _count_pool), ("AvgPool2D", _count_pool), ("AvgPool3D", _count_pool),
+        ("MaxPool1D", _count_pool), ("MaxPool2D", _count_pool), ("MaxPool3D", _count_pool),
+        ("AdaptiveAvgPool1D", _count_pool), ("AdaptiveAvgPool2D", _count_pool),
+        ("AdaptiveMaxPool2D", _count_pool),
+    ]:
+        cls = getattr(nn, cls_name, None)
+        if cls is not None:
+            table[cls] = fn
+    return table
+
+
+def flops(net: Layer, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """Return total FLOPs (multiply-adds counted once) for one forward pass."""
+    if inputs is None:
+        if input_size is None:
+            raise ValueError("flops needs input_size or inputs")
+        inputs = [Tensor(np.zeros([d if d and d > 0 else 1 for d in input_size],
+                                  dtype="float32"))]
+    elif not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+
+    table = _handlers()
+    if custom_ops:
+        table.update(custom_ops)
+    rows, hooks = [], []
+
+    def make_hook(name, layer, fn):
+        def hook(lyr, ins, outs):
+            out = outs[0] if isinstance(outs, (list, tuple)) else outs
+            ins = ins if isinstance(ins, (list, tuple)) else (ins,)
+            n = int(fn(lyr, ins, out))
+            rows.append((name or lyr.__class__.__name__, n))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        fn = None
+        for cls, handler in table.items():
+            if isinstance(sub, cls):
+                fn = handler
+                break
+        if fn is not None and not list(sub.children()):
+            hooks.append(sub.register_forward_post_hook(make_hook(name, sub, fn)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(n for _, n in rows)
+    if print_detail:
+        w1 = max([len(r[0]) for r in rows] + [10]) + 2
+        print(f"{'Layer':<{w1}}{'FLOPs':>16}")
+        for name, n in rows:
+            print(f"{name:<{w1}}{n:>16,}")
+        print(f"Total FLOPs: {total:,}")
+    return total

@@ -73,6 +73,28 @@ class ParamAttr:
         return ParamAttr(initializer=attr)
 
 
+def create_parameter(shape, dtype="float32", name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone parameter factory (reference: paddle.create_parameter,
+    python/paddle/tensor/creation.py)."""
+    from . import initializer as I
+
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    if name is not None and attr.name is None:
+        attr.name = name
+    dtype = dtypes.convert_dtype(dtype)
+    init = attr.initializer or default_initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    data = init(tuple(int(s) for s in shape), dtype)
+    p = Parameter(data, trainable=attr.trainable, name=attr.name or "")
+    p.optimize_attr["learning_rate"] = attr.learning_rate
+    p.regularizer = attr.regularizer
+    return p
+
+
 class HookRemoveHelper:
     def __init__(self, hooks, hook_id):
         self._hooks = hooks

@@ -14,6 +14,7 @@ from .math import *  # noqa: F401,F403
 from .reduction import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
+from .attribute import *  # noqa: F401,F403
 from .activation import (  # noqa: F401
     celu, elu, gelu, glu, gumbel_softmax, hardshrink, hardsigmoid, hardswish,
     hardtanh, leaky_relu, log_sigmoid, log_softmax, maxout, mish, prelu, relu,
@@ -28,6 +29,7 @@ from . import reduction as _reduction
 from . import manipulation as _manip
 from . import linalg as _linalg
 from . import activation as _activation
+from . import attribute as _attribute
 
 
 def _attach_methods():
@@ -128,6 +130,48 @@ def _attach_methods():
         "relu": _activation.relu, "gelu": _activation.gelu,
         # creation-like
         "tril": _creation.tril, "triu": _creation.triu, "diag": _creation.diag,
+        "numel": _creation.numel,
+        # more unary math
+        "acos": M.acos, "asin": M.asin, "atan": M.atan, "sinh": M.sinh,
+        "cosh": M.cosh, "asinh": M.asinh, "acosh": M.acosh, "atanh": M.atanh,
+        "log10": M.log10, "log1p": M.log1p, "expm1": M.expm1, "logit": M.logit,
+        "lgamma": M.lgamma, "digamma": M.digamma, "erfinv": M.erfinv,
+        "frac": M.frac, "conj": M.conj, "real": M.real, "imag": M.imag,
+        "angle": M.angle, "rad2deg": M.rad2deg, "deg2rad": M.deg2rad,
+        "stanh": M.stanh, "increment": M.increment, "multiplex": M.multiplex,
+        "nan_to_num": M.nan_to_num, "sgn": M.sgn, "i0": M.i0,
+        "cummax": M.cummax, "cummin": M.cummin, "logcumsumexp": M.logcumsumexp,
+        "diagonal": M.diagonal, "addmm": M.addmm, "renorm": M.renorm,
+        "add_n": M.add_n, "heaviside": M.heaviside, "hypot": M.hypot,
+        "copysign": M.copysign, "nextafter": M.nextafter, "ldexp": M.ldexp,
+        "logaddexp": M.logaddexp,
+        # more binary math
+        "fmax": M.fmax, "fmin": M.fmin, "floor_mod": M.floor_mod,
+        "gcd": M.gcd, "lcm": M.lcm,
+        "bitwise_and": M.bitwise_and, "bitwise_or": M.bitwise_or,
+        "bitwise_xor": M.bitwise_xor, "bitwise_not": M.bitwise_not,
+        # more reductions
+        "amax": R.amax, "amin": R.amin, "nanmedian": R.nanmedian,
+        "nanquantile": R.nanquantile, "mode": R.mode,
+        # attributes
+        "rank": _attribute.rank, "is_empty": _attribute.is_empty,
+        "is_complex": _attribute.is_complex, "is_integer": _attribute.is_integer,
+        "is_floating_point": _attribute.is_floating_point,
+        # more manipulation
+        "concat": P.concat, "stack": P.stack, "unstack": P.unstack,
+        "reverse": P.reverse, "rot90": P.rot90, "tensordot": P.tensordot,
+        "unique_consecutive": P.unique_consecutive, "as_real": P.as_real,
+        "as_complex": P.as_complex, "shard_index": P.shard_index,
+        "searchsorted": P.searchsorted, "bucketize": P.bucketize,
+        "broadcast_tensors": P.broadcast_tensors, "index_put": P.index_put,
+        "view": P.view,
+        # more linalg
+        "mv": L.mv, "qr": L.qr, "svd": L.svd, "eig": L.eig, "eigh": L.eigh,
+        "eigvals": L.eigvals, "eigvalsh": L.eigvalsh, "lstsq": L.lstsq,
+        "cond": L.cond, "lu": L.lu, "lu_unpack": L.lu_unpack,
+        "multi_dot": L.multi_dot, "solve": L.solve,
+        "cholesky_solve": L.cholesky_solve,
+        "triangular_solve": L.triangular_solve, "matrix_rank": L.matrix_rank,
     }
     import jax.numpy as _jnp
 
@@ -159,6 +203,51 @@ def _attach_methods():
     _inplace("flatten_", P.flatten)
     _inplace("squeeze_", P.squeeze)
     _inplace("unsqueeze_", P.unsqueeze)
+    _inplace("ceil_", M.ceil)
+    _inplace("floor_", M.floor)
+    _inplace("round_", M.round)
+    _inplace("reciprocal_", M.reciprocal)
+    _inplace("rsqrt_", M.rsqrt)
+    _inplace("lerp_", M.lerp)
+    _inplace("erfinv_", M.erfinv)
+    _inplace("scatter_", P.scatter)
+    _inplace("put_along_axis_", P.put_along_axis)
+
+    def _uniform_(s, min=-1.0, max=1.0, seed=0):
+        from ..core import random as _random
+        import jax as _jax
+
+        key = _random.next_key()
+        s.set_value(_jax.random.uniform(key, s._data.shape, s._data.dtype,
+                                        minval=min, maxval=max))
+        return s
+
+    def _exponential_(s, lam=1.0):
+        from ..core import random as _random
+        import jax as _jax
+
+        key = _random.next_key()
+        u = _jax.random.uniform(key, s._data.shape, dtype=s._data.dtype)
+        s.set_value(-_jnp.log1p(-u) / lam)
+        return s
+
+    def _normal_(s, mean=0.0, std=1.0):
+        from ..core import random as _random
+        import jax as _jax
+
+        key = _random.next_key()
+        s.set_value(mean + std * _jax.random.normal(key, s._data.shape, s._data.dtype))
+        return s
+
+    m("uniform_", _uniform_)
+    m("exponential_", _exponential_)
+    m("normal_", _normal_)
+
+    # module-level functions the reference also binds onto Tensor even though
+    # their first argument is not a tensor (python/paddle/tensor/__init__.py)
+    Tensor.broadcast_shape = staticmethod(P.broadcast_shape)
+    Tensor.scatter_nd = staticmethod(P.scatter_nd)
+    Tensor.is_tensor = staticmethod(_attribute.is_tensor)
 
 
 _attach_methods()

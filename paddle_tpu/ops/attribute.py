@@ -1,0 +1,54 @@
+"""Tensor attribute ops.
+
+Reference parity: python/paddle/tensor/attribute.py (shape/rank/is_* helpers) — there these
+lower to C++ ops (`shape`, `rank`) or dtype checks on VarType; here dtype queries go through
+jnp dtypes (bfloat16-aware) and shape/rank return device tensors like the reference does.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ._helpers import t_
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def rank(input, name=None):
+    return Tensor(jnp.asarray(t_(input).ndim, dtype=jnp.int32))
+
+
+def shape(input, name=None):
+    return Tensor(jnp.asarray(t_(input)._data.shape, dtype=jnp.int32))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(t_(x)._data.size == 0))
+
+
+def is_complex(x):
+    return bool(jnp.issubdtype(t_(x)._data.dtype, jnp.complexfloating))
+
+
+def is_integer(x):
+    return bool(jnp.issubdtype(t_(x)._data.dtype, jnp.integer))
+
+
+def is_floating_point(x):
+    return bool(jnp.issubdtype(t_(x)._data.dtype, jnp.floating))
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference: fluid/layers/utils.py:373)."""
+    if isinstance(shape, Tensor):
+        return
+    for ele in shape:
+        if not isinstance(ele, Tensor):
+            if ele < 0:
+                raise ValueError(
+                    "All elements in shape must be positive when argument shape is a list or tuple")
+            if not isinstance(ele, (int, np.integer)):
+                raise TypeError("Elements in shape must be integers or Tensors")

@@ -199,3 +199,57 @@ def bincount(x, weights=None, minlength=0, name=None):
     w = t_(weights)._data if weights is not None else None
     return Tensor(jnp.bincount(t_(x)._data, weights=w, minlength=minlength,
                                length=None))
+
+
+def multi_dot(x, name=None):
+    return apply("multi_dot", lambda *ms: jnp.linalg.multi_dot(ms),
+                 [t_(m) for m in x])
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A @ out = x given y = Cholesky factor of A."""
+
+    def kernel(b, f, upper):
+        lower = not upper
+        z = jax.lax.linalg.triangular_solve(
+            f, b, left_side=True, lower=lower, transpose_a=upper)
+        return jax.lax.linalg.triangular_solve(
+            f, z, left_side=True, lower=lower, transpose_a=lower)
+
+    return apply("cholesky_solve", kernel, [t_(x), t_(y)], {"upper": upper})
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Split combined LU data + pivots (as produced by `lu`) into P, L, U."""
+    a = t_(x)._data
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+        U = jnp.triu(a[..., :k, :])
+    if unpack_pivots:
+        piv = t_(y)._data.astype(jnp.int32) - 1  # sequential row swaps, 1-based
+        perm = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32),
+                                piv.shape[:-1] + (m,))
+
+        for i in range(piv.shape[-1]):
+            j = piv[..., i]                                      # [...,]
+            pi = perm[..., i]                                    # [...,]
+            pj = jnp.take_along_axis(perm, j[..., None], -1)[..., 0]
+            perm = jnp.where(jnp.arange(m) == i,
+                             pj[..., None] if pj.ndim else pj, perm)
+            perm = jnp.where(jnp.arange(m) == j[..., None],
+                             pi[..., None] if pi.ndim else pi, perm)
+        P = (perm[..., :, None] == jnp.arange(m)).astype(a.dtype)
+        P = jnp.swapaxes(P, -1, -2)
+    outs = []
+    if unpack_pivots:
+        outs.append(Tensor(P))
+    if unpack_ludata:
+        outs.extend([Tensor(L), Tensor(U)])
+    return tuple(outs)
+
+
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(t_(x)._data, p=p))

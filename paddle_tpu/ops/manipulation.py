@@ -571,6 +571,17 @@ def as_complex(x, name=None):
     return apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), [t_(x)])
 
 
+def unstack(x, axis=0, num=None, name=None):
+    x = t_(x)
+    n = x._data.shape[axis] if num is None else num
+    assert n == x._data.shape[axis], "num must equal the size of axis"
+    return unbind(x, axis)
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis)
+
+
 def view(x, shape_or_dtype, name=None):
     if isinstance(shape_or_dtype, (list, tuple)):
         return reshape(x, shape_or_dtype)

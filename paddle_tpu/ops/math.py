@@ -266,3 +266,36 @@ def logcumsumexp(x, axis=None, dtype=None, name=None):
 def rsqrt_(x):
     x.set_value(jax.lax.rsqrt(x._data))
     return x
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (reference: sum_op / paddle.add_n)."""
+    if isinstance(inputs, Tensor):
+        # single tensor: a fresh output tensor, never an alias of the input
+        return apply("add_n", lambda a: a, [inputs])
+
+    def k(*xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+
+    return apply("add_n", k, [t_(i) for i in inputs])
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp the p-norm of every slice along `axis` to at most max_norm."""
+
+    def k(a, p, axis, max_norm):
+        other = tuple(i for i in range(a.ndim) if i != axis)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=other, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+
+    ax = axis + t_(x).ndim if axis < 0 else axis
+    return apply("renorm", k, [t_(x)], {"p": float(p), "axis": ax,
+                                        "max_norm": float(max_norm)})
+
+
+def complex(real, imag, name=None):
+    return apply("complex", jax.lax.complex, [t_(real), t_(imag)])

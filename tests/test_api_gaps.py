@@ -1,0 +1,267 @@
+"""Coverage for the API-parity gap fill: attribute ops, new math/linalg ops,
+Tensor-method wiring, and top-level utilities (reference surfaces:
+python/paddle/__init__.py __all__ and python/paddle/tensor/__init__.py method table)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestAttributeOps:
+    def test_is_tensor(self):
+        assert paddle.is_tensor(t([1.0]))
+        assert not paddle.is_tensor([1.0])
+
+    def test_rank_shape(self):
+        x = t(np.zeros((2, 3, 4), np.float32))
+        assert int(paddle.rank(x)) == 3
+        np.testing.assert_array_equal(paddle.shape(x).numpy(), [2, 3, 4])
+
+    def test_is_empty(self):
+        assert bool(paddle.is_empty(t(np.zeros((0, 3)))))
+        assert not bool(paddle.is_empty(t(np.zeros((1,)))))
+
+    def test_dtype_predicates(self):
+        assert paddle.is_floating_point(t(np.float32(1)))
+        assert not paddle.is_floating_point(t(np.int64(1)))
+        assert paddle.is_integer(t(np.int32(1)))
+        assert paddle.is_complex(t(np.complex64(1)))
+        x = paddle.to_tensor(np.ones((2,), np.float32), dtype="bfloat16")
+        assert paddle.is_floating_point(x)
+
+    def test_check_shape(self):
+        paddle.check_shape([2, 3])
+        with pytest.raises(ValueError):
+            paddle.check_shape([-2, 3])
+
+
+class TestNewMathOps:
+    def test_add_n(self):
+        xs = [np.random.RandomState(i).rand(3, 4).astype(np.float32) for i in range(3)]
+        out = paddle.add_n([t(x) for x in xs])
+        np.testing.assert_allclose(out.numpy(), sum(xs), rtol=1e-6)
+
+    def test_add_n_grad(self):
+        a, b = t(np.ones((2, 2), np.float32)), t(np.ones((2, 2), np.float32))
+        a.stop_gradient = False
+        b.stop_gradient = False
+        paddle.add_n([a, b]).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), np.ones((2, 2)))
+        np.testing.assert_allclose(b.grad.numpy(), np.ones((2, 2)))
+
+    def test_renorm(self):
+        x = np.random.RandomState(0).randn(2, 3, 4).astype(np.float32)
+        out = paddle.renorm(t(x), p=2.0, axis=1, max_norm=1.0).numpy()
+        for j in range(3):
+            n = np.linalg.norm(out[:, j, :])
+            assert n <= 1.0 + 1e-4
+        # slices already under the budget are untouched
+        small = np.full((2, 2), 0.01, np.float32)
+        np.testing.assert_allclose(
+            paddle.renorm(t(small), 2.0, 0, 5.0).numpy(), small, rtol=1e-5)
+
+    def test_complex(self):
+        re = np.array([1.0, 2.0], np.float32)
+        im = np.array([3.0, -1.0], np.float32)
+        out = paddle.complex(t(re), t(im))
+        np.testing.assert_allclose(out.numpy(), re + 1j * im)
+        assert paddle.is_complex(out)
+
+    def test_real_imag_conj_angle(self):
+        z = np.array([1 + 2j, 3 - 4j], np.complex64)
+        np.testing.assert_allclose(paddle.real(t(z)).numpy(), z.real)
+        np.testing.assert_allclose(paddle.imag(t(z)).numpy(), z.imag)
+        np.testing.assert_allclose(paddle.conj(t(z)).numpy(), z.conj())
+        np.testing.assert_allclose(paddle.angle(t(z)).numpy(), np.angle(z), rtol=1e-6)
+
+
+class TestNewLinalg:
+    def test_multi_dot(self):
+        rs = np.random.RandomState(0)
+        a, b, c = (rs.rand(4, 5).astype(np.float32), rs.rand(5, 3).astype(np.float32),
+                   rs.rand(3, 2).astype(np.float32))
+        out = paddle.linalg.multi_dot([t(a), t(b), t(c)])
+        np.testing.assert_allclose(out.numpy(), a @ b @ c, rtol=1e-5)
+
+    def test_cholesky_solve(self):
+        rs = np.random.RandomState(1)
+        a = rs.rand(4, 4).astype(np.float64)
+        a = a @ a.T + 4 * np.eye(4)
+        b = rs.rand(4, 2).astype(np.float64)
+        L = np.linalg.cholesky(a)
+        out = paddle.linalg.cholesky_solve(t(b), t(L), upper=False)
+        np.testing.assert_allclose(out.numpy(), np.linalg.solve(a, b), rtol=1e-6)
+        out_u = paddle.linalg.cholesky_solve(t(b), t(L.T.copy()), upper=True)
+        np.testing.assert_allclose(out_u.numpy(), np.linalg.solve(a, b), rtol=1e-6)
+
+    def test_lu_unpack(self):
+        rs = np.random.RandomState(2)
+        a = rs.rand(5, 5).astype(np.float64)
+        lu_t, piv_t = paddle.linalg.lu(t(a))
+        P, L, U = paddle.linalg.lu_unpack(lu_t, piv_t)
+        np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), a, rtol=1e-6,
+                                   atol=1e-8)
+
+    def test_cond(self):
+        a = np.diag([1.0, 10.0]).astype(np.float64)
+        np.testing.assert_allclose(float(paddle.linalg.cond(t(a))), 10.0, rtol=1e-6)
+
+    def test_lu_unpack_batched(self):
+        rs = np.random.RandomState(3)
+        a = rs.rand(3, 4, 4).astype(np.float64)
+        lu_t, piv_t = paddle.linalg.lu(t(a))
+        P, L, U = paddle.linalg.lu_unpack(lu_t, piv_t)
+        np.testing.assert_allclose(
+            np.einsum("bij,bjk,bkl->bil", P.numpy(), L.numpy(), U.numpy()), a,
+            rtol=1e-6, atol=1e-8)
+
+
+class TestManipGaps:
+    def test_unstack(self):
+        x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+        outs = paddle.unstack(t(x), axis=1)
+        assert len(outs) == 3
+        for j, o in enumerate(outs):
+            np.testing.assert_array_equal(o.numpy(), x[:, j, :])
+
+    def test_reverse(self):
+        x = np.arange(6).reshape(2, 3).astype(np.float32)
+        np.testing.assert_array_equal(paddle.reverse(t(x), [0]).numpy(), x[::-1])
+
+
+class TestTensorMethodWiring:
+    def test_trig_methods(self):
+        x = t(np.array([0.1, 0.5], np.float32))
+        np.testing.assert_allclose(x.acos().numpy(), np.arccos(x.numpy()), rtol=1e-6)
+        np.testing.assert_allclose(x.sinh().numpy(), np.sinh(x.numpy()), rtol=1e-6)
+        np.testing.assert_allclose(x.log1p().numpy(), np.log1p(x.numpy()), rtol=1e-6)
+        import math
+        np.testing.assert_allclose(x.lgamma().numpy(),
+                                   np.vectorize(math.lgamma)(x.numpy()), rtol=1e-5)
+
+    def test_linalg_methods(self):
+        a = np.random.RandomState(0).rand(3, 3).astype(np.float64) + 3 * np.eye(3)
+        x = t(a)
+        q, r = x.qr()
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-6)
+        assert x.det().numpy().shape == ()
+        v = t(np.ones(3, np.float64))
+        np.testing.assert_allclose(x.mv(v).numpy(), a @ np.ones(3), rtol=1e-6)
+
+    def test_bitwise_methods(self):
+        a = t(np.array([0b1100], np.int32))
+        b = t(np.array([0b1010], np.int32))
+        assert int(a.bitwise_and(b)) == 0b1000
+        assert int(a.bitwise_or(b)) == 0b1110
+        assert int(a.bitwise_xor(b)) == 0b0110
+
+    def test_inplace_methods(self):
+        x = t(np.array([1.4, 2.6], np.float32))
+        y = x.floor_()
+        assert y is x
+        np.testing.assert_array_equal(x.numpy(), [1.0, 2.0])
+        z = t(np.zeros((100,), np.float32))
+        z.uniform_(0.0, 1.0)
+        assert 0.0 <= float(z.numpy().min()) and float(z.numpy().max()) <= 1.0
+        assert z.numpy().std() > 0.1
+        e = t(np.zeros((200,), np.float32))
+        e.exponential_(2.0)
+        assert e.numpy().min() >= 0 and 0.2 < e.numpy().mean() < 1.0
+
+    def test_misc_methods(self):
+        x = t(np.arange(4, dtype=np.float32))
+        assert x.numel() == 4
+        assert int(x.rank()) == 1
+        assert x.tolist() == [0.0, 1.0, 2.0, 3.0]
+        np.testing.assert_array_equal(
+            x.unstack(0)[2].numpy(), np.float32(2.0))
+
+
+class TestTopLevelUtilities:
+    def test_param_attr_create_parameter(self):
+        attr = paddle.ParamAttr(name="w", learning_rate=0.5)
+        p = paddle.create_parameter([3, 4], "float32", attr=attr)
+        assert p.shape == [3, 4]
+        assert not p.stop_gradient
+        assert p.optimize_attr["learning_rate"] == 0.5
+
+    def test_create_parameter_attr_false(self):
+        assert paddle.create_parameter([3], "float32", attr=False) is None
+
+    def test_add_n_single_tensor_not_aliased(self):
+        x = t(np.ones((2,), np.float32))
+        y = paddle.add_n(x)
+        assert y is not x
+        y.set_value(np.zeros((2,), np.float32))
+        np.testing.assert_array_equal(x.numpy(), [1, 1])
+
+    def test_custom_place_identity(self):
+        a, b = paddle.CustomPlace("npu", 0), paddle.CustomPlace("fpga", 0)
+        assert a != b and a != paddle.TPUPlace(0) and paddle.TPUPlace(0) != a
+        assert a == paddle.CustomPlace("npu", 0)
+        assert "npu" in repr(a)
+        assert paddle.is_compiled_with_distribute()
+
+    def test_sequence_mask_empty(self):
+        import paddle_tpu.nn.functional as F
+
+        m = F.sequence_mask(t(np.zeros((0,), np.int64)))
+        assert m.shape[0] == 0
+
+    def test_batch(self):
+        def reader():
+            return iter(range(7))
+
+        batches = list(paddle.batch(reader, 3)())
+        assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+        batches = list(paddle.batch(reader, 3, drop_last=True)())
+        assert batches == [[0, 1, 2], [3, 4, 5]]
+
+    def test_set_printoptions(self):
+        paddle.set_printoptions(precision=2)
+        s = repr(t(np.array([1.23456], np.float32)))
+        assert "1.23" in s and "1.2345" not in s
+        paddle.set_printoptions(precision=8)
+
+    def test_flops(self):
+        import paddle_tpu.nn as nn
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(10, 20)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        n = paddle.flops(M(), input_size=[1, 10])
+        assert n == 10 * 20 + 20
+
+    def test_places(self):
+        for cls in [paddle.NPUPlace, paddle.XPUPlace, paddle.MLUPlace,
+                    paddle.IPUPlace]:
+            assert cls(0).device_id == 0
+        assert not paddle.is_compiled_with_npu()
+        assert not paddle.is_compiled_with_rocm()
+
+    def test_cuda_rng_state_aliases(self):
+        st = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(st)
+
+    def test_scatter_inplace_toplevel(self):
+        x = t(np.zeros((3, 2), np.float32))
+        idx = t(np.array([1], np.int64))
+        upd = t(np.ones((1, 2), np.float32))
+        y = paddle.scatter_(x, idx, upd)
+        assert y is x
+        np.testing.assert_array_equal(x.numpy(), [[0, 0], [1, 1], [0, 0]])
+
+    def test_disable_signal_handler(self):
+        paddle.disable_signal_handler()
+
+    def test_dtype_alias(self):
+        assert paddle.dtype("float32") == paddle.float32
