@@ -1,0 +1,221 @@
+"""Seq2seq decoding: Decoder protocol, BeamSearchDecoder, dynamic_decode.
+
+Reference parity: python/paddle/fluid/layers/rnn.py (Decoder :700, BeamSearchDecoder
+:850 — beam expansion/tile, log-prob accumulation, topk over beam*vocab, parent
+gathering — and dynamic_decode :1260) plus `gather_tree` (paddle/fluid/operators/
+gather_tree_op.cc) for beam reconstruction.
+
+Decoding is inherently data-dependent, so like the reference's dygraph path this runs
+a host-side step loop over jitted step computations; each step's compute (cell + topk
++ gathers) is still XLA-compiled.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...core import dtype as dtypes
+from ..layer import Layer
+from ...ops import creation as C
+from ...ops import manipulation as P
+from ...ops import math as M
+from ...ops import reduction as R
+from ...ops import activation as A
+
+import jax.numpy as jnp
+
+
+class Decoder:
+    """Abstract decode protocol (reference rnn.py:Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over a cell's token distribution (reference rnn.py:850)."""
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # ---- beam shape helpers (reference: _expand_to_beam_size/_merge/_split) ----
+    def _expand_to_beam_size(self, x):
+        x = P.unsqueeze(x, 1)
+        tile = [1] * len(x.shape)
+        tile[1] = self.beam_size
+        return P.tile(x, tile)
+
+    def _merge_batch_beams(self, x):
+        return P.reshape(x, [-1] + list(x.shape[2:]))
+
+    def _split_batch_beams(self, x):
+        return P.reshape(x, [-1, self.beam_size] + list(x.shape[1:]))
+
+    def _map_states(self, states, fn):
+        if isinstance(states, (tuple, list)):
+            return tuple(self._map_states(s, fn) for s in states)
+        return fn(states)
+
+    def initialize(self, initial_cell_states):
+        batch = (initial_cell_states[0] if isinstance(initial_cell_states,
+                 (tuple, list)) else initial_cell_states).shape[0]
+        self.batch_size = batch
+        cell_states = self._map_states(
+            initial_cell_states,
+            lambda s: self._merge_batch_beams(self._expand_to_beam_size(s)))
+        # log_probs: beam 0 live, the rest -inf so step 1 expands from beam 0 only
+        lp_row = np.full((self.beam_size,), -1e9, np.float32)
+        lp_row[0] = 0.0
+        log_probs = Tensor(jnp.asarray(np.tile(lp_row, (batch, 1))))
+        finished = Tensor(jnp.zeros((batch, self.beam_size), jnp.bool_))
+        lengths = Tensor(jnp.zeros((batch, self.beam_size), jnp.int64))
+        init_ids = C.full([batch, self.beam_size], self.start_token, "int64")
+        init_inputs = (self.embedding_fn(init_ids) if self.embedding_fn
+                       else init_ids)
+        return (init_inputs,
+                self.StateWrapper(cell_states, log_probs, finished, lengths),
+                finished)
+
+    def step(self, time, inputs, states, **kwargs):
+        merged_inputs = self._merge_batch_beams(inputs)
+        cell_out, next_cell_states = self.cell(
+            merged_inputs, states.cell_states, **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        vocab = cell_out.shape[-1]
+        step_log_probs = A.log_softmax(self._split_batch_beams(cell_out))  # [N,B,V]
+        # finished beams only extend with end_token (log-prob 0), everything else -inf
+        fin = states.finished.astype("float32").unsqueeze(-1)
+        onehot_end = Tensor(jnp.asarray(
+            np.where(np.arange(vocab) == self.end_token, 0.0, -1e9)
+            .astype(np.float32)))
+        step_log_probs = step_log_probs * (1.0 - fin) + fin * onehot_end
+        total = states.log_probs.unsqueeze(-1) + step_log_probs  # [N,B,V]
+        flat = P.reshape(total, [-1, self.beam_size * vocab])
+        topk_scores, topk_idx = P.topk(flat, self.beam_size)  # [N,B]
+        parent = P.cast(M.floor_divide(topk_idx, vocab), "int64")
+        token = M.remainder(topk_idx, vocab)
+
+        # gather beam-indexed state by parent
+        gather_idx = parent + Tensor(jnp.arange(self.batch_size)[:, None]) * self.beam_size
+        flat_gather = P.reshape(gather_idx, [-1])
+
+        def regather(s):
+            return P.index_select(s, flat_gather, axis=0)
+
+        next_cell_states = self._map_states(next_cell_states, regather)
+        next_finished = P.reshape(
+            P.index_select(P.reshape(states.finished, [-1]), flat_gather),
+            [self.batch_size, self.beam_size])
+        next_lengths = P.reshape(
+            P.index_select(P.reshape(states.lengths, [-1]), flat_gather),
+            [self.batch_size, self.beam_size])
+        next_lengths = next_lengths + P.cast(
+            M.logical_not(next_finished), "int64")
+        next_finished = M.logical_or(
+            next_finished, M.equal(token, C.full([1], self.end_token, "int64")))
+
+        next_state = self.StateWrapper(next_cell_states, topk_scores,
+                                       next_finished, next_lengths)
+        output = self.OutputWrapper(topk_scores, token, parent)
+        next_inputs = (self.embedding_fn(token) if self.embedding_fn else token)
+        return output, next_state, next_inputs, next_finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        predicted = gather_tree(outputs.predicted_ids, outputs.parent_ids)
+        return predicted, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def gather_tree(ids, parents):
+    """Reconstruct full beams from per-step tokens + parent pointers
+    (reference: gather_tree_op; ids/parents are [T, N, beam])."""
+    ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids)
+    par_np = np.asarray(parents._data if isinstance(parents, Tensor) else parents)
+    T, N, B = ids_np.shape
+    out = np.zeros_like(ids_np)
+    for n in range(N):
+        for b in range(B):
+            beam = b
+            for t in range(T - 1, -1, -1):
+                out[t, n, b] = ids_np[t, n, beam]
+                beam = par_np[t, n, beam]
+    return Tensor(jnp.asarray(out))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run `decoder` until every sequence finishes or max_step_num
+    (reference rnn.py:1260)."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs_acc = []
+    step = 0
+    while True:
+        if max_step_num is not None and step >= max_step_num:
+            break
+        if bool(np.asarray(finished._data).all()):
+            break
+        outputs, next_states, next_inputs, next_finished = decoder.step(
+            step, inputs, states, **kwargs)
+        if not decoder.tracks_own_finished:
+            next_finished = M.logical_or(next_finished, finished)
+        step_outputs_acc.append(outputs)
+        inputs, states, finished = next_inputs, next_states, next_finished
+        step += 1
+
+    if not step_outputs_acc:
+        raise ValueError("dynamic_decode ran zero steps; check initial finished state")
+
+    # stack along time (time-major first, like the reference)
+    first = step_outputs_acc[0]
+    if isinstance(first, tuple) and hasattr(first, "_fields"):
+        stacked = type(first)(*[
+            P.stack([getattr(o, f) for o in step_outputs_acc], axis=0)
+            for f in first._fields])
+    else:
+        stacked = P.stack(step_outputs_acc, axis=0)
+
+    final_outputs, final_states = decoder.finalize(
+        stacked, states, getattr(states, "lengths", None))
+    if not output_time_major:
+        def to_batch_major(x):
+            if isinstance(x, Tensor):
+                perm = [1, 0] + list(range(2, len(x.shape)))
+                return P.transpose(x, perm)
+            return x
+        if isinstance(final_outputs, tuple) and hasattr(final_outputs, "_fields"):
+            final_outputs = type(final_outputs)(
+                *[to_batch_major(getattr(final_outputs, f))
+                  for f in final_outputs._fields])
+        else:
+            final_outputs = to_batch_major(final_outputs)
+    if return_length:
+        return final_outputs, final_states, getattr(states, "lengths", None)
+    return final_outputs, final_states

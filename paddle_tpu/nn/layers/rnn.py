@@ -1,0 +1,455 @@
+"""Recurrent layers: cells + RNN/BiRNN wrappers + multi-layer SimpleRNN/LSTM/GRU.
+
+Reference parity: python/paddle/nn/layer/rnn.py (cells at :380/:480/:600, RNN wrapper
+:700+, _RNNBase multi-layer stacks) and the dynamic-rnn runner
+python/paddle/fluid/layers/rnn.py:524 (`_maybe_copy` state masking at :517).
+
+TPU-first design: the whole time loop is ONE op — a `jax.lax.scan` kernel dispatched
+through `apply`, so XLA sees a single fused scan (no per-step dispatch, no unrolling)
+and the backward pass is the scan's vjp. The reference instead emits per-step ops under
+a `while_loop` (fluid) or runs cuDNN's fused kernel; lax.scan is the TPU analogue of the
+latter. Sequence-length masking matches `_maybe_copy`: states blend by mask, outputs
+are emitted raw. Custom (user-defined) cells still work: `RNN` falls back to an eager
+per-step loop through the cell's `forward`, exactly like the reference's generic path.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ...core import dtype as dtypes
+from ..layer import Layer
+from .. import initializer as I
+from ...ops import nn_functional as F_ops
+from ...ops import manipulation as P
+
+
+# ---------------------------------------------------------------- pure steps
+def _simple_step(act):
+    actfn = jnp.tanh if act == "tanh" else jax.nn.relu
+
+    def step(x, states, params):
+        (h,) = states
+        w_ih, w_hh = params[0], params[1]
+        pre = x @ w_ih.T + h @ w_hh.T
+        if len(params) > 2:
+            pre = pre + params[2] + params[3]
+        return (lambda nh: (nh, (nh,)))(actfn(pre))
+
+    return step
+
+
+def _lstm_step(x, states, params):
+    h, c = states
+    w_ih, w_hh = params[0], params[1]
+    z = x @ w_ih.T + h @ w_hh.T
+    if len(params) > 2:
+        z = z + params[2] + params[3]
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    nc = f * c + i * jnp.tanh(g)
+    nh = o * jnp.tanh(nc)
+    return nh, (nh, nc)
+
+
+def _gru_step(x, states, params):
+    (h,) = states
+    w_ih, w_hh = params[0], params[1]
+    xg = x @ w_ih.T
+    hg = h @ w_hh.T
+    if len(params) > 2:
+        xg = xg + params[2]
+        hg = hg + params[3]
+    x_r, x_z, x_c = jnp.split(xg, 3, axis=-1)
+    h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(x_r + h_r)
+    z = jax.nn.sigmoid(x_z + h_z)
+    c = jnp.tanh(x_c + r * h_c)  # reset gate applied after the matmul
+    nh = (h - c) * z + c
+    return nh, (nh,)
+
+
+def _scan_rnn(step, inputs, states, params, sequence_length=None,
+              is_reverse=False, time_major=False):
+    """One fused scan over time. Returns (outputs, *final_states) Tensors."""
+    nst = len(states)
+    npar = len(params)
+
+    def kernel(*arrays, nst, npar, rev, tm, has_len):
+        x = arrays[0]
+        st = tuple(arrays[1:1 + nst])
+        par = tuple(arrays[1 + nst:1 + nst + npar])
+        seq = arrays[1 + nst + npar] if has_len else None
+        xs = x if tm else jnp.swapaxes(x, 0, 1)  # [T, N, I]
+        T = xs.shape[0]
+        mask = None
+        if seq is not None:
+            mask = (jnp.arange(T)[:, None] < seq[None, :]).astype(xs.dtype)
+            if rev:
+                mask = mask[::-1]
+        if rev:
+            xs = xs[::-1]
+
+        def body(carry, inp):
+            if mask is not None:
+                x_t, m_t = inp
+            else:
+                x_t, m_t = inp, None
+            out, new = step(x_t, carry, par)
+            if m_t is not None:
+                m = m_t[:, None]
+                new = tuple(m * n + (1 - m) * s for n, s in zip(new, carry))
+            return new, out
+
+        xs_in = (xs, mask) if mask is not None else xs
+        final, outs = jax.lax.scan(body, st, xs_in)
+        if rev:
+            outs = outs[::-1]
+        outs = outs if tm else jnp.swapaxes(outs, 0, 1)
+        return (outs,) + tuple(final)
+
+    tensors = [inputs] + list(states) + list(params)
+    has_len = sequence_length is not None
+    if has_len:
+        tensors.append(sequence_length)
+    return apply("rnn_scan", kernel, tensors,
+                 {"nst": nst, "npar": npar, "rev": bool(is_reverse),
+                  "tm": bool(time_major), "has_len": has_len})
+
+
+# ---------------------------------------------------------------- cells
+class RNNCellBase(Layer):
+    """Base for single-step recurrent cells (reference rnn.py:RNNCellBase)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        dtype = dtypes.convert_dtype(dtype or "float32")
+
+        def build(s):
+            if isinstance(s, (tuple, list)) and s and isinstance(s[0], (tuple, list)):
+                return tuple(build(e) for e in s)
+            dims = [batch] + [int(d) for d in (s if isinstance(s, (tuple, list)) else [s])]
+            return Tensor(jnp.full(dims, init_value, dtype=dtype))
+
+        s = self.state_shape
+        if isinstance(s, tuple) and s and isinstance(s[0], (tuple, list)):
+            return tuple(build(e) for e in s)
+        return build(s)
+
+    def _make_params(self, gates, input_size, hidden_size, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr):
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (gates * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (gates * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            (gates * hidden_size,), attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            (gates * hidden_size,), attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def _param_list(self):
+        ps = [self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            ps += [self.bias_ih, self.bias_hh]
+        return ps
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation for SimpleRNNCell should be tanh or relu")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self._make_params(1, input_size, hidden_size, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def _step_fn(self):
+        return _simple_step(self.activation)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out, h = _single_step(self._step_fn(), inputs, (states,), self._param_list())
+        return out, h[0]
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._make_params(4, input_size, hidden_size, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def _step_fn(self):
+        return _lstm_step
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out, st = _single_step(_lstm_step, inputs, tuple(states), self._param_list())
+        return out, st
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._make_params(3, input_size, hidden_size, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def _step_fn(self):
+        return _gru_step
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out, h = _single_step(_gru_step, inputs, (states,), self._param_list())
+        return out, h[0]
+
+
+def _single_step(step, inputs, states, params):
+    """Run one cell step as one op (eager cell.forward path)."""
+    nst = len(states)
+
+    def kernel(*arrays, nst, npar):
+        x = arrays[0]
+        st = tuple(arrays[1:1 + nst])
+        par = tuple(arrays[1 + nst:1 + nst + npar])
+        out, new = step(x, st, par)
+        return (out,) + tuple(new)
+
+    outs = apply("rnn_cell_step", kernel, [inputs] + list(states) + list(params),
+                 {"nst": nst, "npar": len(params)})
+    return outs[0], tuple(outs[1:])
+
+
+# ---------------------------------------------------------------- wrappers
+class RNN(Layer):
+    """Run a cell over time (reference rnn.py:RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        if not hasattr(self.cell, "call") and not hasattr(self.cell, "forward"):
+            raise ValueError("RNN needs a cell with a forward method")
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        if initial_states is None:
+            batch_idx = 1 if self.time_major else 0
+            initial_states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=batch_idx)
+
+        if isinstance(self.cell, (SimpleRNNCell, LSTMCell, GRUCell)) and not kwargs:
+            states = (tuple(initial_states) if isinstance(initial_states, (tuple, list))
+                      else (initial_states,))
+            outs = _scan_rnn(self.cell._step_fn(), inputs, states,
+                             self.cell._param_list(), sequence_length,
+                             self.is_reverse, self.time_major)
+            outputs, final = outs[0], outs[1:]
+            if isinstance(self.cell, LSTMCell):
+                return outputs, tuple(final)
+            return outputs, final[0]
+        return self._eager_loop(inputs, initial_states, sequence_length, **kwargs)
+
+    def _eager_loop(self, inputs, states, sequence_length=None, **kwargs):
+        """Generic path for user-defined cells: per-step cell.forward calls."""
+        time_axis = 0 if self.time_major else 1
+        T = inputs.shape[time_axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        mask = None
+        if sequence_length is not None:
+            mask = F_ops.sequence_mask(sequence_length, maxlen=T, dtype="float32")
+        outs = [None] * T
+        for t in steps:
+            x_t = inputs[:, t] if time_axis == 1 else inputs[t]
+            out, new_states = self.cell(x_t, states, **kwargs)
+            if mask is not None:
+                m = mask[:, t].unsqueeze(-1)
+                flat_new = new_states if isinstance(new_states, (tuple, list)) else [new_states]
+                flat_old = states if isinstance(states, (tuple, list)) else [states]
+                blended = [m * n + (1.0 - m) * o for n, o in zip(flat_new, flat_old)]
+                new_states = (type(new_states)(blended)
+                              if isinstance(new_states, (tuple, list)) else blended[0])
+            outs[t] = out
+            states = new_states
+        outputs = P.stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells over the same input (reference rnn.py:BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length, **kwargs)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length, **kwargs)
+        outputs = P.concat([out_fw, out_bw], axis=-1)
+        return outputs, (st_fw, st_bw)
+
+
+# ---------------------------------------------------------------- stacks
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        if direction in ("bidirectional", "bidirect"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(f"direction should be forward or bidirect(ional), got {direction}")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.state_components = 2 if mode == "LSTM" else 1
+
+        kw = dict(weight_ih_attr=weight_ih_attr, weight_hh_attr=weight_hh_attr,
+                  bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+
+        def make_cell(in_size):
+            if mode == "LSTM":
+                return LSTMCell(in_size, hidden_size, **kw)
+            if mode == "GRU":
+                return GRUCell(in_size, hidden_size, **kw)
+            return SimpleRNNCell(in_size, hidden_size, activation, **kw)
+
+        from .container import LayerList
+
+        self._all_layers = LayerList()
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 else hidden_size * self.num_directions
+            if self.num_directions == 2:
+                self._all_layers.append(BiRNN(make_cell(in_size), make_cell(in_size),
+                                              time_major))
+            else:
+                self._all_layers.append(RNN(make_cell(in_size), False, time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        D, L, C = self.num_directions, self.num_layers, self.state_components
+        batch_idx = 1 if self.time_major else 0
+        batch = inputs.shape[batch_idx]
+
+        if initial_states is None:
+            zeros = lambda: Tensor(jnp.zeros((L * D, batch, self.hidden_size),
+                                             dtypes.convert_dtype("float32")))
+            initial_states = (zeros(), zeros()) if C == 2 else zeros()
+
+        comp = list(initial_states) if C == 2 else [initial_states]
+        # [L*D, N, H] -> per (layer, direction) slices
+        per_layer = []
+        for layer in range(L):
+            if D == 2:
+                fw = tuple(c[2 * layer] for c in comp)
+                bw = tuple(c[2 * layer + 1] for c in comp)
+                per_layer.append((fw if C == 2 else fw[0],
+                                  bw if C == 2 else bw[0]))
+            else:
+                st = tuple(c[layer] for c in comp)
+                per_layer.append(st if C == 2 else st[0])
+
+        x = inputs
+        finals = []
+        for layer in range(L):
+            x, st = self._all_layers[layer](x, per_layer[layer], sequence_length)
+            finals.append(st)
+            if self.dropout > 0.0 and layer < L - 1:
+                x = F_ops.dropout(x, p=self.dropout, training=self.training)
+
+        # restack final states into [L*D, N, H] (x C components for LSTM)
+        comps_out = [[] for _ in range(C)]
+        for st in finals:
+            dirs = st if D == 2 else (st,)
+            for d_st in dirs:
+                parts = d_st if C == 2 else (d_st,)
+                for i, p in enumerate(parts):
+                    comps_out[i].append(p)
+        stacked = [P.stack(c, axis=0) for c in comps_out]
+        final_states = tuple(stacked) if C == 2 else stacked[0]
+        return x, final_states
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__("RNN_TANH" if activation == "tanh" else "RNN_RELU",
+                         input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, activation, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)

@@ -916,3 +916,47 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
         return out.reshape(n, c * ks[0] * ks[1], oh * ow)
 
     return apply("unfold", kernel, [x])
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Row-wise [0, maxlen) < length mask (reference: fluid/layers/sequence_lod.py
+    sequence_mask, used by the dynamic rnn runner for state blending)."""
+    x = t_(x)
+    if maxlen is None:
+        if getattr(x, "is_symbolic", False):
+            raise ValueError("sequence_mask requires an explicit maxlen when "
+                             "building a static program (lengths are symbolic)")
+        maxlen = int(np.asarray(x._data).max()) if x._data.size else 0
+
+    def kernel(lens, maxlen, dtype):
+        return (jnp.arange(maxlen) < lens[..., None]).astype(dtype)
+
+    return apply("sequence_mask", kernel, [x],
+                 {"maxlen": int(maxlen), "dtype": dtypes.convert_dtype(dtype)},
+                 differentiable=False)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (reference: python/paddle/nn/functional/extension.py)."""
+
+    def kernel(a, offset, dim1, dim2):
+        n = a.shape[-1] + abs(offset)
+        ndim = a.ndim + 1
+        d1 = dim1 % ndim
+        d2 = dim2 % ndim
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        rows = jnp.arange(a.shape[-1]) + max(-offset, 0)
+        cols = jnp.arange(a.shape[-1]) + max(offset, 0)
+        base = base.at[..., rows, cols].set(a)
+        # base has the two new axes last; move them to (d1, d2)
+        order = list(range(a.ndim - 1))
+        remaining = [ax for ax in range(ndim) if ax not in (d1, d2)]
+        perm = [0] * ndim
+        for src, dst in zip(order, remaining):
+            perm[dst] = src
+        perm[d1] = a.ndim - 1
+        perm[d2] = a.ndim
+        return jnp.transpose(base, perm)
+
+    return apply("diag_embed", kernel, [t_(input)],
+                 {"offset": offset, "dim1": dim1, "dim2": dim2})

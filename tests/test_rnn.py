@@ -1,0 +1,327 @@
+"""RNN family tests: cells vs numpy references, scan vs eager-loop parity,
+sequence-length masking semantics (reference fluid/layers/rnn.py:517 _maybe_copy),
+multi-layer/bidirectional stacks, and gradient flow through the fused scan."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    z = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = np.split(z, 4, axis=-1)
+    i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+    nc = f * c + i * np.tanh(g)
+    nh = o * np.tanh(nc)
+    return nh, nc
+
+
+def _np_gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    xg = x @ w_ih.T + b_ih
+    hg = h @ w_hh.T + b_hh
+    x_r, x_z, x_c = np.split(xg, 3, axis=-1)
+    h_r, h_z, h_c = np.split(hg, 3, axis=-1)
+    r = _sigmoid(x_r + h_r)
+    z = _sigmoid(x_z + h_z)
+    c = np.tanh(x_c + r * h_c)
+    return (h - c) * z + c
+
+
+class TestCells:
+    def test_simple_rnn_cell(self):
+        paddle.seed(0)
+        cell = nn.SimpleRNNCell(4, 8)
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        h0 = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+        out, h = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+        ref = np.tanh(x @ cell.weight_ih.numpy().T + cell.bias_ih.numpy()
+                      + h0 @ cell.weight_hh.numpy().T + cell.bias_hh.numpy())
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(h.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_lstm_cell(self):
+        paddle.seed(0)
+        cell = nn.LSTMCell(4, 8)
+        rs = np.random.RandomState(0)
+        x, h0, c0 = (rs.randn(2, 4).astype(np.float32),
+                     rs.randn(2, 8).astype(np.float32),
+                     rs.randn(2, 8).astype(np.float32))
+        out, (h, c) = cell(paddle.to_tensor(x),
+                           (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+        rh, rc = _np_lstm_step(x, h0, c0, cell.weight_ih.numpy(),
+                               cell.weight_hh.numpy(), cell.bias_ih.numpy(),
+                               cell.bias_hh.numpy())
+        np.testing.assert_allclose(out.numpy(), rh, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(c.numpy(), rc, rtol=1e-5, atol=1e-6)
+
+    def test_gru_cell(self):
+        paddle.seed(0)
+        cell = nn.GRUCell(4, 8)
+        rs = np.random.RandomState(0)
+        x, h0 = rs.randn(2, 4).astype(np.float32), rs.randn(2, 8).astype(np.float32)
+        out, h = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+        ref = _np_gru_step(x, h0, cell.weight_ih.numpy(), cell.weight_hh.numpy(),
+                           cell.bias_ih.numpy(), cell.bias_hh.numpy())
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_cell_default_states(self):
+        cell = nn.LSTMCell(4, 8)
+        x = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        out, (h, c) = cell(x)
+        assert out.shape == [3, 8] and c.shape == [3, 8]
+
+    def test_no_bias(self):
+        cell = nn.GRUCell(4, 8, bias_ih_attr=False, bias_hh_attr=False)
+        assert cell.bias_ih is None
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        out, _ = cell(x)
+        assert out.shape == [2, 8]
+
+
+class TestRNNWrapper:
+    def test_rnn_matches_manual_loop(self):
+        paddle.seed(0)
+        cell = nn.LSTMCell(4, 8)
+        rnn = nn.RNN(cell)
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 5, 4).astype(np.float32)
+        out, (h, c) = rnn(paddle.to_tensor(x))
+        # manual numpy loop
+        nh = np.zeros((2, 8), np.float32)
+        nc = np.zeros((2, 8), np.float32)
+        w_ih, w_hh = cell.weight_ih.numpy(), cell.weight_hh.numpy()
+        b_ih, b_hh = cell.bias_ih.numpy(), cell.bias_hh.numpy()
+        refs = []
+        for t in range(5):
+            nh, nc = _np_lstm_step(x[:, t], nh, nc, w_ih, w_hh, b_ih, b_hh)
+            refs.append(nh)
+        np.testing.assert_allclose(out.numpy(), np.stack(refs, 1), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), nh, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), nc, rtol=1e-4, atol=1e-5)
+
+    def test_reverse(self):
+        paddle.seed(0)
+        cell = nn.GRUCell(4, 8)
+        rnn_rev = nn.RNN(cell, is_reverse=True)
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 5, 4).astype(np.float32)
+        out, h = rnn_rev(paddle.to_tensor(x))
+        # reverse == forward RNN on time-flipped input, output flipped back
+        rnn_fwd = nn.RNN(cell)
+        out2, h2 = rnn_fwd(paddle.to_tensor(x[:, ::-1].copy()))
+        np.testing.assert_allclose(out.numpy(), out2.numpy()[:, ::-1], rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(h.numpy(), h2.numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_time_major(self):
+        paddle.seed(0)
+        cell = nn.SimpleRNNCell(4, 8)
+        rs = np.random.RandomState(0)
+        x = rs.randn(5, 2, 4).astype(np.float32)  # [T, N, I]
+        out_tm, h_tm = nn.RNN(cell, time_major=True)(paddle.to_tensor(x))
+        out_bm, h_bm = nn.RNN(cell)(paddle.to_tensor(x.transpose(1, 0, 2).copy()))
+        assert out_tm.shape == [5, 2, 8]
+        np.testing.assert_allclose(out_tm.numpy(),
+                                   out_bm.numpy().transpose(1, 0, 2), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(h_tm.numpy(), h_bm.numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_sequence_length_masking(self):
+        """States freeze past each row's length (reference _maybe_copy semantics)."""
+        paddle.seed(0)
+        cell = nn.GRUCell(3, 6)
+        rnn = nn.RNN(cell)
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 5, 3).astype(np.float32)
+        lens = np.array([3, 5], np.int64)
+        out, h = rnn(paddle.to_tensor(x), sequence_length=paddle.to_tensor(lens))
+        # row 0's final state equals running only 3 steps
+        out3, h3 = rnn(paddle.to_tensor(x[:1, :3]))
+        np.testing.assert_allclose(h.numpy()[0], h3.numpy()[0], rtol=1e-5, atol=1e-6)
+        # row 1 runs the full 5 steps
+        out5, h5 = rnn(paddle.to_tensor(x[1:2]))
+        np.testing.assert_allclose(h.numpy()[1], h5.numpy()[0], rtol=1e-5, atol=1e-6)
+
+    def test_custom_cell_eager_path(self):
+        """A user-defined cell exercises the generic per-step loop."""
+
+        class Decay(nn.RNNCellBase):
+            def __init__(self):
+                super().__init__()
+                self.alpha = self.create_parameter((1,), default_initializer=None)
+
+            @property
+            def state_shape(self):
+                return (2,)
+
+            def forward(self, inputs, states=None):
+                if states is None:
+                    states = self.get_initial_states(inputs)
+                h = states * 0.5 + inputs
+                return h, h
+
+        cell = Decay()
+        cell.alpha.set_value(np.ones((1,), np.float32))
+        x = np.ones((1, 3, 2), np.float32)
+        out, h = nn.RNN(cell)(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy()[0, :, 0], [1.0, 1.5, 1.75], rtol=1e-6)
+
+    def test_grad_flows_through_scan(self):
+        paddle.seed(0)
+        cell = nn.LSTMCell(4, 8)
+        rnn = nn.RNN(cell)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 5, 4).astype(np.float32))
+        x.stop_gradient = False
+        out, _ = rnn(x)
+        out.sum().backward()
+        assert cell.weight_ih.grad is not None
+        assert float(np.abs(cell.weight_ih.grad.numpy()).sum()) > 0
+        assert x.grad is not None and x.grad.shape == [2, 5, 4]
+
+
+class TestBiRNN:
+    def test_birnn_shapes_and_parity(self):
+        paddle.seed(0)
+        cf, cb = nn.GRUCell(4, 8), nn.GRUCell(4, 8)
+        bi = nn.BiRNN(cf, cb)
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 5, 4).astype(np.float32)
+        out, (hf, hb) = bi(paddle.to_tensor(x))
+        assert out.shape == [2, 5, 16]
+        of, hf2 = nn.RNN(cf)(paddle.to_tensor(x))
+        ob, hb2 = nn.RNN(cb, is_reverse=True)(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy()[..., :8], of.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(out.numpy()[..., 8:], ob.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestStacks:
+    @pytest.mark.parametrize("cls,comp", [(nn.SimpleRNN, 1), (nn.LSTM, 2),
+                                          (nn.GRU, 1)])
+    def test_shapes_forward(self, cls, comp):
+        paddle.seed(0)
+        m = cls(10, 16, num_layers=2)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 7, 10).astype(np.float32))
+        out, st = m(x)
+        assert out.shape == [4, 7, 16]
+        if comp == 2:
+            h, c = st
+            assert h.shape == [2, 4, 16] and c.shape == [2, 4, 16]
+        else:
+            assert st.shape == [2, 4, 16]
+
+    @pytest.mark.parametrize("cls,comp", [(nn.LSTM, 2), (nn.GRU, 1)])
+    def test_shapes_bidirectional(self, cls, comp):
+        paddle.seed(0)
+        m = cls(10, 16, num_layers=2, direction="bidirect")
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 7, 10).astype(np.float32))
+        out, st = m(x)
+        assert out.shape == [4, 7, 32]
+        h = st[0] if comp == 2 else st
+        assert h.shape == [4, 4, 16]  # L*D = 4
+
+    def test_initial_state_roundtrip(self):
+        """Final states of a run feed back in as initial states consistently."""
+        paddle.seed(0)
+        m = nn.LSTM(4, 8, num_layers=2)
+        rs = np.random.RandomState(0)
+        x1 = paddle.to_tensor(rs.randn(2, 3, 4).astype(np.float32))
+        x2 = paddle.to_tensor(rs.randn(2, 3, 4).astype(np.float32))
+        _, st1 = m(x1)
+        out_chained, _ = m(x2, st1)
+        # same as running 6 steps at once
+        x12 = paddle.to_tensor(np.concatenate([x1.numpy(), x2.numpy()], axis=1))
+        out_full, _ = m(x12)
+        np.testing.assert_allclose(out_chained.numpy(), out_full.numpy()[:, 3:],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lstm_matches_torch(self):
+        """Cross-check the full stacked bidirectional LSTM against torch CPU."""
+        torch = pytest.importorskip("torch")
+        paddle.seed(0)
+        m = nn.LSTM(5, 7, num_layers=2, direction="bidirect")
+        tm = torch.nn.LSTM(5, 7, num_layers=2, bidirectional=True, batch_first=True)
+        # copy paddle params into torch (same gate order i,f,g,o)
+        with torch.no_grad():
+            for layer in range(2):
+                pl = m._all_layers[layer]
+                for d, cell in enumerate([pl.cell_fw, pl.cell_bw]):
+                    sfx = "_reverse" if d else ""
+                    getattr(tm, f"weight_ih_l{layer}{sfx}").copy_(
+                        torch.tensor(cell.weight_ih.numpy()))
+                    getattr(tm, f"weight_hh_l{layer}{sfx}").copy_(
+                        torch.tensor(cell.weight_hh.numpy()))
+                    getattr(tm, f"bias_ih_l{layer}{sfx}").copy_(
+                        torch.tensor(cell.bias_ih.numpy()))
+                    getattr(tm, f"bias_hh_l{layer}{sfx}").copy_(
+                        torch.tensor(cell.bias_hh.numpy()))
+        x = np.random.RandomState(0).randn(3, 6, 5).astype(np.float32)
+        out, (h, c) = m(paddle.to_tensor(x))
+        tout, (th, tc) = tm(torch.tensor(x))
+        np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), tc.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_dropout_between_layers(self):
+        paddle.seed(0)
+        m = nn.GRU(4, 8, num_layers=2, dropout=0.5)
+        x = paddle.to_tensor(np.ones((2, 5, 4), np.float32))
+        m.train()
+        o1, _ = m(x)
+        o2, _ = m(x)
+        assert not np.allclose(o1.numpy(), o2.numpy())  # dropout active
+        m.eval()
+        o3, _ = m(x)
+        o4, _ = m(x)
+        np.testing.assert_allclose(o3.numpy(), o4.numpy())
+
+    def test_train_copy_task(self):
+        """A 1-layer GRU learns to output the first input token (sanity e2e)."""
+        paddle.seed(0)
+        m = nn.GRU(2, 16)
+        head = nn.Linear(16, 2)
+        params = list(m.parameters()) + list(head.parameters())
+        opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=params)
+        rs = np.random.RandomState(0)
+        losses = []
+        for step in range(60):
+            x = rs.randn(8, 4, 2).astype(np.float32)
+            xt = paddle.to_tensor(x)
+            out, h = m(xt)
+            pred = head(out[:, -1])
+            loss = ((pred - xt[:, 0]) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+class TestSequenceMaskFunctional:
+    def test_sequence_mask(self):
+        import paddle_tpu.nn.functional as F
+
+        m = F.sequence_mask(paddle.to_tensor(np.array([1, 3], np.int64)), maxlen=4)
+        np.testing.assert_array_equal(m.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_diag_embed(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        out = F.diag_embed(x)
+        assert out.shape == [2, 2, 2]
+        np.testing.assert_allclose(out.numpy()[0], np.diag([1.0, 2.0]))
+        out_off = F.diag_embed(x, offset=1)
+        assert out_off.shape == [2, 3, 3]
+        np.testing.assert_allclose(out_off.numpy()[1],
+                                   np.diag([3.0, 4.0], k=1))
