@@ -196,7 +196,12 @@ def run_backward(tensors, grad_tensors=None, retain_graph: bool = False, grad_si
         for aval, s in zip(node.out_avals, slots):
             if s is None:
                 shape, dt = aval
-                s = jnp.zeros(shape, dt)
+                # integer/bool outputs (e.g. argmax indices) take float0
+                # cotangents — jax.vjp rejects same-dtype zeros for them
+                if np.issubdtype(dt, np.integer) or dt == np.bool_:
+                    s = np.zeros(shape, jax.dtypes.float0)
+                else:
+                    s = jnp.zeros(shape, dt)
             cots.append(s)
         if node.vjp_fn is None:
             raise RuntimeError(

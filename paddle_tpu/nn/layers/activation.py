@@ -86,3 +86,14 @@ class RReLU(Layer):
 
     def forward(self, x):
         return A.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW/CHW inputs (reference nn.Softmax2D)."""
+
+    def forward(self, x):
+        assert x.ndim in (3, 4), "Softmax2D expects CHW or NCHW input"
+        return A.softmax(x, axis=-3)
+
+
+Silu = SiLU  # reference exports both spellings

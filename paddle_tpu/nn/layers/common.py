@@ -3,6 +3,8 @@ from __future__ import annotations
 
 import math
 
+import numpy as np
+
 from ...ops import nn_functional as F
 from .. import initializer as I
 from ..layer import Layer, ParamAttr
@@ -214,3 +216,66 @@ class Bilinear(Layer):
         if self.bias is not None:
             out = out + self.bias
         return out
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes, self.kernel_sizes = output_sizes, kernel_sizes
+        self.strides, self.paddings, self.dilations = strides, paddings, dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
+                      self.paddings, self.dilations)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from ...ops import linalg as L
+
+        diff = x - y + self.epsilon
+        return L.norm(diff, p=self.p, axis=-1, keepdim=self.keepdim)
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral normalization of a given weight tensor
+    (reference nn.SpectralNorm / spectral_norm op)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12, name=None,
+                 dtype="float32"):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            (h,), dtype=dtype, default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            (w,), dtype=dtype, default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, x):
+        from ...core.autograd import no_grad
+        from ...ops import linalg as L
+
+        dims = list(range(x.ndim))
+        perm = [self.dim] + [d for d in dims if d != self.dim]
+        mat = x.transpose(perm).reshape([x.shape[self.dim], -1])
+        u, v = self.weight_u, self.weight_v
+        with no_grad():
+            for _ in range(self.power_iters):
+                v_new = mat.t().matmul(u.reshape([-1, 1])).reshape([-1])
+                v = v_new / (L.norm(v_new) + self.eps)
+                u_new = mat.matmul(v.reshape([-1, 1])).reshape([-1])
+                u = u_new / (L.norm(u_new) + self.eps)
+            self.weight_u.set_value(u._data)
+            self.weight_v.set_value(v._data)
+        sigma = u.reshape([1, -1]).matmul(mat).matmul(v.reshape([-1, 1]))
+        return x / sigma.reshape([1] * x.ndim)

@@ -113,3 +113,51 @@ class CosineEmbeddingLoss(Layer):
 
     def forward(self, input1, input2, label):
         return F.cosine_embedding_loss(input1, input2, label, self.margin, self.reduction)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, logits, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(logits, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction, norm_by_times)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin = margin
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.hinge_embedding_loss(input, label, self.margin, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classifier head (reference nn.HSigmoidLoss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None, bias_attr=None,
+                 is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if (num_classes < 2) and (not is_custom):
+            raise ValueError("num_classes must not be less than 2 with default tree")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        C = num_classes if is_custom else num_classes - 1
+        import math as _m
+
+        from .. import initializer as I
+
+        std = 1.0 / _m.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            (C, feature_size), attr=weight_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias = self.create_parameter((C, 1), attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)

@@ -170,3 +170,25 @@ def swiglu(x, y=None, name=None):
         return jax.nn.silu(a) * b
 
     return apply("swiglu", kernel, [t_(x)])
+
+
+# in-place variants (reference nn/functional/activation.py relu_/elu_/...):
+# jnp arrays are immutable, so "in-place" rebinds the tensor's buffer like the
+# reference's inplace ops rebind the variable's allocation.
+def _make_inplace(fn):
+    def op(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x.set_value(out._data)
+        return x
+
+    return op
+
+
+relu_ = _make_inplace(relu)
+elu_ = _make_inplace(elu)
+softmax_ = _make_inplace(softmax)
+
+
+def tanh_(x, name=None):
+    x.set_value(jnp.tanh(x._data))
+    return x

@@ -200,17 +200,26 @@ def _pool(name, x, kernel_size, stride, padding, nd, reducer, init, data_format,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCL", name=None):
+    if return_mask:
+        return _max_pool_with_indices("max_pool1d_with_index", x, kernel_size,
+                                      stride, padding, 1)
     df = "NWC" if data_format == "NLC" else "NCW"
     return _pool("max_pool1d", x, kernel_size, stride, padding, 1, "max", None, df, ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_indices("max_pool2d_with_index", x, kernel_size,
+                                      stride, padding, 2)
     return _pool("max_pool2d", x, kernel_size, stride, padding, 2, "max", None, data_format, ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_indices("max_pool3d_with_index", x, kernel_size,
+                                      stride, padding, 3)
     return _pool("max_pool3d", x, kernel_size, stride, padding, 3, "max", None, data_format, ceil_mode)
 
 
@@ -960,3 +969,517 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
 
     return apply("diag_embed", kernel, [t_(input)],
                  {"offset": offset, "dim1": dim1, "dim2": dim2})
+
+
+# ---------- adaptive pools (1d/3d) + max-pool indices + unpool ----------
+
+def _adaptive_pool_nd(name, x, output_size, nd, reducer):
+    """Adaptive pooling over the last nd spatial axes of an NC... tensor."""
+    x = t_(x)
+    out_sz = _pair(output_size, nd)
+    spatial_axes = list(range(2, 2 + nd))
+    in_sz = [x.shape[ax] for ax in spatial_axes]
+    out_sz = tuple(in_sz[i] if out_sz[i] is None else out_sz[i] for i in range(nd))
+
+    def kernel(a):
+        red = jnp.max if reducer == "max" else jnp.mean
+
+        def pool_axis(arr, axis, osz):
+            isz = arr.shape[axis]
+            starts = (np.arange(osz) * isz) // osz
+            ends = ((np.arange(osz) + 1) * isz + osz - 1) // osz
+            pieces = [red(jax.lax.slice_in_dim(arr, int(s), int(e), axis=axis),
+                          axis=axis, keepdims=True) for s, e in zip(starts, ends)]
+            return jnp.concatenate(pieces, axis=axis)
+
+        for ax, osz in zip(spatial_axes, out_sz):
+            a = pool_axis(a, ax, osz)
+        return a
+
+    return apply(name, kernel, [x])
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool_nd("adaptive_avg_pool3d", x, output_size, 3, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool_nd("adaptive_max_pool1d", x, output_size, 1, "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool_nd("adaptive_max_pool3d", x, output_size, 3, "max")
+    return (out, None) if return_mask else out
+
+
+def _max_pool_with_indices(name, x, kernel_size, stride, padding, nd):
+    """Max pool returning (values, flat spatial argmax indices) — the unpool
+    contract (reference: max_pool2d_with_index op)."""
+    x = t_(x)
+    ks = _pair(kernel_size, nd)
+    st = _pair(stride if stride is not None else kernel_size, nd)
+    pd = _pair(padding, nd)
+    in_sz = [x.shape[2 + i] for i in range(nd)]
+    out_sz = [(in_sz[i] + 2 * pd[i] - ks[i]) // st[i] + 1 for i in range(nd)]
+
+    def kernel(a):
+        neg = -jnp.inf if dtypes.is_floating(a.dtype) else jnp.iinfo(a.dtype).min
+        a_p = jnp.pad(a, [(0, 0), (0, 0)] + [(p, p + k) for p, k in zip(pd, ks)],
+                      constant_values=neg)
+        patches = []
+        offsets = list(np.ndindex(*ks))
+        for off in offsets:
+            sl = [slice(None), slice(None)]
+            for i in range(nd):
+                sl.append(slice(off[i], off[i] + out_sz[i] * st[i], st[i]))
+            patches.append(a_p[tuple(sl)])
+        stacked = jnp.stack(patches, axis=-1)            # [N, C, *out, K]
+        vals = jnp.max(stacked, axis=-1)
+        karg = jnp.argmax(stacked, axis=-1)              # window-relative
+        # window-relative -> absolute unpadded flat index
+        off_arr = np.asarray(offsets)                    # [K, nd]
+        out_grid = np.meshgrid(*[np.arange(o) for o in out_sz], indexing="ij")
+        flat = jnp.zeros(karg.shape, jnp.int64)
+        mult = 1
+        for i in range(nd - 1, -1, -1):
+            abs_i = (jnp.asarray(out_grid[i]) * st[i]
+                     + jnp.asarray(off_arr[:, i])[karg] - pd[i])
+            flat = flat + abs_i.astype(jnp.int64) * mult
+            mult *= in_sz[i]
+        return vals, flat
+
+    return apply(name, kernel, [x], nondiff_mask=None)
+
+
+def _max_unpool_nd(name, x, indices, kernel_size, stride, padding, output_size, nd,
+                   data_format):
+    x = t_(x)
+    indices = t_(indices)
+    ks = _pair(kernel_size, nd)
+    st = _pair(stride if stride is not None else kernel_size, nd)
+    pd = _pair(padding, nd)
+    in_sz = [x.shape[2 + i] for i in range(nd)]
+    if output_size is None:
+        out_sz = [(in_sz[i] - 1) * st[i] - 2 * pd[i] + ks[i] for i in range(nd)]
+    else:
+        out_sz = list(output_size)[-nd:]
+
+    def kernel(a, idx):
+        n, c = a.shape[0], a.shape[1]
+        flat_len = int(np.prod(out_sz))
+        a_f = a.reshape(n, c, -1)
+        i_f = idx.reshape(n, c, -1)
+        out = jnp.zeros((n, c, flat_len), a.dtype)
+        bi = jnp.arange(n)[:, None, None]
+        ci = jnp.arange(c)[None, :, None]
+        out = out.at[bi, ci, i_f].set(a_f)
+        return out.reshape([n, c] + out_sz)
+
+    return apply(name, kernel, [x, indices])
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+    return _max_unpool_nd("max_unpool1d", x, indices, kernel_size, stride, padding,
+                          output_size, 1, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+    return _max_unpool_nd("max_unpool2d", x, indices, kernel_size, stride, padding,
+                          output_size, 2, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW",
+                 output_size=None, name=None):
+    return _max_unpool_nd("max_unpool3d", x, indices, kernel_size, stride, padding,
+                          output_size, 3, data_format)
+
+
+# ---------- extra losses ----------
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - 2|X∩Y|/(|X|+|Y|) per batch, meaned (reference nn/functional/loss.py)."""
+    input = t_(input)
+    label = t_(label)
+
+    def kernel(p, l, epsilon):
+        lf = jax.nn.one_hot(l.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * lf, axis=reduce_dims)
+        denom = jnp.sum(p, axis=reduce_dims) + jnp.sum(lf, axis=reduce_dims)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (denom + epsilon))
+
+    return apply("dice_loss", kernel, [input, label], {"epsilon": epsilon})
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def kernel(p, l, epsilon):
+        return -l * jnp.log(p + epsilon) - (1.0 - l) * jnp.log(1.0 - p + epsilon)
+
+    return apply("log_loss", kernel, [t_(input), t_(label)], {"epsilon": epsilon})
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (reference nn/functional/loss.py:npair_loss)."""
+    anchor, positive, labels = t_(anchor), t_(positive), t_(labels)
+
+    def kernel(a, p, l, l2_reg):
+        l = l.reshape(-1, 1).astype(a.dtype)
+        same = (l == l.T).astype(a.dtype)
+        targets = same / jnp.sum(same, axis=1, keepdims=True)
+        sim = a @ p.T
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = jnp.mean(jnp.sum(-targets * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) / 2
+        return ce + reg
+
+    return apply("npair_loss", kernel, [anchor, positive, labels], {"l2_reg": l2_reg})
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def kernel(x, y, margin):
+        loss = jnp.where(y == 1.0, x, jnp.maximum(0.0, margin - x))
+        return loss
+
+    out = apply("hinge_embedding_loss", kernel, [t_(input), t_(label)],
+                {"margin": margin})
+    return _reduce_loss(out, reduction)
+
+
+def _reduce_loss(out, reduction):
+    from . import reduction as R
+
+    if reduction == "mean":
+        return R.mean(out)
+    if reduction == "sum":
+        return R.sum(out)
+    return out
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
+                  path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid over a complete binary tree (default) or a custom
+    tree given by path_table/path_code (reference: hierarchical_sigmoid op,
+    paddle/fluid/operators/hierarchical_sigmoid_op.h MatrixBitCodeFunctor)."""
+    input, label, weight = t_(input), t_(label), t_(weight)
+    lab_np = np.asarray(label._data).reshape(-1)
+    if path_table is None:
+        # default complete binary tree: node code = label + num_classes,
+        # walk from root; internal node ids are (code >> k) - 1
+        codes = [int(c) + num_classes for c in lab_np]
+        max_len = max((c.bit_length() - 1 for c in codes), default=0)
+        tbl = np.zeros((len(codes), max_len), np.int64)
+        cod = np.zeros((len(codes), max_len), np.float32)
+        msk = np.zeros((len(codes), max_len), np.float32)
+        for r, c in enumerate(codes):
+            length = c.bit_length() - 1
+            for j in range(length):
+                tbl[r, j] = (c >> (length - j)) - 1
+                cod[r, j] = float((c >> (length - 1 - j)) & 1)
+                msk[r, j] = 1.0
+        path_table = Tensor(jnp.asarray(tbl))
+        path_code = Tensor(jnp.asarray(cod))
+        mask = Tensor(jnp.asarray(msk))
+    else:
+        path_table, path_code = t_(path_table), t_(path_code)
+        mask = Tensor((path_table._data >= 0).astype(jnp.float32))
+        path_table = Tensor(jnp.maximum(path_table._data, 0))
+
+    args = [input, weight, path_table, path_code, mask]
+    if bias is not None:
+        args.append(t_(bias))
+
+    def kernel(x, w, tbl, cod, msk, *maybe_b):
+        w_path = w[tbl]                       # [N, L, D]
+        pre = jnp.einsum("nld,nd->nl", w_path, x)
+        if maybe_b:
+            pre = pre + maybe_b[0].reshape(-1)[tbl]
+        # BCE-with-logits against the path code bits, masked to real path length
+        loss = jnp.maximum(pre, 0) - pre * cod + jnp.log1p(jnp.exp(-jnp.abs(pre)))
+        return jnp.mean(jnp.sum(loss * msk, axis=1))
+
+    return apply("hsigmoid_loss", kernel, args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """ArcFace-family margin softmax on cosine logits (reference:
+    operators/margin_cross_entropy_op.cu; model-parallel grouping handled by
+    the caller's mp layers here)."""
+    logits, label = t_(logits), t_(label)
+
+    def kernel(cosv, l, margin1, margin2, margin3, scale):
+        lab = l.reshape(-1)
+        onehot = jax.nn.one_hot(lab, cosv.shape[-1], dtype=cosv.dtype)
+        theta = jnp.arccos(jnp.clip(cosv, -1.0 + 1e-7, 1.0 - 1e-7))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adjusted = onehot * target + (1.0 - onehot) * cosv
+        z = adjusted * scale
+        logp = jax.nn.log_softmax(z, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+        return loss, jax.nn.softmax(z, axis=-1)
+
+    loss, soft = apply("margin_cross_entropy", kernel, [logits, label],
+                       {"margin1": margin1, "margin2": margin2,
+                        "margin3": margin3, "scale": scale})
+    loss = _reduce_loss(loss, reduction)
+    if return_softmax:
+        return loss, soft
+    return loss
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss via the forward algorithm as one lax.scan over time
+    (reference: warpctc op, operators/warpctc_op.cc; TPU-native instead of the
+    external warp-ctc kernel). log_probs: [T, N, C] logits (softmax applied
+    internally, like the reference)."""
+    log_probs, labels = t_(log_probs), t_(labels)
+    input_lengths, label_lengths = t_(input_lengths), t_(label_lengths)
+
+    def kernel(logits, lab, in_len, lab_len, blank):
+        lp = jax.nn.log_softmax(logits, axis=-1)      # [T, N, C]
+        T, N, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        NEG = -1e30
+        # extended label sequence: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((N, S), blank, lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        # can skip from s-2 to s when ext[s] != blank and ext[s] != ext[s-2]
+        ext_prev2 = jnp.concatenate([jnp.full((N, 2), -1, ext.dtype), ext[:, :-2]], 1)
+        can_skip = (ext != blank) & (ext != ext_prev2)
+
+        alpha0 = jnp.full((N, S), NEG)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(N), ext[:, 0]])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, lp[0, jnp.arange(N), ext[:, 1]], NEG))
+
+        def step(alpha, t):
+            prev1 = jnp.concatenate([jnp.full((N, 1), NEG), alpha[:, :-1]], 1)
+            prev2 = jnp.concatenate([jnp.full((N, 2), NEG), alpha[:, :-2]], 1)
+            prev2 = jnp.where(can_skip, prev2, NEG)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+            emit = jnp.take_along_axis(lp[t], ext, axis=1)   # [N, S]
+            new = merged + emit
+            # freeze rows whose time is up
+            live = (t < in_len)[:, None]
+            return jnp.where(live, new, alpha), None
+
+        alphaT, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        s_last = 2 * lab_len  # index of final blank
+        a_last = jnp.take_along_axis(alphaT, s_last[:, None], 1)[:, 0]
+        a_prev = jnp.where(
+            lab_len > 0,
+            jnp.take_along_axis(alphaT, jnp.maximum(s_last - 1, 0)[:, None], 1)[:, 0],
+            NEG)
+        nll = -jnp.logaddexp(a_last, a_prev)
+        if norm_by_times:
+            nll = nll / in_len.astype(nll.dtype)
+        return nll
+
+    out = apply("ctc_loss", kernel, [log_probs, labels, input_lengths, label_lengths],
+                {"blank": blank})
+    return _reduce_loss(out, reduction)
+
+
+# ---------- spatial / vision ops ----------
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D affine sampling grid (reference: affine_grid op)."""
+    theta = t_(theta)
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def kernel(th, h, w, align_corners):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+        return jnp.einsum("hwk,nck->nhwc", base, th)            # [N, H, W, 2]
+
+    return apply("affine_grid", kernel, [theta],
+                 {"h": h, "w": w, "align_corners": align_corners})
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True,
+                name=None):
+    """Bilinear/nearest sampling of NCHW by an [N,H,W,2] grid in [-1,1]
+    (reference: grid_sampler op)."""
+    x, grid = t_(x), t_(grid)
+
+    def kernel(a, g, mode, padding_mode, align_corners):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+
+        def unnormalize(coord, size):
+            if align_corners:
+                return (coord + 1.0) / 2.0 * (size - 1)
+            return ((coord + 1.0) * size - 1.0) / 2.0
+
+        fx = unnormalize(gx, w)
+        fy = unnormalize(gy, h)
+
+        def get(ix, iy):
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            v = a[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [N, Hg, Wg, C]
+            if padding_mode == "zeros":
+                inside = ((ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1))
+                v = v * inside[..., None].astype(v.dtype)
+            return v
+
+        if mode == "nearest":
+            out = get(jnp.round(fx).astype(jnp.int32), jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = fx - x0
+            wy = fy - y0
+            v00, v01 = get(x0, y0), get(x1, y0)
+            v10, v11 = get(x0, y1), get(x1, y1)
+            wx = wx[..., None]
+            wy = wy[..., None]
+            out = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+                   + v10 * (1 - wx) * wy + v11 * wx * wy)
+        return jnp.transpose(out, (0, 3, 1, 2))  # NHWC -> NCHW
+
+    return apply("grid_sample", kernel, [x, grid],
+                 {"mode": mode, "padding_mode": padding_mode,
+                  "align_corners": align_corners})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """Shift a fraction of channels one step along the segment (time) dim
+    (reference: temporal_shift op)."""
+    x = t_(x)
+
+    def kernel(a, seg_num, shift_ratio):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([a[:, 1:, :fold], jnp.zeros_like(a[:, :1, :fold])], 1)
+        right = jnp.concatenate([jnp.zeros_like(a[:, :1, fold:2 * fold]),
+                                 a[:, :-1, fold:2 * fold]], 1)
+        rest = a[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, rest], axis=2)
+        return out.reshape(nt, c, h, w)
+
+    return apply("temporal_shift", kernel, [x],
+                 {"seg_num": seg_num, "shift_ratio": shift_ratio})
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[n,k] = x1[n,:] @ W[k] @ x2[n,:] + b (reference: bilinear_tensor_product)."""
+    args = [t_(x1), t_(x2), t_(weight)] + ([t_(bias)] if bias is not None else [])
+
+    def kernel(a, b, w, *maybe_bias):
+        out = jnp.einsum("ni,kij,nj->nk", a, w, b)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+
+    return apply("bilinear", kernel, args)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    x = t_(x)
+    p = _pair(padding, 4)  # left, right, top, bottom
+
+    def kernel(a, p, channel_last):
+        if channel_last:
+            pads = [(0, 0), (p[2], p[3]), (p[0], p[1]), (0, 0)]
+        else:
+            pads = [(0, 0), (0, 0), (p[2], p[3]), (p[0], p[1])]
+        return jnp.pad(a, pads)
+
+    return apply("zeropad2d", kernel, [x],
+                 {"p": tuple(int(v) for v in p),
+                  "channel_last": data_format == "NHWC"})
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """Inverse of unfold: scatter-add columns back into the image
+    (reference: fold op)."""
+    x = t_(x)
+    out_hw = _pair(output_sizes, 2)
+    ks = _pair(kernel_sizes, 2)
+    st = _pair(strides, 2)
+    pd = _pair(paddings, 2)
+    dl = _pair(dilations, 2)
+
+    def kernel(a):
+        n, ckk, ol = a.shape
+        c = ckk // (ks[0] * ks[1])
+        oh = (out_hw[0] + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (out_hw[1] + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        a = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        hp, wp = out_hw[0] + 2 * pd[0], out_hw[1] + 2 * pd[1]
+        out = jnp.zeros((n, c, hp, wp), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                             j * dl[1]: j * dl[1] + ow * st[1]: st[1]].add(a[:, :, i, j])
+        return out[:, :, pd[0]: hp - pd[0], pd[1]: wp - pd[1]]
+
+    return apply("fold", kernel, [x])
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers + all positives; remap labels
+    (reference: class_center_sample op). Host-side sampling, eager only."""
+    label = t_(label)
+    lab = np.asarray(label._data).reshape(-1)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        rng = np.random.default_rng(random_mod.default_generator().initial_seed())
+        extra = rng.choice(rest, size=num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lab])), Tensor(jnp.asarray(sampled)))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention via a dense mask built from the CSR pattern.
+    The reference ships a CUDA-only kernel (operators/sparse_attention_op.cu);
+    on TPU the XLA/Pallas flash path (ops/pallas) covers the perf case, so this
+    provides semantics, not the sparse kernel."""
+    q, k, v = t_(query), t_(key), t_(value)
+    offs, cols = t_(sparse_csr_offset), t_(sparse_csr_columns)
+
+    def kernel(q, k, v, offs, cols):
+        b, h, T, d = q.shape
+        mask = jnp.zeros((b, h, T, T), bool)
+        offs_np = offs
+        for r in range(T):
+            # rows share the CSR layout per (batch, head)
+            start = offs_np[..., r]
+            end = offs_np[..., r + 1]
+            idx = jnp.arange(cols.shape[-1])
+            sel = (idx >= start[..., None]) & (idx < end[..., None])
+            row_cols = jnp.where(sel, cols, -1)
+            row_mask = jnp.zeros((b, h, T), bool)
+            row_mask = row_mask.at[
+                jnp.arange(b)[:, None, None], jnp.arange(h)[None, :, None],
+                row_cols].set(True)
+            row_mask = row_mask & (row_cols >= 0).any(-1)[..., None]
+            mask = mask.at[:, :, r, :].set(row_mask)
+        scores = jnp.einsum("bhtd,bhsd->bhts", q, k) / jnp.sqrt(d).astype(q.dtype)
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhts,bhsd->bhtd", probs, v)
+
+    return apply("sparse_attention", kernel, [q, k, v, offs, cols])

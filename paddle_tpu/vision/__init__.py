@@ -1,3 +1,20 @@
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Select the image-decoding backend for datasets (reference:
+    python/paddle/vision/image.py). 'cv2' is accepted but decoding here goes
+    through numpy either way."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend

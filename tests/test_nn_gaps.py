@@ -1,0 +1,306 @@
+"""Tests for the nn/functional gap fill: adaptive pools, max-unpool roundtrip,
+losses (CTC cross-checked against torch), grid ops, fold, spectral norm, etc."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestAdaptivePools:
+    def test_adaptive_avg_pool3d(self):
+        x = np.random.RandomState(0).rand(2, 3, 8, 8, 8).astype(np.float32)
+        out = nn.AdaptiveAvgPool3D(2)(t(x))
+        assert out.shape == [2, 3, 2, 2, 2]
+        np.testing.assert_allclose(
+            out.numpy()[0, 0, 0, 0, 0], x[0, 0, :4, :4, :4].mean(), rtol=1e-5)
+
+    def test_adaptive_max_pool1d_3d(self):
+        x = np.random.RandomState(0).rand(2, 3, 9).astype(np.float32)
+        out = nn.AdaptiveMaxPool1D(3)(t(x))
+        assert out.shape == [2, 3, 3]
+        np.testing.assert_allclose(out.numpy()[0, 0, 0], x[0, 0, :3].max(), rtol=1e-6)
+        x3 = np.random.RandomState(0).rand(2, 3, 4, 4, 4).astype(np.float32)
+        out3 = nn.AdaptiveMaxPool3D(2)(t(x3))
+        assert out3.shape == [2, 3, 2, 2, 2]
+
+    def test_uneven_adaptive(self):
+        x = np.arange(7, dtype=np.float32).reshape(1, 1, 7)
+        out = F.adaptive_max_pool1d(t(x), 3)
+        # windows: [0:3), [2:5), [4:7) per the floor/ceil rule
+        np.testing.assert_allclose(out.numpy()[0, 0], [2, 4, 6])
+
+
+class TestMaxUnpool:
+    def test_pool_unpool_roundtrip_2d(self):
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 3, 8, 8).astype(np.float32)
+        vals, idx = F.max_pool2d(t(x), 2, 2, return_mask=True)
+        assert vals.shape == [2, 3, 4, 4] and idx.shape == [2, 3, 4, 4]
+        # indices are flat positions into 8*8; values match gathering by index
+        flat = x.reshape(2, 3, 64)
+        np.testing.assert_allclose(
+            np.take_along_axis(flat, idx.numpy().reshape(2, 3, 16), -1),
+            vals.numpy().reshape(2, 3, 16), rtol=1e-6)
+        un = F.max_unpool2d(vals, idx, 2, 2)
+        assert un.shape == [2, 3, 8, 8]
+        # unpooled has the max values at their original places, zeros elsewhere
+        assert np.count_nonzero(un.numpy()) == 2 * 3 * 16
+        np.testing.assert_allclose(un.numpy().max(axis=(2, 3)),
+                                   x.max(axis=(2, 3)), rtol=1e-6)
+
+    def test_unpool_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(1)
+        x = rs.rand(1, 2, 6, 6).astype(np.float32)
+        vals, idx = F.max_pool2d(t(x), 2, 2, return_mask=True)
+        un = F.max_unpool2d(vals, idx, 2, 2)
+        tv, ti = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, 2, return_indices=True)
+        tun = torch.nn.functional.max_unpool2d(tv, ti, 2, 2)
+        np.testing.assert_allclose(vals.numpy(), tv.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(idx.numpy(), ti.numpy())
+        np.testing.assert_allclose(un.numpy(), tun.numpy(), rtol=1e-6)
+
+    def test_unpool_1d_3d_shapes(self):
+        x = np.random.RandomState(0).rand(2, 3, 8).astype(np.float32)
+        v, i = F.max_pool1d(t(x), 2, return_mask=True)
+        assert F.max_unpool1d(v, i, 2).shape == [2, 3, 8]
+        x3 = np.random.RandomState(0).rand(1, 2, 4, 4, 4).astype(np.float32)
+        v3, i3 = F.max_pool3d(t(x3), 2, return_mask=True)
+        assert F.max_unpool3d(v3, i3, 2).shape == [1, 2, 4, 4, 4]
+
+    def test_layers(self):
+        x = t(np.random.RandomState(0).rand(1, 1, 4, 4).astype(np.float32))
+        v, i = F.max_pool2d(x, 2, 2, return_mask=True)
+        assert nn.MaxUnPool2D(2, 2)(v, i).shape == [1, 1, 4, 4]
+
+    def test_grad_through_pool_with_indices(self):
+        x = t(np.random.RandomState(0).rand(1, 1, 4, 4).astype(np.float32))
+        x.stop_gradient = False
+        v, i = F.max_pool2d(x, 2, 2, return_mask=True)
+        F.max_unpool2d(v, i, 2, 2).sum().backward()
+        # each window's max gets grad 1, others 0
+        assert float(x.grad.numpy().sum()) == 4.0
+
+
+class TestLosses:
+    def test_log_loss(self):
+        p = np.array([[0.9], [0.1]], np.float32)
+        l = np.array([[1.0], [0.0]], np.float32)
+        out = F.log_loss(t(p), t(l)).numpy()
+        ref = -l * np.log(p + 1e-4) - (1 - l) * np.log(1 - p + 1e-4)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_dice_loss(self):
+        # perfect prediction -> loss ~ 0
+        lab = np.array([[0], [1]], np.int64)
+        pred = np.eye(2, dtype=np.float32)[lab.reshape(-1)]
+        out = float(F.dice_loss(t(pred), t(lab)))
+        assert out < 1e-4
+
+    def test_hinge_embedding_loss(self):
+        x = np.array([1.0, 0.4], np.float32)
+        y = np.array([1.0, -1.0], np.float32)
+        out = float(F.hinge_embedding_loss(t(x), t(y), margin=1.0))
+        np.testing.assert_allclose(out, (1.0 + 0.6) / 2, rtol=1e-6)
+        loss_layer = nn.HingeEmbeddingLoss(reduction="sum")
+        np.testing.assert_allclose(float(loss_layer(t(x), t(y))), 1.6, rtol=1e-6)
+
+    def test_npair_loss_runs(self):
+        rs = np.random.RandomState(0)
+        a = rs.rand(4, 8).astype(np.float32)
+        p = rs.rand(4, 8).astype(np.float32)
+        l = np.array([0, 1, 0, 2], np.int64)
+        out = float(F.npair_loss(t(a), t(p), t(l)))
+        assert out > 0
+
+    def test_margin_cross_entropy(self):
+        rs = np.random.RandomState(0)
+        cosv = np.clip(rs.rand(4, 10).astype(np.float32), 0.1, 0.9)
+        lab = np.array([1, 2, 3, 4], np.int64)
+        loss, soft = F.margin_cross_entropy(t(cosv), t(lab), return_softmax=True,
+                                            reduction=None)
+        assert loss.shape == [4, 1] and soft.shape == [4, 10]
+        # margin makes the target logit harder -> loss above plain CE
+        plain = -np.log(np.exp(cosv * 64)[np.arange(4), lab]
+                        / np.exp(cosv * 64).sum(-1))
+        assert (loss.numpy().reshape(-1) >= plain - 1e-3).all()
+
+    def test_ctc_loss_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(0)
+        T, N, C, L = 12, 3, 5, 4
+        logits = rs.randn(T, N, C).astype(np.float32)
+        labels = rs.randint(1, C, (N, L)).astype(np.int64)
+        in_len = np.array([12, 10, 8], np.int64)
+        lab_len = np.array([4, 3, 2], np.int64)
+        out = F.ctc_loss(t(logits), t(labels), t(in_len), t(lab_len),
+                         blank=0, reduction=None)
+        tl = torch.nn.functional.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), -1), torch.tensor(labels),
+            torch.tensor(in_len), torch.tensor(lab_len), blank=0,
+            reduction="none")
+        np.testing.assert_allclose(out.numpy(), tl.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_ctc_loss_grad_and_layer(self):
+        rs = np.random.RandomState(0)
+        logits = t(rs.randn(6, 2, 4).astype(np.float32))
+        logits.stop_gradient = False
+        loss = nn.CTCLoss()(logits, t(np.array([[1, 2], [2, 3]], np.int64)),
+                            t(np.array([6, 6], np.int64)),
+                            t(np.array([2, 2], np.int64)))
+        loss.backward()
+        assert logits.grad is not None
+        assert np.isfinite(logits.grad.numpy()).all()
+
+    def test_hsigmoid_loss(self):
+        paddle.seed(0)
+        m = nn.HSigmoidLoss(8, 6)
+        x = t(np.random.RandomState(0).rand(4, 8).astype(np.float32))
+        lab = t(np.array([[0], [2], [4], [5]], np.int64))
+        loss = m(x, lab)
+        assert loss.shape == [] or loss.shape == [1]
+        assert float(loss) > 0
+        # training decreases it
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=m.parameters())
+        first = float(loss)
+        for _ in range(20):
+            loss = m(x, lab)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < first * 0.7
+
+
+class TestSpatialOps:
+    def test_affine_grid_identity(self):
+        theta = np.array([[[1.0, 0, 0], [0, 1, 0]]], np.float32)
+        grid = F.affine_grid(t(theta), [1, 1, 3, 3])
+        assert grid.shape == [1, 3, 3, 2]
+        np.testing.assert_allclose(grid.numpy()[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(grid.numpy()[0, 2, 2], [1, 1], atol=1e-6)
+
+    def test_grid_sample_identity(self):
+        x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+        theta = np.array([[[1.0, 0, 0], [0, 1, 0]]], np.float32)
+        grid = F.affine_grid(t(theta), [1, 1, 3, 3])
+        out = F.grid_sample(t(x), grid)
+        np.testing.assert_allclose(out.numpy(), x, atol=1e-5)
+
+    def test_grid_sample_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 3, 5, 5).astype(np.float32)
+        grid = (rs.rand(2, 4, 4, 2).astype(np.float32) - 0.5) * 2.2  # incl. OOB
+        out = F.grid_sample(t(x), t(grid), align_corners=True)
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), mode="bilinear",
+            padding_mode="zeros", align_corners=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_temporal_shift(self):
+        x = np.random.RandomState(0).rand(4, 8, 2, 2).astype(np.float32)  # N*T=4
+        out = F.temporal_shift(t(x), seg_num=2, shift_ratio=0.25)
+        assert out.shape == [4, 8, 2, 2]
+        # first quarter channels shifted left: out[t] = x[t+1]
+        np.testing.assert_allclose(out.numpy()[0, :2], x[1, :2], rtol=1e-6)
+        np.testing.assert_allclose(out.numpy()[1, :2], 0.0, atol=1e-6)
+
+    def test_fold_unfold_roundtrip(self):
+        x = np.random.RandomState(0).rand(1, 2, 4, 4).astype(np.float32)
+        cols = F.unfold(t(x), 2, strides=2)
+        assert cols.shape == [1, 8, 4]
+        back = F.fold(cols, (4, 4), 2, strides=2)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+        assert nn.Fold((4, 4), 2, strides=2)(cols).shape == [1, 2, 4, 4]
+
+    def test_zeropad2d_bilinear(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        out = F.zeropad2d(t(x), [1, 0, 0, 1])
+        assert out.shape == [1, 1, 3, 3]
+        assert out.numpy()[0, 0, 0, 0] == 0  # left pad column
+        w = np.random.RandomState(0).rand(3, 4, 5).astype(np.float32)
+        x1 = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+        x2 = np.random.RandomState(2).rand(2, 5).astype(np.float32)
+        out = F.bilinear(t(x1), t(x2), t(w))
+        ref = np.einsum("ni,kij,nj->nk", x1, w, x2)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+class TestMiscLayers:
+    def test_softmax2d(self):
+        x = np.random.RandomState(0).rand(2, 3, 4, 4).astype(np.float32)
+        out = nn.Softmax2D()(t(x))
+        np.testing.assert_allclose(out.numpy().sum(1), np.ones((2, 4, 4)), rtol=1e-5)
+
+    def test_silu_alias(self):
+        assert nn.Silu is nn.SiLU
+
+    def test_pairwise_distance(self):
+        x = np.array([[0.0, 0.0], [1.0, 1.0]], np.float32)
+        y = np.array([[3.0, 4.0], [1.0, 1.0]], np.float32)
+        out = nn.PairwiseDistance()(t(x), t(y))
+        np.testing.assert_allclose(out.numpy(), [5.0, 2e-6 * 2 ** 0.5], rtol=1e-3,
+                                   atol=1e-5)
+
+    def test_spectral_norm(self):
+        paddle.seed(0)
+        w = np.random.RandomState(0).rand(4, 6).astype(np.float32) + 1.0
+        sn = nn.SpectralNorm(w.shape, power_iters=20)
+        out = sn(t(w))
+        # spectral norm of the output ~ 1
+        s = np.linalg.svd(out.numpy(), compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+    def test_inplace_functionals(self):
+        x = t(np.array([-1.0, 2.0], np.float32))
+        y = F.relu_(x)
+        assert y is x
+        np.testing.assert_allclose(x.numpy(), [0.0, 2.0])
+        z = t(np.array([0.5], np.float32))
+        F.tanh_(z)
+        np.testing.assert_allclose(z.numpy(), np.tanh(0.5), rtol=1e-6)
+
+    def test_class_center_sample(self):
+        lab = t(np.array([1, 5, 9], np.int64))
+        remapped, sampled = F.class_center_sample(lab, 20, 6)
+        s = sampled.numpy()
+        assert len(s) == 6
+        assert {1, 5, 9}.issubset(set(s.tolist()))
+        # remapped labels index into sampled
+        np.testing.assert_array_equal(s[remapped.numpy()], [1, 5, 9])
+
+    def test_sparse_attention_semantics(self):
+        # full CSR pattern == dense attention
+        b, h, T, d = 1, 1, 4, 8
+        rs = np.random.RandomState(0)
+        q = rs.rand(b, h, T, d).astype(np.float32)
+        k = rs.rand(b, h, T, d).astype(np.float32)
+        v = rs.rand(b, h, T, d).astype(np.float32)
+        offs = np.broadcast_to(np.arange(0, 4 * (T + 1), 4, dtype=np.int64)[None, None],
+                               (b, h, T + 1)).copy()
+        cols = np.broadcast_to(np.tile(np.arange(T, dtype=np.int64), T)[None, None],
+                               (b, h, T * T)).copy()
+        out = F.sparse_attention(t(q), t(k), t(v), t(offs), t(cols))
+        att = np.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(d)
+        att = np.exp(att - att.max(-1, keepdims=True))
+        att /= att.sum(-1, keepdims=True)
+        ref = np.einsum("bhts,bhsd->bhtd", att, v)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_set_image_backend(self):
+        import paddle_tpu.vision as vision
+
+        vision.set_image_backend("cv2")
+        assert vision.get_image_backend() == "cv2"
+        vision.set_image_backend("pil")
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            vision.set_image_backend("nope")
