@@ -1,0 +1,195 @@
+"""ERNIE/BERT encoder family — the BASELINE config-3 model (ERNIE-3.0-base
+sharding on v5p).
+
+Reference analogue: the ERNIE/BERT configs the fleet sharding tests train
+(dygraph_sharding_stage2.py trains a transformer encoder; BASELINE.json names
+ERNIE-3.0-base tokens/sec as the sharding north star). Same TPU-first design as
+models/gpt.py: TP layers (column→row pairs, vocab-parallel embedding) so every
+parameter carries its PartitionSpec dist_attr; dp/sharding come from the engine's
+batch + optimizer-state shardings; bidirectional (non-causal) attention.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed.fleet.utils import recompute
+from ..distributed.meta_parallel.mp_layers import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..nn import functional as F
+from ..ops import creation as C
+from ..ops import manipulation as P
+
+
+class ErnieConfig:
+    def __init__(self, vocab_size=40000, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_hidden_size=None, max_seq_len=512,
+                 type_vocab_size=4, dropout=0.1, attention_dropout=0.1,
+                 use_recompute=False, tie_word_embeddings=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+        self.attention_dropout = attention_dropout
+        self.use_recompute = use_recompute
+        self.tie_word_embeddings = tie_word_embeddings
+
+
+def ernie_tiny(**kw):
+    kw.setdefault("dropout", 0.0)
+    kw.setdefault("attention_dropout", 0.0)
+    return ErnieConfig(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+                       max_seq_len=128, **kw)
+
+
+def ernie_base(**kw):
+    """ERNIE-3.0-base shape (BASELINE config 3)."""
+    return ErnieConfig(vocab_size=40000, hidden_size=768, num_layers=12,
+                       num_heads=12, max_seq_len=512, **kw)
+
+
+def ernie_large(**kw):
+    return ErnieConfig(vocab_size=40000, hidden_size=1024, num_layers=24,
+                       num_heads=16, max_seq_len=512, **kw)
+
+
+class ErnieSelfAttention(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        self.hidden_size = config.hidden_size
+        self.qkv_proj = ColumnParallelLinear(config.hidden_size,
+                                             3 * config.hidden_size,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(config.hidden_size, config.hidden_size,
+                                          input_is_parallel=True)
+        self.attn_dropout = config.attention_dropout
+
+    def forward(self, x, attn_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        qkv = P.reshape(qkv, (b, s, 3, self.num_heads, self.head_dim))
+        q, k, v = P.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=self.attn_dropout, training=self.training)
+        out = P.reshape(out, (b, s, self.hidden_size))
+        return self.out_proj(out)
+
+
+class ErnieBlock(nn.Layer):
+    """Post-LN encoder block (BERT/ERNIE convention, unlike GPT's pre-LN)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.attn = ErnieSelfAttention(config)
+        self.ln1 = nn.LayerNorm(config.hidden_size)
+        self.fc1 = ColumnParallelLinear(config.hidden_size, config.ffn_hidden_size,
+                                        gather_output=False)
+        self.fc2 = RowParallelLinear(config.ffn_hidden_size, config.hidden_size,
+                                     input_is_parallel=True)
+        self.ln2 = nn.LayerNorm(config.hidden_size)
+        self.dropout = config.dropout
+        self.use_recompute = config.use_recompute
+
+    def _forward(self, x, attn_mask=None):
+        h = self.ln1(x + F.dropout(self.attn(x, attn_mask), self.dropout,
+                                   training=self.training))
+        ffn = self.fc2(F.gelu(self.fc1(h), approximate=True))
+        return self.ln2(h + F.dropout(ffn, self.dropout, training=self.training))
+
+    def forward(self, x, attn_mask=None):
+        if self.use_recompute and self.training:
+            return recompute(self._forward, x, attn_mask)
+        return self._forward(x, attn_mask)
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.word_emb = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        self.pos_emb = nn.Embedding(config.max_seq_len, config.hidden_size)
+        self.type_emb = nn.Embedding(config.type_vocab_size, config.hidden_size)
+        self.emb_ln = nn.LayerNorm(config.hidden_size)
+        self.drop = nn.Dropout(config.dropout)
+        self.blocks = nn.LayerList([ErnieBlock(config)
+                                    for _ in range(config.num_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        s = input_ids.shape[1]
+        pos = C.arange(0, s, dtype="int64")
+        x = self.word_emb(input_ids) + self.pos_emb(pos)
+        if token_type_ids is not None:
+            x = x + self.type_emb(token_type_ids)
+        x = self.drop(self.emb_ln(x))
+        mask = None
+        if attention_mask is not None:
+            # [b, s] 1/0 -> additive [b, 1, 1, s]
+            mask = (1.0 - attention_mask.astype("float32")) * -1e4
+            mask = P.reshape(mask, (mask.shape[0], 1, 1, mask.shape[1]))
+        for blk in self.blocks:
+            x = blk(x, mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM + sentence-order head over the encoder (the reference pretraining
+    objective shape); returns the combined loss."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.mlm_transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.mlm_ln = nn.LayerNorm(config.hidden_size)
+        if not config.tie_word_embeddings:
+            self.mlm_decoder = ColumnParallelLinear(config.hidden_size,
+                                                    config.vocab_size)
+        self.nsp_head = nn.Linear(config.hidden_size, 2)
+        self.loss_fn = ParallelCrossEntropy(ignore_index=-100)
+        self.config = config
+
+    def logits(self, hidden):
+        h = self.mlm_ln(F.gelu(self.mlm_transform(hidden), approximate=True))
+        if self.config.tie_word_embeddings:
+            return P.reshape(
+                h, (-1, h.shape[-1])) @ self.ernie.word_emb.weight.t()
+        return self.mlm_decoder(P.reshape(h, (-1, h.shape[-1])))
+
+    def forward(self, input_ids, labels, token_type_ids=None, attention_mask=None,
+                next_sentence_label=None):
+        hidden, pooled = self.ernie(input_ids, token_type_ids, attention_mask)
+        logits = self.logits(hidden)
+        mlm_loss = self.loss_fn(logits, P.reshape(labels, (-1, 1))).mean()
+        if next_sentence_label is not None:
+            nsp_logits = self.nsp_head(pooled)
+            nsp_loss = F.softmax_with_cross_entropy(
+                nsp_logits, next_sentence_label).mean()
+            return mlm_loss + nsp_loss
+        return mlm_loss
+
+
+# BERT aliases: same architecture, WordPiece-era defaults
+BertConfig = ErnieConfig
+BertModel = ErnieModel
+BertForPretraining = ErnieForPretraining
+
+
+def bert_base(**kw):
+    return ErnieConfig(vocab_size=30522, hidden_size=768, num_layers=12,
+                       num_heads=12, max_seq_len=512, type_vocab_size=2, **kw)
+
+
+def bert_large(**kw):
+    return ErnieConfig(vocab_size=30522, hidden_size=1024, num_layers=24,
+                       num_heads=16, max_seq_len=512, type_vocab_size=2, **kw)

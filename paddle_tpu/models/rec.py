@@ -1,0 +1,113 @@
+"""Recommendation models for the parameter-server benchmark (BASELINE config 5:
+Wide&Deep / DeepFM, examples/sec on TPU workers + CPU PS).
+
+Reference analogue: the PS tests' CTR models (python/paddle/fluid/tests/unittests/
+ps/ wide&deep-style dist models built on sparse_embedding +
+distributed_lookup_table, operators/pscore/distributed_lookup_table_op.cc).
+Sparse embedding tables can live on the parameter server (DistributedEmbedding —
+trainer holds no rows, pulls on forward / pushes grads on backward) or fall back
+to a dense trainer-side nn.Embedding for single-process runs; the dense tower
+runs on the TPU either way.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed.ps.layers import DistributedEmbedding
+from ..nn import functional as F
+from ..ops import manipulation as P
+from ..ops import math as M
+from ..ops import reduction as R
+
+
+class _SparseFeatures(nn.Layer):
+    """Embeds `num_fields` categorical id fields into [b, fields, dim]."""
+
+    def __init__(self, sparse_feature_dim, embedding_dim, num_fields,
+                 use_ps=False, table_id=0, client=None):
+        super().__init__()
+        self.use_ps = use_ps
+        self.num_fields = num_fields
+        self.embedding_dim = embedding_dim
+        if use_ps:
+            self.emb = DistributedEmbedding(table_id, embedding_dim, client)
+        else:
+            self.emb = nn.Embedding(sparse_feature_dim, embedding_dim,
+                                    sparse=True)
+
+    def forward(self, sparse_ids):  # [b, fields]
+        return self.emb(sparse_ids)  # [b, fields, dim]
+
+
+class WideDeep(nn.Layer):
+    """Wide (linear over sparse) + Deep (MLP over embeddings + dense feats).
+
+    forward(sparse_ids [b, F] int64, dense [b, D] f32) -> logits [b, 1]
+    """
+
+    def __init__(self, sparse_feature_dim=100000, embedding_dim=8, num_fields=26,
+                 dense_dim=13, hidden_sizes=(128, 64, 32), use_ps=False,
+                 wide_table_id=0, deep_table_id=1, client=None):
+        super().__init__()
+        self.num_fields = num_fields
+        # wide part: per-id scalar weight == embedding with dim 1
+        if use_ps:
+            self.wide_emb = DistributedEmbedding(wide_table_id, 1, client)
+        else:
+            self.wide_emb = nn.Embedding(sparse_feature_dim, 1, sparse=True)
+        self.deep_emb = _SparseFeatures(sparse_feature_dim, embedding_dim,
+                                        num_fields, use_ps, deep_table_id, client)
+        sizes = [num_fields * embedding_dim + dense_dim] + list(hidden_sizes)
+        self.mlp = nn.LayerList([nn.Linear(sizes[i], sizes[i + 1])
+                                 for i in range(len(sizes) - 1)])
+        self.out = nn.Linear(hidden_sizes[-1], 1)
+
+    def forward(self, sparse_ids, dense):
+        wide = R.sum(self.wide_emb(sparse_ids), axis=1)         # [b, 1]
+        deep = self.deep_emb(sparse_ids)                        # [b, F, dim]
+        x = P.concat([P.reshape(deep, (deep.shape[0], -1)), dense], axis=1)
+        for fc in self.mlp:
+            x = F.relu(fc(x))
+        return self.out(x) + wide
+
+
+class DeepFM(nn.Layer):
+    """Factorization machine (1st + 2nd order over field embeddings) + deep MLP.
+
+    forward(sparse_ids [b, F] int64, dense [b, D] f32) -> logits [b, 1]
+    """
+
+    def __init__(self, sparse_feature_dim=100000, embedding_dim=8, num_fields=26,
+                 dense_dim=13, hidden_sizes=(128, 64), use_ps=False,
+                 first_table_id=0, second_table_id=1, client=None):
+        super().__init__()
+        if use_ps:
+            self.first_emb = DistributedEmbedding(first_table_id, 1, client)
+        else:
+            self.first_emb = nn.Embedding(sparse_feature_dim, 1, sparse=True)
+        self.second_emb = _SparseFeatures(sparse_feature_dim, embedding_dim,
+                                          num_fields, use_ps, second_table_id,
+                                          client)
+        sizes = [num_fields * embedding_dim + dense_dim] + list(hidden_sizes)
+        self.mlp = nn.LayerList([nn.Linear(sizes[i], sizes[i + 1])
+                                 for i in range(len(sizes) - 1)])
+        self.out = nn.Linear(hidden_sizes[-1], 1)
+
+    def forward(self, sparse_ids, dense):
+        first = R.sum(self.first_emb(sparse_ids), axis=1)       # [b, 1]
+        emb = self.second_emb(sparse_ids)                       # [b, F, d]
+        # FM 2nd order: 0.5 * ((sum v)^2 - sum v^2), summed over dim
+        sum_sq = M.pow(R.sum(emb, axis=1), 2)
+        sq_sum = R.sum(M.pow(emb, 2), axis=1)
+        fm2 = 0.5 * R.sum(sum_sq - sq_sum, axis=1, keepdim=True)  # [b, 1]
+        x = P.concat([P.reshape(emb, (emb.shape[0], -1)), dense], axis=1)
+        for fc in self.mlp:
+            x = F.relu(fc(x))
+        return self.out(x) + first + fm2
+
+
+def ctr_loss(logits, label):
+    """BCE-with-logits click loss used by both CTR models."""
+    return F.binary_cross_entropy_with_logits(logits, label.astype("float32"))

@@ -1,0 +1,154 @@
+"""ERNIE/BERT + recommendation model family tests: shapes, training, and the
+ERNIE sharding path on the virtual 8-device mesh (BASELINE configs 3 and 5)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models import (
+    BertModel, DeepFM, ErnieForPretraining, ErnieModel, WideDeep, bert_base,
+    ctr_loss, ernie_base, ernie_tiny,
+)
+
+
+class TestErnie:
+    def test_forward_shapes(self):
+        paddle.seed(0)
+        cfg = ernie_tiny()
+        m = ErnieModel(cfg)
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (2, 16)).astype(np.int64))
+        seq, pooled = m(ids)
+        assert seq.shape == [2, 16, cfg.hidden_size]
+        assert pooled.shape == [2, cfg.hidden_size]
+
+    def test_attention_mask_effect(self):
+        """Masked positions must not change other positions' outputs."""
+        paddle.seed(0)
+        cfg = ernie_tiny()
+        m = ErnieModel(cfg)
+        m.eval()
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, cfg.vocab_size, (1, 8)).astype(np.int64)
+        mask = np.ones((1, 8), np.int64)
+        mask[0, 4:] = 0
+        seq1, _ = m(paddle.to_tensor(ids), attention_mask=paddle.to_tensor(mask))
+        ids2 = ids.copy()
+        ids2[0, 4:] = (ids2[0, 4:] + 7) % cfg.vocab_size  # change masked tokens
+        seq2, _ = m(paddle.to_tensor(ids2), attention_mask=paddle.to_tensor(mask))
+        np.testing.assert_allclose(seq1.numpy()[0, :4], seq2.numpy()[0, :4],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_pretraining_loss_decreases(self):
+        paddle.seed(0)
+        cfg = ernie_tiny()
+        m = ErnieForPretraining(cfg)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        rs = np.random.RandomState(0)
+        ids = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (4, 16)).astype(np.int64))
+        labels = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (4, 16)).astype(np.int64))
+        losses = []
+        for _ in range(8):
+            loss = m(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_base_config_shapes(self):
+        cfg = ernie_base()
+        assert (cfg.hidden_size, cfg.num_layers, cfg.num_heads) == (768, 12, 12)
+        b = bert_base()
+        assert b.vocab_size == 30522 and b.type_vocab_size == 2
+
+    def test_engine_sharded_training(self):
+        """ERNIE on the dp×mp mesh through the pjit engine (config-3 path)."""
+        paddle.seed(0)
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                   "sharding_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        cfg = ernie_tiny()
+        m = ErnieForPretraining(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        engine = fleet.distributed_engine(m, opt)
+        rs = np.random.RandomState(0)
+        ids = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (8, 32)).astype(np.int64))
+        labels = paddle.to_tensor(rs.randint(0, cfg.vocab_size, (8, 32)).astype(np.int64))
+        losses = [float(engine.step(ids, labels).item()) for _ in range(4)]
+        assert losses[-1] < losses[0], losses
+
+
+class TestRecModels:
+    def _batch(self, rs, n=16, fields=5, dense=3, vocab=1000):
+        return (paddle.to_tensor(rs.randint(0, vocab, (n, fields)).astype(np.int64)),
+                paddle.to_tensor(rs.rand(n, dense).astype(np.float32)),
+                paddle.to_tensor(rs.randint(0, 2, (n, 1)).astype(np.int64)))
+
+    @pytest.mark.parametrize("cls", [WideDeep, DeepFM])
+    def test_trains(self, cls):
+        paddle.seed(0)
+        net = cls(sparse_feature_dim=1000, num_fields=5, dense_dim=3)
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        rs = np.random.RandomState(0)
+        sids, dense, lab = self._batch(rs)
+        losses = []
+        for _ in range(25):
+            loss = ctr_loss(net(sids, dense), lab)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    def test_deepfm_fm_term(self):
+        """FM 2nd-order matches the explicit pairwise-interaction sum."""
+        paddle.seed(0)
+        net = DeepFM(sparse_feature_dim=50, embedding_dim=4, num_fields=3,
+                     dense_dim=2, hidden_sizes=(8,))
+        rs = np.random.RandomState(0)
+        sids = rs.randint(0, 50, (2, 3)).astype(np.int64)
+        emb = net.second_emb(paddle.to_tensor(sids)).numpy()  # [2, 3, 4]
+        ref = np.zeros((2, 1), np.float32)
+        for i in range(3):
+            for j in range(i + 1, 3):
+                ref[:, 0] += (emb[:, i] * emb[:, j]).sum(-1)
+        sum_sq = (emb.sum(1)) ** 2
+        sq_sum = (emb ** 2).sum(1)
+        fm2 = 0.5 * (sum_sq - sq_sum).sum(-1, keepdims=True)
+        np.testing.assert_allclose(fm2, ref, rtol=1e-5)
+
+    def test_ps_mode_wide_deep(self):
+        """WideDeep with both sparse tables on a live (in-process) PS."""
+        from paddle_tpu.distributed.ps import (PSClient, PSServer,
+                                               SparseTableConfig)
+
+        sparse = [SparseTableConfig(table_id=0, dim=1, learning_rate=0.1),
+                  SparseTableConfig(table_id=1, dim=8, learning_rate=0.1)]
+        server = PSServer(0, sparse, [])
+        client = PSClient([f"127.0.0.1:{server.port}"])
+        for t in sparse:
+            client.register_table_dim(t.table_id, t.dim)
+        paddle.seed(0)
+        net = WideDeep(sparse_feature_dim=1000, embedding_dim=8, num_fields=4,
+                       dense_dim=3, use_ps=True, wide_table_id=0, deep_table_id=1,
+                       client=client)
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        rs = np.random.RandomState(0)
+        sids = paddle.to_tensor(rs.randint(0, 1000, (8, 4)).astype(np.int64))
+        dense = paddle.to_tensor(rs.rand(8, 3).astype(np.float32))
+        lab = paddle.to_tensor(rs.randint(0, 2, (8, 1)).astype(np.int64))
+        losses = []
+        for _ in range(20):
+            loss = ctr_loss(net(sids, dense), lab)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
