@@ -139,6 +139,24 @@ class TrainStepEngine:
         sp_impl = getattr(self.strategy, "sep_impl", "ring") if self.strategy else "ring"
         mesh = self.mesh
 
+        # strategy.amp: autocast the whole traced forward (the analogue of the
+        # static amp_optimizer's program rewrite — here the cast happens at
+        # trace time through the dispatch-level autocast, so the compiled step
+        # runs bf16 matmuls with no loss-scaling needed on TPU)
+        amp_cfg = getattr(self.strategy, "amp_configs", None) \
+            if self.strategy is not None and getattr(self.strategy, "amp", False) else None
+
+        def _amp_ctx():
+            if amp_cfg is None:
+                return contextlib.nullcontext()
+            from ..core.dispatch import amp_guard
+
+            return amp_guard(
+                dtype=getattr(amp_cfg, "dtype", "bfloat16"),
+                level="O2" if getattr(amp_cfg, "use_pure_fp16", False) else "O1",
+                custom_white_list=getattr(amp_cfg, "custom_white_list", None),
+                custom_black_list=getattr(amp_cfg, "custom_black_list", None))
+
         def step(params, opt_state, lr, step_i, key, *batch):
             def compute_loss(ps):
                 state = dict(ps)
@@ -146,7 +164,7 @@ class TrainStepEngine:
                     state[bn] = buffers[bn]
                 sp_ctx = (sequence_parallel_scope(mesh, "sp", sp_impl)
                           if sp_deg > 1 else contextlib.nullcontext())
-                with sp_ctx, random_mod.trace_key_scope(key):
+                with sp_ctx, _amp_ctx(), random_mod.trace_key_scope(key):
                     inputs = [Tensor(b, stop_gradient=True) for b in batch]
                     out = functional_call(model, state, *inputs)
                 if loss_fn is not None:

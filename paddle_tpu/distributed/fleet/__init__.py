@@ -116,19 +116,27 @@ class Fleet:
         # params already carry dist_attrs — wrapper is identity for those modes
         return model
 
-    def distributed_optimizer(self, optimizer, strategy=None):
+    def distributed_optimizer(self, optimizer, strategy=None, model=None):
         if strategy is not None:
             self._strategy = strategy
         if not self._is_initialized:
             self.init()
+        # strategy -> meta-optimizer chain (reference strategy_compiler.py),
+        # then the hybrid wrapper (dp grad sync + cross-group clip) outermost
+        from .meta_optimizers import StrategyCompiler
+
+        optimizer, applied = StrategyCompiler().compile(
+            optimizer, self._strategy, self._hcg, model=model)
+        self._applied_meta_list = applied
         return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
 
     def distributed_engine(self, model, optimizer, loss_fn=None, **kw):
         """TPU-native: build the fused pjit train step for this fleet config."""
         from ..engine import TrainStepEngine
 
-        inner = optimizer._inner_opt if isinstance(optimizer, HybridParallelOptimizer) \
-            else optimizer
+        inner = optimizer
+        while hasattr(inner, "_inner_opt"):  # unwrap hybrid + meta chain
+            inner = inner._inner_opt
         return TrainStepEngine(model, inner, loss_fn=loss_fn, hcg=self._hcg,
                                strategy=self._strategy, **kw)
 

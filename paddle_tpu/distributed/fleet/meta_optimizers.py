@@ -1,0 +1,395 @@
+"""Meta-optimizers + StrategyCompiler.
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/ (amp_optimizer.py,
+recompute_optimizer.py, gradient_merge_optimizer.py, localsgd_optimizer.py,
+dgc_optimizer.py, lars/lamb_optimizer.py, fp16_allreduce, raw_program_optimizer,
+sharding_optimizer.py) selected and chained by strategy_compiler.py via
+meta_optimizer_factory.py.
+
+TPU-native: the reference's meta-optimizers REWRITE a static ProgramDesc (insert
+cast ops, comm ops, segment programs). Here the "program" is either the eager
+tape or the engine's single pjit computation, so each meta-optimizer is a
+composable wrapper over the optimizer's step/clear_grad (eager path) plus a
+strategy marker the TrainStepEngine reads at trace time (amp autocast, sharded
+optimizer states, recompute). The compiler keeps the reference's selection and
+ordering semantics so `fleet.distributed_optimizer(opt, strategy)` behaves the
+same from the user's side.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ...core.autograd import no_grad
+
+
+class MetaOptimizerBase:
+    """Wrapper protocol: everything proxies to the innermost optimizer unless
+    overridden. `applied_meta_list`-style introspection via .name chains."""
+
+    name = "base"
+    # meta-optimizers this one cannot compose with (reference
+    # meta_optimizer.disable_in_strategy semantics)
+    conflicts: tuple = ()
+
+    def __init__(self, inner, strategy, hcg=None):
+        self._inner_opt = inner
+        self._strategy = strategy
+        self._hcg = hcg
+
+    @classmethod
+    def can_apply(cls, strategy, hcg=None) -> bool:
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, []
+
+    @property
+    def applied_meta_list(self):
+        chain = []
+        opt = self
+        while isinstance(opt, MetaOptimizerBase):
+            chain.append(opt.name)
+            opt = opt._inner_opt
+        return chain
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    """bf16 autocast + (optional) dynamic loss scaling.
+
+    Reference amp_optimizer.py rewrites the program with cast ops +
+    check_finite_and_unscale/update_loss_scaling. On TPU the low dtype is
+    bfloat16 whose exponent range equals f32, so loss scaling is inert by
+    default; the autocast itself happens in the forward — eagerly via the
+    amp_context() this wrapper exposes, or at trace time when the engine sees
+    strategy.amp. float16 configs still get a working GradScaler."""
+
+    name = "amp"
+
+    def __init__(self, inner, strategy, hcg=None):
+        super().__init__(inner, strategy, hcg)
+        from ...amp import GradScaler
+
+        cfg = strategy.amp_configs
+        need_scaling = cfg.dtype == "float16" and cfg.use_dynamic_loss_scaling
+        self._scaler = GradScaler(
+            enable=need_scaling,
+            init_loss_scaling=cfg.init_loss_scaling,
+            incr_ratio=cfg.incr_ratio, decr_ratio=cfg.decr_ratio,
+            incr_every_n_steps=cfg.incr_every_n_steps,
+            decr_every_n_nan_or_inf=cfg.decr_every_n_nan_or_inf)
+
+    @classmethod
+    def can_apply(cls, strategy, hcg=None):
+        return bool(strategy.amp)
+
+    def amp_context(self):
+        from ...core.dispatch import amp_guard
+
+        cfg = self._strategy.amp_configs
+        return amp_guard(dtype=cfg.dtype,
+                         level="O2" if cfg.use_pure_fp16 else "O1",
+                         custom_white_list=cfg.custom_white_list,
+                         custom_black_list=cfg.custom_black_list)
+
+    def scale(self, loss):
+        return self._scaler.scale(loss) if self._scaler._enable else loss
+
+    def step(self):
+        if self._scaler._enable:
+            self._scaler.step(self._inner_opt)
+            self._scaler.update()
+        else:
+            self._inner_opt.step()
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    """Turns on activation checkpointing for the model's recompute-capable
+    blocks (reference recompute_optimizer.py marks checkpoint vars; models here
+    carry `use_recompute` flags consumed by fleet.utils.recompute)."""
+
+    name = "recompute"
+
+    @classmethod
+    def can_apply(cls, strategy, hcg=None):
+        return bool(strategy.recompute)
+
+    def enable_on(self, model):
+        n = 0
+        for layer in model.sublayers(include_self=True):
+            if hasattr(layer, "use_recompute"):
+                layer.use_recompute = True
+                n += 1
+        return n
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    """Accumulate grads for k_steps micro-steps, then apply one update
+    (reference gradient_merge_optimizer.py; the tape's += grad accumulation
+    plays the role of the @GRAD@MERGED vars)."""
+
+    name = "gradient_merge"
+
+    def __init__(self, inner, strategy, hcg=None):
+        super().__init__(inner, strategy, hcg)
+        self.k_steps = max(1, int(strategy.gradient_merge_configs.k_steps))
+        self.avg = bool(strategy.gradient_merge_configs.avg)
+        self._acc = 0
+
+    @classmethod
+    def can_apply(cls, strategy, hcg=None):
+        return bool(strategy.gradient_merge) and \
+            strategy.gradient_merge_configs.k_steps > 1
+
+    @no_grad()
+    def step(self):
+        self._acc += 1
+        if self._acc % self.k_steps != 0:
+            return  # keep accumulating; clear_grad below also holds
+        if self.avg:
+            for p in self._inner_opt._parameter_list:
+                if p.grad is not None:
+                    p.grad.set_value(p.grad._data / self.k_steps)
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        if self._acc % self.k_steps == 0:
+            self._inner_opt.clear_grad(set_to_zero)
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    """Step locally; average params across the dp group every k_steps
+    (reference localsgd_optimizer.py)."""
+
+    name = "localsgd"
+    conflicts = ("dgc",)
+
+    def __init__(self, inner, strategy, hcg=None):
+        super().__init__(inner, strategy, hcg)
+        self.k_steps = max(1, int(strategy.localsgd_configs.k_steps))
+        self.begin_step = int(strategy.localsgd_configs.begin_step)
+        self._step_i = 0
+
+    @classmethod
+    def can_apply(cls, strategy, hcg=None):
+        return bool(strategy.localsgd)
+
+    @no_grad()
+    def step(self):
+        self._inner_opt.step()
+        self._step_i += 1
+        if self._step_i >= self.begin_step and self._step_i % self.k_steps == 0:
+            self._sync_params()
+
+    def _sync_params(self):
+        from .. import collective
+        from ..env import get_world_size
+
+        world = (self._hcg.get_data_parallel_world_size()
+                 if self._hcg is not None else get_world_size())
+        if world <= 1:
+            return
+        group = self._hcg.get_data_parallel_group() if self._hcg else None
+        for p in self._inner_opt._parameter_list:
+            collective.all_reduce(p, group=group)
+            p.set_value(p._data / world)
+
+
+class DGCOptimizer(MetaOptimizerBase):
+    """Deep gradient compression: before each step keep only the top-s
+    fraction of each grad's entries (reference dgc_optimizer.py /
+    operators/dgc_op). The momentum-correction residual is kept locally."""
+
+    name = "dgc"
+    conflicts = ("localsgd",)
+
+    def __init__(self, inner, strategy, hcg=None):
+        super().__init__(inner, strategy, hcg)
+        cfg = strategy.dgc_configs
+        self.rampup_begin_step = int(cfg.rampup_begin_step)
+        self.sparsity = list(cfg.sparsity) or [0.999]
+        self._step_i = 0
+        self._residual = {}
+
+    @classmethod
+    def can_apply(cls, strategy, hcg=None):
+        return bool(strategy.dgc)
+
+    @no_grad()
+    def step(self):
+        import jax.numpy as jnp
+
+        self._step_i += 1
+        if self._step_i > self.rampup_begin_step:
+            s = self.sparsity[min(len(self.sparsity) - 1, self._step_i - 1)]
+            for p in self._inner_opt._parameter_list:
+                if p.grad is None:
+                    continue
+                g = p.grad._data + self._residual.get(id(p), 0.0)
+                k = max(1, int(round(g.size * (1.0 - s))))
+                flat = jnp.abs(g.reshape(-1))
+                thresh = jnp.sort(flat)[-k]
+                mask = (jnp.abs(g) >= thresh).astype(g.dtype)
+                self._residual[id(p)] = g * (1.0 - mask)
+                p.grad.set_value(g * mask)
+        self._inner_opt.step()
+
+
+class FP16AllReduceOptimizer(MetaOptimizerBase):
+    """Halve allreduce bytes by casting grads to bf16 before the dp sync
+    (reference fp16_allreduce meta-optimizer casts to fp16 for NCCL)."""
+
+    name = "fp16_allreduce"
+
+    @classmethod
+    def can_apply(cls, strategy, hcg=None):
+        return bool(getattr(strategy, "fp16_allreduce", False))
+
+    @no_grad()
+    def step(self):
+        import jax.numpy as jnp
+
+        for p in self._inner_opt._parameter_list:
+            if p.grad is not None and p.grad._data.dtype == jnp.float32:
+                p.grad.set_value(
+                    p.grad._data.astype(jnp.bfloat16).astype(jnp.float32))
+        self._inner_opt.step()
+
+
+class LarsOptimizer(MetaOptimizerBase):
+    """Swap Momentum/SGD for LARS (reference lars_optimizer.py)."""
+
+    name = "lars"
+
+    @classmethod
+    def can_apply(cls, strategy, hcg=None):
+        return bool(strategy.lars)
+
+    @staticmethod
+    def rebuild(inner, strategy):
+        from ... import optimizer as opt_mod
+
+        if inner._rule not in ("sgd", "momentum"):
+            return inner
+        return opt_mod.Lars(
+            learning_rate=inner._learning_rate,
+            momentum=inner._hyper.get("momentum", 0.9)
+            if hasattr(inner, "_hyper") else 0.9,
+            parameters=inner._parameter_list, grad_clip=inner._grad_clip)
+
+
+class LambOptimizer(MetaOptimizerBase):
+    """Swap Adam/AdamW for LAMB (reference lamb_optimizer.py)."""
+
+    name = "lamb"
+
+    @classmethod
+    def can_apply(cls, strategy, hcg=None):
+        return bool(strategy.lamb)
+
+    @staticmethod
+    def rebuild(inner, strategy):
+        from ... import optimizer as opt_mod
+
+        if inner._rule not in ("adam", "adamw"):
+            return inner
+        cfg = strategy.lamb_configs
+        exclude = list(cfg.exclude_from_weight_decay)
+
+        def exclude_fn(p):
+            return any(s in (getattr(p, "name", "") or "") for s in exclude)
+
+        return opt_mod.Lamb(
+            learning_rate=inner._learning_rate,
+            lamb_weight_decay=cfg.lamb_weight_decay,
+            parameters=inner._parameter_list, grad_clip=inner._grad_clip,
+            exclude_from_weight_decay_fn=exclude_fn if exclude else None)
+
+
+class ShardingOptimizer(MetaOptimizerBase):
+    """Marker: optimizer-state sharding happens inside the engine's pjit step
+    (opt-state arrays laid out over the sharding axis — TrainStepEngine reads
+    strategy.sharding), replacing the reference's program-segmenting rewrite
+    (sharding_optimizer.py:569)."""
+
+    name = "sharding"
+
+    @classmethod
+    def can_apply(cls, strategy, hcg=None):
+        return bool(strategy.sharding)
+
+
+class RawProgramOptimizer(MetaOptimizerBase):
+    """Plain dp allreduce mode (reference raw_program_optimizer.py). The eager
+    dp path already allreduces through HybridParallelOptimizer/DataParallel;
+    under the engine the grads are reduced by GSPMD — nothing to rewrite."""
+
+    name = "raw_program"
+
+    @classmethod
+    def can_apply(cls, strategy, hcg=None):
+        return bool(getattr(strategy, "without_graph_optimization", False))
+
+
+# reference ordering (meta_optimizer_factory.py list order matters: outermost
+# listed first gets applied last)
+_META_OPTIMIZERS = [
+    AMPOptimizer,
+    RecomputeOptimizer,
+    GradientMergeOptimizer,
+    ShardingOptimizer,
+    LocalSGDOptimizer,
+    DGCOptimizer,
+    FP16AllReduceOptimizer,
+    LarsOptimizer,
+    LambOptimizer,
+    RawProgramOptimizer,
+]
+
+
+class StrategyCompiler:
+    """Pick applicable meta-optimizers, drop conflicting ones (first wins, like
+    the reference's _disable_strategy propagation), order and chain them."""
+
+    def compile(self, optimizer, strategy, hcg=None, model=None):
+        applied: List[str] = []
+        disabled: set = set()
+
+        # optimizer-rule swaps first (they replace, not wrap)
+        if LarsOptimizer.can_apply(strategy, hcg):
+            optimizer = LarsOptimizer.rebuild(optimizer, strategy)
+            applied.append("lars")
+        if LambOptimizer.can_apply(strategy, hcg):
+            optimizer = LambOptimizer.rebuild(optimizer, strategy)
+            applied.append("lamb")
+
+        for cls in _META_OPTIMIZERS:
+            if cls.name in ("lars", "lamb"):
+                continue
+            if cls.name in disabled or not cls.can_apply(strategy, hcg):
+                continue
+            disabled.update(cls.conflicts)
+            wrapper = cls(optimizer, strategy, hcg)
+            if isinstance(wrapper, RecomputeOptimizer) and model is not None:
+                wrapper.enable_on(model)
+            if cls.name in ("sharding", "raw_program"):
+                # markers: engine-level behavior, no wrapping needed
+                applied.append(cls.name)
+                continue
+            optimizer = wrapper
+            applied.append(cls.name)
+
+        return optimizer, applied

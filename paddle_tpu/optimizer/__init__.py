@@ -213,6 +213,31 @@ class RMSProp(Optimizer):
                        "centered": centered}
 
 
+class Lars(Optimizer):
+    """LARS momentum (reference: fluid.optimizer.LarsMomentumOptimizer /
+    operators/optimizers/lars_momentum_op)."""
+
+    _rule = "lars"
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name, **kw)
+        self._hyper = {"momentum": momentum, "lars_coeff": lars_coeff,
+                       "lars_weight_decay": lars_weight_decay, "epsilon": epsilon}
+        self._exclude_names = list(exclude_from_weight_decay or [])
+
+    def _rule_kwargs(self, param):
+        kw = dict(self._hyper)
+        pname = getattr(param, "name", "") or ""
+        if any(s in pname for s in self._exclude_names):
+            kw["exclude_from_decay"] = True
+        return kw
+
+
+LarsMomentum = Lars
+
+
 class Lamb(Optimizer):
     _rule = "lamb"
 

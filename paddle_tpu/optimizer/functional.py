@@ -34,6 +34,8 @@ def init_state(rule: str, param):
         return (z(), z(), z())  # mean_sq, mean, momentum
     if rule == "lamb":
         return (z(), z())
+    if rule == "lars":
+        return (z(),)
     raise ValueError(rule)
 
 
@@ -191,10 +193,29 @@ def lamb(param, grad, state, *, lr, beta1=0.9, beta2=0.999, epsilon=1e-6, step,
     return new_p.astype(param.dtype), (m, v)
 
 
+def lars(param, grad, state, *, lr, momentum=0.9, lars_coeff=0.001,
+         lars_weight_decay=0.0005, epsilon=0.0, exclude_from_decay=False):
+    """LARS (reference: operators/optimizers/lars_momentum_op): layerwise lr =
+    lars_coeff * ||w|| / (||g|| + wd * ||w|| + eps), momentum applied after."""
+    (vel,) = state
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    wd = 0.0 if exclude_from_decay else lars_weight_decay
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        lars_coeff * w_norm / (g_norm + wd * w_norm + epsilon), 1.0)
+    d = g + wd * p32
+    vel = momentum * vel + lr * local_lr * d
+    new_p = p32 - vel
+    return new_p.astype(param.dtype), (vel,)
+
+
 RULES = {
     "sgd": sgd, "momentum": momentum, "adam": adam, "adamw": adamw,
     "adamax": adamax, "adagrad": adagrad, "adadelta": adadelta,
-    "rmsprop": rmsprop, "lamb": lamb,
+    "rmsprop": rmsprop, "lamb": lamb, "lars": lars,
 }
 
 _NEEDS_STEP = {"adam", "adamw", "adamax", "lamb"}

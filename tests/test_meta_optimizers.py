@@ -1,0 +1,239 @@
+"""Meta-optimizer suite: strategy compiler selection/chaining + each
+meta-optimizer's training semantics (reference fleet/meta_optimizers/* and
+strategy_compiler.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_optimizers import StrategyCompiler
+
+
+def _net_and_data(seed=0, n=32):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    rs = np.random.RandomState(seed)
+    x = paddle.to_tensor(rs.rand(n, 8).astype(np.float32))
+    y = paddle.to_tensor((rs.rand(n, 1) > 0.5).astype(np.float32))
+    return net, x, y
+
+
+def _strategy(**flags):
+    s = dist.DistributedStrategy()
+    for k, v in flags.items():
+        setattr(s, k, v)
+    return s
+
+
+class TestStrategyCompiler:
+    def test_selection_and_order(self):
+        net, _, _ = _net_and_data()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        s = _strategy(amp=True, gradient_merge=True, localsgd=True)
+        s.gradient_merge_configs = {"k_steps": 4}
+        final, applied = StrategyCompiler().compile(opt, s)
+        assert applied == ["amp", "gradient_merge", "localsgd", "raw_program"]
+        # chain introspection: outermost applied last
+        assert final.applied_meta_list[:3] == ["localsgd", "gradient_merge", "amp"]
+
+    def test_conflict_resolution(self):
+        net, _, _ = _net_and_data()
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters())
+        s = _strategy(localsgd=True, dgc=True)
+        final, applied = StrategyCompiler().compile(opt, s)
+        assert "localsgd" in applied and "dgc" not in applied  # first wins
+
+    def test_lamb_swap(self):
+        net, _, _ = _net_and_data()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        final, applied = StrategyCompiler().compile(opt, _strategy(lamb=True))
+        assert "lamb" in applied
+        inner = final
+        while hasattr(inner, "_inner_opt"):
+            inner = inner._inner_opt
+        assert inner._rule == "lamb"
+
+    def test_lars_swap(self):
+        net, _, _ = _net_and_data()
+        opt = paddle.optimizer.Momentum(learning_rate=0.01,
+                                        parameters=net.parameters())
+        final, applied = StrategyCompiler().compile(opt, _strategy(lars=True))
+        inner = final
+        while hasattr(inner, "_inner_opt"):
+            inner = inner._inner_opt
+        assert inner._rule == "lars"
+
+    def test_fleet_distributed_optimizer_applies(self):
+        fleet.init(is_collective=True, strategy=_strategy(gradient_merge=True))
+        net, x, y = _net_and_data()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        s = _strategy(gradient_merge=True)
+        s.gradient_merge_configs = {"k_steps": 2}
+        wrapped = fleet.distributed_optimizer(opt, s)
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        wrapped.step()
+        wrapped.clear_grad()
+        assert "gradient_merge" in fleet.fleet._applied_meta_list
+
+
+class TestGradientMerge:
+    def test_updates_every_k(self):
+        net, x, y = _net_and_data()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        s = _strategy(gradient_merge=True)
+        s.gradient_merge_configs = {"k_steps": 3, "avg": True}
+        merged, _ = StrategyCompiler().compile(opt, s)
+        w0 = net[0].weight.numpy().copy()
+        for i in range(1, 7):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            merged.step()
+            merged.clear_grad()
+            changed = not np.allclose(net[0].weight.numpy(), w0)
+            assert changed == (i % 3 == 0) or i > 3  # first update at step 3
+            if i == 3:
+                w0 = net[0].weight.numpy().copy()
+                changed_at_3 = changed
+        assert changed_at_3
+
+    def test_merge_equals_big_batch(self):
+        """k merged micro-batches ~ one batch over their union (SGD linearity)."""
+        net1, x, y = _net_and_data(7, n=32)
+        opt1 = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=net1.parameters())
+        s = _strategy(gradient_merge=True)
+        s.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        merged, _ = StrategyCompiler().compile(opt1, s)
+        for half in (slice(0, 16), slice(16, 32)):
+            loss = ((net1(x[half]) - y[half]) ** 2).mean()
+            loss.backward()
+            merged.step()
+            merged.clear_grad()
+
+        net2, x2, y2 = _net_and_data(7, n=32)  # same init, same data
+        opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=net2.parameters())
+        loss = ((net2(x2) - y2) ** 2).mean()
+        loss.backward()
+        opt2.step()
+        np.testing.assert_allclose(net1[0].weight.numpy(), net2[0].weight.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestLocalSGD:
+    def test_param_sync_noop_single_rank(self):
+        net, x, y = _net_and_data()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        s = _strategy(localsgd=True)
+        s.localsgd_configs = {"k_steps": 2, "begin_step": 1}
+        wrapped, _ = StrategyCompiler().compile(opt, s)
+        for _ in range(4):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            wrapped.step()
+            wrapped.clear_grad()
+        assert np.isfinite(net[0].weight.numpy()).all()
+
+
+class TestDGC:
+    def test_sparsifies_grads(self):
+        net, x, y = _net_and_data()
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters())
+        s = _strategy(dgc=True)
+        s.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.75]}
+        wrapped, _ = StrategyCompiler().compile(opt, s)
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        wrapped.step()
+        # after step, grads were masked to ~25% density
+        g = net[0].weight.grad.numpy()
+        density = np.count_nonzero(g) / g.size
+        assert density <= 0.30, density
+
+    def test_residual_accumulates(self):
+        net, x, y = _net_and_data()
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters())
+        s = _strategy(dgc=True)
+        s.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.75]}
+        wrapped, _ = StrategyCompiler().compile(opt, s)
+        for _ in range(3):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            wrapped.step()
+            wrapped.clear_grad()
+        assert len(wrapped._residual) > 0
+
+
+class TestAMPMeta:
+    def test_amp_context_casts(self):
+        net, x, y = _net_and_data()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        s = _strategy(amp=True)
+        wrapped, applied = StrategyCompiler().compile(opt, s)
+        assert "amp" in applied
+        with wrapped.amp_context():
+            out = net(x)
+        assert out.dtype == paddle.bfloat16
+        # bf16 on TPU: no loss scaling engaged
+        assert not wrapped._scaler._enable
+        # fp16 config turns scaling on
+        s2 = _strategy(amp=True)
+        s2.amp_configs = {"dtype": "float16"}
+        w2, _ = StrategyCompiler().compile(opt, s2)
+        assert w2._scaler._enable
+
+    def test_engine_amp_trace(self):
+        """strategy.amp reaches the pjit step: matmuls run bf16 inside."""
+        fleet.init(is_collective=True, strategy=_strategy())
+        net, x, y = _net_and_data()
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters())
+        s = _strategy(amp=True)
+        fleet.fleet._strategy = s
+        engine = fleet.distributed_engine(net, opt,
+                                          loss_fn=lambda out: ((out) ** 2).mean())
+        l0 = float(engine.step(x).item())
+        l1 = float(engine.step(x).item())
+        assert np.isfinite([l0, l1]).all() and l1 < l0
+
+
+class TestRecomputeMeta:
+    def test_enables_model_flags(self):
+        from paddle_tpu.models import GPTForPretraining, gpt_tiny
+
+        paddle.seed(0)
+        model = GPTForPretraining(gpt_tiny())
+        assert not model.gpt.blocks[0].use_recompute
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        s = _strategy(recompute=True)
+        fleet.init(is_collective=True, strategy=s)
+        fleet.distributed_optimizer(opt, s, model=model)
+        assert model.gpt.blocks[0].use_recompute
+
+
+class TestLarsTraining:
+    def test_lars_converges(self):
+        net, x, y = _net_and_data()
+        opt = paddle.optimizer.Lars(learning_rate=0.02,
+                                    parameters=net.parameters())
+        losses = []
+        for _ in range(20):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
