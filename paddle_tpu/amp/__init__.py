@@ -42,6 +42,21 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weigh
     return models, optimizers
 
 
+def amp_guard_from_configs(cfg, force_bf16=False):
+    """Build the autocast context from a strategy AMPConfig — the single
+    mapping used by both the eager meta-optimizer and the traced engine step."""
+    from ..core.dispatch import amp_guard
+
+    dtype = getattr(cfg, "dtype", "bfloat16")
+    if force_bf16 and dtype == "float16":
+        dtype = "bfloat16"
+    return amp_guard(
+        dtype=dtype,
+        level="O2" if getattr(cfg, "use_pure_fp16", False) else "O1",
+        custom_white_list=getattr(cfg, "custom_white_list", None),
+        custom_black_list=getattr(cfg, "custom_black_list", None))
+
+
 class GradScaler:
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15, incr_ratio=2.0,
                  decr_ratio=0.5, incr_every_n_steps=1000, decr_every_n_nan_or_inf=1,

@@ -141,21 +141,19 @@ class TrainStepEngine:
 
         # strategy.amp: autocast the whole traced forward (the analogue of the
         # static amp_optimizer's program rewrite — here the cast happens at
-        # trace time through the dispatch-level autocast, so the compiled step
-        # runs bf16 matmuls with no loss-scaling needed on TPU)
+        # trace time through the dispatch-level autocast). float16 is forced to
+        # bfloat16: the fused step has no loss scaling, and bf16's f32 exponent
+        # range makes scaling unnecessary — fp16 without scaling would silently
+        # under/overflow.
         amp_cfg = getattr(self.strategy, "amp_configs", None) \
             if self.strategy is not None and getattr(self.strategy, "amp", False) else None
 
         def _amp_ctx():
             if amp_cfg is None:
                 return contextlib.nullcontext()
-            from ..core.dispatch import amp_guard
+            from ..amp import amp_guard_from_configs
 
-            return amp_guard(
-                dtype=getattr(amp_cfg, "dtype", "bfloat16"),
-                level="O2" if getattr(amp_cfg, "use_pure_fp16", False) else "O1",
-                custom_white_list=getattr(amp_cfg, "custom_white_list", None),
-                custom_black_list=getattr(amp_cfg, "custom_black_list", None))
+            return amp_guard_from_configs(amp_cfg, force_bf16=True)
 
         def step(params, opt_state, lr, step_i, key, *batch):
             def compute_loss(ps):

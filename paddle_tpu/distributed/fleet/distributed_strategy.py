@@ -96,6 +96,14 @@ class LambConfig:
 
 
 @dataclass
+class LarsConfig:
+    lars_coeff: float = 0.001
+    lars_weight_decay: float = 0.0005
+    epsilon: float = 0.0
+    exclude_from_weight_decay: List[str] = field(default_factory=list)
+
+
+@dataclass
 class ASyncConfig:
     k_steps: int = -1
     max_merge_var_num: int = 1
@@ -121,6 +129,7 @@ class DistributedStrategy:
         self.localsgd = False
         self.lars = False
         self.lamb = False
+        self.fp16_allreduce = False
         self.a_sync = False
         self.heter_ccl_mode = False
         self.fuse_all_reduce_ops = True
@@ -145,6 +154,7 @@ class DistributedStrategy:
         self.localsgd_configs = LocalSGDConfig()
         self.dgc_configs = DGCConfig()
         self.lamb_configs = LambConfig()
+        self.lars_configs = LarsConfig()
         self.a_sync_configs = ASyncConfig()
 
     def __setattr__(self, name, value):

@@ -25,7 +25,11 @@ class HybridParallelOptimizer:
 
     @no_grad()
     def step(self):
-        if self._hcg is not None and self._hcg.get_data_parallel_world_size() > 1:
+        # a meta-optimizer chain moves dp sync innermost (after dgc/fp16 grad
+        # transforms, on gradient-merge boundaries only) — don't double-sync
+        if not getattr(self._inner_opt, "_handles_dp_sync", False) and \
+                self._hcg is not None and \
+                self._hcg.get_data_parallel_world_size() > 1:
             fused_allreduce_gradients(self._inner_opt._parameter_list, self._hcg)
         self._inner_opt.step()
 

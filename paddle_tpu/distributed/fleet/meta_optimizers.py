@@ -97,21 +97,29 @@ class AMPOptimizer(MetaOptimizerBase):
         return bool(strategy.amp)
 
     def amp_context(self):
-        from ...core.dispatch import amp_guard
+        from ...amp import amp_guard_from_configs
 
-        cfg = self._strategy.amp_configs
-        return amp_guard(dtype=cfg.dtype,
-                         level="O2" if cfg.use_pure_fp16 else "O1",
-                         custom_white_list=cfg.custom_white_list,
-                         custom_black_list=cfg.custom_black_list)
+        return amp_guard_from_configs(self._strategy.amp_configs)
 
     def scale(self, loss):
-        return self._scaler.scale(loss) if self._scaler._enable else loss
+        if self._scaler._enable:
+            self._loss_was_scaled = True
+            return self._scaler.scale(loss)
+        return loss
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.scale(loss).backward()
+        self.step()
+        return None, []
 
     def step(self):
-        if self._scaler._enable:
+        # unscale only when this wrapper scaled the loss — a plain
+        # loss.backward(); step() must not divide unscaled grads
+        if self._scaler._enable and getattr(self, "_loss_was_scaled", False):
             self._scaler.step(self._inner_opt)
             self._scaler.update()
+            self._loss_was_scaled = False
         else:
             self._inner_opt.step()
 
@@ -284,10 +292,15 @@ class LarsOptimizer(MetaOptimizerBase):
 
         if inner._rule not in ("sgd", "momentum"):
             return inner
+        cfg = strategy.lars_configs
         return opt_mod.Lars(
             learning_rate=inner._learning_rate,
             momentum=inner._hyper.get("momentum", 0.9)
             if hasattr(inner, "_hyper") else 0.9,
+            lars_coeff=cfg.lars_coeff,
+            lars_weight_decay=cfg.lars_weight_decay,
+            epsilon=cfg.epsilon,
+            exclude_from_weight_decay=cfg.exclude_from_weight_decay,
             parameters=inner._parameter_list, grad_clip=inner._grad_clip)
 
 
@@ -344,18 +357,36 @@ class RawProgramOptimizer(MetaOptimizerBase):
         return bool(getattr(strategy, "without_graph_optimization", False))
 
 
-# reference ordering (meta_optimizer_factory.py list order matters: outermost
-# listed first gets applied last)
+class DpSyncOptimizer(MetaOptimizerBase):
+    """Innermost dp gradient allreduce: runs AFTER every grad-transforming
+    meta-optimizer (dgc sparsification, fp16 cast) and only when an update
+    actually happens (gradient merge boundaries) — the ordering the reference
+    gets by rewriting comm ops into the program. LocalSGD replaces it."""
+
+    name = "dp_sync"
+
+    @no_grad()
+    def step(self):
+        from .utils import fused_allreduce_gradients
+
+        if self._hcg is not None and \
+                self._hcg.get_data_parallel_world_size() > 1:
+            fused_allreduce_gradients(self._inner_opt._parameter_list, self._hcg)
+        self._inner_opt.step()
+
+
+# innermost-first chain order: grad-transforming comm optimizers sit just
+# outside dp_sync; step-frequency optimizers (gradient merge) outside those;
+# amp outermost (reference strategy_compiler ordering, inverted because we
+# wrap instead of rewrite)
 _META_OPTIMIZERS = [
-    AMPOptimizer,
-    RecomputeOptimizer,
-    GradientMergeOptimizer,
-    ShardingOptimizer,
-    LocalSGDOptimizer,
-    DGCOptimizer,
     FP16AllReduceOptimizer,
-    LarsOptimizer,
-    LambOptimizer,
+    DGCOptimizer,
+    LocalSGDOptimizer,
+    ShardingOptimizer,
+    GradientMergeOptimizer,
+    RecomputeOptimizer,
+    AMPOptimizer,
     RawProgramOptimizer,
 ]
 
@@ -370,18 +401,34 @@ class StrategyCompiler:
 
         # optimizer-rule swaps first (they replace, not wrap)
         if LarsOptimizer.can_apply(strategy, hcg):
-            optimizer = LarsOptimizer.rebuild(optimizer, strategy)
-            applied.append("lars")
+            rebuilt = LarsOptimizer.rebuild(optimizer, strategy)
+            if rebuilt is not optimizer:
+                optimizer = rebuilt
+                applied.append("lars")
         if LambOptimizer.can_apply(strategy, hcg):
-            optimizer = LambOptimizer.rebuild(optimizer, strategy)
-            applied.append("lamb")
+            rebuilt = LambOptimizer.rebuild(optimizer, strategy)
+            if rebuilt is not optimizer:
+                optimizer = rebuilt
+                applied.append("lamb")
 
+        wrappers = []
         for cls in _META_OPTIMIZERS:
             if cls.name in ("lars", "lamb"):
                 continue
             if cls.name in disabled or not cls.can_apply(strategy, hcg):
                 continue
             disabled.update(cls.conflicts)
+            wrappers.append(cls)
+
+        handles_dp_sync = False
+        if any(w.name not in ("sharding", "raw_program") for w in wrappers):
+            # a real chain exists: dp sync moves innermost (LocalSGD replaces it)
+            if not any(w.name == "localsgd" for w in wrappers) and \
+                    hcg is not None and hcg.get_data_parallel_world_size() > 1:
+                optimizer = DpSyncOptimizer(optimizer, strategy, hcg)
+            handles_dp_sync = True
+
+        for cls in wrappers:
             wrapper = cls(optimizer, strategy, hcg)
             if isinstance(wrapper, RecomputeOptimizer) and model is not None:
                 wrapper.enable_on(model)
@@ -392,4 +439,6 @@ class StrategyCompiler:
             optimizer = wrapper
             applied.append(cls.name)
 
+        if handles_dp_sync:
+            optimizer._handles_dp_sync = True
         return optimizer, applied
