@@ -1,0 +1,203 @@
+"""Fleet datasets: InMemoryDataset / QueueDataset over the C++ data feed.
+
+Reference: python/paddle/distributed/fleet/dataset/dataset.py (InMemoryDataset
+:init/_init_distributed_settings/load_into_memory/global_shuffle, QueueDataset)
+backed by the C++ MultiSlotDataset/InMemoryDataFeed (data_set.h:47,
+data_feed.h:966). Same split here: core/native/data_feed.cc does the
+multithreaded parsing, in-memory store, shuffle, and CSR batch emission; this
+module is the user-facing config + iteration surface. Sparse (uint64 id)
+slots come back as (values, lod_offsets); dense float slots as [batch, dim]
+arrays.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class _NativeFeed:
+    def __init__(self):
+        from ...core.native import load_library
+
+        self._lib = load_library("data_feed")
+        if self._lib is None:
+            raise RuntimeError("native data_feed unavailable (no C++ toolchain)")
+        self._lib.df_load.restype = ctypes.c_longlong
+        self._lib.df_size.restype = ctypes.c_longlong
+        self._lib.df_next.restype = ctypes.c_longlong
+        self._lib.df_slot_vals.restype = ctypes.c_longlong
+        self._lib.df_shuffle.argtypes = [ctypes.c_int, ctypes.c_longlong]
+        self._h = None
+
+    def create(self, types: str):
+        self._h = self._lib.df_create(len(types), types.encode())
+        if self._h < 0:
+            raise RuntimeError("df_create failed (slot/type mismatch)")
+
+    def load(self, files: Sequence[str], nthreads: int) -> int:
+        return self._lib.df_load(self._h, ",".join(files).encode(), nthreads)
+
+    def size(self) -> int:
+        return self._lib.df_size(self._h)
+
+    def shuffle(self, seed: int):
+        self._lib.df_shuffle(self._h, seed)
+
+    def begin(self, batch_size: int):
+        self._lib.df_begin(self._h, batch_size)
+
+    def next(self) -> int:
+        return self._lib.df_next(self._h)
+
+    def slot(self, idx: int, typ: str, rows: int):
+        n = self._lib.df_slot_vals(self._h, idx)
+        offs = np.zeros(rows + 1, np.int64)
+        offs_p = offs.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+        if typ == "u":
+            vals = np.zeros(max(n, 1), np.uint64)
+            self._lib.df_slot_copy_u(
+                self._h, idx, vals.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                offs_p)
+        else:
+            vals = np.zeros(max(n, 1), np.float32)
+            self._lib.df_slot_copy_f(
+                self._h, idx, vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                offs_p)
+        return vals[:n], offs
+
+    def destroy(self):
+        if self._h is not None:
+            self._lib.df_destroy(self._h)
+            self._h = None
+
+
+class DatasetBase:
+    """Config surface shared by InMemory/Queue datasets (reference
+    DatasetBase.init: batch_size/thread_num/use_var/pipe_command...)."""
+
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._slots: List[Tuple[str, str]] = []  # (name, 'u'|'f')
+        self._filelist: List[str] = []
+        self._feed: Optional[_NativeFeed] = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, fs_name="",
+             fs_ugi="", pipe_command="cat", download_cmd="cat",
+             input_type=0, **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        if use_var:
+            self._slots = [self._var_slot(v) for v in use_var]
+        return self
+
+    @staticmethod
+    def _var_slot(v):
+        """Accept (name, kind) pairs, dicts, or Tensors (int dtype -> sparse)."""
+        if isinstance(v, tuple):
+            return (v[0], "u" if v[1] in ("u", "sparse", "int64") else "f")
+        if isinstance(v, dict):
+            return (v["name"], "u" if v.get("sparse") else "f")
+        name = getattr(v, "name", str(id(v)))
+        dt = str(getattr(v, "dtype", "float32"))
+        return (name, "u" if "int" in dt else "f")
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num: int):
+        self._thread_num = thread_num
+
+    def set_use_var(self, use_var):
+        self._slots = [self._var_slot(v) for v in use_var]
+
+    def _ensure_feed(self):
+        if self._feed is None:
+            self._feed = _NativeFeed()
+            self._feed.create("".join(t for _, t in self._slots))
+        return self._feed
+
+    # ---- iteration: yields {slot_name: dense [b, d] | (values, lod)} ----
+    def _iter_batches(self):
+        feed = self._ensure_feed()
+        feed.begin(self._batch_size)
+        while True:
+            rows = feed.next()
+            if rows <= 0:
+                break
+            out: Dict[str, object] = {}
+            for i, (name, typ) in enumerate(self._slots):
+                vals, offs = feed.slot(i, typ, rows)
+                widths = np.diff(offs)
+                if typ == "f" and len(widths) and (widths == widths[0]).all():
+                    out[name] = vals.reshape(rows, -1)
+                else:
+                    out[name] = (vals, offs)
+            yield out
+
+    def __iter__(self):
+        return self._iter_batches()
+
+    def release_memory(self):
+        if self._feed is not None:
+            self._feed.destroy()
+            self._feed = None
+
+
+class InMemoryDataset(DatasetBase):
+    """Load everything, shuffle globally, iterate (reference InMemoryDataset)."""
+
+    def load_into_memory(self):
+        assert self._filelist, "call set_filelist() first"
+        feed = self._ensure_feed()
+        n = feed.load(self._filelist, self._thread_num)
+        if n < 0:
+            raise RuntimeError("data feed load failed")
+        return n
+
+    def get_memory_data_size(self) -> int:
+        return self._ensure_feed().size()
+
+    def global_shuffle(self, fleet=None, thread_num=12, seed=None):
+        """Single-host global shuffle; with a fleet handle the reference
+        exchanges records across trainers — here each trainer shuffles its own
+        shard (the launcher already splits the filelist per trainer)."""
+        if seed is None:
+            seed = int.from_bytes(os.urandom(4), "little")
+        self._ensure_feed().shuffle(seed)
+
+    def local_shuffle(self, seed=None):
+        self.global_shuffle(seed=seed)
+
+
+class QueueDataset(DatasetBase):
+    """Streaming iteration: files are parsed lazily per-iteration rather than
+    held resident (reference QueueDataset). Reuses the same native parser,
+    loading one file at a time."""
+
+    def _iter_batches(self):
+        for f in self._filelist:
+            feed = _NativeFeed()
+            feed.create("".join(t for _, t in self._slots))
+            feed.load([f], self._thread_num)
+            feed.begin(self._batch_size)
+            while True:
+                rows = feed.next()
+                if rows <= 0:
+                    break
+                out: Dict[str, object] = {}
+                for i, (name, typ) in enumerate(self._slots):
+                    vals, offs = feed.slot(i, typ, rows)
+                    widths = np.diff(offs)
+                    if typ == "f" and len(widths) and (widths == widths[0]).all():
+                        out[name] = vals.reshape(rows, -1)
+                    else:
+                        out[name] = (vals, offs)
+                yield out
+            feed.destroy()
