@@ -63,6 +63,8 @@ from . import text  # noqa: E402
 from . import incubate  # noqa: E402
 from . import metric  # noqa: E402
 from . import profiler  # noqa: E402
+from . import device  # noqa: E402
+from . import utils  # noqa: E402
 from . import hapi  # noqa: E402
 from .hapi import Model  # noqa: E402
 from .framework import io as _fw_io  # noqa: E402

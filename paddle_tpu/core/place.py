@@ -103,6 +103,21 @@ class CustomPlace(Place):
         super().__init__(device_id)
         self.custom_device_type = device_type
 
+    def jax_device(self):
+        # registered custom devices resolve to their PJRT platform
+        # (paddle_tpu.device.register_custom_device); unregistered ones fall
+        # back to the accelerator like the base class
+        from ..device import get_registered_custom_device
+
+        plat = get_registered_custom_device(self.custom_device_type)
+        if plat is not None:
+            import jax
+
+            devs = [d for d in jax.devices() if d.platform == plat]
+            if devs:
+                return devs[self.device_id % len(devs)]
+        return super().jax_device()
+
 
 def _default_place() -> Place:
     import jax

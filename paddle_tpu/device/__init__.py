@@ -1,0 +1,79 @@
+"""paddle.device namespace: device selection + memory introspection.
+
+Reference: python/paddle/device/__init__.py (set/get_device, vendor place
+ctors, is_compiled_with_*) and device/cuda/ (streams, synchronize, memory
+stats at cuda/__init__.py:195-327). TPU-native: a "stream" is XLA's internal
+per-device queue — stream objects exist for API parity and synchronize maps
+to blocking on enqueued work; memory numbers come from the PJRT device's
+memory_stats() (the allocator the reference queries with memory_stats
+STAT_int macros is PJRT's BFC allocator here).
+"""
+from __future__ import annotations
+
+from ..core.place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, IPUPlace, MLUPlace,
+    NPUPlace, Place, TPUPlace, XPUPlace, device_count as _device_count,
+    get_device, is_compiled_with_cinn, is_compiled_with_cuda,
+    is_compiled_with_ipu, is_compiled_with_mlu, is_compiled_with_npu,
+    is_compiled_with_rocm, is_compiled_with_tpu, is_compiled_with_xpu,
+    set_device,
+)
+
+from . import cuda  # noqa: E402,F401
+from . import tpu  # noqa: E402,F401
+
+
+def get_cudnn_version():
+    """No cuDNN in a TPU build (reference returns None when not compiled in)."""
+    return None
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return sorted(_custom_device_registry)
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    out = []
+    for name in get_all_custom_device_type():
+        out.extend(f"{name}:{i}" for i in range(device_count(name)))
+    return out
+
+
+def device_count(device_type=None):
+    import jax
+
+    if device_type is None:
+        return _device_count()
+    if device_type in _custom_device_registry:
+        device_type = _custom_device_registry[device_type]
+    return len([d for d in jax.devices() if d.platform == device_type])
+
+
+# ---- custom device seam (reference: phi/backends/device_ext.h C_DeviceInterface
+# plugin ABI). On TPU-stack the device plugin mechanism IS PJRT: a vendor ships
+# a PJRT plugin, jax exposes it as a platform; this registry maps the paddle
+# custom-device name onto that platform so CustomPlace resolves to it. ----
+_custom_device_registry = {}
+
+
+def register_custom_device(device_type: str, jax_platform: str):
+    """Map a custom device name (CustomPlace(device_type, i)) to a jax/PJRT
+    platform. The PJRT plugin itself is loaded by jax (PJRT_NAMES_AND_LIBRARY_PATHS
+    or jax_plugins entry points) — this records the paddle-side name."""
+    _custom_device_registry[device_type] = jax_platform
+
+
+def get_registered_custom_device(device_type: str):
+    return _custom_device_registry.get(device_type)

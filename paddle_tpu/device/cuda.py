@@ -1,0 +1,89 @@
+"""paddle.device.cuda parity surface, mapped onto the accelerator.
+
+Reference: python/paddle/device/cuda/__init__.py. A TPU build has no CUDA, but
+user code ported from the reference calls these; they operate on the jax
+accelerator device (like CUDAPlace does). Streams/events are parity objects:
+XLA runs one in-order queue per device, so record/wait/synchronize degrade to
+device synchronization.
+"""
+from __future__ import annotations
+
+import time as _time
+
+from .tpu import (  # noqa: F401
+    empty_cache, get_device_name, get_device_properties, max_memory_allocated,
+    max_memory_reserved, memory_allocated, memory_reserved, synchronize,
+)
+
+
+def device_count():
+    import jax
+
+    try:
+        return len([d for d in jax.devices() if d.platform != "cpu"]) or \
+            len(jax.devices())
+    except Exception:
+        return 0
+
+
+class Stream:
+    """Parity object: XLA keeps one in-order execution queue per device."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._t = None
+
+    def record(self, stream=None):
+        (stream or Stream()).synchronize()
+        self._t = _time.monotonic()
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        pass
+
+    def elapsed_time(self, end_event):
+        if self._t is None or end_event._t is None:
+            return 0.0
+        return (end_event._t - self._t) * 1000.0
+
+
+_current = Stream()
+
+
+def current_stream(device=None):
+    return _current
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+def get_device_capability(device=None):
+    return (0, 0)  # no CUDA compute capability on TPU

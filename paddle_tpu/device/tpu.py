@@ -1,0 +1,102 @@
+"""TPU device utilities: synchronization + PJRT memory statistics.
+
+Reference analogue: python/paddle/device/cuda/ (synchronize :78, memory stats
+:195-327 reading the allocator's STAT counters). The PJRT client tracks
+bytes_in_use / peak_bytes_in_use per device; where a backend doesn't report
+(CPU), live-buffer accounting over jax.live_arrays() is the fallback.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _device(device=None):
+    import jax
+
+    devs = jax.devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device % len(devs)]
+    if hasattr(device, "jax_device"):
+        return device.jax_device()
+    return device
+
+
+def synchronize(device=None):
+    """Block until all enqueued work on the device finished (reference
+    cuda.synchronize; XLA has one in-order execution queue per device)."""
+    import jax
+    import jax.numpy as jnp
+
+    d = _device(device)
+    jax.device_put(jnp.zeros(()), d).block_until_ready()
+
+
+def _stats(device=None) -> Optional[dict]:
+    d = _device(device)
+    try:
+        return d.memory_stats()
+    except Exception:
+        return None
+
+
+def _live_bytes(d) -> int:
+    import jax
+
+    return sum(int(a.size * a.dtype.itemsize) for a in jax.live_arrays()
+               if d in a.devices())
+
+
+def memory_allocated(device=None) -> int:
+    s = _stats(device)
+    if s and "bytes_in_use" in s:
+        return int(s["bytes_in_use"])
+    return _live_bytes(_device(device))
+
+
+def max_memory_allocated(device=None) -> int:
+    s = _stats(device)
+    if s and "peak_bytes_in_use" in s:
+        return int(s["peak_bytes_in_use"])
+    return memory_allocated(device)
+
+
+def memory_reserved(device=None) -> int:
+    s = _stats(device)
+    if s:
+        for k in ("bytes_reserved", "bytes_limit"):
+            if k in s:
+                return int(s[k])
+    return memory_allocated(device)
+
+
+def max_memory_reserved(device=None) -> int:
+    s = _stats(device)
+    if s and "peak_bytes_reserved" in s:
+        return int(s["peak_bytes_reserved"])
+    return max_memory_allocated(device)
+
+
+def empty_cache():
+    """Free framework-held caches. XLA/PJRT owns the allocator; python-side
+    we can only drop dead references so the GC returns buffers."""
+    import gc
+
+    gc.collect()
+
+
+def get_device_properties(device=None):
+    d = _device(device)
+    return {
+        "platform": d.platform,
+        "device_kind": getattr(d, "device_kind", ""),
+        "id": d.id,
+        "process_index": d.process_index,
+        "memory_stats": _stats(device) or {},
+    }
+
+
+def get_device_name(device=None) -> str:
+    d = _device(device)
+    return getattr(d, "device_kind", d.platform)

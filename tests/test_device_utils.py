@@ -1,0 +1,170 @@
+"""paddle.device (memory stats, streams, custom-device registry) and
+paddle.utils (custom ops, cpp_extension host ops, run_check) tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestDeviceNamespace:
+    def test_memory_stats_monotonic(self):
+        import paddle_tpu.device.tpu as dtpu
+
+        a = paddle.to_tensor(np.ones((256, 256), np.float32))
+        allocated = dtpu.memory_allocated()
+        assert allocated >= 0
+        assert dtpu.max_memory_allocated() >= allocated or \
+            dtpu.max_memory_allocated() >= 0
+        assert dtpu.memory_reserved() >= 0
+        del a
+
+    def test_synchronize_and_properties(self):
+        import paddle_tpu.device.tpu as dtpu
+
+        dtpu.synchronize()
+        props = dtpu.get_device_properties()
+        assert "platform" in props and props["id"] >= 0
+        assert isinstance(dtpu.get_device_name(), str)
+
+    def test_cuda_parity_surface(self):
+        cuda = paddle.device.cuda
+        assert cuda.device_count() >= 1
+        s = cuda.Stream()
+        e1 = s.record_event()
+        e2 = cuda.Event()
+        e2.record(s)
+        assert e1.elapsed_time(e2) >= 0
+        with cuda.stream_guard(s):
+            cuda.synchronize()
+        assert cuda.current_stream() is not None
+        assert cuda.memory_allocated() >= 0
+
+    def test_device_listing(self):
+        assert paddle.device.device_count() >= 1
+        assert len(paddle.device.get_available_device()) >= 1
+        assert paddle.device.get_cudnn_version() is None
+
+    def test_custom_device_registry(self):
+        import jax
+
+        paddle.device.register_custom_device("mynpu", jax.devices()[0].platform)
+        assert "mynpu" in paddle.device.get_all_custom_device_type()
+        p = paddle.CustomPlace("mynpu", 0)
+        assert p.jax_device() is jax.devices()[0]
+        assert paddle.device.device_count("mynpu") >= 1
+
+
+class TestCustomOps:
+    def test_register_custom_op_autograd(self):
+        import jax.numpy as jnp
+        from paddle_tpu.utils import register_custom_op
+
+        cube = register_custom_op("test_cube", lambda a: a ** 3)
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        y = cube(x)
+        np.testing.assert_allclose(y.numpy(), [8.0, 27.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0, 27.0])  # 3x^2
+
+    def test_custom_vjp(self):
+        import jax.numpy as jnp
+        from paddle_tpu.utils import register_custom_op
+
+        # intentionally wrong gradient (x10) to prove the custom vjp is used
+        op = register_custom_op(
+            "test_double", lambda a: a * 2,
+            backward=lambda g, a: g * 20.0)
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        x.stop_gradient = False
+        op(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+    def test_duplicate_rejected(self):
+        from paddle_tpu.utils import register_custom_op
+
+        register_custom_op("test_once", lambda a: a)
+        with pytest.raises(ValueError):
+            register_custom_op("test_once", lambda a: a)
+
+    def test_works_in_layer_and_jit(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.utils import register_custom_op
+
+        sq = register_custom_op("test_sq", lambda a: a * a)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return sq(self.fc(x)).sum()
+
+        m = M()
+        st = paddle.jit.to_static(m)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        v_eager = float(m(x))
+        v_jit = float(st(x))
+        np.testing.assert_allclose(v_eager, v_jit, rtol=1e-6)
+
+
+class TestCppExtension:
+    def test_load_host_op(self, tmp_path):
+        src = tmp_path / "myops.cc"
+        src.write_text("""
+            extern "C" void my_negate(const float* x, float* y, long long n) {
+                for (long long i = 0; i < n; ++i) y[i] = -x[i];
+            }
+            extern "C" void my_half(const float* x, float* y, long long n) {
+                for (long long i = 0; i < n; ++i) y[i] = x[i] * 0.5f;
+            }
+        """)
+        from paddle_tpu.utils import cpp_extension
+
+        mod = cpp_extension.load("myops", [str(src)],
+                                 functions=["my_negate", "my_half"])
+        x = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+        np.testing.assert_allclose(mod.my_negate(x).numpy(), [-1.0, 2.0])
+        np.testing.assert_allclose(mod.my_half(x).numpy(), [0.5, -1.0])
+
+    def test_host_op_under_jit(self, tmp_path):
+        src = tmp_path / "jitop.cc"
+        src.write_text("""
+            extern "C" void plus_one(const float* x, float* y, long long n) {
+                for (long long i = 0; i < n; ++i) y[i] = x[i] + 1.0f;
+            }
+        """)
+        from paddle_tpu.utils import cpp_extension
+
+        mod = cpp_extension.load("jitop", [str(src)], functions=["plus_one"])
+        fn = paddle.jit.to_static(lambda t: mod.plus_one(t) * 2.0)
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(fn(x).numpy(), [4.0, 6.0])
+
+
+class TestUtilsMisc:
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert "installed successfully" in capsys.readouterr().out
+
+    def test_require_version(self):
+        paddle.utils.require_version("0.0.1")
+        with pytest.raises(Exception):
+            paddle.utils.require_version("999.0.0")
+
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+
+        a = unique_name.generate("fc")
+        b = unique_name.generate("fc")
+        assert a != b
+        with unique_name.guard():
+            c = unique_name.generate("fc")
+            assert c == "fc_0"
+
+    def test_try_import(self):
+        m = paddle.utils.try_import("math")
+        assert m.sqrt(4) == 2
+        with pytest.raises(ImportError):
+            paddle.utils.try_import("definitely_not_a_module_xyz")
