@@ -1,6 +1,21 @@
-"""paddle.incubate extras. Reference: python/paddle/incubate/ (#54) —
-ASP (2:4 structured sparsity), LookAhead and ModelAverage optimizers."""
-from . import asp
-from .optimizer import LookAhead, ModelAverage
+"""paddle.incubate extras. Reference: python/paddle/incubate/ (#54) — ASP,
+LookAhead/ModelAverage, fused transformer layers, softmax-mask fusions, graph
+ops, segment reductions, functional autograd, auto checkpoint, shared-memory
+multiprocessing."""
+from . import asp  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from . import nn  # noqa: F401
+from . import autograd  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .operators import (  # noqa: F401
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors, graph_send_recv,
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+)
+from .tensor import segment_max, segment_mean, segment_min, segment_sum  # noqa: F401
 
-__all__ = ["asp", "LookAhead", "ModelAverage"]
+__all__ = [
+    "asp", "LookAhead", "ModelAverage", "nn", "autograd", "checkpoint",
+    "softmax_mask_fuse_upper_triangle", "softmax_mask_fuse", "graph_send_recv",
+    "graph_khop_sampler", "graph_sample_neighbors", "graph_reindex",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+]

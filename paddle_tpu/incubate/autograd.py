@@ -1,0 +1,136 @@
+"""Functional autograd: vjp / jvp / Jacobian / Hessian.
+
+Reference: python/paddle/incubate/autograd/ (primapi + functional.py) —
+there these build on primitive ops with registered transpose rules. TPU-native
+they ARE jax transforms: the user function (Tensor -> Tensor) is bridged to an
+array function and handed to jax.vjp/jvp/jacfwd; our ops run under no_grad so
+the eager tape stays out of the way and jax tracers flow straight through the
+kernels."""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _array_fn(func, n_inputs):
+    def f(*arrays):
+        with no_grad():
+            ins = [Tensor(a) for a in arrays]
+            out = func(*ins)
+        outs = _as_list(out)
+        res = tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+        return res if len(res) > 1 else res[0]
+
+    return f
+
+
+def vjp(func: Callable, xs, v=None):
+    """Vector-Jacobian product: returns (func(xs), vjp_result)."""
+    xs_l = _as_list(xs)
+    f = _array_fn(func, len(xs_l))
+    out, vjp_fn = jax.vjp(f, *[x._data for x in xs_l])
+    outs = _as_list(out)
+    if v is None:
+        cot = tuple(jnp.ones_like(o) for o in outs)
+    else:
+        cot = tuple(t._data for t in _as_list(v))
+    grads = vjp_fn(cot if len(cot) > 1 else cot[0])
+    wrap = lambda seq: [Tensor(g) for g in seq]
+    out_t = [Tensor(o) for o in outs]
+    g_t = wrap(grads)
+    return (out_t if len(out_t) > 1 else out_t[0],
+            g_t if len(g_t) > 1 else g_t[0])
+
+
+def jvp(func: Callable, xs, v=None):
+    """Jacobian-vector product: returns (func(xs), jvp_result)."""
+    xs_l = _as_list(xs)
+    f = _array_fn(func, len(xs_l))
+    prim = [x._data for x in xs_l]
+    if v is None:
+        tang = [jnp.ones_like(p) for p in prim]
+    else:
+        tang = [t._data for t in _as_list(v)]
+    out, jv = jax.jvp(f, tuple(prim), tuple(tang))
+    outs, jvs = _as_list(out), _as_list(jv)
+    out_t = [Tensor(o) for o in outs]
+    jv_t = [Tensor(j) for j in jvs]
+    return (out_t if len(out_t) > 1 else out_t[0],
+            jv_t if len(jv_t) > 1 else jv_t[0])
+
+
+class Jacobian:
+    """Full Jacobian with lazy row access (reference autograd.Jacobian:
+    J[i] rows, J[:] whole matrix; inputs/outputs flattened)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        xs_l = _as_list(xs)
+        f = _array_fn(func, len(xs_l))
+
+        def flat_f(flat_in):
+            # unflatten -> call -> flatten
+            arrays, off = [], 0
+            for x in xs_l:
+                n = x._data.size
+                arrays.append(flat_in[off:off + n].reshape(x._data.shape))
+                off += n
+            out = f(*arrays)
+            outs = out if isinstance(out, tuple) else (out,)
+            return jnp.concatenate([jnp.ravel(o) for o in outs])
+
+        flat_in = jnp.concatenate([jnp.ravel(x._data) for x in xs_l])
+        self._jac = jax.jacfwd(flat_f)(flat_in)
+
+    @property
+    def shape(self):
+        return list(self._jac.shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._jac[idx])
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._jac)
+
+
+class Hessian:
+    """Hessian of a scalar function (reference autograd.Hessian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        xs_l = _as_list(xs)
+        f = _array_fn(func, len(xs_l))
+
+        def flat_f(flat_in):
+            arrays, off = [], 0
+            for x in xs_l:
+                n = x._data.size
+                arrays.append(flat_in[off:off + n].reshape(x._data.shape))
+                off += n
+            out = f(*arrays)
+            out = out[0] if isinstance(out, tuple) else out
+            return jnp.reshape(out, ())
+
+        flat_in = jnp.concatenate([jnp.ravel(x._data) for x in xs_l])
+        self._hess = jax.hessian(flat_f)(flat_in)
+
+    @property
+    def shape(self):
+        return list(self._hess.shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._hess[idx])
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._hess)

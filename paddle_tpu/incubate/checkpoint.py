@@ -1,0 +1,60 @@
+"""Auto checkpoint: epoch-range resume hooks.
+
+Reference: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py —
+train_epoch_range wraps the epoch loop, snapshots program+scope per epoch
+under a job id, and on restart fast-forwards to the first unfinished epoch.
+TPU-native: the snapshot is the model/optimizer state_dicts via paddle.save;
+job identity comes from PADDLE_JOB_ID (the launcher sets it)."""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+class _EpochRange:
+    def __init__(self, max_epoch_num: int, save_dir: Optional[str] = None,
+                 name: Optional[str] = None):
+        self.max_epoch_num = max_epoch_num
+        job = name or os.environ.get("PADDLE_JOB_ID", "default")
+        root = save_dir or os.environ.get("PADDLE_CHECKPOINT_DIR",
+                                          os.path.join(".", "auto_checkpoint"))
+        self.dir = os.path.join(root, job)
+        os.makedirs(self.dir, exist_ok=True)
+        self._meta_path = os.path.join(self.dir, "meta.json")
+        self._start = 0
+        self._bound = []  # (name, obj) pairs to snapshot
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                meta = json.load(f)
+            self._start = int(meta.get("next_epoch", 0))
+
+    def bind(self, **named_objects):
+        """Register model/optimizer (anything with state_dict/set_state_dict)."""
+        self._bound = list(named_objects.items())
+        # restore on resume
+        from .. import load
+
+        for name, obj in self._bound:
+            path = os.path.join(self.dir, f"{name}.pdparams")
+            if os.path.exists(path) and self._start > 0:
+                obj.set_state_dict(load(path))
+        return self
+
+    def __iter__(self):
+        from .. import save
+
+        for epoch in range(self._start, self.max_epoch_num):
+            yield epoch
+            for name, obj in self._bound:
+                save(obj.state_dict(), os.path.join(self.dir, f"{name}.pdparams"))
+            with open(self._meta_path, "w") as f:
+                json.dump({"next_epoch": epoch + 1}, f)
+
+
+def train_epoch_range(max_epoch_num: int, save_checkpoint_inter: int = 0,
+                      save_dir: Optional[str] = None, name: Optional[str] = None):
+    """`for epoch in train_epoch_range(N): ...` resumes after restart.
+    Call .bind(model=m, optimizer=o) on the returned range to checkpoint
+    state each epoch (reference acp.train_epoch_range)."""
+    return _EpochRange(max_epoch_num, save_dir, name)

@@ -1,0 +1,49 @@
+"""Shared-memory tensor transfer between processes.
+
+Reference: python/paddle/incubate/multiprocessing/reductions.py — registers
+ForkingPickler reducers so Tensors cross process boundaries through shared
+memory instead of pickled copies (the DataLoader workers' transport).
+TPU-native: device buffers are host-fetched once, the host copy rides
+multiprocessing.shared_memory, and the receiver re-wraps without another copy."""
+from __future__ import annotations
+
+import multiprocessing.reduction as _reduction
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_KEEPALIVE = {}
+
+
+def _rebuild_tensor(shm_name, shape, dtype_str):
+    shm = shared_memory.SharedMemory(name=shm_name)
+    arr = np.ndarray(shape, dtype=np.dtype(dtype_str), buffer=shm.buf)
+    t = Tensor(np.array(arr))  # own the data; shm can be released
+    shm.close()
+    try:
+        shm_owner = _KEEPALIVE.pop(shm_name, None)
+        if shm_owner is not None:
+            shm_owner.unlink()
+    except FileNotFoundError:
+        pass
+    return t
+
+
+def _reduce_tensor(t: Tensor):
+    arr = np.asarray(t.numpy())
+    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+    dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
+    dst[...] = arr
+    _KEEPALIVE[shm.name] = shm
+    return _rebuild_tensor, (shm.name, arr.shape, arr.dtype.str)
+
+
+def init_reductions():
+    """Install the Tensor reducer into ForkingPickler (call once per process;
+    the reference does this at import of paddle.incubate.multiprocessing)."""
+    _reduction.ForkingPickler.register(Tensor, _reduce_tensor)
+
+
+init_reductions()

@@ -1,0 +1,124 @@
+"""incubate.nn fused transformer layers.
+
+Reference: python/paddle/incubate/nn/layer/fused_transformer.py —
+FusedMultiHeadAttention / FusedFeedForward / FusedTransformerEncoderLayer
+backed by the hand-fused CUDA kernels (operators/fused/fused_attention_op.cu,
+fused_feedforward_op.cu). TPU-native: "fused" means the whole block traces
+into one XLA computation — layernorm/bias/residual/dropout fuse into the
+matmuls automatically, and the attention core routes to the Pallas flash
+kernel — so these layers share code with nn.MultiHeadAttention-level ops but
+keep the reference's fused-layer API (normalize_before, single qkv weight,
+epilogue residual+dropout inside the layer)."""
+from __future__ import annotations
+
+import math
+
+from .. import nn as base_nn
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..ops import manipulation as P
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None, ln_scale_attr=None,
+                 ln_bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        # reference layout: qkv_weight [3, heads, head_dim, embed]
+        self.qkv_weight = self.create_parameter(
+            (3 * embed_dim, embed_dim), attr=qkv_weight_attr)
+        self.qkv_bias = self.create_parameter(
+            (3 * embed_dim,), attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), attr=linear_weight_attr)
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=linear_bias_attr, is_bias=True)
+        self.ln = base_nn.LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        b, s = x.shape[0], x.shape[1]
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        qkv = x @ self.qkv_weight.t() + self.qkv_bias
+        qkv = P.reshape(qkv, (b, s, 3, self.num_heads, self.head_dim))
+        q, k, v = P.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate,
+            is_causal=False, training=self.training)
+        out = P.reshape(out, (b, s, self.embed_dim))
+        out = out @ self.linear_weight + self.linear_bias
+        out = residual + F.dropout(out, self.dropout_rate,
+                                   training=self.training)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-5,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.activation = activation
+        self.linear1 = base_nn.Linear(d_model, dim_feedforward,
+                                      weight_attr=linear1_weight_attr,
+                                      bias_attr=linear1_bias_attr)
+        self.linear2 = base_nn.Linear(dim_feedforward, d_model,
+                                      weight_attr=linear2_weight_attr,
+                                      bias_attr=linear2_bias_attr)
+        self.ln = base_nn.LayerNorm(d_model, epsilon=epsilon)
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        act = getattr(F, self.activation)
+        x = F.dropout(act(self.linear1(x)), self.act_dropout_rate,
+                      training=self.training)
+        x = residual + F.dropout(self.linear2(x), self.dropout_rate,
+                                 training=self.training)
+        if not self.normalize_before:
+            x = self.ln(x)
+        return x
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]

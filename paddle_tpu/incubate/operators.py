@@ -1,0 +1,139 @@
+"""Incubate operators: fused softmax-mask + graph ops.
+
+Reference: python/paddle/incubate/operators/ — softmax_mask_fuse(_upper_triangle)
+(operators/fused/fused_softmax_mask*.cu), graph_send_recv
+(operators/graph_send_recv_op.*), graph_reindex, graph_sample_neighbors,
+graph_khop_sampler. On TPU the "fused" softmax-mask is one XLA fusion; the
+message-passing op lowers to segment reductions; the samplers are host-side
+(data preparation, like the reference's CPU kernels)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..ops._helpers import t_
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fusion (reference fused_softmax_mask_op.cu)."""
+
+    def kernel(a, m):
+        return jax.nn.softmax((a.astype(jnp.float32)
+                               + m.astype(jnp.float32)), axis=-1).astype(a.dtype)
+
+    return apply("softmax_mask_fuse", kernel, [t_(x), t_(mask)])
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax without materializing the mask tensor
+    (reference fused_softmax_mask_upper_triangle_op.cu)."""
+
+    def kernel(a):
+        s = a.shape[-1]
+        causal = jnp.tril(jnp.ones((a.shape[-2], s), bool))
+        scores = jnp.where(causal, a.astype(jnp.float32), -1e9)
+        return jax.nn.softmax(scores, axis=-1).astype(a.dtype)
+
+    return apply("softmax_mask_fuse_upper_triangle", kernel, [t_(x)])
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Gather rows at src_index, scatter-reduce onto dst_index (message
+    passing; reference graph_send_recv_op)."""
+    x, src_index, dst_index = t_(x), t_(src_index), t_(dst_index)
+
+    def kernel(a, src, dst, pool_type, out_size):
+        n = out_size or a.shape[0]
+        msgs = a[src]
+        if pool_type == "sum":
+            return jax.ops.segment_sum(msgs, dst, num_segments=n)
+        if pool_type == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), a.dtype), dst,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1.0).reshape((-1,) + (1,) * (a.ndim - 1))
+        if pool_type == "max":
+            out = jax.ops.segment_max(msgs, dst, num_segments=n)
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+        if pool_type == "min":
+            out = jax.ops.segment_min(msgs, dst, num_segments=n)
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+        raise ValueError(f"pool_type {pool_type!r}")
+
+    return apply("graph_send_recv", kernel, [x, src_index, dst_index],
+                 {"pool_type": pool_type, "out_size": out_size})
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a neighborhood subgraph to local ids (reference
+    graph_reindex_op). Host-side: graph sampling is data prep."""
+    x_np = np.asarray(t_(x)._data)
+    nb_np = np.asarray(t_(neighbors)._data)
+    cnt_np = np.asarray(t_(count)._data)
+    keys = list(dict.fromkeys(x_np.tolist() + nb_np.tolist()))
+    mapping = {k: i for i, k in enumerate(keys)}
+    reindex_src = np.array([mapping[v] for v in nb_np], np.int64)
+    # dst: each center i repeated count[i] times
+    reindex_dst = np.repeat(np.arange(len(x_np), dtype=np.int64), cnt_np)
+    out_nodes = np.array(keys, np.int64)
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1, return_eids=False,
+                           flag_perm_buffer=False, name=None):
+    """Uniformly sample up to sample_size neighbors per input node from a CSC
+    graph (reference graph_sample_neighbors_op). Host-side."""
+    row_np = np.asarray(t_(row)._data)
+    colptr_np = np.asarray(t_(colptr)._data)
+    nodes_np = np.asarray(t_(input_nodes)._data)
+    rng = np.random.default_rng()
+    out_neighbors, out_count = [], []
+    for n in nodes_np:
+        beg, end = int(colptr_np[n]), int(colptr_np[n + 1])
+        neigh = row_np[beg:end]
+        if sample_size > 0 and len(neigh) > sample_size:
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out_neighbors.append(neigh)
+        out_count.append(len(neigh))
+    neighbors = np.concatenate(out_neighbors) if out_neighbors else \
+        np.zeros((0,), row_np.dtype)
+    return (Tensor(jnp.asarray(neighbors)),
+            Tensor(jnp.asarray(np.array(out_count, np.int64))))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighborhood sampling + reindex (reference
+    graph_khop_sampler_op). Host-side."""
+    frontier = np.asarray(t_(input_nodes)._data)
+    all_src, all_dst = [], []
+    seen = list(dict.fromkeys(frontier.tolist()))
+    cur = frontier
+    for k in sample_sizes:
+        neigh, cnt = graph_sample_neighbors(row, colptr,
+                                            Tensor(jnp.asarray(cur)),
+                                            sample_size=k)
+        neigh_np = np.asarray(neigh._data)
+        cnt_np = np.asarray(cnt._data)
+        dst = np.repeat(cur, cnt_np)
+        all_src.append(neigh_np)
+        all_dst.append(dst)
+        nxt = [v for v in neigh_np.tolist() if v not in set(seen)]
+        seen.extend(dict.fromkeys(nxt))
+        cur = np.array(list(dict.fromkeys(neigh_np.tolist())), frontier.dtype)
+    src = np.concatenate(all_src) if all_src else np.zeros((0,), np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.zeros((0,), np.int64)
+    mapping = {k: i for i, k in enumerate(seen)}
+    reindex_src = np.array([mapping[v] for v in src], np.int64)
+    reindex_dst = np.array([mapping[v] for v in dst], np.int64)
+    return (Tensor(jnp.asarray(np.array(seen, np.int64))),
+            Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)))

@@ -1,0 +1,55 @@
+"""Segment reductions (reference python/paddle/incubate/tensor/math.py →
+phi segment_pool kernels). TPU-native: jax.ops.segment_* lower to efficient
+sorted-segment XLA scatters."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..ops._helpers import t_
+
+
+def _segment(name, jfn, data, segment_ids, fill=0.0):
+    data, segment_ids = t_(data), t_(segment_ids)
+
+    def kernel(x, ids):
+        n = int(jnp.max(ids)) + 1 if ids.size else 0
+        return jfn(x, ids, num_segments=n)
+
+    return apply(name, kernel, [data, segment_ids])
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("segment_sum", jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    data, segment_ids = t_(data), t_(segment_ids)
+
+    def kernel(x, ids):
+        n = int(jnp.max(ids)) + 1 if ids.size else 0
+        s = jax.ops.segment_sum(x, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), ids,
+                                  num_segments=n)
+        return s / jnp.maximum(cnt, 1.0).reshape((-1,) + (1,) * (x.ndim - 1))
+
+    return apply("segment_mean", kernel, [data, segment_ids])
+
+
+def segment_max(data, segment_ids, name=None):
+    def kernel(x, ids):
+        n = int(jnp.max(ids)) + 1 if ids.size else 0
+        out = jax.ops.segment_max(x, ids, num_segments=n)
+        return jnp.where(jnp.isfinite(out), out, 0.0)  # empty segments -> 0
+
+    return apply("segment_max", kernel, [t_(data), t_(segment_ids)])
+
+
+def segment_min(data, segment_ids, name=None):
+    def kernel(x, ids):
+        n = int(jnp.max(ids)) + 1 if ids.size else 0
+        out = jax.ops.segment_min(x, ids, num_segments=n)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return apply("segment_min", kernel, [t_(data), t_(segment_ids)])

@@ -1,0 +1,207 @@
+"""Incubate extras: segment/graph ops, fused softmax-mask, fused transformer
+layers, functional autograd, auto checkpoint, shared-memory multiprocessing."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate as incubate
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestSegmentOps:
+    def test_segment_sum_mean_max_min(self):
+        data = t(np.array([[1.0, 2], [3, 4], [5, 6], [7, 8]], np.float32))
+        ids = t(np.array([0, 0, 1, 1], np.int64))
+        np.testing.assert_allclose(incubate.segment_sum(data, ids).numpy(),
+                                   [[4, 6], [12, 14]])
+        np.testing.assert_allclose(incubate.segment_mean(data, ids).numpy(),
+                                   [[2, 3], [6, 7]])
+        np.testing.assert_allclose(incubate.segment_max(data, ids).numpy(),
+                                   [[3, 4], [7, 8]])
+        np.testing.assert_allclose(incubate.segment_min(data, ids).numpy(),
+                                   [[1, 2], [5, 6]])
+
+    def test_segment_sum_grad(self):
+        data = t(np.ones((4, 2), np.float32))
+        data.stop_gradient = False
+        ids = t(np.array([0, 1, 1, 1], np.int64))
+        incubate.segment_sum(data, ids).sum().backward()
+        np.testing.assert_allclose(data.grad.numpy(), np.ones((4, 2)))
+
+
+class TestSoftmaxMaskFuse:
+    def test_fuse_matches_composed(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 4, 8, 8).astype(np.float32)
+        mask = (rs.rand(2, 1, 8, 8) > 0.5).astype(np.float32) * -1e4
+        out = incubate.softmax_mask_fuse(t(x), t(mask)).numpy()
+        ref = x + mask
+        ref = np.exp(ref - ref.max(-1, keepdims=True))
+        ref /= ref.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+
+    def test_upper_triangle(self):
+        x = t(np.zeros((1, 1, 4, 4), np.float32))
+        out = incubate.softmax_mask_fuse_upper_triangle(x).numpy()[0, 0]
+        # row i: uniform over first i+1 positions
+        for i in range(4):
+            np.testing.assert_allclose(out[i, :i + 1], 1.0 / (i + 1), rtol=1e-5)
+            np.testing.assert_allclose(out[i, i + 1:], 0.0, atol=1e-7)
+
+
+class TestGraphOps:
+    def test_send_recv_sum_mean(self):
+        x = t(np.array([[1.0], [2], [3]], np.float32))
+        src = t(np.array([0, 1, 2, 0], np.int64))
+        dst = t(np.array([1, 2, 1, 0], np.int64))
+        out = incubate.graph_send_recv(x, src, dst, "sum").numpy()
+        np.testing.assert_allclose(out, [[1], [4], [2]])
+        out_m = incubate.graph_send_recv(x, src, dst, "mean").numpy()
+        np.testing.assert_allclose(out_m, [[1], [2], [2]])
+
+    def test_sample_and_reindex(self):
+        # CSC graph: node n's neighbors = row[colptr[n]:colptr[n+1]]
+        row = t(np.array([1, 2, 0, 2, 0, 1], np.int64))
+        colptr = t(np.array([0, 2, 4, 6], np.int64))
+        nodes = t(np.array([0], np.int64))
+        neigh, cnt = incubate.graph_sample_neighbors(row, colptr, nodes,
+                                                     sample_size=-1)
+        np.testing.assert_array_equal(np.sort(neigh.numpy()), [1, 2])
+        assert cnt.numpy()[0] == 2
+        r_src, r_dst, out_nodes = incubate.graph_reindex(nodes, neigh, cnt)
+        assert out_nodes.numpy()[0] == 0
+        assert (r_dst.numpy() == 0).all()
+
+    def test_khop(self):
+        row = t(np.array([1, 2, 0, 2, 0, 1], np.int64))
+        colptr = t(np.array([0, 2, 4, 6], np.int64))
+        nodes, src, dst = incubate.graph_khop_sampler(
+            row, colptr, t(np.array([0], np.int64)), [2, 2])
+        assert set(nodes.numpy().tolist()) == {0, 1, 2}
+        assert len(src.numpy()) == len(dst.numpy()) > 0
+
+
+class TestFusedLayers:
+    def test_fused_mha_shapes_and_grad(self):
+        paddle.seed(0)
+        m = incubate.nn.FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                                attn_dropout_rate=0.0)
+        x = t(np.random.RandomState(0).randn(2, 6, 32).astype(np.float32))
+        x.stop_gradient = False
+        out = m(x)
+        assert out.shape == [2, 6, 32]
+        out.sum().backward()
+        assert m.qkv_weight.grad is not None
+
+    def test_fused_encoder_layer_trains(self):
+        paddle.seed(0)
+        layer = incubate.nn.FusedTransformerEncoderLayer(
+            32, 4, 64, dropout_rate=0.0)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=layer.parameters())
+        rs = np.random.RandomState(0)
+        x = t(rs.randn(4, 6, 32).astype(np.float32))
+        y = t(rs.randn(4, 6, 32).astype(np.float32))
+        losses = []
+        for _ in range(10):
+            loss = ((layer(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_normalize_before(self):
+        m = incubate.nn.FusedFeedForward(16, 32, dropout_rate=0.0,
+                                         normalize_before=True)
+        x = t(np.random.RandomState(0).randn(2, 3, 16).astype(np.float32))
+        assert m(x).shape == [2, 3, 16]
+
+
+class TestFunctionalAutograd:
+    def test_vjp(self):
+        func = lambda x: (x * x).sum()
+        x = t(np.array([1.0, 2.0, 3.0], np.float32))
+        out, grad = incubate.autograd.vjp(func, x)
+        np.testing.assert_allclose(float(out), 14.0)
+        np.testing.assert_allclose(grad.numpy(), [2, 4, 6])
+
+    def test_jvp(self):
+        func = lambda x: x * x
+        x = t(np.array([1.0, 2.0], np.float32))
+        v = t(np.array([1.0, 0.0], np.float32))
+        out, jv = incubate.autograd.jvp(func, x, v)
+        np.testing.assert_allclose(jv.numpy(), [2.0, 0.0])
+
+    def test_jacobian(self):
+        func = lambda x: x * x
+        x = t(np.array([1.0, 2.0, 3.0], np.float32))
+        J = incubate.autograd.Jacobian(func, x)
+        np.testing.assert_allclose(J[:].numpy(), np.diag([2.0, 4.0, 6.0]))
+        assert J.shape == [3, 3]
+
+    def test_hessian(self):
+        func = lambda x: (x * x).sum()
+        x = t(np.array([1.0, 2.0], np.float32))
+        H = incubate.autograd.Hessian(func, x)
+        np.testing.assert_allclose(H[:].numpy(), 2 * np.eye(2), atol=1e-6)
+
+
+class TestAutoCheckpoint:
+    def test_resume_epoch_range(self, tmp_path):
+        import paddle_tpu.nn as nn
+
+        epochs_run = []
+        paddle.seed(0)
+        m = nn.Linear(2, 2)
+        rng = incubate.checkpoint.train_epoch_range(5, save_dir=str(tmp_path),
+                                                    name="job1").bind(model=m)
+        for epoch in rng:
+            epochs_run.append(epoch)
+            m.weight.set_value(np.full((2, 2), float(epoch), np.float32))
+            if epoch == 2:
+                break  # simulated crash DURING epoch 2 (before its snapshot)
+
+        # "restart": epoch 2 wasn't snapshotted, so it reruns; weights restore
+        # from the last completed epoch (1)
+        m2 = nn.Linear(2, 2)
+        rng2 = incubate.checkpoint.train_epoch_range(5, save_dir=str(tmp_path),
+                                                     name="job1").bind(model=m2)
+        resumed = []
+        for epoch in rng2:
+            if not resumed:
+                np.testing.assert_allclose(m2.weight.numpy()[0, 0], 1.0)
+            resumed.append(epoch)
+        assert resumed == [2, 3, 4]
+
+
+class TestSharedMemory:
+    def test_tensor_crosses_process(self):
+        import multiprocessing as mp
+
+        import paddle_tpu.incubate.multiprocessing  # installs reducers
+
+        ctx = mp.get_context("spawn")
+        q_in, q_out = ctx.Queue(), ctx.Queue()
+        x = t(np.arange(6, dtype=np.float32).reshape(2, 3))
+        p = ctx.Process(target=_echo_worker, args=(q_in, q_out))
+        p.start()
+        q_in.put(x)
+        out = q_out.get(timeout=60)
+        p.join(timeout=30)
+        np.testing.assert_allclose(np.asarray(out), x.numpy() * 2)
+
+
+def _echo_worker(q_in, q_out):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.incubate.multiprocessing  # noqa: F401
+
+    t_in = q_in.get(timeout=30)
+    q_out.put(t_in.numpy() * 2)
