@@ -205,6 +205,10 @@ def as_tensor(x, dtype=None):
     if isinstance(x, (bool, int, float, complex)):
         # weak-typed scalar: let jnp promote like the reference's scalar attrs do
         return Tensor(jnp.asarray(x), stop_gradient=True)
+    if isinstance(x, jax.Array) or isinstance(x, jax.core.Tracer):
+        # raw jax value (tracer from lax.cond/while_loop bodies, or a user's
+        # jnp array): wrap without forcing a host materialization
+        return Tensor(x, stop_gradient=True)
     if dtype is not None:
         return Tensor(jnp.array(x, dtypes.convert_dtype(dtype)), stop_gradient=True)
     a = np.asarray(x)

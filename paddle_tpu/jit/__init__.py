@@ -101,9 +101,15 @@ class StaticFunction:
         self._param_names = []
 
     def _build_kernel(self, n_inputs, kwargs):
+        from . import dy2static
+
         layer = self._layer
         function = self._function
         param_names = self._param_names
+        # AST conversion first (reference ProgramTranslator): tensor-dependent
+        # if/while/for become lax.cond/while_loop so tracing succeeds
+        raw = function or (layer.forward if layer is not None else None)
+        converted = dy2static.convert_to_static(raw) if raw is not None else None
 
         def kernel(*arrays):
             param_arrays = arrays[:len(param_names)]
@@ -112,13 +118,20 @@ class StaticFunction:
             if layer is not None:
                 state = dict(zip(param_names, param_arrays))
                 with _swapped_state(layer, state), _tracing(), no_grad():
-                    out = (function or layer.forward)(*inputs, **kwargs)
+                    out = converted(*inputs, **kwargs)
             else:
                 with _tracing(), no_grad():
-                    out = function(*inputs, **kwargs)
+                    out = converted(*inputs, **kwargs)
             return _unwrap(out)
 
         return kernel
+
+    @property
+    def code(self):
+        from . import dy2static
+
+        raw = self._function or (self._layer.forward if self._layer else None)
+        return dy2static.get_code(raw)
 
     def __call__(self, *args, **kwargs):
         inputs = _wrap_inputs(args)
@@ -300,3 +313,28 @@ def not_to_static(fn):
 
 def ignore_module(modules):
     pass
+
+
+from . import dy2static  # noqa: E402,F401
+from .dy2static import enable_to_static  # noqa: E402,F401
+
+
+class ProgramTranslator:
+    """Singleton switch parity (reference program_translator.py:775)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static_flag: bool):
+        enable_to_static(enable_to_static_flag)
+
+    def get_code(self, dygraph_func):
+        return dy2static.get_code(dygraph_func)

@@ -1,0 +1,382 @@
+"""Dygraph→static AST transpiler.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/ — ProgramTranslator
+(program_translator.py:775) rewrites the function's AST with ~20 transformers
+(ifelse_transformer, loop_transformer, logical_transformer, ...) so
+tensor-dependent Python control flow becomes `cond`/`while` *ops* in the
+ProgramDesc.
+
+TPU-native: the rewritten control flow lands on XLA's structured primitives —
+`convert_ifelse` → `jax.lax.cond`, `convert_while_loop` → `jax.lax.while_loop`
+— which is exactly what `@to_static` tracing needs: without the rewrite, a
+`if tensor:` raises a concretization error under tracing; with it, the program
+stays one compiled computation with native branches/loops.
+
+Supported subset (the transformers that carry the reference's test weight):
+  * `if`/`elif`/`else` on tensor or python predicates (SSA-style var merging)
+  * `while` on tensor conditions (assigned names become loop carries)
+  * `for i in range(...)` with tensor bounds (lowered to while)
+  * `and`/`or`/`not` via convert_logical_* (short-circuit kept for python values)
+Statements with early `return`/`break`/`continue` inside a transformed block
+fall back to plain Python (they work for concrete predicates, like eager mode).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import threading
+from typing import Callable
+
+import jax
+
+from ..core.tensor import Tensor
+
+_state = threading.local()
+_CONVERTED_CACHE = {}
+_enabled = True
+
+
+def enable_to_static(flag: bool):
+    """ProgramTranslator.enable parity: globally toggle AST conversion."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def _is_tensorish(x):
+    return isinstance(x, Tensor) or isinstance(x, jax.core.Tracer) or \
+        hasattr(x, "aval")
+
+
+# --------------------------------------------------------------- runtime API
+def convert_ifelse(pred, true_fn, false_fn):
+    """`if` lowering: lax.cond when the predicate is traced, python otherwise
+    (reference convert_operators.py convert_ifelse)."""
+    if isinstance(pred, Tensor):
+        pred = pred._data
+    if _is_tensorish(pred):
+        import jax.numpy as jnp
+
+        p = pred
+        if isinstance(p, Tensor):
+            p = p._data
+        p = jnp.reshape(p.astype(bool) if p.dtype != bool else p, ())
+        return jax.lax.cond(p, true_fn, false_fn)
+    return true_fn() if pred else false_fn()
+
+
+def convert_while_loop(cond_fn, body_fn, loop_vars):
+    """`while` lowering: lax.while_loop when the condition is traced
+    (reference convert_while_loop). Loop carries are the assigned names."""
+    first = cond_fn(*loop_vars)
+    if isinstance(first, Tensor) or _is_tensorish(first):
+        import jax.numpy as jnp
+
+        def cond(vs):
+            c = cond_fn(*vs)
+            c = c._data if isinstance(c, Tensor) else c
+            return jnp.reshape(c.astype(bool) if c.dtype != bool else c, ())
+
+        def body(vs):
+            out = body_fn(*vs)
+            return tuple(out) if isinstance(out, tuple) else (out,)
+
+        return jax.lax.while_loop(cond, body, tuple(loop_vars))
+    vs = tuple(loop_vars)
+    while cond_fn(*vs):
+        out = body_fn(*vs)
+        vs = tuple(out) if isinstance(out, tuple) else (out,)
+    return vs
+
+
+def convert_logical_and(x_fn: Callable, y_fn: Callable):
+    x = x_fn()
+    if isinstance(x, Tensor) or _is_tensorish(x):
+        from ..ops import math as M
+
+        return M.logical_and(x, y_fn())
+    return x and y_fn()
+
+
+def convert_logical_or(x_fn: Callable, y_fn: Callable):
+    x = x_fn()
+    if isinstance(x, Tensor) or _is_tensorish(x):
+        from ..ops import math as M
+
+        return M.logical_or(x, y_fn())
+    return x or y_fn()
+
+
+def convert_logical_not(x):
+    if isinstance(x, Tensor) or _is_tensorish(x):
+        from ..ops import math as M
+
+        return M.logical_not(x)
+    return not x
+
+
+# ------------------------------------------------------------- AST analysis
+class _NameCollector(ast.NodeVisitor):
+    """Names assigned at any depth of a block, excluding nested functions."""
+
+    def __init__(self):
+        self.stored = []
+
+    def visit_FunctionDef(self, node):  # don't descend
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Store) and node.id not in self.stored:
+            self.stored.append(node.id)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name) and node.target.id not in self.stored:
+            self.stored.append(node.target.id)
+        self.generic_visit(node)
+
+
+def _assigned_names(stmts):
+    c = _NameCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.stored
+
+
+class _HasEscape(ast.NodeVisitor):
+    """Detects return/break/continue (at this block's depth, not nested fns)."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+
+def _has_escape(stmts) -> bool:
+    v = _HasEscape()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _load(name):
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _store(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+def _jst_call(fn_name, args):
+    return ast.Call(
+        func=ast.Attribute(value=_load("_jst"), attr=fn_name, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+# ---------------------------------------------------------- the transformer
+class _Dy2Static(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # --- bool ops ---
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        out = node.values[0]
+        for rhs in node.values[1:]:
+            out = _jst_call(fn, [
+                ast.Lambda(args=ast.arguments(posonlyargs=[], args=[],
+                                              kwonlyargs=[], kw_defaults=[],
+                                              defaults=[]), body=out),
+                ast.Lambda(args=ast.arguments(posonlyargs=[], args=[],
+                                              kwonlyargs=[], kw_defaults=[],
+                                              defaults=[]), body=rhs),
+            ])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+    # --- if/else ---
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node  # python fallback (concrete predicates only)
+        out_vars = _assigned_names(node.body + node.orelse)
+        if not out_vars:
+            return node  # side-effect-only branches: leave to python
+        uid = self._uid()
+        t_name, f_name = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_load(v) for v in out_vars], ctx=ast.Load()))
+        empty_args = ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[])
+        t_def = ast.FunctionDef(name=t_name, args=empty_args,
+                                body=list(node.body) + [ret], decorator_list=[],
+                                type_params=[])
+        f_body = list(node.orelse) + [ret]
+        f_def = ast.FunctionDef(name=f_name, args=empty_args, body=f_body,
+                                decorator_list=[], type_params=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(v) for v in out_vars],
+                               ctx=ast.Store())],
+            value=_jst_call("convert_ifelse",
+                            [node.test, _load(t_name), _load(f_name)]))
+        return [t_def, f_def, assign]
+
+    # --- while ---
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or node.orelse:
+            return node
+        loop_vars = _assigned_names(node.body)
+        if not loop_vars:
+            return node
+        uid = self._uid()
+        c_name, b_name = f"__dy2st_cond_{uid}", f"__dy2st_body_{uid}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in loop_vars],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        c_def = ast.FunctionDef(
+            name=c_name, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            type_params=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_load(v) for v in loop_vars], ctx=ast.Load()))
+        b_def = ast.FunctionDef(name=b_name, args=args,
+                                body=list(node.body) + [ret], decorator_list=[],
+                                type_params=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(v) for v in loop_vars],
+                               ctx=ast.Store())],
+            value=_jst_call("convert_while_loop",
+                            [_load(c_name), _load(b_name),
+                             ast.Tuple(elts=[_load(v) for v in loop_vars],
+                                       ctx=ast.Load())]))
+        return [c_def, b_def, assign]
+
+    # --- for i in range(...) ---
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (_has_escape(node.body) or node.orelse
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or not isinstance(node.target, ast.Name)
+                or not 1 <= len(node.iter.args) <= 3):
+            return node
+        uid = self._uid()
+        i = node.target.id
+        start_n, stop_n, step_n = (f"__dy2st_start_{uid}", f"__dy2st_stop_{uid}",
+                                   f"__dy2st_step_{uid}")
+        a = node.iter.args
+        start = a[0] if len(a) >= 2 else ast.Constant(value=0)
+        stop = a[1] if len(a) >= 2 else a[0]
+        step = a[2] if len(a) == 3 else ast.Constant(value=1)
+        init = [
+            ast.Assign(targets=[_store(start_n)], value=start),
+            ast.Assign(targets=[_store(stop_n)], value=stop),
+            ast.Assign(targets=[_store(step_n)], value=step),
+            ast.Assign(targets=[_store(i)], value=_load(start_n)),
+        ]
+        # while i*sign < stop*sign:  body;  i += step   (sign via step>0 check
+        # is python-level for constant steps; tensor steps assume positive)
+        if isinstance(step, ast.Constant) and isinstance(step.value, int) and \
+                step.value < 0:
+            test = ast.Compare(left=_load(i), ops=[ast.Gt()],
+                               comparators=[_load(stop_n)])
+        else:
+            test = ast.Compare(left=_load(i), ops=[ast.Lt()],
+                               comparators=[_load(stop_n)])
+        incr = ast.AugAssign(target=_store(i), op=ast.Add(),
+                             value=_load(step_n))
+        loop = ast.While(test=test, body=list(node.body) + [incr], orelse=[])
+        out = init + [self.visit_While(loop)]
+        flat = []
+        for o in out:
+            (flat.extend if isinstance(o, list) else flat.append)(o)
+        return flat
+
+
+# ------------------------------------------------------------- entry points
+def convert_to_static(fn):
+    """Rewrite `fn`'s AST (cached). Returns the original on any failure —
+    code without tensor-dependent control flow behaves identically either way."""
+    if not _enabled:
+        return fn
+    key = getattr(fn, "__func__", fn)
+    if key in _CONVERTED_CACHE:
+        return _CONVERTED_CACHE[key]
+    converted = _convert(fn)
+    _CONVERTED_CACHE[key] = converted
+    return converted
+
+
+def _convert(fn):
+    raw = getattr(fn, "__func__", fn)
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []  # don't re-apply @to_static etc.
+    new_tree = _Dy2Static().visit(tree)
+    ast.fix_missing_locations(new_tree)
+
+    glb = dict(raw.__globals__)
+    from . import dy2static as _jst_mod
+
+    glb["_jst"] = _jst_mod
+    # freevars: bind current closure cell values as globals of the new function
+    if raw.__closure__:
+        for name, cell in zip(raw.__code__.co_freevars, raw.__closure__):
+            try:
+                glb.setdefault(name, cell.cell_contents)
+            except ValueError:
+                return fn  # unfilled cell (recursive def): fall back
+    try:
+        code = compile(new_tree, filename=f"<dy2static {raw.__name__}>",
+                       mode="exec")
+        exec(code, glb)
+        new_fn = glb[fdef.name]
+    except Exception:
+        return fn
+    functools.update_wrapper(new_fn, raw, updated=[])
+    new_fn.__dy2static_source__ = ast.unparse(new_tree)
+    if hasattr(fn, "__self__"):  # rebind methods
+        return new_fn.__get__(fn.__self__, type(fn.__self__))
+    return new_fn
+
+
+def get_code(fn) -> str:
+    """Transformed source (reference StaticFunction.code)."""
+    converted = convert_to_static(fn)
+    return getattr(converted, "__dy2static_source__",
+                   inspect.getsource(getattr(fn, "__func__", fn)))
