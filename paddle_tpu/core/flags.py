@@ -53,3 +53,4 @@ define_flag("eager_op_jit", True, "jit-cache per-op computations in dygraph")
 define_flag("tpu_matmul_precision", "default", "default|high|highest for MXU matmuls")
 define_flag("use_flash_attention", True, "route attention to the Pallas flash kernel on TPU")
 define_flag("seed", 0, "global random seed")
+define_flag("apply_ir_passes", True, "run CSE/DCE/fuse passes before lowering static programs")

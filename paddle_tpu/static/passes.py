@@ -1,0 +1,163 @@
+"""IR pass system over the static Program.
+
+Reference: paddle/fluid/framework/ir/ — SSA Graph + Pass + PassRegistry with
+~150 passes (conv_bn_fuse_pass, coalesce_grad_tensor_pass, ...). TPU-native
+altitude: XLA already performs the heavy fusions/layout work after lowering,
+so the pass surface here operates on the OpDesc list for the things XLA can't
+see — dead fetches, duplicate subexpressions, and op-granularity (which also
+speeds the per-op debug interpreter). The registry/apply surface mirrors the
+reference so strategy code can name passes.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+PASS_REGISTRY: Dict[str, Callable] = {}
+
+
+class ProgramView:
+    """Mutable per-lowering view of a Program: same pass surface, private op
+    list, so one fetch-set's optimization never corrupts another's."""
+
+    def __init__(self, program):
+        import types
+
+        self._block = types.SimpleNamespace(
+            ops=list(program.global_block().ops),
+            vars=program.global_block().vars)
+        self._train = program._train
+        self._var_aliases: Dict[str, str] = {}
+
+    def global_block(self):
+        return self._block
+
+
+def register_pass(name: str):
+    def deco(fn):
+        PASS_REGISTRY[name] = fn
+        fn.pass_name = name
+        return fn
+
+    return deco
+
+
+def apply_pass(program, name: str, fetch_names: Sequence[str] = ()):
+    """Run one registered pass in place; returns the program."""
+    PASS_REGISTRY[name](program, list(fetch_names))
+    return program
+
+
+def apply_default_passes(program, fetch_names: Sequence[str] = ()):
+    for name in ("common_subexpression_elimination", "dead_code_elimination",
+                 "fuse_elementwise"):
+        apply_pass(program, name, fetch_names)
+    return program
+
+
+def _roots(program, fetch_names):
+    roots = set(fetch_names)
+    if program._train is not None:
+        roots.add(program._train[0])  # loss
+    for name, v in program.global_block().vars.items():
+        if getattr(v, "persistable", False):
+            roots.add(name)
+    return roots
+
+
+@register_pass("dead_code_elimination")
+def dead_code_elimination(program, fetch_names):
+    """Drop ops whose outputs nothing consumes (reference ir pass of the same
+    purpose; roots = fetches + loss + persistables)."""
+    block = program.global_block()
+    live = _roots(program, fetch_names)
+    kept: List = []
+    for op in reversed(block.ops):
+        if any(o in live for o in op.output_names):
+            kept.append(op)
+            live.update(op.input_names)
+    kept.reverse()
+    removed = len(block.ops) - len(kept)
+    block.ops = kept
+    return removed
+
+
+@register_pass("common_subexpression_elimination")
+def common_subexpression_elimination(program, fetch_names):
+    """Merge ops with identical (type, inputs, attrs): later occurrences alias
+    the first result (safe: kernels are pure functions of their inputs)."""
+    block = program.global_block()
+    seen: Dict = {}
+    rename: Dict[str, str] = {}
+    kept: List = []
+    for op in block.ops:
+        ins = tuple(rename.get(n, n) for n in op.input_names)
+        try:
+            key = (op.type, ins, tuple(sorted(op.attrs.items())))
+            hash(key)
+        except TypeError:
+            key = None
+        if key is not None and key in seen and \
+                len(seen[key].output_names) == len(op.output_names):
+            for mine, theirs in zip(op.output_names, seen[key].output_names):
+                rename[mine] = theirs
+            continue
+        if rename:
+            op.input_names = [rename.get(n, n) for n in op.input_names]
+        if key is not None:
+            seen[key] = op
+        kept.append(op)
+    merged = len(block.ops) - len(kept)
+    block.ops = kept
+    # propagate renames into any later uses already recorded (fetches handled
+    # by callers reading the rename map via var aliasing in the env replay)
+    program._var_aliases = getattr(program, "_var_aliases", {})
+    program._var_aliases.update(rename)
+    return merged
+
+
+@register_pass("fuse_elementwise")
+def fuse_elementwise(program, fetch_names):
+    """Compose single-consumer chains of one-input ops into one fused OpDesc
+    (the micro analogue of the reference's elementwise fuse passes; XLA
+    re-fuses anyway — this shrinks the op list the interpreter walks)."""
+    block = program.global_block()
+    consumers: Dict[str, int] = {}
+    for op in block.ops:
+        for n in op.input_names:
+            consumers[n] = consumers.get(n, 0) + 1
+    roots = _roots(program, fetch_names)
+
+    from .framework import OpDesc
+
+    kept: List = []
+    i = 0
+    ops = block.ops
+    while i < len(ops):
+        op = ops[i]
+        chain = [op]
+        while (i + 1 < len(ops)
+               and len(chain[-1].output_names) == 1
+               and ops[i + 1].input_names == chain[-1].output_names
+               and len(ops[i + 1].input_names) == 1
+               and consumers.get(chain[-1].output_names[0], 0) == 1
+               and chain[-1].output_names[0] not in roots):
+            chain.append(ops[i + 1])
+            i += 1
+        if len(chain) > 1:
+            kernels = [c.kernel for c in chain]
+
+            def fused_kernel(*args, _ks=tuple(kernels)):
+                out = _ks[0](*args)
+                for k in _ks[1:]:
+                    out = k(out)
+                return out
+
+            kept.append(OpDesc(
+                "fused_" + "_".join(c.type for c in chain), fused_kernel,
+                chain[0].input_names, chain[-1].output_names, {}))
+        else:
+            kept.append(op)
+        i += 1
+    fused = len(block.ops) - len(kept)
+    block.ops = kept
+    return fused

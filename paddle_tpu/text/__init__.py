@@ -5,6 +5,8 @@ Conll05st, WMT14/16). Zero-egress environment: absent real files, each dataset
 falls back to a deterministic synthetic sample set with the same shapes/dtypes
 and a learnable signal, the same hermetic pattern as vision/datasets.
 """
-from .datasets import Conll05st, Imdb, Imikolov, Movielens, UCIHousing
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
+                       WMT14, WMT16)
 
-__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st"]
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st",
+           "WMT14", "WMT16"]

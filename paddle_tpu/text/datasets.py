@@ -129,3 +129,47 @@ class Conll05st(Dataset):
 
     def __len__(self):
         return len(self.words)
+
+
+class _WMTBase(Dataset):
+    """Synthetic parallel corpus with the reference's (src_ids, trg_ids,
+    trg_ids_next) sample shape and <s>/<e>/<unk> special tokens."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, mode="train", src_dict_size=1000, trg_dict_size=1000,
+                 lang="en", size=512, seed=0):
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        n = size if mode == "train" else max(size // 4, 64)
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.samples = []
+        for _ in range(n):
+            slen = rng.randint(3, 12)
+            tlen = rng.randint(3, 12)
+            src = rng.randint(3, src_dict_size, slen).astype(np.int64)
+            trg = rng.randint(3, trg_dict_size, tlen).astype(np.int64)
+            trg_in = np.concatenate([[self.BOS], trg]).astype(np.int64)
+            trg_next = np.concatenate([trg, [self.EOS]]).astype(np.int64)
+            self.samples.append((src, trg_in, trg_next))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+    def get_dict(self, lang="en", reverse=False):
+        vocab = {i: f"w{i}" for i in range(self.src_dict_size)}
+        vocab[0], vocab[1], vocab[2] = "<s>", "<e>", "<unk>"
+        if reverse:
+            return vocab
+        return {v: k for k, v in vocab.items()}
+
+
+class WMT14(_WMTBase):
+    """Reference: python/paddle/text/datasets/wmt14.py."""
+
+
+class WMT16(_WMTBase):
+    """Reference: python/paddle/text/datasets/wmt16.py."""

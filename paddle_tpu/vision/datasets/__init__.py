@@ -83,3 +83,122 @@ class Cifar100(Cifar10):
         super().__init__(*args, **kwargs)
         rng = np.random.RandomState(7)
         self.labels = rng.randint(0, 100, len(self.labels)).astype(np.int64)
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image tree (reference vision/datasets/folder.py).
+    Loads .npy arrays or image files (via PIL when available); samples are
+    (image, class_index) with classes sorted by folder name."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        extensions = extensions or (".npy", ".png", ".jpg", ".jpeg", ".bmp")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(extensions))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive image list without labels (reference folder.py:ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        extensions = extensions or (".npy", ".png", ".jpg", ".jpeg", ".bmp")
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append(path)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+
+        return np.asarray(Image.open(path))
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(f"cannot load {path}: PIL unavailable") from e
+
+
+class Flowers(Dataset):
+    """Flowers-102 (synthetic fallback, shapes per the reference dataset)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None,
+                 size=256, seed=0):
+        self.transform = transform
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        n = size if mode == "train" else max(size // 4, 64)
+        self.labels = rng.randint(0, 102, n).astype(np.int64)
+        self.images = (rng.rand(n, 3, 96, 96) * 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation (synthetic fallback: image + label mask pairs)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, size=64, seed=0):
+        self.transform = transform
+        rng = np.random.RandomState(seed if mode == "train" else seed + 1)
+        n = size if mode == "train" else max(size // 4, 16)
+        self.images = (rng.rand(n, 3, 128, 128) * 255).astype(np.uint8)
+        self.labels = rng.randint(0, 21, (n, 128, 128)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        lab = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lab
+
+    def __len__(self):
+        return len(self.images)

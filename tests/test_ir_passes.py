@@ -1,0 +1,106 @@
+"""IR pass system + per-op debug interpreter tests (reference
+framework/ir pass registry; classic Executor walk as debug mode)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.static import passes
+
+
+def _build_program():
+    """x -> relu -> exp (fetched), plus a dead branch and a duplicate relu."""
+    paddle.enable_static()
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4], "float32")
+        a = paddle.nn.functional.relu(x)
+        b = paddle.exp(a)
+        dead = paddle.tanh(x) * 3.0       # nothing fetches this
+        dup = paddle.nn.functional.relu(x)  # identical to `a`
+        c = b + dup
+    paddle.disable_static()
+    return main, x, c
+
+
+class TestPasses:
+    def test_dce_removes_dead_ops(self):
+        main, x, c = _build_program()
+        view = passes.ProgramView(main)
+        n_before = len(view.global_block().ops)
+        removed = passes.dead_code_elimination(view, [c.name])
+        assert removed >= 2  # tanh + mul of the dead branch
+        assert len(view.global_block().ops) == n_before - removed
+        # the original program keeps every op (view isolation)
+        assert len(main.global_block().ops) == n_before
+
+    def test_cse_merges_duplicates(self):
+        main, x, c = _build_program()
+        view = passes.ProgramView(main)
+        merged = passes.common_subexpression_elimination(view, [c.name])
+        assert merged >= 1  # the duplicate relu folds into the first
+        relus = [op for op in view.global_block().ops if op.type == "relu"]
+        assert len(relus) == 1
+
+    def test_fuse_elementwise_chains(self):
+        paddle.enable_static()
+        main = static.Program()
+        with static.program_guard(main, static.Program()):
+            x = static.data("x", [4], "float32")
+            y = paddle.exp(paddle.tanh(paddle.nn.functional.relu(x)))
+        paddle.disable_static()
+        view = passes.ProgramView(main)
+        fused = passes.fuse_elementwise(view, [y.name])
+        assert fused >= 1
+        assert any(op.type.startswith("fused_") for op in view.global_block().ops)
+
+    def test_executor_results_unchanged_by_passes(self):
+        main, x, c = _build_program()
+        exe = static.Executor()
+        feed = {"x": np.array([-1.0, 0.5, 2.0, -3.0], np.float32)}
+        paddle.set_flags({"apply_ir_passes": True})
+        with_passes = exe.run(main, feed=feed, fetch_list=[c])
+        exe2 = static.Executor()
+        paddle.set_flags({"apply_ir_passes": False})
+        try:
+            without = exe2.run(main, feed=feed, fetch_list=[c])
+        finally:
+            paddle.set_flags({"apply_ir_passes": True})
+        np.testing.assert_allclose(with_passes[0], without[0], rtol=1e-6)
+        ref = np.exp(np.maximum(feed["x"], 0)) + np.maximum(feed["x"], 0)
+        np.testing.assert_allclose(with_passes[0], ref, rtol=1e-5)
+
+    def test_pass_registry(self):
+        assert "dead_code_elimination" in passes.PASS_REGISTRY
+        assert "common_subexpression_elimination" in passes.PASS_REGISTRY
+        assert "fuse_elementwise" in passes.PASS_REGISTRY
+        main, x, c = _build_program()
+        passes.apply_pass(passes.ProgramView(main), "dead_code_elimination",
+                          [c.name])
+
+
+class TestDebugInterpreter:
+    def test_matches_compiled_run(self):
+        main, x, c = _build_program()
+        exe = static.Executor()
+        feed = {"x": np.array([1.0, -2.0, 3.0, 0.0], np.float32)}
+        compiled = exe.run(main, feed=feed, fetch_list=[c])
+        debug = exe.run_debug(main, feed=feed, fetch_list=[c])
+        np.testing.assert_allclose(compiled[0], debug[0], rtol=1e-6)
+        # per-op stats recorded
+        assert len(exe.last_run_stats) == len(main.global_block().ops)
+        assert all(t >= 0 for _, t in exe.last_run_stats)
+
+    def test_nan_pinpointing(self):
+        paddle.enable_static()
+        main = static.Program()
+        with static.program_guard(main, static.Program()):
+            x = static.data("x", [2], "float32")
+            y = paddle.log(x)       # NaN for negative input
+            z = paddle.exp(y)
+        paddle.disable_static()
+        exe = static.Executor()
+        with pytest.raises(FloatingPointError, match="log"):
+            exe.run_debug(main, feed={"x": np.array([-1.0, 1.0], np.float32)},
+                          fetch_list=[z], check_nan_inf=True)
