@@ -17,6 +17,10 @@ from .process_mesh import ProcessMesh
 from .api import shard_tensor, shard_op, reshard
 from .engine import Engine
 from .strategy import Strategy
+from .dist_saver import (  # noqa: F401
+    Converter, load_distributed_checkpoint, load_distributed_state,
+    save_distributed_checkpoint,
+)
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "reshard", "Engine",
            "Strategy"]
