@@ -65,6 +65,14 @@ from . import metric  # noqa: E402
 from . import profiler  # noqa: E402
 from . import device  # noqa: E402
 from . import utils  # noqa: E402
+from . import regularizer  # noqa: E402
+from . import signal  # noqa: E402
+from . import callbacks  # noqa: E402
+from . import hub  # noqa: E402
+from . import sysconfig  # noqa: E402
+from . import reader  # noqa: E402
+from . import onnx  # noqa: E402
+from . import compat  # noqa: E402
 from . import hapi  # noqa: E402
 from .hapi import Model  # noqa: E402
 from .framework import io as _fw_io  # noqa: E402

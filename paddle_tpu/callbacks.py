@@ -1,0 +1,6 @@
+"""paddle.callbacks namespace (reference python/paddle/callbacks.py re-exports
+the hapi callbacks)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    VisualDL,
+)

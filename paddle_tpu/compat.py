@@ -1,0 +1,27 @@
+"""paddle.compat shims (reference python/paddle/compat.py)."""
+
+
+def to_text(obj, encoding="utf-8"):
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    if isinstance(obj, (list, set, tuple)):
+        return type(obj)(to_text(o, encoding) for o in obj)
+    return obj
+
+
+def to_bytes(obj, encoding="utf-8"):
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    if isinstance(obj, (list, set, tuple)):
+        return type(obj)(to_bytes(o, encoding) for o in obj)
+    return obj
+
+
+def get_exception_message(exc):
+    return str(exc)
+
+
+def round(x, d=0):
+    import builtins
+
+    return builtins.round(x, d)

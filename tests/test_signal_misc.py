@@ -1,0 +1,98 @@
+"""paddle.signal (stft/istft roundtrip, frame/overlap_add) + small namespace
+modules (regularizer, hub, reader, callbacks, sysconfig, compat, onnx gate)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip(self):
+        x = np.arange(16, dtype=np.float32)
+        frames = paddle.signal.frame(t(x), frame_length=4, hop_length=4)
+        assert frames.shape == [4, 4]  # [frame_length, n_frames]
+        back = paddle.signal.overlap_add(frames, hop_length=4)
+        np.testing.assert_allclose(back.numpy(), x)
+
+    def test_frame_values(self):
+        x = np.arange(8, dtype=np.float32)
+        frames = paddle.signal.frame(t(x), frame_length=4, hop_length=2).numpy()
+        np.testing.assert_array_equal(frames[:, 0], [0, 1, 2, 3])
+        np.testing.assert_array_equal(frames[:, 1], [2, 3, 4, 5])
+
+    def test_stft_matches_numpy(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(1, 64).astype(np.float32)
+        n_fft, hop = 16, 8
+        win = np.hanning(n_fft).astype(np.float32)
+        out = paddle.signal.stft(t(x), n_fft, hop_length=hop,
+                                 window=t(win), center=False).numpy()
+        # manual reference
+        n_frames = 1 + (64 - n_fft) // hop
+        ref = np.stack([np.fft.rfft(x[0, f * hop:f * hop + n_fft] * win)
+                        for f in range(n_frames)], axis=-1)
+        np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-5)
+
+    def test_stft_istft_roundtrip(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(2, 128).astype(np.float32)
+        n_fft, hop = 32, 8
+        win = np.hanning(n_fft).astype(np.float32)
+        spec = paddle.signal.stft(t(x), n_fft, hop_length=hop, window=t(win))
+        back = paddle.signal.istft(spec, n_fft, hop_length=hop, window=t(win),
+                                   length=128)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-4)
+
+
+class TestSmallNamespaces:
+    def test_regularizer(self):
+        r = paddle.regularizer.L2Decay(1e-4)
+        assert r.coeff == 1e-4 and r._coeff == 1e-4
+        l1 = paddle.regularizer.L1Decay(0.1)
+        p = t(np.array([1.0, -2.0], np.float32))
+        g = l1.apply(p, np.zeros(2, np.float32))
+        np.testing.assert_allclose(np.asarray(g), [0.1, -0.1], rtol=1e-6)
+
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_model(scale=2):\n"
+            "    'docs here'\n"
+            "    return ('model', scale)\n")
+        assert paddle.hub.list(str(tmp_path)) == ["tiny_model"]
+        assert "docs" in paddle.hub.help(str(tmp_path), "tiny_model")
+        assert paddle.hub.load(str(tmp_path), "tiny_model", scale=3) == ("model", 3)
+        with pytest.raises(RuntimeError, match="zero-egress"):
+            paddle.hub.load("user/repo", "m", source="github")
+
+    def test_reader_decorators(self):
+        base = lambda: iter(range(10))
+        assert len(list(paddle.reader.firstn(base, 3)())) == 3
+        shuffled = list(paddle.reader.shuffle(base, 5)())
+        assert sorted(shuffled) == list(range(10))
+        chained = list(paddle.reader.chain(base, base)())
+        assert len(chained) == 20
+        mapped = list(paddle.reader.map_readers(lambda a, b: a + b, base, base)())
+        assert mapped[3] == 6
+
+    def test_callbacks_namespace(self):
+        assert paddle.callbacks.EarlyStopping is not None
+        assert paddle.callbacks.ModelCheckpoint is not None
+
+    def test_sysconfig(self):
+        assert paddle.sysconfig.get_include().endswith("include")
+        assert paddle.sysconfig.get_lib().endswith("libs")
+
+    def test_compat(self):
+        assert paddle.compat.to_text(b"abc") == "abc"
+        assert paddle.compat.to_bytes("abc") == b"abc"
+        assert paddle.compat.to_text([b"a", b"b"]) == ["a", "b"]
+
+    def test_onnx_gated(self):
+        with pytest.raises((RuntimeError, NotImplementedError)):
+            paddle.onnx.export(None, "/tmp/x")
