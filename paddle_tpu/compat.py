@@ -22,6 +22,11 @@ def get_exception_message(exc):
 
 
 def round(x, d=0):
-    import builtins
+    """py2-style round: half away from zero, returns float (the reference
+    shim's whole purpose; python3's builtin does banker's rounding)."""
+    import math
 
-    return builtins.round(x, d)
+    p = 10 ** d
+    if x >= 0.0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    return float(math.ceil((x * p) + math.copysign(0.5, x))) / p

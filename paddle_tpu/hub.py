@@ -14,7 +14,16 @@ def _load_hubconf(repo_dir):
         raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
     spec = importlib.util.spec_from_file_location("hubconf", path)
     mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    # hubconf files import sibling modules relative to the repo (reference hub
+    # inserts repo_dir into sys.path around the import)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        try:
+            sys.path.remove(repo_dir)
+        except ValueError:
+            pass
     return mod
 
 

@@ -29,11 +29,18 @@ class Optimizer:
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         wd = weight_decay
+        self._l1_decay = None
         if wd is None:
             wd = 0.0
         elif not isinstance(wd, float):
-            # L2Decay object parity
-            wd = float(getattr(wd, "_coeff", getattr(wd, "coeff", wd)))
+            if getattr(wd, "_is_l1", False):
+                # L1Decay as global weight_decay: applied as a grad penalty in
+                # step(), NOT folded into the rules' (L2) weight_decay
+                self._l1_decay = wd
+                wd = 0.0
+            else:
+                # L2Decay object parity
+                wd = float(getattr(wd, "_coeff", getattr(wd, "coeff", wd)))
         self._weight_decay = wd
         self._states = {}  # id(param) -> state tuple
         self._step_count = 0
@@ -78,6 +85,16 @@ class Optimizer:
         for p, g in params_grads:
             if g is None:
                 continue
+            # L1 regularization (per-param ParamAttr(regularizer=L1Decay) or
+            # optimizer-level weight_decay=L1Decay): grad += coeff*sign(param)
+            # — the l1_decay op of the reference
+            reg = getattr(p, "regularizer", None)
+            if reg is None or not getattr(reg, "_is_l1", False):
+                reg = self._l1_decay
+            if reg is not None and getattr(reg, "_is_l1", False):
+                from ..core.tensor import Tensor as _T
+
+                g = _T(reg.apply(p, g._data))
             st = self._states.get(id(p))
             if st is None:
                 st = funct.init_state(self._rule, p._data)

@@ -36,12 +36,29 @@ def chain(*readers):
     return reader_
 
 
-def compose(*readers):
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment=True):
     def reader_():
-        for items in zip(*[r() for r in readers]):
+        iters = [iter(r()) for r in readers]
+        while True:
+            items = []
+            stopped = 0
+            for it in iters:
+                try:
+                    items.append(next(it))
+                except StopIteration:
+                    stopped += 1
+            if stopped:
+                if check_alignment and stopped != len(iters):
+                    raise ComposeNotAligned(
+                        "composed readers have different lengths")
+                return
             out = []
-            for it in items:
-                out.extend(it if isinstance(it, tuple) else (it,))
+            for item in items:
+                out.extend(item if isinstance(item, tuple) else (item,))
             yield tuple(out)
 
     return reader_

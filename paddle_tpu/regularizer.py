@@ -20,7 +20,10 @@ class L2Decay(WeightDecayRegularizer):
 
 class L1Decay(WeightDecayRegularizer):
     """L1 penalty: grad += coeff * sign(param). Applied by Optimizer.step when a
-    parameter carries this regularizer (reference appends the l1_decay op)."""
+    parameter carries this regularizer or when passed as the optimizer's
+    weight_decay (reference appends the l1_decay op)."""
+
+    _is_l1 = True
 
     def apply(self, param, grad_data):
         import jax.numpy as jnp

@@ -16,6 +16,9 @@ from .ops._helpers import t_
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
     """Slice overlapping frames of the last (or first) axis."""
+    if axis not in (0, -1):
+        raise ValueError(f"frame supports axis 0 or -1 (reference contract), "
+                         f"got {axis}")
 
     def kernel(a, frame_length, hop_length, axis):
         if axis in (-1, a.ndim - 1):
@@ -37,24 +40,29 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
                   "axis": axis})
 
 
+def _scatter_add_frames(frames, hop_length):
+    """[..., n_frames, frame_length] -> [..., out_len] in ONE scatter-add."""
+    n_frames, fl = frames.shape[-2], frames.shape[-1]
+    out_len = (n_frames - 1) * hop_length + fl
+    idx = (hop_length * jnp.arange(n_frames)[:, None]
+           + jnp.arange(fl)[None, :])                  # [n_frames, fl]
+    out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+    return out.at[..., idx].add(frames)
+
+
 def overlap_add(x, hop_length, axis=-1, name=None):
     """Inverse of frame: add overlapping frames back together."""
+    if axis not in (0, -1):
+        raise ValueError(f"overlap_add supports axis 0 or -1, got {axis}")
 
     def kernel(a, hop_length, axis):
         if axis in (-1, a.ndim - 1):
-            fl, n_frames = a.shape[-2], a.shape[-1]
-            out_len = (n_frames - 1) * hop_length + fl
-            out = jnp.zeros(a.shape[:-2] + (out_len,), a.dtype)
-            for f in range(n_frames):
-                out = out.at[..., f * hop_length:f * hop_length + fl].add(
-                    a[..., :, f])
-            return out
-        fl, n_frames = a.shape[1], a.shape[0]
-        out_len = (n_frames - 1) * hop_length + fl
-        out = jnp.zeros((out_len,) + a.shape[2:], a.dtype)
-        for f in range(n_frames):
-            out = out.at[f * hop_length:f * hop_length + fl].add(a[f])
-        return out
+            # [..., frame_length, n_frames] -> [..., n_frames, frame_length]
+            return _scatter_add_frames(jnp.swapaxes(a, -1, -2), hop_length)
+        # axis 0: [n_frames, frame_length, ...] -> [..., n_frames, frame_length]
+        moved = jnp.moveaxis(jnp.moveaxis(a, 0, -1), 0, -1)
+        out = _scatter_add_frames(moved, hop_length)
+        return jnp.moveaxis(out, -1, 0)
 
     return apply("overlap_add", kernel, [t_(x)],
                  {"hop_length": hop_length, "axis": axis})
@@ -115,23 +123,21 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
         if onesided:
             frames = jnp.fft.irfft(frames_f, n=n_fft, axis=-1)
         else:
-            frames = jnp.fft.ifft(frames_f, n=n_fft, axis=-1).real
+            frames = jnp.fft.ifft(frames_f, n=n_fft, axis=-1)
+            if not return_complex:
+                frames = frames.real
         if maybe_win:
             w = maybe_win[0]
             if win_length < n_fft:
                 lp = (n_fft - win_length) // 2
                 w = jnp.pad(w, (lp, n_fft - win_length - lp))
         else:
-            w = jnp.ones((n_fft,), frames.dtype)
+            w = jnp.ones((n_fft,), jnp.float32)
         frames = frames * w
         n_frames = frames.shape[-2]
-        out_len = (n_frames - 1) * hop_length + n_fft
-        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
-        env = jnp.zeros((out_len,), frames.dtype)
-        for f in range(n_frames):
-            sl = slice(f * hop_length, f * hop_length + n_fft)
-            out = out.at[..., sl].add(frames[..., f, :])
-            env = env.at[sl].add(w * w)
+        out = _scatter_add_frames(frames, hop_length)   # one scatter-add
+        env = _scatter_add_frames(
+            jnp.broadcast_to(w * w, (n_frames, n_fft)), hop_length)
         out = out / jnp.maximum(env, 1e-11)
         if center:
             out = out[..., n_fft // 2: out.shape[-1] - n_fft // 2]

@@ -39,6 +39,22 @@ class TestSignal:
                         for f in range(n_frames)], axis=-1)
         np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-5)
 
+    def test_frame_rejects_middle_axis(self):
+        with pytest.raises(ValueError, match="axis"):
+            paddle.signal.frame(t(np.zeros((2, 8, 2), np.float32)), 4, 2, axis=1)
+        with pytest.raises(ValueError, match="axis"):
+            paddle.signal.overlap_add(t(np.zeros((2, 4, 3), np.float32)), 2,
+                                      axis=1)
+
+    def test_istft_return_complex(self):
+        rs = np.random.RandomState(2)
+        x = (rs.randn(32) + 1j * rs.randn(32)).astype(np.complex64)
+        spec = paddle.signal.stft(t(x), 16, hop_length=4, onesided=False)
+        back = paddle.signal.istft(spec, 16, hop_length=4, onesided=False,
+                                   return_complex=True, length=32)
+        assert paddle.is_complex(back)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-4)
+
     def test_stft_istft_roundtrip(self):
         rs = np.random.RandomState(1)
         x = rs.randn(2, 128).astype(np.float32)
@@ -58,6 +74,38 @@ class TestSmallNamespaces:
         p = t(np.array([1.0, -2.0], np.float32))
         g = l1.apply(p, np.zeros(2, np.float32))
         np.testing.assert_allclose(np.asarray(g), [0.1, -0.1], rtol=1e-6)
+
+    def test_l1_decay_actually_applies_in_step(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(2, 2, bias_attr=False)
+        lin.weight.set_value(np.array([[1.0, -1.0], [2.0, -2.0]], np.float32))
+        opt = paddle.optimizer.SGD(
+            learning_rate=1.0, parameters=lin.parameters(),
+            weight_decay=paddle.regularizer.L1Decay(0.5))
+        x = t(np.zeros((1, 2), np.float32))
+        lin(x).sum().backward()  # zero grads: only the L1 term moves weights
+        w0 = lin.weight.numpy().copy()
+        opt.step()
+        np.testing.assert_allclose(lin.weight.numpy(),
+                                   w0 - 0.5 * np.sign(w0), rtol=1e-6)
+
+    def test_l1_per_param_regularizer(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(2, 2, bias_attr=False,
+                        weight_attr=paddle.ParamAttr(
+                            regularizer=paddle.regularizer.L1Decay(0.25)))
+        lin.weight.set_value(np.array([[4.0, -4.0], [4.0, -4.0]], np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=1.0,
+                                   parameters=lin.parameters())
+        lin(t(np.zeros((1, 2), np.float32))).sum().backward()
+        w0 = lin.weight.numpy().copy()
+        opt.step()
+        np.testing.assert_allclose(lin.weight.numpy(),
+                                   w0 - 0.25 * np.sign(w0), rtol=1e-6)
 
     def test_hub_local(self, tmp_path):
         (tmp_path / "hubconf.py").write_text(
@@ -92,6 +140,25 @@ class TestSmallNamespaces:
         assert paddle.compat.to_text(b"abc") == "abc"
         assert paddle.compat.to_bytes("abc") == b"abc"
         assert paddle.compat.to_text([b"a", b"b"]) == ["a", "b"]
+        # py2 semantics: half away from zero, float result
+        assert paddle.compat.round(2.5) == 3.0
+        assert paddle.compat.round(-2.5) == -3.0
+        assert isinstance(paddle.compat.round(2.5), float)
+
+    def test_compose_alignment(self):
+        short = lambda: iter(range(3))
+        long_ = lambda: iter(range(5))
+        with pytest.raises(paddle.reader.ComposeNotAligned):
+            list(paddle.reader.compose(short, long_)())
+        ok = list(paddle.reader.compose(short, short)())
+        assert ok == [(0, 0), (1, 1), (2, 2)]
+
+    def test_hub_sibling_import(self, tmp_path):
+        (tmp_path / "helpers.py").write_text("VALUE = 42\n")
+        (tmp_path / "hubconf.py").write_text(
+            "import helpers\n"
+            "def get():\n    return helpers.VALUE\n")
+        assert paddle.hub.load(str(tmp_path), "get") == 42
 
     def test_onnx_gated(self):
         with pytest.raises((RuntimeError, NotImplementedError)):
