@@ -77,3 +77,26 @@ def ifftshift(x, axes=None, name=None):
 __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
            "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
            "rfftfreq", "fftshift", "ifftshift"]
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply("hfft2", lambda a, s, axes, norm: jnp.fft.hfft2(a, s=s, axes=axes,
+                 norm=norm), [_t(x)], {"s": s, "axes": tuple(axes), "norm": norm})
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply("ihfft2", lambda a, s, axes, norm: jnp.fft.ihfft2(a, s=s,
+                 axes=axes, norm=norm), [_t(x)], {"s": s, "axes": tuple(axes),
+                 "norm": norm})
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply("hfftn", lambda a, s, axes, norm: jnp.fft.hfftn(a, s=s, axes=axes,
+                 norm=norm), [_t(x)],
+                 {"s": s, "axes": tuple(axes) if axes else None, "norm": norm})
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply("ihfftn", lambda a, s, axes, norm: jnp.fft.ihfftn(a, s=s,
+                 axes=axes, norm=norm), [_t(x)],
+                 {"s": s, "axes": tuple(axes) if axes else None, "norm": norm})

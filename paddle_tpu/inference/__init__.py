@@ -128,3 +128,93 @@ def create_predictor(config: Config) -> Predictor:
 
 
 __all__ = ["Config", "Predictor", "create_predictor"]
+
+
+# ---- parity enums/utilities (reference paddle/inference/__init__.py over
+# pybind paddle_infer types) ----
+import enum as _enum
+
+
+class DataType(_enum.Enum):
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+class PlaceType(_enum.Enum):
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    NPU = 3
+    TPU = 10
+
+
+class PrecisionType(_enum.Enum):
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+class Tensor:
+    """Zero-copy handle parity (reference paddle_infer.Tensor): wraps the
+    predictor's named input/output buffer."""
+
+    def __init__(self, name, store):
+        self._name = name
+        self._store = store
+
+    def name(self):
+        return self._name
+
+    def copy_from_cpu(self, arr):
+        import numpy as _np
+
+        self._store[self._name] = _np.asarray(arr)
+
+    def copy_to_cpu(self):
+        import numpy as _np
+
+        return _np.asarray(self._store[self._name])
+
+    def shape(self):
+        return list(self._store[self._name].shape)
+
+
+def get_version():
+    from ..version import full_version
+
+    return f"paddle_tpu {full_version} (XLA inference path)"
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # no TensorRT in a TPU build
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def get_num_bytes_of_data_type(dtype):
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2}
+    return sizes[dtype]
+
+
+class PredictorPool:
+    """N predictors over one config (reference paddle_infer.PredictorPool);
+    XLA executables are thread-compatible so these share the loaded program."""
+
+    def __init__(self, config, size=1):
+        self._predictors = [Predictor(config) for _ in range(size)]
+
+    def retrive(self, idx):  # reference spells it this way
+        return self._predictors[idx]
+
+    retrieve = retrive

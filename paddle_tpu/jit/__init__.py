@@ -338,3 +338,43 @@ class ProgramTranslator:
 
     def get_code(self, dygraph_func):
         return dy2static.get_code(dygraph_func)
+
+
+_code_level = 0
+
+
+def set_code_level(level=100):
+    """Log transformed code (reference dygraph_to_static logging_utils)."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _code_level
+    _code_level = level
+
+
+class TracedLayer:
+    """Reference jit.TracedLayer: trace a dygraph layer with example inputs
+    into a static program; here the trace is the StaticFunction program and
+    save_inference_model reuses jit.save's StableHLO artifact."""
+
+    def __init__(self, layer, inputs):
+        self._layer = layer
+        self._static = StaticFunction(layer=layer, function=layer.forward)
+        self._example_inputs = inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        traced = TracedLayer(layer, inputs)
+        return traced(*inputs), traced
+
+    def __call__(self, *args):
+        return self._static(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        from ..static import InputSpec
+
+        specs = [InputSpec(list(t.shape), str(t.dtype)) for t in
+                 self._example_inputs]
+        save(self._layer, path, input_spec=specs)

@@ -179,3 +179,37 @@ class Dirac(Initializer):
 constant = Constant
 normal = Normal
 uniform = Uniform
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init for transposed convs (reference
+    nn/initializer/Bilinear): weight shape [C_out, C_in, k, k]."""
+
+    def __call__(self, shape, dtype):
+        import numpy as _np
+
+        w = _np.zeros(shape, dtype=_np.float32)
+        k = shape[-1]
+        f = int(_np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(_np.prod(shape)):
+            x = i % k
+            y = (i // k) % k
+            w.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        import jax.numpy as _jnp
+
+        return _jnp.asarray(w.astype(_np.dtype(dtype)))
+
+
+_global_initializer = {}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Override default initializers for subsequently created parameters
+    (reference nn/initializer/set_global_initializer). Pass None to reset."""
+    _global_initializer["weight"] = weight_init
+    _global_initializer["bias"] = bias_init
+
+
+def _global_default(is_bias):
+    return _global_initializer.get("bias" if is_bias else "weight")

@@ -87,6 +87,8 @@ def create_parameter(shape, dtype="float32", name=None, attr=None, is_bias=False
     dtype = dtypes.convert_dtype(dtype)
     init = attr.initializer or default_initializer
     if init is None:
+        init = I._global_default(is_bias)  # set_global_initializer override
+    if init is None:
         init = I.Constant(0.0) if is_bias else I.XavierNormal()
     data = init(tuple(int(s) for s in shape), dtype)
     p = Parameter(data, trainable=attr.trainable, name=attr.name or "")
@@ -178,6 +180,8 @@ class Layer:
             return None
         dtype = dtypes.convert_dtype(dtype or self._dtype)
         init = attr.initializer or default_initializer
+        if init is None:
+            init = I._global_default(is_bias)  # set_global_initializer override
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         data = init(tuple(int(s) for s in shape), dtype)

@@ -253,3 +253,6 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
 
 def cond(x, p=None, name=None):
     return Tensor(jnp.linalg.cond(t_(x)._data, p=p))
+
+
+inv = inverse  # paddle.linalg.inv alias

@@ -197,3 +197,27 @@ class Benchmark:
 
 def load_profiler_result(path):
     raise NotImplementedError
+
+
+class SortedKeys(enum.Enum):
+    """Summary-table sort keys (reference profiler/profiler_statistic.py)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """Profiler on_trace_ready exporter (reference exports the paddle profiler
+    proto; here the portable artifact is the chrome trace, same directory
+    contract)."""
+
+    def handler(prof):
+        prof.export(dir_name, format="json")
+
+    return handler

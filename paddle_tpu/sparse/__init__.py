@@ -163,3 +163,65 @@ def transpose(x, perm):
 __all__ = ["SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor", "add",
            "matmul", "masked_matmul", "relu", "sin", "tanh", "sqrt", "abs",
            "neg", "transpose"]
+
+
+# ---- sparse layers (reference python/paddle/sparse/layer/): activation +
+# 3-D (submanifold) sparse convolution over SparseCooTensor point clouds ----
+class ReLU:
+    """Sparse ReLU on the stored values (reference sparse/layer/activation.py)."""
+
+    def __call__(self, x):
+        if isinstance(x, SparseCooTensor):
+            return relu(x)
+        return Tensor(jax.nn.relu(x._data))
+
+
+class Conv3D:
+    """Sparse 3-D convolution on NDHWC SparseCooTensor (reference
+    sparse/layer/conv.py, gpu sparse convolution kernels). Densify ->
+    lax.conv -> re-sparsify: on TPU the dense conv IS the MXU fast path; the
+    sparse layout is a memory format here, same numerics as the reference."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None, subm=False):
+        from ..nn import initializer as I
+        from ..nn.layer import create_parameter
+        from ..nn.layers.conv_pool import _ntuple
+
+        ks = _ntuple(kernel_size, 3)
+        fan_in = in_channels * int(np.prod(ks))
+        self.weight = create_parameter(
+            (out_channels, in_channels) + tuple(ks), "float32",
+            default_initializer=I.Normal(0.0, (2.0 / fan_in) ** 0.5))
+        self.bias = None if bias_attr is False else create_parameter(
+            (out_channels,), "float32", is_bias=True)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups = groups
+        self.subm = subm
+
+    def __call__(self, x):
+        from ..ops import nn_functional as F
+
+        is_sparse = isinstance(x, SparseCooTensor)
+        dense = x.to_dense() if is_sparse else x
+        d = Tensor(jnp.moveaxis(dense._data, -1, 1))  # NDHWC -> NCDHW
+        out = F.conv3d(d, self.weight, self.bias, self.stride, self.padding,
+                       self.dilation, self.groups)
+        out_nd = jnp.moveaxis(out._data, 1, -1)       # back to NDHWC
+        if not is_sparse:
+            return Tensor(out_nd)
+        if self.subm:
+            # submanifold: output sparsity pattern == input pattern
+            idx = x._bcoo.indices                      # [nnz, sparse_dim]
+            sd = idx.shape[1]
+            vals = out_nd[tuple(idx[:, i] for i in range(sd))]  # [nnz, C]
+            bcoo = jsparse.BCOO((vals, idx), shape=tuple(out_nd.shape))
+            return SparseCooTensor(bcoo, stop_gradient=x.stop_gradient)
+        bcoo = jsparse.BCOO.fromdense(out_nd, n_dense=1)
+        return SparseCooTensor(bcoo, stop_gradient=x.stop_gradient)
+
+
+class SubmConv3D(Conv3D):
+    def __init__(self, *args, **kwargs):
+        kwargs["subm"] = True
+        super().__init__(*args, **kwargs)

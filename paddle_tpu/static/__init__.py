@@ -17,6 +17,32 @@ from .executor import (  # noqa: F401
     global_scope, scope_guard,
 )
 from . import nn  # noqa: F401
+from .io import (  # noqa: F401
+    deserialize_persistables, deserialize_program, load, load_from_file,
+    load_inference_model, load_program_state, normalize_program, save,
+    save_inference_model, save_to_file, serialize_persistables,
+    serialize_program, set_program_state,
+)
+from .misc import (  # noqa: F401
+    ExponentialMovingAverage, IpuCompiledProgram, IpuStrategy, Print,
+    WeightNormParamAttr, accuracy, auc, cpu_places, create_global_var,
+    create_parameter, cuda_places, device_guard, gradients, ipu_shard_guard,
+    mlu_places, npu_places, py_func, xpu_places,
+)
+
+# ParallelExecutor parity: multi-device execution happens through pjit/GSPMD
+# in this build; the class accepts the reference surface and runs the program
+# through the (single fused computation) Executor.
+class ParallelExecutor:
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None):
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list, return_numpy=return_numpy)
 
 
 class InputSpec:

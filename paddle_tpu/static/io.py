@@ -1,0 +1,166 @@
+"""Static-graph persistence + misc utilities.
+
+Reference: python/paddle/static/io.py (save/load_inference_model,
+serialize_program/persistables, save/load_to_file, normalize_program) and
+fluid/io.py (save/load, load_program_state/set_program_state). TPU-native:
+a "program" serializes as the recorded OpDesc replay spec via pickle of its
+structural description + captured parameter arrays; inference artifacts are
+self-contained (the Executor re-lowers on load)."""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as np
+
+try:  # kernels are closures: cloudpickle serializes them, stdlib cannot
+    import cloudpickle as _kpickle
+except ImportError:  # pragma: no cover
+    _kpickle = pickle
+
+from .framework import Program, Variable, default_main_program
+
+
+# ------------------------------------------------------ program state (params)
+def load_program_state(model_path, var_list=None):
+    """Read a saved state into {name: ndarray} (reference io.load_program_state)."""
+    path = model_path if model_path.endswith(".pdparams") else \
+        model_path + ".pdparams"
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    return {k: np.asarray(v) for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    """Overwrite the program's captured parameters (reference
+    io.set_program_state)."""
+    import jax.numpy as jnp
+
+    missing = []
+    for name, arr in state_dict.items():
+        t = program._captures.get(name)
+        if t is None:
+            missing.append(name)
+            continue
+        t._data = jnp.asarray(np.asarray(arr), dtype=t._data.dtype)
+    return missing
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Persist the program's parameters (reference static.save -> .pdparams +
+    .pdopt; optimizer state lives on the program here)."""
+    state = {n: np.asarray(t._data) for n, t in program._captures.items()
+             if getattr(t, "persistable", False) or not t.stop_gradient}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+    opt_state = {n: [np.asarray(s) for s in st]
+                 for n, st in getattr(program, "_opt_state", {}).items()}
+    with open(model_path + ".pdopt", "wb") as f:
+        pickle.dump(opt_state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Restore parameters (+ optimizer state if present)."""
+    set_program_state(program, load_program_state(model_path))
+    opt_path = model_path + ".pdopt"
+    if os.path.exists(opt_path):
+        import jax.numpy as jnp
+
+        with open(opt_path, "rb") as f:
+            opt_state = pickle.load(f)
+        program._opt_state = {
+            n: tuple(jnp.asarray(s) for s in st) for n, st in opt_state.items()}
+
+
+# ---------------------------------------------------- inference model save/load
+def normalize_program(program, feeds, fetches):
+    """Prune to the inference slice (reference normalize_program): clone
+    without the training mark; passes trim dead ops at lowering."""
+    pruned = program.clone(for_test=True)
+    pruned._inference_feeds = [v.name if isinstance(v, Variable) else str(v)
+                               for v in feeds]
+    pruned._inference_fetches = [v.name if isinstance(v, Variable) else str(v)
+                                 for v in fetches]
+    return pruned
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None, **kwargs):
+    program = program or default_main_program()
+    ops = [{"type": op.type, "inputs": op.input_names,
+            "outputs": op.output_names, "attrs": op.attrs,
+            "kernel": _kpickle.dumps(op.kernel)}
+           for op in program.global_block().ops]
+    meta = {
+        "ops": ops,
+        "feeds": [v.name if isinstance(v, Variable) else str(v)
+                  for v in (feed_vars or [])],
+        "fetches": [v.name if isinstance(v, Variable) else str(v)
+                    for v in (fetch_vars or [])],
+        "var_shapes": {n: (list(v.shape), str(v.dtype))
+                       for n, v in program.global_block().vars.items()},
+    }
+    return pickle.dumps(meta)
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
+                           **kwargs):
+    program = program or default_main_program()
+    state = {n: np.asarray(t._data) for n, t in program._captures.items()}
+    return pickle.dumps(state)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    meta = pickle.loads(data)
+    from .framework import OpDesc
+
+    prog = Program()
+    block = prog.global_block()
+    for name, (shape, dtype) in meta["var_shapes"].items():
+        block.create_var(name=name, shape=shape, dtype=dtype)
+    for od in meta["ops"]:
+        block.ops.append(OpDesc(od["type"], _kpickle.loads(od["kernel"]),
+                                od["inputs"], od["outputs"], od["attrs"]))
+    prog._inference_feeds = meta["feeds"]
+    prog._inference_fetches = meta["fetches"]
+    return prog
+
+
+def deserialize_persistables(program, data, executor=None):
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    state = pickle.loads(data)
+    for n, arr in state.items():
+        program._captures[n] = Tensor(jnp.asarray(arr))
+    return program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """`{prefix}.pdmodel` (program) + `{prefix}.pdiparams` (weights)
+    (reference static.save_inference_model)."""
+    program = program or default_main_program()
+    program = normalize_program(program, feed_vars, fetch_vars)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    save_to_file(path_prefix + ".pdmodel",
+                 serialize_program(feed_vars, fetch_vars, program))
+    save_to_file(path_prefix + ".pdiparams",
+                 serialize_persistables(feed_vars, fetch_vars, program))
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program, feed_names, fetch_names) like the reference."""
+    prog = deserialize_program(load_from_file(path_prefix + ".pdmodel"))
+    deserialize_persistables(prog, load_from_file(path_prefix + ".pdiparams"))
+    return prog, prog._inference_feeds, prog._inference_fetches

@@ -1,0 +1,277 @@
+"""Static-mode utilities: Print/py_func/gradients/EMA/places/device_guard/
+accuracy/auc/create_global_var + parity shims (reference python/paddle/static/
+__init__.py surface over fluid layers/optimizer helpers)."""
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .framework import Variable, default_main_program
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    """A persistable filled variable (reference layers.create_global_var)."""
+    import jax.numpy as jnp
+
+    from ..core import dtype as dtypes
+
+    prog = default_main_program()
+    t = Tensor(jnp.full(tuple(shape), value, dtypes.convert_dtype(dtype)))
+    t.persistable = persistable
+    if prog is not None:
+        name = name or prog._unique_name("global_var")
+        prog._captures[name] = t
+        t.name = name
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.layer import create_parameter as _cp
+
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug print op (reference controlflow Print op). Under tracing it
+    becomes a jax.debug.print; eagerly it prints immediately."""
+    from ..core.dispatch import apply
+    from ..ops._helpers import t_
+
+    msg = message or ""
+
+    def kernel(a):
+        import jax
+
+        jax.debug.print(msg + " {x}", x=a)
+        return a
+
+    return apply("print", kernel, [t_(input)])
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host python function as an op (reference py_func_op): runs via
+    pure_callback under tracing, eagerly otherwise."""
+    from ..core.dispatch import apply
+    from ..ops._helpers import t_
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+
+    def kernel(*arrays):
+        import jax
+
+        shapes = [jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(str(o.dtype)))
+                  for o in outs]
+
+        def host(*args):
+            res = func(*args)
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return tuple(np.asarray(r) for r in res)
+
+        result = jax.pure_callback(host, tuple(shapes), *arrays,
+                                   vmap_method="sequential")
+        return tuple(result) if len(shapes) > 1 else result[0]
+
+    return apply("py_func", kernel, [t_(v) for v in xs],
+                 differentiable=backward_func is not None)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Static gradients API (reference static.gradients): marks the program
+    for training on `targets` and returns symbolic grad placeholders resolved
+    at lowering. Eager tensors differentiate immediately via paddle.grad."""
+    from ..core.autograd import grad as eager_grad
+
+    t_list = targets if isinstance(targets, (list, tuple)) else [targets]
+    i_list = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if not isinstance(t_list[0], Variable):
+        return eager_grad(t_list, i_list, grad_outputs=target_gradients,
+                          allow_unused=True)
+    raise NotImplementedError(
+        "symbolic static.gradients placeholders are not supported; use "
+        "append_backward + Optimizer.minimize (grads materialize at lowering)")
+
+
+def device_guard(device=None):
+    """Parity context (reference static.device_guard): XLA owns placement
+    inside a compiled program, so this is an annotation no-op."""
+    return contextlib.nullcontext()
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    return contextlib.nullcontext()
+
+
+class IpuStrategy:  # Graphcore parity shims: accepted, inert on TPU
+    def __init__(self):
+        self.num_ipus = 1
+
+    def set_graph_config(self, **kw):
+        pass
+
+    def set_pipelining_config(self, **kw):
+        pass
+
+    def set_precision_config(self, **kw):
+        pass
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        self._program = program
+
+    def compile(self, feed_list, fetch_list):
+        return self._program
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (CUDAPlace maps onto the TPU chip set)."""
+    import jax
+
+    from ..core.place import CUDAPlace
+
+    if device_ids is None:
+        device_ids = range(len([d for d in jax.devices()
+                                if d.platform != "cpu"]) or 1)
+    return [CUDAPlace(i) for i in device_ids]
+
+
+def xpu_places(device_ids=None):
+    from ..core.place import XPUPlace
+
+    return [XPUPlace(i) for i in (device_ids or [0])]
+
+
+def npu_places(device_ids=None):
+    from ..core.place import NPUPlace
+
+    return [NPUPlace(i) for i in (device_ids or [0])]
+
+
+def mlu_places(device_ids=None):
+    from ..core.place import MLUPlace
+
+    return [MLUPlace(i) for i in (device_ids or [0])]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy op (reference metric op)."""
+    from ..core.dispatch import apply
+    from ..ops._helpers import t_
+
+    def kernel(pred, lab, k):
+        import jax.numpy as jnp
+
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        hit = (topk == lab.reshape(-1, 1)).any(-1)
+        return hit.astype(jnp.float32).mean()
+
+    return apply("accuracy", kernel, [t_(input), t_(label)], {"k": k},
+                 differentiable=False)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC (reference auc op; returns the metric tensor)."""
+    from ..core.dispatch import apply
+    from ..ops._helpers import t_
+
+    def kernel(pred, lab):
+        import jax.numpy as jnp
+
+        p = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 else \
+            pred.reshape(-1)
+        y = lab.reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(p)
+        y_sorted = y[order]
+        n_pos = y.sum()
+        n_neg = y.shape[0] - n_pos
+        ranks = jnp.arange(1, y.shape[0] + 1, dtype=jnp.float32)
+        sum_pos_ranks = (ranks * y_sorted).sum()
+        auc_v = (sum_pos_ranks - n_pos * (n_pos + 1) / 2) / \
+            jnp.maximum(n_pos * n_neg, 1.0)
+        return auc_v
+
+    return apply("auc", kernel, [t_(input), t_(label)], differentiable=False)
+
+
+class WeightNormParamAttr:
+    """Parity attr (reference WeightNormParamAttr): carries dim for weight
+    normalization; consumed like ParamAttr."""
+
+    def __init__(self, dim=None, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        from ..nn.layer import ParamAttr
+
+        self.dim = dim
+        self._attr = ParamAttr(name=name, initializer=initializer,
+                               learning_rate=learning_rate,
+                               regularizer=regularizer, trainable=trainable)
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static.ExponentialMovingAverage):
+    update() after each step; apply()/restore() swap shadow weights in/out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+        self._step = 0
+
+    def _collect(self):
+        if not self._params:
+            prog = default_main_program()
+            if prog is not None:
+                self._params = [(n, t) for n, t in prog._captures.items()
+                                if not t.stop_gradient]
+        return self._params
+
+    def bind(self, parameters):
+        self._params = [(getattr(p, "name", str(i)) or str(i), p)
+                        for i, p in enumerate(parameters)]
+        return self
+
+    def update(self):
+        import jax.numpy as jnp
+
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for n, p in self._collect():
+            prev = self._shadow.get(n, p._data)
+            self._shadow[n] = d * prev + (1 - d) * p._data
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for n, p in self._collect():
+            if n in self._shadow:
+                self._backup[n] = p._data
+                p._data = self._shadow[n]
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for n, p in self._collect():
+            if n in self._backup:
+                p._data = self._backup.pop(n)

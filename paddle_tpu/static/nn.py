@@ -56,3 +56,318 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 
         out = getattr(nn.functional, act)(out)
     return out
+
+
+# ----------------------------------------------------------- control flow
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Static cond (reference static/nn/control_flow.py cond): lowers to
+    lax.cond via the dy2static runtime when the predicate is traced."""
+    from ..jit import dy2static
+
+    return dy2static.convert_ifelse(pred, true_fn or (lambda: None),
+                                    false_fn or (lambda: None))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First matching predicate wins (reference control_flow.case)."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    (pred, fn), rest = pred_fn_pairs[0], pred_fn_pairs[1:]
+    if not rest and default is None:
+        return cond(pred, fn, fn)
+    return cond(pred, fn, lambda: case(rest, default) if rest
+                else (default() if default else None))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Integer dispatch (reference control_flow.switch_case); traced indices
+    lower to lax.switch."""
+    import jax
+
+    from ..core.tensor import Tensor
+
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    keys = sorted(fns)
+    idx = branch_index
+    if isinstance(idx, Tensor):
+        import jax.numpy as jnp
+
+        table = [fns[k] for k in keys] + [default or fns[keys[-1]]]
+        # map branch_index -> position (default for misses)
+        pos = jnp.searchsorted(jnp.asarray(keys), jnp.reshape(idx._data, ()))
+        hit = jnp.isin(jnp.reshape(idx._data, ()), jnp.asarray(keys))
+        pos = jnp.where(hit, pos, len(keys))
+        return jax.lax.switch(pos, table)
+    fn = fns.get(int(idx), default or fns[keys[-1]])
+    return fn()
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Static while (reference control_flow.while_loop) -> lax.while_loop."""
+    from ..jit import dy2static
+
+    out = dy2static.convert_while_loop(cond, body, tuple(loop_vars))
+    return list(out)
+
+
+# ------------------------------------------------------------- layer funcs
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    num = 1 if mode == "all" else (x.shape[1] if mode == "channel"
+                                   else int(np.prod(x.shape[1:])))
+    layer = _nn.PReLU(num_parameters=num, weight_attr=param_attr,
+                      data_format=data_format)
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    layer = _nn.SpectralNorm(weight.shape, dim=dim, power_iters=power_iters,
+                             eps=eps)
+    return layer(weight)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    layer = _nn.Bilinear(x.shape[-1], y.shape[-1], size, weight_attr=param_attr,
+                         bias_attr=bias_attr)
+    out = layer(x, y)
+    if act:
+        from .. import nn as _n
+
+        out = getattr(_n.functional, act)(out)
+    return out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import DeformConv2D
+
+    layer = DeformConv2D(x.shape[1], num_filters, filter_size, stride, padding,
+                         dilation, deformable_groups, groups,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(x, offset, mask)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None, data_layout="NCHW",
+              in_place=False, name=None, moving_mean_name=None,
+              moving_variance_name=None, do_model_average_for_mean_and_var=True,
+              slot_dim=-1, summary_decay_0=0.9999999, enable_scale_and_shift=False):
+    """Data normalization without batch statistics coupling (reference
+    data_norm op: per-feature running mean/scale learned as parameters)."""
+    from ..core.dispatch import apply
+    from ..ops._helpers import t_
+    from ..nn.layer import create_parameter
+
+    d = input.shape[-1]
+    batch_size = create_parameter([d], "float32",
+                                  default_initializer=_nn.initializer.Constant(1e4))
+    batch_sum = create_parameter([d], "float32",
+                                 default_initializer=_nn.initializer.Constant(0.0))
+    batch_square = create_parameter(
+        [d], "float32", default_initializer=_nn.initializer.Constant(1e4))
+
+    def kernel(a, n, s, sq):
+        mean = s / n
+        scale = jnp.sqrt(n / sq)
+        return (a - mean) * scale
+
+    import jax.numpy as jnp
+
+    return apply("data_norm", kernel,
+                 [t_(input), batch_size, batch_sum, batch_square])
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference row_conv op): out[t] =
+    sum_{i=0..k} w[i] * x[t+i]."""
+    from ..core.dispatch import apply
+    from ..ops._helpers import t_
+    from ..nn.layer import create_parameter
+
+    d = input.shape[-1]
+    k = future_context_size + 1
+    w = create_parameter([k, d], "float32")
+
+    def kernel(a, wk):
+        import jax.numpy as jnp
+
+        T = a.shape[-2]
+        pad = jnp.pad(a, [(0, 0)] * (a.ndim - 2) + [(0, k - 1), (0, 0)])
+        out = jnp.zeros_like(a)
+        for i in range(k):
+            out = out + pad[..., i:i + T, :] * wk[i]
+        return out
+
+    out = apply("row_conv", kernel, [t_(input), w])
+    if act:
+        from .. import nn as _n
+
+        out = getattr(_n.functional, act)(out)
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference nce op): sampled-softmax
+    style binary logistic loss over the true class + k noise classes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import random as random_mod
+    from ..core.dispatch import apply
+    from ..nn.layer import create_parameter
+    from ..ops._helpers import t_
+
+    d = input.shape[-1]
+    k = num_neg_samples or 10
+    weight = create_parameter([num_total_classes, d], "float32",
+                              attr=param_attr)
+    bias = create_parameter([num_total_classes], "float32", attr=bias_attr,
+                            is_bias=True)
+    key = random_mod.next_key()
+
+    def kernel(x, lab, w, b):
+        n = x.shape[0]
+        neg = jax.random.randint(key, (n, k), 0, num_total_classes)
+        lab_f = lab.reshape(-1)
+        pos_logit = (x * w[lab_f]).sum(-1) + b[lab_f]
+        neg_logit = jnp.einsum("nd,nkd->nk", x, w[neg]) + b[neg]
+        bce = lambda z, t: jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        loss = bce(pos_logit, 1.0) + bce(neg_logit, 0.0).sum(-1)
+        return loss.reshape(-1, 1)
+
+    return apply("nce", kernel, [t_(input), t_(label), weight, bias],
+                 nondiff_mask=[False, True, False, False])
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    """Viterbi decode over linear-chain CRF emissions (reference crf_decoding
+    op). input: [B, T, n_tags] emissions; transition [n_tags+2, n_tags]
+    (reference layout: row 0 start, row 1 stop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+    from ..nn.layer import create_parameter
+    from ..ops._helpers import t_
+
+    n_tags = input.shape[-1]
+    trans = transition if transition is not None else create_parameter(
+        [n_tags + 2, n_tags], "float32", attr=param_attr)
+
+    def kernel(em, tr):
+        start, stop, T = tr[0], tr[1], tr[2:]
+
+        def decode_one(e):
+            def step(carry, obs):
+                score = carry  # [n_tags]
+                cand = score[:, None] + T  # [from, to]
+                best = cand.max(0) + obs
+                return best, cand.argmax(0)
+
+            init = start + e[0]
+            last, back = jax.lax.scan(step, init, e[1:])
+            last = last + stop
+
+            def backtrack(tag, bp):
+                return bp[tag], bp[tag]
+
+            final = last.argmax()
+            _, path_rev = jax.lax.scan(backtrack, final, back[::-1])
+            return jnp.concatenate([path_rev[::-1], jnp.array([final])])
+
+        return jax.vmap(decode_one)(em)
+
+    return apply("crf_decoding", kernel, [t_(input), t_(trans)],
+                 differentiable=False)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """PS-backed embedding (reference static.nn.sparse_embedding ->
+    distributed_lookup_table): wires a DistributedEmbedding when a PS client
+    is live, dense nn.Embedding otherwise."""
+    from ..distributed.ps.layers import DistributedEmbedding
+
+    layer = _nn.Embedding(size[0], size[1], sparse=True, weight_attr=param_attr)
+    return layer(input)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, offset=0.5, flip=True,
+                   kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (reference multi_box_head): per-scale loc + conf
+    convs over the feature pyramid + prior boxes."""
+    import math as _m
+
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from ..ops import manipulation as P
+
+    n_in = len(inputs)
+    if min_sizes is None:
+        min_ratio, max_ratio = int(min_ratio), int(max_ratio)
+        step = int(_m.floor((max_ratio - min_ratio) / (n_in - 2))) if n_in > 2 else 0
+        min_sizes, max_sizes = [], []
+        for r in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:n_in - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:n_in - 1]
+
+    locs, confs, boxes_all = [], [], []
+    img_h, img_w = image.shape[2], image.shape[3]
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        n_priors = len(ar) * (2 if flip else 1) + (2 if max_sizes else 1)
+        c_in = feat.shape[1]
+        loc = _nn.Conv2D(c_in, n_priors * 4, kernel_size, stride=stride,
+                         padding=pad)(feat)
+        conf = _nn.Conv2D(c_in, n_priors * num_classes, kernel_size,
+                          stride=stride, padding=pad)(feat)
+        fh, fw = feat.shape[2], feat.shape[3]
+        locs.append(P.reshape(P.transpose(loc, (0, 2, 3, 1)), (loc.shape[0], -1, 4)))
+        confs.append(P.reshape(P.transpose(conf, (0, 2, 3, 1)),
+                               (conf.shape[0], -1, num_classes)))
+        # prior boxes for this scale
+        sk = min_sizes[i] / base_size
+        sk2 = (max_sizes[i] / base_size) if max_sizes else sk
+        widths = [sk] + [sk * _m.sqrt(a) for a in ar] + \
+            ([sk / _m.sqrt(a) for a in ar] if flip else []) + [_m.sqrt(sk * sk2)]
+        heights = [sk] + [sk / _m.sqrt(a) for a in ar] + \
+            ([sk * _m.sqrt(a) for a in ar] if flip else []) + [_m.sqrt(sk * sk2)]
+        cx = (np.arange(fw) + offset) / fw
+        cy = (np.arange(fh) + offset) / fh
+        gx, gy = np.meshgrid(cx, cy)
+        pri = []
+        for w_, h_ in zip(widths[:n_priors], heights[:n_priors]):
+            pri.append(np.stack([gx - w_ / 2, gy - h_ / 2, gx + w_ / 2,
+                                 gy + h_ / 2], -1))
+        pri = np.stack(pri, 2).reshape(-1, 4).clip(0, 1)
+        boxes_all.append(pri.astype(np.float32))
+
+    mbox_locs = P.concat(locs, axis=1)
+    mbox_confs = P.concat(confs, axis=1)
+    boxes = Tensor(jnp.asarray(np.concatenate(boxes_all, 0)))
+    variances = Tensor(jnp.full_like(boxes._data, 0.1))
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+import numpy as np  # noqa: E402  (used by layer funcs above)
+
+from .misc import py_func  # noqa: E402,F401
+
+
+from .sequence import (  # noqa: E402,F401
+    sequence_concat, sequence_conv, sequence_enumerate, sequence_expand,
+    sequence_expand_as, sequence_first_step, sequence_last_step, sequence_pad,
+    sequence_pool, sequence_reshape, sequence_reverse, sequence_scatter,
+    sequence_slice, sequence_softmax, sequence_unpad, set_lod,
+)

@@ -1,0 +1,308 @@
+"""LoD sequence ops (reference python/paddle/static/nn sequence_lod.py over
+the fluid sequence_* C++ ops).
+
+LoD convention: variable-length sequences are stored FLATTENED — one
+[total_rows, ...] tensor plus level-1 offsets `lod` = [0, end_0, end_1, ...].
+The reference threads lod inside LoDTensor; here the tensor carries a host
+`.lod` list attached with `set_lod` (offsets are host metadata in the
+reference too — shapes must be static for XLA either way). Differentiable ops
+(pool/softmax/conv/pad/...) run as jnp programs over the static offsets;
+gradients flow through `apply` as usual.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..ops._helpers import t_
+
+
+def set_lod(x, lod: Sequence[int]):
+    """Attach level-1 offsets ([0, e0, e1, ...]) to a tensor."""
+    x = t_(x)
+    x.lod = [int(v) for v in lod]
+    assert x.lod[0] == 0 and x.lod[-1] == x.shape[0], "bad lod offsets"
+    return x
+
+
+def _lod(x) -> List[int]:
+    lod = getattr(x, "lod", None)
+    if lod is None:
+        raise ValueError(
+            "sequence op input needs lod offsets; attach with "
+            "paddle.static.nn.set_lod(tensor, [0, len0, len0+len1, ...])")
+    return lod
+
+
+def _seg_ids(lod):
+    return np.repeat(np.arange(len(lod) - 1), np.diff(lod))
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    x = t_(input)
+    lod = _lod(x)
+    n = len(lod) - 1
+    ids = jnp.asarray(_seg_ids(lod))
+    pt = pool_type.lower()
+
+    def kernel(a, pt):
+        if pt == "sum":
+            return jax.ops.segment_sum(a, ids, num_segments=n)
+        if pt in ("average", "mean"):
+            s = jax.ops.segment_sum(a, ids, num_segments=n)
+            c = jnp.asarray(np.diff(lod)).reshape((-1,) + (1,) * (a.ndim - 1))
+            return s / jnp.maximum(c, 1)
+        if pt == "sqrt":
+            s = jax.ops.segment_sum(a, ids, num_segments=n)
+            c = jnp.asarray(np.diff(lod)).reshape((-1,) + (1,) * (a.ndim - 1))
+            return s / jnp.sqrt(jnp.maximum(c, 1).astype(a.dtype))
+        if pt == "max":
+            out = jax.ops.segment_max(a, ids, num_segments=n)
+            return jnp.where(jnp.isfinite(out), out, pad_value)
+        if pt == "first":
+            return a[jnp.asarray(lod[:-1])]
+        if pt == "last":
+            return a[jnp.asarray(lod[1:]) - 1]
+        raise ValueError(pool_type)
+
+    return apply("sequence_pool", kernel, [x], {"pt": pt})
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    x = t_(input)
+    lod = _lod(x)
+    ids = jnp.asarray(_seg_ids(lod))
+    n = len(lod) - 1
+
+    def kernel(a):
+        flat = a.reshape(-1)
+        mx = jax.ops.segment_max(flat, ids, num_segments=n)
+        e = jnp.exp(flat - mx[ids])
+        s = jax.ops.segment_sum(e, ids, num_segments=n)
+        return (e / s[ids]).reshape(a.shape)
+
+    out = apply("sequence_softmax", kernel, [x])
+    out.lod = lod
+    return out
+
+
+def sequence_reverse(x, name=None):
+    x = t_(x)
+    lod = _lod(x)
+    perm = np.concatenate([np.arange(lod[i], lod[i + 1])[::-1]
+                           for i in range(len(lod) - 1)]) if len(lod) > 1 \
+        else np.arange(0)
+    pidx = jnp.asarray(perm.astype(np.int64))
+
+    def kernel(a):
+        return a[pidx]
+
+    out = apply("sequence_reverse", kernel, [x])
+    out.lod = lod
+    return out
+
+
+def sequence_concat(input, name=None):
+    xs = [t_(v) for v in input]
+    lods = [_lod(v) for v in xs]
+    n = len(lods[0]) - 1
+    order = []
+    offsets = [0] * len(xs)
+    bases = np.cumsum([0] + [v.shape[0] for v in xs[:-1]])
+    new_lod = [0]
+    for i in range(n):
+        for j, lod in enumerate(lods):
+            order.extend(range(bases[j] + lod[i], bases[j] + lod[i + 1]))
+        new_lod.append(len(order))
+    pidx = jnp.asarray(np.array(order, np.int64))
+
+    def kernel(*arrays):
+        return jnp.concatenate(arrays, 0)[pidx]
+
+    out = apply("sequence_concat", kernel, xs)
+    out.lod = new_lod
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat each sequence i of x by the length of y's sequence i."""
+    x = t_(x)
+    y_lod = _lod(t_(y))
+    x_lod = getattr(x, "lod", list(range(x.shape[0] + 1)))
+    reps = np.diff(y_lod)
+    order = []
+    new_lod = [0]
+    for i in range(len(x_lod) - 1):
+        seq = list(range(x_lod[i], x_lod[i + 1]))
+        for _ in range(int(reps[i]) if i < len(reps) else 1):
+            order.extend(seq)
+        new_lod.append(len(order))
+    pidx = jnp.asarray(np.array(order, np.int64))
+    out = apply("sequence_expand", lambda a: a[pidx], [x])
+    out.lod = new_lod
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    """Row i of x repeats len(y_i) times (reference sequence_expand_as)."""
+    x = t_(x)
+    y_lod = _lod(t_(y))
+    reps = np.diff(y_lod)
+    ridx = jnp.asarray(np.repeat(np.arange(x.shape[0]), reps).astype(np.int64))
+    out = apply("sequence_expand_as", lambda a: a[ridx], [x])
+    out.lod = list(y_lod)
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Flattened -> [num_seqs, maxlen, ...] + lengths."""
+    x = t_(x)
+    lod = _lod(x)
+    lens = np.diff(lod)
+    m = maxlen or int(lens.max())
+    n = len(lens)
+    gather = np.zeros((n, m), np.int64)
+    mask = np.zeros((n, m), np.float32)
+    for i in range(n):
+        L = int(lens[i])
+        gather[i, :L] = np.arange(lod[i], lod[i + 1])
+        mask[i, :L] = 1
+    gidx = jnp.asarray(gather)
+    gmask = jnp.asarray(mask)
+    pv = float(pad_value if not isinstance(pad_value, Tensor)
+               else pad_value.item())
+
+    def kernel(a):
+        shaped_mask = gmask.reshape(gmask.shape + (1,) * (a.ndim - 1))
+        return a[gidx] * shaped_mask + pv * (1 - shaped_mask)
+
+    out = apply("sequence_pad", kernel, [x])
+    return out, Tensor(jnp.asarray(lens.astype(np.int64)))
+
+
+def sequence_unpad(x, length, name=None):
+    """[num_seqs, maxlen, ...] + lengths -> flattened with lod."""
+    x = t_(x)
+    lens = np.asarray(t_(length)._data).astype(np.int64)
+    rows = np.concatenate([np.stack([np.full(L, i), np.arange(L)], 1)
+                           for i, L in enumerate(lens)]) if len(lens) else \
+        np.zeros((0, 2), np.int64)
+    ridx = jnp.asarray(rows)
+
+    def kernel(a):
+        return a[ridx[:, 0], ridx[:, 1]]
+
+    out = apply("sequence_unpad", kernel, [x])
+    out.lod = [0] + list(np.cumsum(lens))
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    x = t_(input)
+    lod = _lod(x)
+    d = x.shape[-1]
+    out = apply("sequence_reshape", lambda a: a.reshape(-1, new_dim), [x])
+    out.lod = [int(v * d // new_dim) for v in lod]
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    x = t_(input)
+    lod = _lod(x)
+    offs = np.asarray(t_(offset)._data).reshape(-1)
+    lens = np.asarray(t_(length)._data).reshape(-1)
+    order = []
+    new_lod = [0]
+    for i in range(len(lod) - 1):
+        start = lod[i] + int(offs[i])
+        order.extend(range(start, start + int(lens[i])))
+        new_lod.append(len(order))
+    pidx = jnp.asarray(np.array(order, np.int64))
+    out = apply("sequence_slice", lambda a: a[pidx], [x])
+    out.lod = new_lod
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """Add updates into input rows addressed per-sequence (reference
+    sequence_scatter: seq i of index/updates scatters into row i of input)."""
+    x, idx, upd = t_(input), t_(index), t_(updates)
+    lod = _lod(idx)
+    rows = jnp.asarray(_seg_ids(lod))
+
+    def kernel(a, iv, uv):
+        return a.at[rows, iv.reshape(-1).astype(jnp.int64)].add(uv.reshape(-1))
+
+    return apply("sequence_scatter", kernel, [x, idx, upd],
+                 nondiff_mask=[False, True, False])
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """Sliding id windows per sequence (reference sequence_enumerate)."""
+    x = t_(input)
+    lod = _lod(x)
+    a = np.asarray(x._data).reshape(-1)
+    out = np.full((a.shape[0], win_size), pad_value, a.dtype)
+    for i in range(len(lod) - 1):
+        for r in range(lod[i], lod[i + 1]):
+            for w in range(win_size):
+                if r + w < lod[i + 1]:
+                    out[r, w] = a[r + w]
+    res = Tensor(jnp.asarray(out))
+    res.lod = lod
+    return res
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window convolution over each sequence (reference sequence_conv
+    op): each position sees filter_size rows centered by padding_start."""
+    from ..nn.layer import create_parameter
+    from .. import nn as _n
+
+    x = t_(input)
+    lod = _lod(x)
+    d = x.shape[-1]
+    w = create_parameter([filter_size * d, num_filters], "float32",
+                         attr=param_attr)
+    b = create_parameter([num_filters], "float32", attr=bias_attr, is_bias=True)
+    start = -((filter_size - 1) // 2) if padding_start is None else padding_start
+    # per-position gather indices (host-built from lod; -1 = zero pad)
+    total = x.shape[0]
+    gather = np.zeros((total, filter_size), np.int64)
+    valid = np.zeros((total, filter_size), np.float32)
+    for i in range(len(lod) - 1):
+        for r in range(lod[i], lod[i + 1]):
+            for k in range(filter_size):
+                src = r + start + k
+                if lod[i] <= src < lod[i + 1]:
+                    gather[r, k] = src
+                    valid[r, k] = 1.0
+    gidx = jnp.asarray(gather)
+    gval = jnp.asarray(valid)
+
+    def kernel(a, wk, bk):
+        ctx = a[gidx] * gval[..., None]          # [total, fs, d]
+        ctx = ctx.reshape(a.shape[0], filter_size * d)
+        return ctx @ wk + bk
+
+    out = apply("sequence_conv", kernel, [x, w, b])
+    out.lod = lod
+    if act:
+        out = getattr(_n.functional, act)(out)
+        out.lod = lod
+    return out

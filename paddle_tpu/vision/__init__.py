@@ -1,6 +1,7 @@
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
+from . import ops  # noqa: F401
 
 
 _image_backend = "pil"

@@ -336,3 +336,138 @@ class Transpose:
 
     def __call__(self, img):
         return np.asarray(img).transpose(self.order)
+
+
+# ---------------- functional API (reference vision/transforms/functional.py) ---
+class BaseTransform:
+    """Base for custom transforms (reference transforms.BaseTransform):
+    subclasses implement _apply_image / _apply_* per data kind."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            return self._apply_image(inputs)
+        out = []
+        for key, data in zip(self.keys, inputs):
+            fn = getattr(self, f"_apply_{key}", None)
+            out.append(fn(data) if fn else data)
+        return tuple(out)
+
+
+def _chw(arr):
+    a = np.asarray(arr)
+    return a, (a.ndim == 3 and a.shape[0] in (1, 3, 4))
+
+
+def to_tensor(pic, data_format="CHW"):
+    from ...core.tensor import Tensor
+    import jax.numpy as jnp
+
+    a = np.asarray(pic)
+    if a.ndim == 2:
+        a = a[None] if data_format == "CHW" else a[..., None]
+    elif a.ndim == 3 and data_format == "CHW" and a.shape[-1] in (1, 3, 4) \
+            and a.shape[0] not in (1, 3, 4):
+        a = a.transpose(2, 0, 1)  # HWC -> CHW
+    if a.dtype == np.uint8:
+        a = a.astype(np.float32) / 255.0
+    return Tensor(jnp.asarray(a.astype(np.float32)))
+
+
+def hflip(img):
+    a, chw = _chw(img)
+    return a[..., ::-1] if chw or a.ndim == 2 else a[:, ::-1]
+
+
+def vflip(img):
+    a, chw = _chw(img)
+    return a[..., ::-1, :] if chw else a[::-1]
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(np.asarray(img))
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(np.asarray(img))
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr = np.asarray(img, np.float32)
+    h_ax, w_ax = _hw_axes(arr)
+    h, w = arr.shape[h_ax], arr.shape[w_ax]
+    cy, cx = (h - 1) / 2, (w - 1) / 2
+    th = np.deg2rad(float(angle))
+    yy, xx = np.mgrid[0:h, 0:w]
+    ys = (cy + (yy - cy) * np.cos(th) + (xx - cx) * np.sin(th)).round()
+    xs = (cx - (yy - cy) * np.sin(th) + (xx - cx) * np.cos(th)).round()
+    valid = (ys >= 0) & (ys < h) & (xs >= 0) & (xs < w)
+    ys, xs = ys.clip(0, h - 1).astype(int), xs.clip(0, w - 1).astype(int)
+    if h_ax == 1:  # CHW
+        out = arr[:, ys, xs]
+        return np.where(valid[None], out, fill)
+    out = arr[ys, xs]
+    return np.where(valid if out.ndim == 2 else valid[..., None], out, fill)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(np.asarray(img))
+
+
+def crop(img, top, left, height, width):
+    a, chw = _chw(img)
+    if chw:
+        return a[:, top:top + height, left:left + width]
+    return a[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(np.asarray(img))
+
+
+def adjust_brightness(img, brightness_factor):
+    a, _ = _chw(img)
+    return np.clip(a * brightness_factor, 0, 255 if a.dtype == np.uint8 else 1e9).astype(a.dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = np.asarray(img, np.float32)
+    mean = arr.mean()
+    hi = 255 if arr.max() > 1.5 else 1.0
+    return ((arr - mean) * contrast_factor + mean).clip(0, hi)
+
+
+def adjust_hue(img, hue_factor):
+    """hue_factor in [-0.5, 0.5]: fraction of the hue circle to rotate by."""
+    arr = np.asarray(img, np.float32)
+    chw = _hw_axes(arr) == (1, 2)
+    if arr.ndim != 3 or (arr.shape[0] if chw else arr.shape[-1]) < 3:
+        return arr
+    rgb = arr if not chw else np.moveaxis(arr, 0, -1)
+    hi = 255 if rgb.max() > 1.5 else 1.0
+    x = rgb[..., :3] / hi
+    theta = 2 * np.pi * float(hue_factor)
+    c, s = np.cos(theta), np.sin(theta)
+    to_yiq = np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], np.float32)
+    rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
+    m = np.linalg.inv(to_yiq) @ rot @ to_yiq
+    out3 = (x @ m.T).clip(0, 1) * hi
+    out = np.concatenate([out3, rgb[..., 3:]], -1) if rgb.shape[-1] > 3 else out3
+    return np.moveaxis(out, -1, 0) if chw else out
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    a = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (a - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    return (a - mean) / std

@@ -1,0 +1,254 @@
+"""Static-mode extras: persistence (save/load, inference model round-trip),
+utility ops (accuracy/auc/EMA/Print/py_func), control flow, and the LoD
+sequence_* family (reference static/io.py, static/nn surface)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+snn = static.nn
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _seq(arr, lod):
+    x = t(np.asarray(arr, np.float32))
+    return snn.set_lod(x, lod)
+
+
+class TestSequenceOps:
+    def test_pool_variants(self):
+        x = _seq(np.arange(10).reshape(5, 2), [0, 2, 5])
+        np.testing.assert_allclose(snn.sequence_pool(x, "sum").numpy(),
+                                   [[2, 4], [18, 21]])
+        np.testing.assert_allclose(snn.sequence_pool(x, "average").numpy(),
+                                   [[1, 2], [6, 7]])
+        np.testing.assert_allclose(snn.sequence_pool(x, "max").numpy(),
+                                   [[2, 3], [8, 9]])
+        np.testing.assert_allclose(snn.sequence_first_step(x).numpy(),
+                                   [[0, 1], [4, 5]])
+        np.testing.assert_allclose(snn.sequence_last_step(x).numpy(),
+                                   [[2, 3], [8, 9]])
+
+    def test_softmax_per_sequence(self):
+        x = _seq(np.zeros((5, 1)), [0, 2, 5])
+        out = snn.sequence_softmax(x).numpy().reshape(-1)
+        np.testing.assert_allclose(out[:2], [0.5, 0.5], rtol=1e-6)
+        np.testing.assert_allclose(out[2:], [1 / 3] * 3, rtol=1e-6)
+
+    def test_reverse_concat(self):
+        x = _seq(np.arange(6).reshape(3, 2), [0, 1, 3])
+        rev = snn.sequence_reverse(x).numpy()
+        np.testing.assert_allclose(rev, [[0, 1], [4, 5], [2, 3]])
+        y = _seq(np.arange(6, 10).reshape(2, 2), [0, 1, 2])
+        cat = snn.sequence_concat([x, y])
+        np.testing.assert_allclose(
+            cat.numpy(), [[0, 1], [6, 7], [2, 3], [4, 5], [8, 9]])
+        assert cat.lod == [0, 2, 5]
+
+    def test_pad_unpad_roundtrip(self):
+        x = _seq(np.arange(10).reshape(5, 2), [0, 2, 5])
+        padded, lens = snn.sequence_pad(x, -1.0)
+        assert padded.shape == [2, 3, 2]
+        np.testing.assert_allclose(padded.numpy()[0, 2], [-1, -1])
+        back = snn.sequence_unpad(padded, lens)
+        np.testing.assert_allclose(back.numpy(), x.numpy())
+        assert back.lod == [0, 2, 5]
+
+    def test_expand_as(self):
+        x = _seq(np.array([[1.0], [2.0]]), [0, 1, 2])
+        y = _seq(np.zeros((5, 1)), [0, 2, 5])
+        out = snn.sequence_expand_as(x, y)
+        np.testing.assert_allclose(out.numpy().reshape(-1), [1, 1, 2, 2, 2])
+
+    def test_slice_and_scatter(self):
+        x = _seq(np.arange(10).reshape(5, 2), [0, 2, 5])
+        out = snn.sequence_slice(x, t(np.array([0, 1])), t(np.array([1, 2])))
+        np.testing.assert_allclose(out.numpy(), [[0, 1], [6, 7], [8, 9]])
+        base = t(np.zeros((2, 4), np.float32))
+        idx = snn.set_lod(t(np.array([[0], [3], [1]], np.int64)), [0, 1, 3])
+        upd = snn.set_lod(t(np.array([[5.0], [6.0], [7.0]], np.float32)),
+                          [0, 1, 3])
+        res = snn.sequence_scatter(base, idx, upd)
+        np.testing.assert_allclose(res.numpy(), [[5, 0, 0, 0], [0, 7, 0, 6]])
+
+    def test_enumerate(self):
+        x = snn.set_lod(t(np.array([[1], [2], [3], [4]], np.int64)), [0, 2, 4])
+        out = snn.sequence_enumerate(x, 2, pad_value=0).numpy()
+        np.testing.assert_array_equal(out, [[1, 2], [2, 0], [3, 4], [4, 0]])
+
+    def test_conv_and_grad(self):
+        paddle.seed(0)
+        x = _seq(np.random.RandomState(0).rand(5, 3), [0, 2, 5])
+        x.stop_gradient = False
+        out = snn.sequence_conv(x, num_filters=4, filter_size=3)
+        assert out.shape == [5, 4]
+        out.sum().backward()
+        assert x.grad is not None and x.grad.shape == [5, 3]
+
+    def test_expand(self):
+        x = _seq(np.array([[1.0], [2.0], [3.0]]), [0, 1, 3])
+        y = _seq(np.zeros((5, 1)), [0, 2, 5])
+        out = snn.sequence_expand(x, y)
+        # seq0 ([1]) x2, seq1 ([2,3]) x3
+        np.testing.assert_allclose(out.numpy().reshape(-1),
+                                   [1, 1, 2, 3, 2, 3, 2, 3])
+
+    def test_reshape(self):
+        x = _seq(np.arange(12).reshape(6, 2), [0, 2, 6])
+        out = snn.sequence_reshape(x, 4)
+        assert out.shape == [3, 4] and out.lod == [0, 1, 3]
+
+
+class TestControlFlowAPI:
+    def test_cond_python(self):
+        assert snn.cond(True, lambda: 1, lambda: 2) == 1
+
+    def test_switch_case(self):
+        out = snn.switch_case(t(np.int64(2)),
+                              {1: lambda: t(np.float32(10.0)),
+                               2: lambda: t(np.float32(20.0))})
+        assert float(out) == 20.0
+        out = snn.switch_case(5, {1: lambda: 1.0}, default=lambda: -1.0)
+        assert out == -1.0
+
+    def test_while_loop(self):
+        out = snn.while_loop(lambda i, s: i < 4, lambda i, s: (i + 1, s + i),
+                             [0, 0])
+        assert tuple(out) == (4, 6)
+
+
+class TestStaticLayersMisc:
+    def test_prelu_spectral(self):
+        paddle.seed(0)
+        x = t(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        assert snn.prelu(x).shape == [2, 4]
+        w = t(np.random.RandomState(0).rand(4, 6).astype(np.float32))
+        sn = snn.spectral_norm(w, power_iters=10)
+        s = np.linalg.svd(sn.numpy(), compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=5e-2)
+
+    def test_bilinear_tensor_product(self):
+        paddle.seed(0)
+        x = t(np.random.RandomState(0).rand(3, 4).astype(np.float32))
+        y = t(np.random.RandomState(1).rand(3, 5).astype(np.float32))
+        assert snn.bilinear_tensor_product(x, y, 6).shape == [3, 6]
+
+    def test_row_conv(self):
+        paddle.seed(0)
+        x = t(np.random.RandomState(0).rand(2, 5, 3).astype(np.float32))
+        assert snn.row_conv(x, 2).shape == [2, 5, 3]
+
+    def test_nce_trains(self):
+        paddle.seed(0)
+        x = t(np.random.RandomState(0).rand(8, 6).astype(np.float32))
+        lab = t(np.random.RandomState(1).randint(0, 50, (8, 1)).astype(np.int64))
+        loss = snn.nce(x, lab, num_total_classes=50, num_neg_samples=5)
+        assert loss.shape == [8, 1]
+        assert np.isfinite(loss.numpy()).all()
+
+    def test_crf_decoding(self):
+        # transitions force tag alternation
+        em = np.zeros((1, 4, 2), np.float32)
+        trans = np.array([[1.0, 0.0],   # start: prefer tag 0
+                          [0.0, 0.0],   # stop
+                          [-5.0, 5.0],  # from 0 -> 1
+                          [5.0, -5.0]], np.float32)  # from 1 -> 0
+        path = snn.crf_decoding(t(em), transition=t(trans)).numpy()
+        np.testing.assert_array_equal(path[0], [0, 1, 0, 1])
+
+    def test_accuracy_auc(self):
+        pred = t(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+        lab = t(np.array([[1], [0]], np.int64))
+        assert float(static.accuracy(pred, lab)) == 1.0
+        auc_v = float(static.auc(pred, lab))
+        assert 0.99 <= auc_v <= 1.0
+
+    def test_multi_box_head(self):
+        paddle.seed(0)
+        feats = [t(np.random.RandomState(i).rand(1, 8, s, s).astype(np.float32))
+                 for i, s in enumerate([8, 4])]
+        img = t(np.zeros((1, 3, 64, 64), np.float32))
+        locs, confs, boxes, var = snn.multi_box_head(
+            feats, img, base_size=64, num_classes=3, aspect_ratios=[[2.0], [2.0]],
+            min_ratio=20, max_ratio=90)
+        assert locs.shape[0] == 1 and locs.shape[2] == 4
+        assert confs.shape[2] == 3
+        assert boxes.shape[0] == locs.shape[1]
+
+
+class TestStaticPersistence:
+    def _train_program(self):
+        paddle.enable_static()
+        main = static.Program()
+        with static.program_guard(main, static.Program()):
+            x = static.data("x", [4, 8], "float32")
+            y = snn.fc(x, 2)
+        paddle.disable_static()
+        return main, y
+
+    def test_save_load_roundtrip(self, tmp_path):
+        main, y = self._train_program()
+        exe = static.Executor()
+        feed = {"x": np.random.RandomState(0).rand(4, 8).astype(np.float32)}
+        out1 = exe.run(main, feed=feed, fetch_list=[y])
+        static.save(main, str(tmp_path / "model"))
+        state = static.load_program_state(str(tmp_path / "model"))
+        assert state  # params present
+        # perturb then restore
+        for n, v in main._captures.items():
+            v._data = v._data * 0
+        static.set_program_state(main, state)
+        out2 = static.Executor().run(main, feed=feed, fetch_list=[y])
+        np.testing.assert_allclose(out1[0], out2[0], rtol=1e-6)
+
+    def test_inference_model_roundtrip(self, tmp_path):
+        main, y = self._train_program()
+        exe = static.Executor()
+        feed = {"x": np.random.RandomState(0).rand(4, 8).astype(np.float32)}
+        ref = exe.run(main, feed=feed, fetch_list=[y])
+        xvar = main.global_block().var("x")
+        static.save_inference_model(str(tmp_path / "inf"), [xvar], [y],
+                                    program=main)
+        prog2, feeds, fetches = static.load_inference_model(str(tmp_path / "inf"))
+        assert feeds == ["x"]
+        out = static.Executor().run(prog2, feed=feed, fetch_list=fetches)
+        np.testing.assert_allclose(ref[0], out[0], rtol=1e-6)
+
+    def test_ema(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(2, 2, bias_attr=False)
+        ema = static.ExponentialMovingAverage(decay=0.5).bind(lin.parameters())
+        w0 = lin.weight.numpy().copy()
+        ema.update()
+        lin.weight.set_value(w0 * 3)
+        ema.update()
+        with ema.apply():
+            inside = lin.weight.numpy().copy()
+        outside = lin.weight.numpy()
+        np.testing.assert_allclose(outside, w0 * 3, rtol=1e-6)
+        assert not np.allclose(inside, outside)  # shadow applied inside
+
+    def test_py_func_and_print(self):
+        x = t(np.array([1.0, 2.0], np.float32))
+        out = static.py_func(lambda a: a * 3, x, x)
+        np.testing.assert_allclose(out.numpy(), [3.0, 6.0])
+        static.Print(x, message="dbg")  # must not crash
+
+    def test_places_helpers(self):
+        assert len(static.cpu_places(2)) == 2
+        assert static.cuda_places([0])[0].device_id == 0
+        assert static.xpu_places() and static.npu_places() and static.mlu_places()
+
+    def test_create_global_var(self):
+        paddle.enable_static()
+        main = static.Program()
+        with static.program_guard(main, static.Program()):
+            v = static.create_global_var([2, 2], 1.5, "float32", persistable=True)
+        paddle.disable_static()
+        np.testing.assert_allclose(v.numpy(), np.full((2, 2), 1.5))

@@ -1,0 +1,174 @@
+"""paddle.vision.ops: RoI ops, NMS, deformable conv, YOLO decode/loss,
+and the transforms functional API."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+import paddle_tpu.vision.transforms as T
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestRoIOps:
+    def test_roi_align_whole_image_avg(self):
+        # aligned sampling of the whole box with 1x1 output == exact mean
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        boxes = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+        out = vops.roi_align(t(x), t(boxes), t(np.array([1])), output_size=1,
+                             aligned=True)
+        np.testing.assert_allclose(out.numpy().item(), x.mean(), rtol=1e-6)
+
+    def test_roi_align_shapes_and_grad(self):
+        rs = np.random.RandomState(0)
+        x = t(rs.rand(2, 3, 8, 8).astype(np.float32))
+        x.stop_gradient = False
+        boxes = t(np.array([[0, 0, 4, 4], [2, 2, 6, 6], [0, 0, 8, 8]],
+                           np.float32))
+        bnum = t(np.array([2, 1]))
+        out = vops.roi_align(x, boxes, bnum, output_size=2)
+        assert out.shape == [3, 3, 2, 2]
+        out.sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 1, 1] = 9.0
+        out = vops.roi_pool(t(x), t(np.array([[0, 0, 3, 3]], np.float32)),
+                            t(np.array([1])), output_size=1)
+        assert out.numpy().item() == 9.0
+
+    def test_psroi_pool_shape(self):
+        x = t(np.random.RandomState(0).rand(1, 8, 4, 4).astype(np.float32))
+        out = vops.psroi_pool(x, t(np.array([[0, 0, 4, 4]], np.float32)),
+                              t(np.array([1])), output_size=2)
+        assert out.shape == [1, 2, 2, 2]  # 8 channels / (2*2) = 2 out channels
+
+    def test_layers(self):
+        x = t(np.random.RandomState(0).rand(1, 2, 4, 4).astype(np.float32))
+        boxes = t(np.array([[0, 0, 4, 4]], np.float32))
+        bnum = t(np.array([1]))
+        assert vops.RoIAlign(2)(x, boxes, bnum).shape == [1, 2, 2, 2]
+        assert vops.RoIPool(2)(x, boxes, bnum).shape == [1, 2, 2, 2]
+
+
+class TestNMS:
+    def test_suppression(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = vops.nms(t(boxes), iou_threshold=0.5, scores=t(scores)).numpy()
+        np.testing.assert_array_equal(keep, [0, 2])  # box 1 overlaps box 0
+
+    def test_category_aware(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1], np.int64)
+        keep = vops.nms(t(boxes), 0.5, t(scores), category_idxs=t(cats),
+                        categories=[0, 1]).numpy()
+        assert len(keep) == 2  # different classes never suppress each other
+
+    def test_top_k(self):
+        boxes = np.array([[0, 0, 1, 1], [5, 5, 6, 6], [9, 9, 10, 10]],
+                         np.float32)
+        scores = np.array([0.5, 0.9, 0.7], np.float32)
+        keep = vops.nms(t(boxes), 0.5, t(scores), top_k=2).numpy()
+        np.testing.assert_array_equal(keep, [1, 2])
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_regular_conv(self):
+        import paddle_tpu.nn.functional as F
+
+        rs = np.random.RandomState(0)
+        x = rs.rand(1, 2, 6, 6).astype(np.float32)
+        w = rs.rand(4, 2, 3, 3).astype(np.float32)
+        offset = np.zeros((1, 18, 4, 4), np.float32)  # kh*kw*2 channels
+        out = vops.deform_conv2d(t(x), t(offset), t(w))
+        ref = F.conv2d(t(x), t(w))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_layer_and_mask(self):
+        paddle.seed(0)
+        layer = vops.DeformConv2D(2, 4, 3, padding=1)
+        x = t(np.random.RandomState(0).rand(1, 2, 5, 5).astype(np.float32))
+        offset = t(np.zeros((1, 18, 5, 5), np.float32))
+        mask = t(np.ones((1, 9, 5, 5), np.float32))
+        out = layer(x, offset, mask)
+        assert out.shape == [1, 4, 5, 5]
+
+
+class TestYolo:
+    def test_yolo_box_shapes(self):
+        na, cls = 3, 4
+        x = t(np.random.RandomState(0).randn(2, na * (5 + cls), 4, 4)
+              .astype(np.float32))
+        img = t(np.array([[64, 64], [64, 64]], np.int64))
+        boxes, scores = vops.yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23],
+                                      class_num=cls, conf_thresh=0.0,
+                                      downsample_ratio=16)
+        assert boxes.shape == [2, na * 16, 4]
+        assert scores.shape == [2, na * 16, cls]
+
+    def test_yolo_loss_decreases(self):
+        paddle.seed(0)
+        na, cls = 3, 4
+        rs = np.random.RandomState(0)
+        x = t(rs.randn(1, na * (5 + cls), 4, 4).astype(np.float32) * 0.1)
+        x.stop_gradient = False
+        gt_box = t(np.array([[[0.5, 0.5, 0.3, 0.4]]], np.float32))
+        gt_label = t(np.array([[2]], np.int64))
+        loss = vops.yolo_loss(x, gt_box, gt_label,
+                              anchors=[10, 13, 16, 30, 33, 23],
+                              anchor_mask=[0, 1, 2], class_num=cls,
+                              ignore_thresh=0.7, downsample_ratio=16)
+        assert loss.shape == [1]
+        loss.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestTransformsFunctional:
+    def test_to_tensor_and_flips(self):
+        img = (np.random.RandomState(0).rand(5, 6, 3) * 255).astype(np.uint8)
+        tt = T.to_tensor(img)
+        assert tt.shape == [3, 5, 6] and float(tt.numpy().max()) <= 1.0
+        np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(T.vflip(img), img[::-1])
+
+    def test_crop_center_resize(self):
+        img = np.arange(48, dtype=np.float32).reshape(6, 8)
+        c = T.crop(img, 1, 2, 3, 4)
+        np.testing.assert_array_equal(c, img[1:4, 2:6])
+        cc = T.center_crop(np.zeros((3, 8, 8), np.float32), 4)
+        assert cc.shape == (3, 4, 4)
+
+    def test_adjust_and_normalize(self):
+        img = np.full((3, 2, 2), 0.5, np.float32)
+        np.testing.assert_allclose(T.adjust_brightness(img, 2.0), 1.0)
+        out = T.normalize(img, [0.5, 0.5, 0.5], [0.5, 0.5, 0.5])
+        np.testing.assert_allclose(out, 0.0)
+        hue = T.adjust_hue(img, 0.25)
+        assert hue.shape == img.shape
+
+    def test_rotate_identity(self):
+        img = np.random.RandomState(0).rand(1, 5, 5).astype(np.float32)
+        np.testing.assert_allclose(T.rotate(img, 0.0), img, atol=1e-6)
+
+    def test_base_transform(self):
+        class Double(T.BaseTransform):
+            def _apply_image(self, image):
+                return image * 2
+
+        out = Double()(np.ones((2, 2), np.float32))
+        np.testing.assert_allclose(out, 2.0)
+
+
+class TestImageIO:
+    def test_read_file(self, tmp_path):
+        p = tmp_path / "blob.bin"
+        p.write_bytes(bytes([1, 2, 3, 250]))
+        data = vops.read_file(str(p))
+        np.testing.assert_array_equal(data.numpy(), [1, 2, 3, 250])
