@@ -27,6 +27,7 @@ from . import fleet  # noqa: F401
 from .fleet.distributed_strategy import DistributedStrategy  # noqa: F401
 from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from . import fleet_executor  # noqa: F401
+from . import utils  # noqa: F401
 from .meta_parallel.mp_layers import split  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from .spawn import spawn  # noqa: F401

@@ -1,0 +1,131 @@
+"""Filesystem abstraction: LocalFS + HDFSClient surface.
+
+Reference: python/paddle/distributed/fleet/utils/fs.py — checkpoint/PS table
+dumps go through an FS interface so HDFS-backed clusters work. The TPU build
+keeps the interface; HDFS operations require a `hadoop` binary on PATH and
+degrade with a clear error otherwise (zero-egress images have none)."""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    def ls_dir(self, path):
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name)) else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if overwrite:
+            self.delete(dst)
+        os.rename(src, dst)
+
+    def upload(self, local, remote):
+        shutil.copy(local, remote)
+
+    def download(self, remote, local):
+        shutil.copy(remote, local)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path) and not exist_ok:
+            raise ExecuteError(path)
+        open(path, "a").close()
+
+    def cat(self, path):
+        with open(path) as f:
+            return f.read()
+
+    def list_dirs(self, path):
+        return self.ls_dir(path)[0]
+
+
+class HDFSClient(FS):
+    """hadoop-CLI-backed client (reference fs.py HDFSClient)."""
+
+    def __init__(self, hadoop_home, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else "hadoop"
+        self._configs = configs or {}
+
+    def _run(self, *args):
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True, check=True)
+        except FileNotFoundError as e:
+            raise ExecuteError(
+                "hadoop binary not found — HDFSClient needs a hadoop install "
+                "(this build is zero-egress; use LocalFS)") from e
+        except subprocess.CalledProcessError as e:
+            raise ExecuteError(e.stderr) from e
+        return out.stdout
+
+    def is_exist(self, path):
+        try:
+            self._run("-test", "-e", path)
+            return True
+        except ExecuteError:
+            return False
+
+    def ls_dir(self, path):
+        out = self._run("-ls", path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            (dirs if parts[0].startswith("d") else files).append(parts[-1])
+        return dirs, files
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", "-f", path)
+
+    def upload(self, local, remote):
+        self._run("-put", "-f", local, remote)
+
+    def download(self, remote, local):
+        self._run("-get", remote, local)

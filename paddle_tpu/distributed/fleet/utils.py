@@ -131,3 +131,7 @@ def broadcast_dp_parameters(model, hcg):
 
 def broadcast_sharding_parameters(model, hcg):
     pass
+
+
+from . import fs  # noqa: E402,F401
+from .fs import HDFSClient, LocalFS  # noqa: E402,F401

@@ -1,0 +1,52 @@
+"""paddle.distributed.utils: MoE dispatch collectives + helpers.
+
+Reference: python/paddle/distributed/utils.py — global_scatter (:57) /
+global_gather (:179) route token rows to/from expert ranks via all-to-all
+(operators/collective/global_scatter_op). TPU-native: inside a pjit program
+the routing IS lax.all_to_all over the 'ep' axis; eagerly (single process)
+the permutation semantics run directly so tests and single-chip code work.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops._helpers import t_
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Send local_count[e] consecutive rows to each expert e; receive
+    global_count[e] rows back (single-process semantics: reorder rows into
+    expert-major layout; multi-device routing happens through the MoE layer's
+    all_to_all inside pjit)."""
+    x, lc, gc = t_(x), t_(local_count), t_(global_count)
+    lc_np = np.asarray(lc._data).astype(np.int64)
+    # expert-major regrouping == identity reordering on one rank
+    return Tensor(x._data)
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of global_scatter."""
+    x = t_(x)
+    return Tensor(x._data)
+
+
+def expert_count(gate_idx, n_expert) -> Tensor:
+    """Rows routed to each expert (reference utils.py expert_count op)."""
+    g = t_(gate_idx)
+
+    def count(a):
+        return jnp.bincount(a.reshape(-1).astype(jnp.int32), length=n_expert)
+
+    return Tensor(count(g._data).astype(jnp.int64))
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, device_mode="tpu",
+                devices_per_proc=None):
+    """Launcher helper parity (reference utils.get_cluster)."""
+    return {"node_ips": node_ips, "node_ip": node_ip,
+            "endpoints": trainer_endpoints, "device_mode": device_mode}
