@@ -54,12 +54,23 @@ def export_chrome_tracing(dir_name, worker_name=None):
     return handler
 
 
+# host-side event aggregation feeding Profiler.summary (the analogue of the
+# reference's HostEventRecorder -> profiler_statistic tables)
+_event_stats = {}  # name -> [count, total_s, max_s, min_s]
+
+
+def reset_event_stats():
+    _event_stats.clear()
+
+
 class RecordEvent:
-    """RAII marker (reference RecordEvent, platform/profiler/event_tracing.h)."""
+    """RAII marker (reference RecordEvent, platform/profiler/event_tracing.h):
+    annotates the device trace AND aggregates host wall time for summary()."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ta = None
+        self._t0 = None
 
     def __enter__(self):
         self.begin()
@@ -70,6 +81,9 @@ class RecordEvent:
         return False
 
     def begin(self):
+        import time as _time
+
+        self._t0 = _time.perf_counter()
         try:
             import jax.profiler
 
@@ -79,9 +93,19 @@ class RecordEvent:
             self._ta = None
 
     def end(self):
+        import time as _time
+
         if self._ta is not None:
             self._ta.__exit__(None, None, None)
             self._ta = None
+        if self._t0 is not None:
+            dt = _time.perf_counter() - self._t0
+            st = _event_stats.setdefault(self.name, [0, 0.0, 0.0, float("inf")])
+            st[0] += 1
+            st[1] += dt
+            st[2] = max(st[2], dt)
+            st[3] = min(st[3], dt)
+            self._t0 = None
 
 
 class Profiler:
@@ -157,9 +181,22 @@ class Profiler:
         pass  # traces already exported by stop_trace
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        """Throughput line + RecordEvent aggregation table (reference
+        profiler_statistic.py summary tables, host-event subset)."""
         info = self._benchmark.report()
         print(f"ips: {info.get('ips', 0.0):.2f} steps/s  reader_cost: "
               f"{info.get('reader_cost', 0.0) * 1000:.3f} ms")
+        if not _event_stats:
+            return
+        unit = {"ms": 1e3, "us": 1e6, "s": 1.0}.get(time_unit, 1e3)
+        rows = sorted(_event_stats.items(), key=lambda kv: -kv[1][1])
+        w = max(len(n) for n, _ in rows) + 2
+        print(f"{'Event':<{w}}{'Calls':>8}{'Total':>12}{'Avg':>12}"
+              f"{'Max':>12}{'Min':>12}  ({time_unit})")
+        for name, (cnt, tot, mx, mn) in rows:
+            print(f"{name:<{w}}{cnt:>8}{tot * unit:>12.3f}"
+                  f"{tot / cnt * unit:>12.3f}{mx * unit:>12.3f}"
+                  f"{mn * unit:>12.3f}")
 
 
 class Benchmark:
