@@ -26,6 +26,8 @@ def main():
     on_tpu = jax.default_backend() != "cpu"
     n_dev = jax.device_count()
 
+    import os
+
     if on_tpu:
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
                         max_seq_len=1024)
@@ -33,6 +35,10 @@ def main():
     else:
         cfg = gpt_tiny()
         batch, seq, steps, warmup = 8, 128, 5, 1
+    batch = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", batch))
+    steps = int(os.environ.get("PADDLE_TPU_BENCH_STEPS", steps))
+    if batch % n_dev:  # batch dim shards over dp_degree = n_dev
+        batch = max(n_dev, batch - batch % n_dev)
 
     paddle.seed(0)
     strategy = dist.DistributedStrategy()

@@ -81,9 +81,7 @@ class RecordEvent:
         return False
 
     def begin(self):
-        import time as _time
-
-        self._t0 = _time.perf_counter()
+        self._t0 = time.perf_counter()
         try:
             import jax.profiler
 
@@ -93,13 +91,11 @@ class RecordEvent:
             self._ta = None
 
     def end(self):
-        import time as _time
-
         if self._ta is not None:
             self._ta.__exit__(None, None, None)
             self._ta = None
         if self._t0 is not None:
-            dt = _time.perf_counter() - self._t0
+            dt = time.perf_counter() - self._t0
             st = _event_stats.setdefault(self.name, [0, 0.0, 0.0, float("inf")])
             st[0] += 1
             st[1] += dt
@@ -124,6 +120,7 @@ class Profiler:
         self._benchmark = Benchmark()
 
     def start(self):
+        reset_event_stats()  # each profiling session aggregates its own events
         self._benchmark.begin()
         self._transition()
 
@@ -188,8 +185,21 @@ class Profiler:
               f"{info.get('reader_cost', 0.0) * 1000:.3f} ms")
         if not _event_stats:
             return
-        unit = {"ms": 1e3, "us": 1e6, "s": 1.0}.get(time_unit, 1e3)
-        rows = sorted(_event_stats.items(), key=lambda kv: -kv[1][1])
+        units = {"ms": 1e3, "us": 1e6, "s": 1.0}
+        if time_unit not in units:
+            raise ValueError(f"time_unit must be one of {sorted(units)}, "
+                             f"got {time_unit!r}")
+        unit = units[time_unit]
+        # sort key per SortedKeys (host events: the CPU* keys apply)
+        key_fns = {
+            None: lambda st: -st[1],
+            SortedKeys.CPUTotal: lambda st: -st[1],
+            SortedKeys.CPUAvg: lambda st: -(st[1] / st[0]),
+            SortedKeys.CPUMax: lambda st: -st[2],
+            SortedKeys.CPUMin: lambda st: -st[3],
+        }
+        key = key_fns.get(sorted_by, key_fns[None])
+        rows = sorted(_event_stats.items(), key=lambda kv: key(kv[1]))
         w = max(len(n) for n, _ in rows) + 2
         print(f"{'Event':<{w}}{'Calls':>8}{'Total':>12}{'Avg':>12}"
               f"{'Max':>12}{'Min':>12}  ({time_unit})")
