@@ -46,6 +46,12 @@ def init_parallel_env():
     if env.world_size > 1 and not _initialized:
         import jax
 
+        try:
+            # CPU hosts join cross-process collectives through gloo — the
+            # reference's CPU backend (ProcessGroupGloo); TPU slices use ICI
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
         coordinator = env.master_addr and f"{env.master_addr}:{env.master_port}"
         if not coordinator and env.trainer_endpoints and env.trainer_endpoints[0]:
             coordinator = env.trainer_endpoints[0]
