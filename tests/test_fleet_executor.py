@@ -179,8 +179,11 @@ def test_dist_model_two_processes(tmp_path):
                "PATH": "/usr/bin:/bin"}
         import os
 
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env = {**os.environ, **env}
-        procs.append(subprocess.run if False else subprocess.Popen(
+        existing = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = repo_root + (os.pathsep + existing if existing else "")
+        procs.append(subprocess.Popen(
             [sys.executable, str(script)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     outs = [p.communicate(timeout=120)[0] for p in procs]
