@@ -311,10 +311,29 @@ class GPTForPretraining(nn.Layer):
         return self.lm_head(h)
 
     def forward(self, input_ids, labels=None):
+        from ..ops import reduction as R
+
+        if labels is not None and self._can_fuse_loss():
+            # chunked LM-head+CE (ops/fused.py): skips the [b, s, vocab] f32
+            # logits materialization — the dominant activation of the step
+            from ..ops.fused import fused_linear_cross_entropy
+
+            h = self.gpt(input_ids)
+            loss = fused_linear_cross_entropy(h, self.gpt.wte.weight, labels,
+                                              transpose_y=True,
+                                              ignore_index=self.loss_fn.ignore_index)
+            return R.mean(loss)
         logits = self.logits(input_ids)
         if labels is None:
             return logits
         loss = self.loss_fn(logits, labels)
-        from ..ops import reduction as R
-
         return R.mean(loss)
+
+    def _can_fuse_loss(self):
+        if self.lm_head is not None:
+            return False
+        from ..distributed.mesh import get_hybrid_communicate_group
+
+        hcg = get_hybrid_communicate_group()
+        # vocab-sharded weight (mp > 1) keeps the vocab-parallel psum loss path
+        return hcg is None or hcg.degrees["mp"] <= 1

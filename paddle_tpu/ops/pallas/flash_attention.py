@@ -76,12 +76,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)  # [bq, d]
-        k = k_ref[0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)  # [bk, d]
+        # matmul inputs stay in their storage dtype (bf16 under amp) so the MXU
+        # runs at bf16 rate; accumulation is forced to f32 via
+        # preferred_element_type — casting inputs to f32 here would quarter
+        # matmul throughput on v5e for no accuracy gain over f32 accumulation.
+        q = q_ref[0]  # [bq, d]
+        k = k_ref[0]  # [bk, d]
+        v = v_ref[0]  # [bk, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        s = s * sm_scale  # [bq, bk]
+        s = s * sm_scale  # [bq, bk] f32
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -94,7 +98,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         alpha = jnp.exp(m_prev - m_new)                 # [bq, 1]
         l_new = alpha * l_scr[...][:, :1] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -162,10 +167,11 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)        # [bq, d]
-        k = k_ref[0].astype(jnp.float32)        # [bk, d]
-        v = v_ref[0].astype(jnp.float32)        # [bk, d]
-        do = do_ref[0].astype(jnp.float32)      # [bq, d]
+        # storage-dtype (bf16) matmul inputs + f32 accumulation, as in forward
+        q = q_ref[0]                            # [bq, d]
+        k = k_ref[0]                            # [bk, d]
+        v = v_ref[0]                            # [bk, d]
+        do = do_ref[0]                          # [bq, d]
         lse = lse_ref[0][:, :1]                 # [bq, 1]
         delta = delta_ref[0][:, :1]             # [bq, 1]
 
@@ -175,14 +181,16 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             qpos = ki * 0 + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(qpos >= kpos, s, jnp.float32(NEG_INF))
-        p = jnp.exp(s - lse)                    # [bq, bk]
+        p = jnp.exp(s - lse)                    # [bq, bk] f32
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(qi == q_blocks - 1)
     def _finalize():
@@ -204,10 +212,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
 
@@ -222,7 +230,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         dq_scr[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(ki == kv_blocks - 1)
     def _finalize():

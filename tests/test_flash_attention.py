@@ -55,3 +55,24 @@ def test_supported_predicate():
     assert supported(512, 512, 64)
     assert not supported(7, 512, 64)     # too short
     assert not supported(512, 512, 63)   # head_dim not 8-aligned
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bf16_storage_dtype(causal):
+    """bf16 inputs exercise the storage-dtype matmul path (bf16 operands,
+    f32 accumulation) that real-chip amp runs; CPU f32 tests can't see it."""
+    rng = np.random.RandomState(3)
+    qf, kf, vf = [rng.randn(1, 128, 2, 32).astype(np.float32) for _ in range(3)]
+    q, k, v = [jnp.asarray(x, jnp.bfloat16) for x in (qf, kf, vf)]
+
+    out = flash_attention(q, k, v, causal=causal)
+    assert out.dtype == jnp.bfloat16
+    ref = dense_ref(jnp.asarray(qf), jnp.asarray(kf), jnp.asarray(vf), causal)
+    np.testing.assert_allclose(out.astype(np.float32), ref, atol=2e-2)
+
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, causal=causal).astype(jnp.float32)),
+        argnums=(0, 1, 2))(q, k, v)
+    for a in g:
+        assert a.dtype == jnp.bfloat16
+        assert bool(jnp.isfinite(a.astype(jnp.float32)).all())
