@@ -57,6 +57,10 @@ from . import jit  # noqa: E402
 from . import static  # noqa: E402
 from . import inference  # noqa: E402
 from . import fft  # noqa: E402
+from .ops import linalg as linalg  # noqa: E402
+import sys as _sys
+_sys.modules[__name__ + ".linalg"] = linalg  # importable paddle_tpu.linalg, like paddle.linalg
+del _sys
 from . import distribution  # noqa: E402
 from . import sparse  # noqa: E402
 from . import text  # noqa: E402

@@ -1,0 +1,204 @@
+"""Kernel autotune cache (reference: paddle/phi/kernels/autotune/cache.h
+`AlgorithmsCache`, switch_autotune.h `AutoTuneStatus`).
+
+The reference times candidate cuDNN/cuBLAS algorithms per input-shape key
+during a tuning step window and caches the winner. The TPU analogue tunes
+Pallas kernel *block sizes*: for a given logical shape the grid/tile choice is
+the one free parameter XLA does not search for us. The mechanics are kept:
+
+- `AlgorithmsCache` — (kernel, key) -> choice, with hit/miss stats and an
+  optional JSON persistence file (survives processes the way XLA's own
+  autotune cache does).
+- a step-window switch (`set_step`): tuning only runs inside
+  [tuning_start, tuning_stop) steps, like AutoTuneStatus; outside the window
+  an uncached key falls back to the kernel's heuristic default.
+- `pick(...)` — measure each candidate out-of-band (a standalone jitted call
+  on freshly materialized inputs, NOT inside the caller's trace; tracing is
+  plain Python so launching a separate compiled computation is legal) and
+  cache the argmin.
+
+Enabled via paddle.incubate.autotune.set_config({"kernel": {"enable": True}})
+or FLAGS_use_autotune.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+_lock = threading.Lock()
+
+
+class AlgorithmsCache:
+    def __init__(self):
+        self._map: Dict[str, Dict[str, Any]] = {}
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _k(kernel: str, key: Tuple) -> Tuple[str, str]:
+        return kernel, json.dumps(key, default=str)
+
+    def get(self, kernel: str, key: Tuple):
+        k1, k2 = self._k(kernel, key)
+        with _lock:
+            sub = self._map.get(k1)
+            if sub is not None and k2 in sub:
+                self.hits += 1
+                return sub[k2]
+            self.misses += 1
+            return None
+
+    def peek(self, kernel: str, key: Tuple):
+        """Lookup without touching hit/miss stats (for disabled-autotune paths)."""
+        k1, k2 = self._k(kernel, key)
+        with _lock:
+            sub = self._map.get(k1)
+            return sub.get(k2) if sub is not None else None
+
+    def put(self, kernel: str, key: Tuple, choice):
+        k1, k2 = self._k(kernel, key)
+        with _lock:
+            self._map.setdefault(k1, {})[k2] = choice
+
+    def size(self) -> int:
+        return sum(len(v) for v in self._map.values())
+
+    def cache_hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    # ---- persistence ----
+    def save(self, path: str):
+        with _lock:
+            blob = json.dumps(self._map)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+    def load(self, path: str):
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+        except (OSError, ValueError):
+            return
+        with _lock:
+            for k1, sub in loaded.items():
+                self._map.setdefault(k1, {}).update(
+                    {k2: tuple(v) if isinstance(v, list) else v
+                     for k2, v in sub.items()})
+
+
+_cache = AlgorithmsCache()
+
+_config = {
+    "kernel": {"enable": False, "tuning_range": [1, 10]},
+    "cache_path": None,  # set to persist across processes
+}
+_step = 0
+_saved = False
+
+
+def cache() -> AlgorithmsCache:
+    return _cache
+
+
+def enabled() -> bool:
+    if _config["kernel"]["enable"]:
+        return True
+    from .flags import flag
+
+    return bool(flag("use_autotune"))
+
+
+def set_config(config: Optional[dict] = None):
+    """paddle.incubate.autotune.set_config semantics: dict (or json file path)
+    with a "kernel" section {enable, tuning_range}."""
+    if config is None:
+        _config["kernel"]["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    k = config.get("kernel")
+    if k:
+        if "enable" in k:
+            _config["kernel"]["enable"] = bool(k["enable"])
+        if "tuning_range" in k:
+            _config["kernel"]["tuning_range"] = list(k["tuning_range"])
+    if "cache_path" in config:
+        global _saved
+        _saved = False
+        _config["cache_path"] = config["cache_path"]
+        if config["cache_path"] and os.path.exists(config["cache_path"]):
+            _cache.load(config["cache_path"])
+
+
+def set_step(step: int):
+    """Advance the global step for the tuning window (AutoTuneStatus::Update).
+    Called by the train engines; harmless if never called (window stays open)."""
+    global _step, _saved
+    _step = step
+    path = _config["cache_path"]
+    lo, hi = _config["kernel"]["tuning_range"]
+    if path and not _saved and step >= hi and _cache.size():
+        # save at the window's last step, not one past it: a job that stops
+        # exactly at tuning_stop must still persist its choices
+        _cache.save(path)
+        _saved = True
+
+
+def _in_window() -> bool:
+    lo, hi = _config["kernel"]["tuning_range"]
+    return _step == 0 or lo <= _step < hi
+
+
+def should_tune() -> bool:
+    """True when a pick() call would actually measure candidates. Kernels use
+    this to skip materializing timing inputs for cache hits / closed windows.
+
+    Multi-controller runs must NOT time independently: noise would let ranks
+    cache different choices and trace divergent SPMD programs (deadlock).
+    There, tuned choices only come from a preloaded cache_path produced on a
+    single controller.
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        return False
+    return enabled() and _in_window()
+
+
+def pick(kernel: str, key: Tuple, candidates: Sequence,
+         run_candidate: Callable[[Any], None], default=None):
+    """Return the cached/measured best candidate, or `default` when tuning is
+    off (or the window closed) and nothing is cached.
+
+    run_candidate(c) must execute the kernel with choice c to completion
+    (block on the result); it is called 2x per candidate — warmup/compile,
+    then the timed run. Candidates that fail to compile are skipped.
+    """
+    got = _cache.peek(kernel, key)  # non-counting: hit/miss stats belong to
+    if got is not None:             # the kernel-side lookup, not the tuner
+        return got
+    if not should_tune() or not candidates:
+        return default if default is not None else (candidates[0] if candidates else None)
+
+    best, best_t = None, float("inf")
+    for c in candidates:
+        try:
+            run_candidate(c)          # compile + warmup
+            t0 = time.perf_counter()
+            run_candidate(c)
+            dt = time.perf_counter() - t0
+        except Exception:
+            continue
+        if dt < best_t:
+            best, best_t = c, dt
+    if best is None:
+        best = default if default is not None else candidates[0]
+    _cache.put(kernel, key, best)
+    return best

@@ -169,6 +169,8 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
 
     if flag("check_nan_inf"):
         _check_nan_inf(name, outs_data)
+    if flag("enable_unused_var_check"):
+        _check_unused_vars(name, f, diff_arrays)
 
     outs = [_wrap_out(d, stop_gradient=not need_grad) for d in outs_data]
 
@@ -186,6 +188,38 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
     if multi:
         return tuple(outs)
     return outs[0]
+
+
+_unused_var_warned = set()
+
+
+def _check_unused_vars(name, f, diff_arrays):
+    """FLAGS_enable_unused_var_check analogue (reference
+    framework/unused_var_check.cc): flag ops that declare inputs their compute
+    never reads. XLA-native check: trace the kernel to a jaxpr and look for
+    input vars that appear in no equation — dead operands mean a wrong op
+    signature or a silently dropped tensor."""
+    if name in _unused_var_warned:
+        return
+    _unused_var_warned.add(name)
+    try:
+        jaxpr = jax.make_jaxpr(f)(*diff_arrays)
+    except Exception:
+        return  # kernels with data-dependent python control flow can't trace here
+    from jax.extend.core import Literal
+
+    used = set()
+    for eqn in jaxpr.jaxpr.eqns:
+        used.update(id(v) for v in eqn.invars if not isinstance(v, Literal))
+    used.update(id(v) for v in jaxpr.jaxpr.outvars if not isinstance(v, Literal))
+    unused = [i for i, v in enumerate(jaxpr.jaxpr.invars) if id(v) not in used]
+    if unused:
+        import warnings
+
+        warnings.warn(
+            f"Operator {name} declares {len(jaxpr.jaxpr.invars)} differentiable "
+            f"inputs but never reads input(s) {unused} "
+            f"(FLAGS_enable_unused_var_check)", stacklevel=3)
 
 
 def _check_nan_inf(name, outs_data):

@@ -54,3 +54,5 @@ define_flag("tpu_matmul_precision", "default", "default|high|highest for MXU mat
 define_flag("use_flash_attention", True, "route attention to the Pallas flash kernel on TPU")
 define_flag("seed", 0, "global random seed")
 define_flag("apply_ir_passes", True, "run CSE/DCE/fuse passes before lowering static programs")
+define_flag("use_autotune", False, "enable kernel autotune (pallas block-size search + cache)")
+define_flag("enable_unused_var_check", False, "warn when an op kernel never reads a declared input")

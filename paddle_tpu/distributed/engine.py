@@ -209,6 +209,8 @@ class TrainStepEngine:
                     f"batch dim {a.shape[0]} is not divisible by "
                     f"dp*sharding = {batch_axes}; pad or resize the batch "
                     f"(topology: {self.hcg.topology()})")
+        from ..core import autotune
+        autotune.set_step(self._step_count + 1)
         if self._step_fn is None:
             self._step_fn = self._build(arrays)
         # place batch according to specs (host->device with the right sharding)

@@ -72,16 +72,20 @@ def _chunk_attn(q, k, v, sm_scale, mask):
     q: [b, sq, h, d], k/v: [b, sk, h, d], mask: [sq, sk] bool or None.
     Returns (acc [b,h,sq,d] f32, m [b,h,sq] f32, l [b,h,sq] f32).
     """
-    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [b,h,sq,d]
-    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
-    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * sm_scale
+    # matmul inputs stay in storage dtype (bf16 under amp) for MXU rate;
+    # f32 accumulation + f32 softmax stats keep the numerics
+    qt = jnp.swapaxes(q, 1, 2)  # [b,h,sq,d]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                   preferred_element_type=jnp.float32) * sm_scale
     if mask is not None:
         s = jnp.where(mask[None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1)                              # [b,h,sq]
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vt.dtype), vt,
+                     preferred_element_type=jnp.float32)
     return acc, m, l
 
 

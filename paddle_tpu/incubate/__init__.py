@@ -3,6 +3,7 @@ LookAhead/ModelAverage, fused transformer layers, softmax-mask fusions, graph
 ops, segment reductions, functional autograd, auto checkpoint, shared-memory
 multiprocessing."""
 from . import asp  # noqa: F401
+from . import autotune  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from . import nn  # noqa: F401
 from . import autograd  # noqa: F401
@@ -14,7 +15,7 @@ from .operators import (  # noqa: F401
 from .tensor import segment_max, segment_mean, segment_min, segment_sum  # noqa: F401
 
 __all__ = [
-    "asp", "LookAhead", "ModelAverage", "nn", "autograd", "checkpoint",
+    "asp", "autotune", "LookAhead", "ModelAverage", "nn", "autograd", "checkpoint",
     "softmax_mask_fuse_upper_triangle", "softmax_mask_fuse", "graph_send_recv",
     "graph_khop_sampler", "graph_sample_neighbors", "graph_reindex",
     "segment_sum", "segment_mean", "segment_max", "segment_min",

@@ -1,0 +1,22 @@
+"""paddle.incubate.autotune — user-facing autotune switch.
+
+Reference: python/paddle/incubate/autotune.py `set_config` (kernel/layout/
+dataloader sections; kernel tuning backed by phi's AlgorithmsCache +
+switch_autotune). Here the kernel section drives the Pallas block-size tuner
+in core/autotune.py; layout tuning has no TPU meaning (XLA owns layouts) and
+is accepted as a no-op for API compatibility.
+"""
+from __future__ import annotations
+
+from ..core import autotune as _core
+
+__all__ = ["set_config"]
+
+
+def set_config(config=None):
+    _core.set_config(config)
+
+
+def kernel_cache():
+    """Expose cache stats (hit rate / size) like phi's autotune status."""
+    return _core.cache()

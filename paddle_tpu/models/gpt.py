@@ -277,7 +277,8 @@ def _pipe_block_fwd(x, p, nh, hd):
     qkv = h @ p["qkv_w"] + p["qkv_b"]
     qkv = qkv.reshape(b, s, 3, nh, hd)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / math.sqrt(hd)
     mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
     scores = jnp.where(mask[None, None], scores, -1e30)
     attn = jax.nn.softmax(scores, axis=-1).astype(v.dtype)

@@ -112,11 +112,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = jnp.broadcast_to(m_scr[...][:, :1] + jnp.log(l), lse_ref.shape[1:])
 
 
-def _fwd(q, k, v, sm_scale, causal):
+def _fwd(q, k, v, sm_scale, causal, blocks=None):
     """q,k,v: [bh, s, d] -> (o [bh, sq, d], lse [bh, sq] f32)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq, bk = _pick_block(sq), _pick_block(sk)
+    bq, bk = blocks if blocks else (_pick_block(sq), _pick_block(sk))
     kv_blocks = sk // bk
     grid = (bh, sq // bq, kv_blocks)
     kernel = functools.partial(
@@ -238,12 +238,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd(res, g, sm_scale, causal):
+def _bwd(res, g, sm_scale, causal, blocks=None):
     q, k, v, o, lse = res
     do = g
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq, bk = _pick_block(sq), _pick_block(sk)
+    bq, bk = blocks if blocks else (_pick_block(sq), _pick_block(sk))
     q_blocks, kv_blocks = sq // bq, sk // bk
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -299,22 +299,66 @@ def _bwd(res, g, sm_scale, causal):
 
 # ------------------------------------------------------------- public API ----
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_bhsd(q, k, v, sm_scale, causal):
-    o, _ = _fwd(q, k, v, sm_scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, sm_scale, causal, blocks):
+    o, _ = _fwd(q, k, v, sm_scale, causal, blocks)
     return o
 
 
-def _flash_fwd_rule(q, k, v, sm_scale, causal):
-    o, lse = _fwd(q, k, v, sm_scale, causal)
+def _flash_fwd_rule(q, k, v, sm_scale, causal, blocks):
+    o, lse = _fwd(q, k, v, sm_scale, causal, blocks)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(sm_scale, causal, res, g):
-    return _bwd(res, g, sm_scale, causal)
+def _flash_bwd_rule(sm_scale, causal, blocks, res, g):
+    return _bwd(res, g, sm_scale, causal, blocks)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _tuned_blocks(bh, sq, sk, d, dtype, sm_scale, causal):
+    """Block-size choice via the kernel autotune cache (core/autotune.py — the
+    phi AlgorithmsCache analogue). Tuning runs the forward kernel out-of-band
+    on materialized random inputs, so it is legal mid-trace; when autotune is
+    off this collapses to the static heuristic."""
+    from ...core import autotune
+
+    default = (_pick_block(sq), _pick_block(sk))
+    key = (int(bh), int(sq), int(sk), int(d), str(dtype), bool(causal),
+           jax.default_backend())
+    if not autotune.enabled():
+        # peek (non-counting): a disabled run must not skew hit-rate stats
+        cached = autotune.cache().peek("flash_attention", key)
+        return cached or default
+    cached = autotune.cache().get("flash_attention", key)
+    if cached is not None:
+        return cached
+    if not autotune.should_tune():  # closed window / multi-controller: no timing
+        return default
+    candidates = sorted({(q_, k_) for q_ in (512, 256, 128) for k_ in (512, 256, 128)
+                         if sq % q_ == 0 and sk % k_ == 0}) or [default]
+    if len(candidates) == 1:
+        return candidates[0]
+
+    rng = np.random.RandomState(0)
+    qa = jnp.asarray(rng.randn(bh, sq, d), dtype=dtype)
+    ka = jnp.asarray(rng.randn(bh, sk, d), dtype=dtype)
+    va = jnp.asarray(rng.randn(bh, sk, d), dtype=dtype)
+
+    # one jitted executable per candidate, shared by the warmup and timed calls
+    # (a fresh lambda per call would re-compile and time the compiler instead)
+    compiled = {
+        blocks: jax.jit(functools.partial(
+            lambda bl, a, b, c: _fwd(a, b, c, sm_scale, causal, bl)[0], blocks))
+        for blocks in candidates}
+
+    def run(blocks):
+        out = compiled[blocks](qa, ka, va)
+        np.asarray(out[0, 0, 0])  # D2H sync (block_until_ready can return
+        #                           early through a remote PJRT tunnel)
+
+    return autotune.pick("flash_attention", key, candidates, run, default=default)
 
 
 def flash_attention(q, k, v, causal: bool = False, sm_scale: float | None = None):
@@ -328,5 +372,7 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale: float | None = None
         s = x.shape[1]
         return jnp.swapaxes(x, 1, 2).reshape(b * h, s, x.shape[-1])
 
-    o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), float(sm_scale), bool(causal))
+    blocks = _tuned_blocks(b * h, sq, sk, d, q.dtype, float(sm_scale), bool(causal))
+    o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), float(sm_scale), bool(causal),
+                    tuple(blocks))
     return jnp.swapaxes(o.reshape(b, h, sq, d), 1, 2)

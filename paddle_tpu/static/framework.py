@@ -239,6 +239,9 @@ def data(name, shape, dtype="float32", lod_level=0) -> Variable:
         raise ValueError(f"feed var '{name}' already exists")
     v = Variable(block, name, shape, dtype, stop_gradient=True)
     block.vars[name] = v
+    if not hasattr(prog, "_feed_vars"):
+        prog._feed_vars = []
+    prog._feed_vars.append(v)  # consumed by trainer.py's dataset feed mapping
     return v
 
 

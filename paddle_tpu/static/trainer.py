@@ -1,0 +1,188 @@
+"""Trainer / DeviceWorker stack: Executor.train_from_dataset backing.
+
+Reference (#12): the fleet-run training loop — `TrainerBase/MultiTrainer/
+DistMultiTrainer` (paddle/fluid/framework/trainer.h:59-336) own
+`DeviceWorker/HogwildWorker` threads (device_worker.h:154,249), each thread
+pulling batches from its C++ `DataFeed` shard and executing the program; the
+Python side (`python/paddle/fluid/executor.py` train_from_dataset) just picks
+a trainer from the strategy and launches it.
+
+TPU-native split: batch PARSING is already multithreaded inside the native
+feed (core/native/data_feed.cc); the HogwildWorker thread pool here overlaps
+host-side batch assembly (numpy padding, feed-dict building) with device
+execution, and the device step itself is the Executor's single fused XLA
+computation — one chip consumes one instruction stream, so "threads racing
+ops onto the device" (the CUDA Hogwild picture) collapses into a bounded
+prefetch queue in front of a serialized step loop. Sparse (lod) slots are fed
+as dense-padded [batch, maxlen] int64 plus a `<name>.lens` length vector when
+the program declares it — static shapes are what XLA wants; maxlen is bucketed
+to powers of two to bound recompilation.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["TrainerFactory", "MultiTrainer", "DistMultiTrainer", "HogwildWorker"]
+
+
+def _bucket(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _assemble_feed(batch: Dict[str, object], feed_names: List[str]) -> Dict[str, np.ndarray]:
+    """Dataset batch -> feed dict; sparse (vals, lod) slots become padded ids
+    (+ optional .lens var). Unreferenced slots are dropped."""
+    out = {}
+    for name, val in batch.items():
+        if isinstance(val, tuple):
+            vals, offs = val
+            rows = len(offs) - 1
+            widths = np.diff(offs)
+            maxw = _bucket(int(widths.max())) if rows and widths.max() > 0 else 1
+            dense = np.zeros((rows, maxw), np.int64)
+            for r in range(rows):
+                w = int(widths[r])
+                dense[r, :w] = vals[offs[r]:offs[r + 1]].astype(np.int64)
+            if name in feed_names:
+                out[name] = dense
+            lens_name = name + ".lens"
+            if lens_name in feed_names:
+                out[lens_name] = widths.astype(np.int64)
+        elif name in feed_names:
+            out[name] = val
+    return out
+
+
+class DeviceWorker:
+    def __init__(self, executor, program, fetch_list, fetch_info, print_period, debug):
+        self.exe = executor
+        self.program = program
+        self.fetch_list = fetch_list or []
+        self.fetch_info = fetch_info or [str(f) for f in self.fetch_list]
+        self.print_period = print_period
+        self.debug = debug
+        self.steps = 0
+
+    def run_step(self, feed):
+        fetched = self.exe.run(self.program, feed=feed, fetch_list=self.fetch_list)
+        self.steps += 1
+        if self.fetch_list and self.print_period and self.steps % self.print_period == 0:
+            msg = ", ".join(f"{i}: {np.asarray(v).mean():.6f}"
+                            for i, v in zip(self.fetch_info, fetched))
+            print(f"[step {self.steps}] {msg}", flush=True)
+        return fetched
+
+
+class HogwildWorker(DeviceWorker):
+    """Plain feed->run loop (reference HogwildWorker::TrainFiles,
+    device_worker.h:249). Lock-free param updates have no TPU analogue — the
+    fused step owns the weights — so 'hogwild' here means workers assemble
+    batches concurrently while steps run in arrival order."""
+
+
+class TrainerBase:
+    worker_cls = HogwildWorker
+
+    def __init__(self, executor, program, dataset, fetch_list=None, fetch_info=None,
+                 print_period=100, debug=False, thread_num=None):
+        self.dataset = dataset
+        self.thread_num = max(1, thread_num or getattr(dataset, "_thread_num", 1))
+        self.worker = self.worker_cls(executor, program, fetch_list, fetch_info,
+                                      print_period, debug)
+        self._feed_names = [v.name for v in getattr(program, "_feed_vars", [])] or None
+
+    def _feed_name_list(self, batch):
+        if self._feed_names is not None:
+            return self._feed_names
+        # no declared feeds recorded: accept every dense slot + ids of sparse
+        return [n for n in batch] + [n + ".lens" for n in batch]
+
+    def run(self):
+        """Bounded prefetch queue: thread_num assembly workers (host) ahead of
+        the device step loop. Returns the last fetch values. Worker exceptions
+        are re-raised here — a truncated epoch must not look like a clean one."""
+        q: "queue.Queue" = queue.Queue(maxsize=4 * self.thread_num)
+        stop = object()
+        it = iter(self.dataset)
+        it_lock = threading.Lock()
+
+        def produce():
+            try:
+                while True:
+                    with it_lock:
+                        batch = next(it, stop)
+                    if batch is stop:
+                        break
+                    q.put(_assemble_feed(batch, self._feed_name_list(batch)))
+            except BaseException as e:  # propagate to the consumer
+                q.put(e)
+            finally:
+                q.put(stop)
+
+        threads = [threading.Thread(target=produce, daemon=True)
+                   for _ in range(self.thread_num)]
+        for t in threads:
+            t.start()
+        last = None
+        stops = 0
+        error = None
+        while stops < len(threads):
+            item = q.get()
+            if item is stop:
+                stops += 1
+                continue
+            if isinstance(item, BaseException):
+                error = error or item
+                continue
+            if error is None:
+                try:
+                    last = self.worker.run_step(item)
+                except BaseException as e:
+                    # keep draining so producers blocked on q.put can exit and
+                    # join; re-raise after shutdown
+                    error = e
+        for t in threads:
+            t.join()
+        if error is not None:
+            raise error
+        return last
+
+
+class MultiTrainer(TrainerBase):
+    """Single-host collective/plain training (reference MultiTrainer,
+    trainer.h:59)."""
+
+
+class DistMultiTrainer(TrainerBase):
+    """PS-mode trainer: flushes the fleet communicator around the epoch
+    (reference DistMultiTrainer + async Communicator, trainer.h:126)."""
+
+    def run(self):
+        comm = None
+        try:
+            from ..distributed.ps import runtime as ps_runtime
+
+            comm = getattr(ps_runtime, "_global_communicator", None)
+        except Exception:
+            pass
+        out = super().run()
+        if comm is not None and hasattr(comm, "flush"):
+            comm.flush()
+        return out
+
+
+class TrainerFactory:
+    """Pick a trainer from the program's distributed strategy (reference
+    TrainerFactory::CreateTrainer via trainer_desc proto)."""
+
+    @staticmethod
+    def create(executor, program, dataset, is_dist=False, **kw) -> TrainerBase:
+        cls = DistMultiTrainer if is_dist else MultiTrainer
+        return cls(executor, program, dataset, **kw)

@@ -1,0 +1,33 @@
+"""API surface guard: the committed API.spec must match the live package.
+
+Reference: paddle/fluid/API.spec + the CI check that diffs public API
+signatures so breaking changes are deliberate. Regenerate after intentional
+changes with:  python tools/gen_api_spec.py > API.spec
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_spec_up_to_date():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from gen_api_spec import collect
+    finally:
+        sys.path.pop(0)
+
+    live = collect()
+    with open(os.path.join(REPO, "API.spec")) as f:
+        committed = [l.rstrip("\n") for l in f if l.strip()]
+
+    missing = sorted(set(committed) - set(live))
+    added = sorted(set(live) - set(committed))
+    msg = []
+    if missing:
+        msg.append("signatures removed/changed vs API.spec:\n  " + "\n  ".join(missing[:20]))
+    if added:
+        msg.append("new/changed signatures not in API.spec:\n  " + "\n  ".join(added[:20]))
+    assert not msg, (
+        "\n".join(msg)
+        + "\n\nIf intentional: python tools/gen_api_spec.py > API.spec")

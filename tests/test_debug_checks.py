@@ -1,0 +1,76 @@
+"""Numeric/debug sentinels: FLAGS_enable_unused_var_check + op_bench harness.
+
+Reference: framework/unused_var_check.cc (ops that declare-but-don't-read
+inputs) and operators/benchmark/op_tester.cc (config-driven op latency).
+"""
+import json
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import dispatch
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_unused_var_check_warns():
+    paddle.set_flags({"enable_unused_var_check": True})
+    dispatch._unused_var_warned.discard("bad_op")
+    try:
+        import jax.numpy as jnp
+
+        a = Tensor(jnp.ones((4,)), stop_gradient=False)
+        b = Tensor(jnp.ones((4,)), stop_gradient=False)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            dispatch.apply("bad_op", lambda x, y: x * 2.0, [a, b])
+        assert any("never reads input(s) [1]" in str(x.message) for x in w), \
+            [str(x.message) for x in w]
+
+        # a well-formed op stays silent
+        dispatch._unused_var_warned.discard("good_op")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            dispatch.apply("good_op", lambda x, y: x + y, [a, b])
+        assert not [x for x in w if "never reads" in str(x.message)]
+    finally:
+        paddle.set_flags({"enable_unused_var_check": False})
+
+
+def test_unused_var_check_warns_once():
+    paddle.set_flags({"enable_unused_var_check": True})
+    dispatch._unused_var_warned.discard("bad_once")
+    try:
+        import jax.numpy as jnp
+
+        a = Tensor(jnp.ones((2,)), stop_gradient=False)
+        b = Tensor(jnp.ones((2,)), stop_gradient=False)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            dispatch.apply("bad_once", lambda x, y: x, [a, b])
+            dispatch.apply("bad_once", lambda x, y: x, [a, b])
+        assert len([x for x in w if "never reads" in str(x.message)]) == 1
+    finally:
+        paddle.set_flags({"enable_unused_var_check": False})
+
+
+def test_op_bench_harness(tmp_path):
+    cfgs = [{"op": "matmul", "args": [[64, 64], [64, 64]], "dtype": "float32",
+             "repeat": 3},
+            {"op": "relu", "args": [[128, 128]], "dtype": "float32", "repeat": 3}]
+    cfg_file = tmp_path / "cfg.json"
+    cfg_file.write_text(json.dumps(cfgs))
+    out = subprocess.run(
+        [sys.executable, "tools/op_bench.py", "--config", str(cfg_file),
+         "--device", "cpu"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
+    assert lines[0]["backend"] == "cpu"
+    by_op = {l.get("op"): l for l in lines[1:]}
+    assert "error" not in by_op["matmul"], by_op["matmul"]
+    assert by_op["matmul"]["mean_us"] > 0
+    assert by_op["relu"]["p50_us"] > 0

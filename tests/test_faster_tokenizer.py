@@ -1,0 +1,113 @@
+"""FasterTokenizer (C++ wordpiece, core/native/tokenizer.cc) vs the Python
+fallback and reference semantics (faster_tokenizer_op.h BertTokenizer)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.text import FasterTokenizer
+from paddle_tpu.text.faster_tokenizer import (_NativeTok, _basic_tokenize,
+                                              wordpiece_tokenize)
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "brown", "fox",
+         "jump", "##ed", "##s", "over", "lazy", "dog", "!", ",", "a",
+         "un", "##aff", "##able", "你", "好", "caf", "##e"]
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return FasterTokenizer(VOCAB)
+
+
+def test_native_backend_built(tok):
+    assert tok._native is not None, "C++ tokenizer should build in this image"
+
+
+def test_basic_wordpiece(tok):
+    ids, tt = tok("The quick brown fox jumped over the lazy dog!")
+    row = ids.numpy()[0].tolist()
+    v = {t: i for i, t in enumerate(VOCAB)}
+    assert row[0] == v["[CLS]"] and row[-1] == v["[SEP]"]
+    assert row[1:-1] == [v[t] for t in
+                         ["the", "quick", "brown", "fox", "jump", "##ed",
+                          "over", "the", "lazy", "dog", "!"]]
+    assert (tt.numpy() == 0).all()
+
+
+def test_unknown_word_collapses_to_unk(tok):
+    ids, _ = tok("the zebra")
+    v = {t: i for i, t in enumerate(VOCAB)}
+    assert ids.numpy()[0].tolist() == [v["[CLS]"], v["the"], v["[UNK]"], v["[SEP]"]]
+
+
+def test_cjk_isolated_and_accent_fold(tok):
+    ids, _ = tok("Café 你好")  # Café 你好
+    v = {t: i for i, t in enumerate(VOCAB)}
+    assert ids.numpy()[0].tolist() == [
+        v["[CLS]"], v["caf"], v["##e"], v["你"], v["好"], v["[SEP]"]]
+
+
+def test_pairs_truncation_padding(tok):
+    ids, tt = tok(["the quick fox", "a dog"],
+                  text_pair=["over a lazy dog", "the fox"],
+                  max_seq_len=10, pad_to_max_seq_len=True)
+    assert list(ids.shape) == [2, 10] and list(tt.shape) == [2, 10]
+    a, b = ids.numpy(), tt.numpy()
+    v = {t: i for i, t in enumerate(VOCAB)}
+    # row 1: [CLS] a dog [SEP] the fox [SEP] + pad
+    assert a[1].tolist()[:7] == [v["[CLS]"], v["a"], v["dog"], v["[SEP]"],
+                                 v["the"], v["fox"], v["[SEP]"]]
+    assert (a[1][7:] == v["[PAD]"]).all()
+    assert b[1].tolist()[:7] == [0, 0, 0, 0, 1, 1, 1]
+    # truncation respected
+    assert (np.sum(a[0] != v["[PAD]"])) <= 10
+
+
+def test_native_matches_python_fallback(tok):
+    texts = ["The QUICK brown fox!", "unaffable", "café, 你好 dog",
+             "the the the", "", "zebra unaffable !"]
+    v = tok.vocab
+    for t in texts:
+        native = tok._native.tokenize(t)
+        py = []
+        for w in _basic_tokenize(t, True):
+            py.extend(wordpiece_tokenize(w, v, tok.unk_id))
+        assert native == py, (t, native, py)
+
+
+def test_wordpiece_greedy_longest():
+    v = {t: i for i, t in enumerate(VOCAB)}
+    assert wordpiece_tokenize("unaffable", v, 1) == [v["un"], v["##aff"], v["##able"]]
+    assert wordpiece_tokenize("jumps", v, 1) == [v["jump"], v["##s"]]
+    assert wordpiece_tokenize("x" * 200, v, 1) == [1]  # max_chars -> unk
+
+
+def test_tokenizer_feeds_ernie():
+    """The reference's faster_tokenizer->ERNIE pipeline: text in, encoder out."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.ernie import ErnieConfig, ErnieModel
+
+    paddle.seed(0)
+    tok = FasterTokenizer(VOCAB)
+    ids, tt = tok(["the quick brown fox", "你 好 dog"],
+                  max_seq_len=16, pad_to_max_seq_len=True)
+    cfg = ErnieConfig(vocab_size=len(VOCAB), hidden_size=32, num_layers=2,
+                      num_heads=2, max_seq_len=16)
+    model = ErnieModel(cfg)
+    seq_out, pooled = model(ids, token_type_ids=tt)
+    assert list(seq_out.shape) == [2, 16, 32]
+    assert list(pooled.shape) == [2, 32]
+
+
+def test_dict_vocab_ids_preserved():
+    """Caller-assigned ids (gaps, non-zero base) must survive — both backends."""
+    v = {"[PAD]": 0, "[UNK]": 100, "[CLS]": 7, "[SEP]": 9, "hello": 7007}
+    tok = FasterTokenizer(v)
+    ids, _ = tok("hello zzz")
+    assert ids.numpy()[0].tolist() == [7, 7007, 100, 9]
+    if tok._native is not None:
+        assert tok._native.tokenize("hello") == [7007]
+
+
+def test_max_seq_len_too_small_raises():
+    tok = FasterTokenizer(VOCAB)
+    with pytest.raises(ValueError, match="cannot hold"):
+        tok("a", text_pair="dog", max_seq_len=2)

@@ -1,0 +1,152 @@
+"""Trainer/DeviceWorker stack: Executor.train_from_dataset over the C++ feed.
+
+Reference (#12): trainer.h:59 MultiTrainer + device_worker.h:249 HogwildWorker
+driven from executor.py train_from_dataset; here the loop is
+static/trainer.py's prefetch-queue + fused-XLA-step design.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import static
+
+
+def _write_dense_file(path, rows, seed):
+    """Slots: x (4 dense floats), y (1 float). y = x @ w_true + 0.1."""
+    rs = np.random.RandomState(seed)
+    w = np.array([0.5, -1.0, 2.0, 0.25])
+    lines = []
+    for _ in range(rows):
+        x = rs.rand(4).round(4)
+        y = float(x @ w + 0.1)
+        lines.append("4 " + " ".join(f"{v:.4f}" for v in x) + f" 1 {y:.5f}")
+    path.write_text("\n".join(lines) + "\n")
+
+
+@pytest.fixture()
+def dense_dataset(tmp_path):
+    for i in range(2):
+        _write_dense_file(tmp_path / f"part-{i}", 32, i)
+    ds = dist.InMemoryDataset()
+    ds.init(batch_size=8, thread_num=2, use_var=[("x", "f"), ("y", "f")])
+    ds.set_filelist([str(tmp_path / "part-0"), str(tmp_path / "part-1")])
+    ds.load_into_memory()
+    return ds
+
+
+def _build_program():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 4], "float32")
+        y = static.data("y", [-1, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss = paddle.mean((pred - y) ** 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def test_train_from_dataset_learns(dense_dataset, capsys):
+    paddle.seed(0)
+    main, startup, loss = _build_program()
+    exe = static.Executor()
+    exe.run(startup)
+
+    first = exe.train_from_dataset(main, dense_dataset, fetch_list=[loss],
+                                   fetch_info=["loss"], print_period=4)
+    for _ in range(25):  # more epochs over the in-memory set
+        last = exe.train_from_dataset(main, dense_dataset, fetch_list=[loss],
+                                      print_period=0)
+    assert float(last[0]) < float(first[0])
+    assert float(last[0]) < 0.05
+    out = capsys.readouterr().out
+    assert "[step 4] loss:" in out  # print_period fetch reporting
+
+
+def test_infer_from_dataset_no_update(dense_dataset):
+    paddle.seed(0)
+    main, startup, loss = _build_program()
+    exe = static.Executor()
+    exe.run(startup)
+    exe.train_from_dataset(main, dense_dataset, print_period=0)
+
+    w_name = [n for n in main._captures][0]
+    before = np.asarray(main._captures[w_name]._data).copy()
+    out = exe.infer_from_dataset(main, dense_dataset, fetch_list=[loss],
+                                 print_period=0)
+    after = np.asarray(main._captures[w_name]._data)
+    np.testing.assert_array_equal(before, after)  # no parameter updates
+    assert out is not None
+
+
+def test_sparse_slot_padding():
+    from paddle_tpu.static.trainer import _assemble_feed
+
+    vals = np.array([5, 6, 7, 8, 9], np.uint64)
+    offs = np.array([0, 2, 2, 5], np.int64)  # rows of width 2, 0, 3
+    feed = _assemble_feed({"ids": (vals, offs)}, ["ids", "ids.lens"])
+    assert feed["ids"].shape == (3, 4)  # maxlen 3 -> bucket 4
+    np.testing.assert_array_equal(feed["ids"][0], [5, 6, 0, 0])
+    np.testing.assert_array_equal(feed["ids"][1], [0, 0, 0, 0])
+    np.testing.assert_array_equal(feed["ids"][2], [7, 8, 9, 0])
+    np.testing.assert_array_equal(feed["ids.lens"], [2, 0, 3])
+
+
+def test_trainer_factory_dist_selection(dense_dataset):
+    from paddle_tpu.static.trainer import (DistMultiTrainer, MultiTrainer,
+                                           TrainerFactory)
+
+    main, startup, loss = _build_program()
+    exe = static.Executor()
+    t = TrainerFactory.create(exe, main, dense_dataset, is_dist=False)
+    assert isinstance(t, MultiTrainer) and not isinstance(t, DistMultiTrainer)
+    t = TrainerFactory.create(exe, main, dense_dataset, is_dist=True)
+    assert isinstance(t, DistMultiTrainer)
+
+
+def test_producer_exception_propagates(dense_dataset):
+    main, startup, loss = _build_program()
+    exe = static.Executor()
+    exe.run(startup)
+
+    class Exploding:
+        _thread_num = 2
+
+        def __iter__(self):
+            yield from iter(dense_dataset)
+            raise OSError("corrupt feed file")
+
+    with pytest.raises(OSError, match="corrupt feed file"):
+        exe.train_from_dataset(main, Exploding(), print_period=0)
+
+
+def test_multi_thread_producers(dense_dataset):
+    paddle.seed(0)
+    main, startup, loss = _build_program()
+    exe = static.Executor()
+    exe.run(startup)
+    out = exe.train_from_dataset(main, dense_dataset, thread=4,
+                                 fetch_list=[loss], print_period=0)
+    assert out is not None
+
+
+def test_device_step_exception_joins_producers(dense_dataset):
+    """A failing device step must drain the queue, join producers, and raise
+    (not leak threads blocked on q.put)."""
+    import threading
+
+    main, startup, loss = _build_program()
+    exe = static.Executor()
+    exe.run(startup)
+    before = threading.active_count()
+
+    class BoomExec:
+        def run(self, *a, **k):
+            raise RuntimeError("device step failed")
+
+    from paddle_tpu.static.trainer import MultiTrainer
+    t = MultiTrainer(BoomExec(), main, dense_dataset, thread_num=3)
+    with pytest.raises(RuntimeError, match="device step failed"):
+        t.run()
+    assert threading.active_count() <= before + 1  # producers joined

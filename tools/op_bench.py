@@ -1,0 +1,135 @@
+"""Op micro-benchmark harness.
+
+Reference: the config-driven OpTester (paddle/fluid/operators/benchmark/
+op_tester.cc + op_tester_config.h) — build one op from a config, run it in a
+loop, report per-launch latency. TPU-native version: benchmark PUBLIC ops
+through the same dispatch path training uses (paddle_tpu op wrapper -> apply ->
+jit-cached XLA executable), so the number includes real dispatch overhead.
+
+Usage:
+  python tools/op_bench.py                         # built-in config set
+  python tools/op_bench.py --config my.json        # custom configs
+  python tools/op_bench.py --op matmul --repeat 200
+
+Config entries: {"op": "matmul", "args": [[1024,1024],[1024,1024]],
+                 "dtype": "float32", "attrs": {...}, "repeat": 100}
+"args" are input shapes (lists) or scalars passed through.
+One JSON line per config: {"op", "shape", "dtype", "mean_us", "p50_us", ...}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+DEFAULT_CONFIGS = [
+    {"op": "matmul", "args": [[1024, 1024], [1024, 1024]], "dtype": "bfloat16"},
+    {"op": "matmul", "args": [[4096, 4096], [4096, 4096]], "dtype": "bfloat16"},
+    {"op": "add", "args": [[4096, 4096], [4096, 4096]], "dtype": "float32"},
+    {"op": "softmax", "args": [[64, 4096]], "dtype": "float32"},
+    {"op": "layer_norm", "args": [[64, 4096]], "dtype": "float32"},
+    {"op": "relu", "args": [[4096, 4096]], "dtype": "float32"},
+    {"op": "mean", "args": [[4096, 4096]], "dtype": "float32"},
+    {"op": "transpose", "args": [[2048, 2048]], "dtype": "float32",
+     "attrs": {"perm": [1, 0]}},
+]
+
+
+def _resolve(op_name):
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+
+    for mod in (paddle, paddle.nn.functional if hasattr(paddle.nn, "functional") else F):
+        fn = getattr(mod, op_name, None)
+        if callable(fn):
+            return fn
+    raise SystemExit(f"unknown op {op_name!r}")
+
+
+def bench_one(cfg, warmup=5):
+    import paddle_tpu as paddle
+
+    fn = _resolve(cfg["op"])
+    rng = np.random.RandomState(0)
+    dtype = cfg.get("dtype", "float32")
+    repeat = int(cfg.get("repeat", 100))
+    args = []
+    for a in cfg["args"]:
+        if isinstance(a, list):
+            args.append(paddle.to_tensor(rng.randn(*a).astype(np.float32)).astype(dtype))
+        else:
+            args.append(a)
+    attrs = cfg.get("attrs", {})
+
+    def call():
+        out = fn(*args, **attrs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return out
+
+    for _ in range(warmup):
+        out = call()
+    float(np.asarray(out.numpy()).ravel()[0])  # full D2H sync after warmup
+
+    times = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        out = call()
+        np.asarray(out.numpy()).ravel()[:1]  # sync each launch: latency incl. dispatch
+        times.append((time.perf_counter() - t0) * 1e6)
+    times = np.array(times)
+    return {
+        "op": cfg["op"],
+        "shape": cfg["args"],
+        "dtype": dtype,
+        "mean_us": round(float(times.mean()), 2),
+        "p50_us": round(float(np.percentile(times, 50)), 2),
+        "p99_us": round(float(np.percentile(times, 99)), 2),
+        "repeat": repeat,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", help="json file with a list of op configs")
+    ap.add_argument("--op", help="bench a single op by name")
+    ap.add_argument("--shape", default="1024,1024",
+                    help="input shapes for --op: comma dims, ';' between inputs "
+                         "(e.g. '512,256;256,64' for matmul)")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--repeat", type=int, default=100)
+    ap.add_argument("--device", help="jax platform override (e.g. cpu); needed "
+                    "because the site config freezes JAX_PLATFORMS at startup")
+    args = ap.parse_args()
+
+    if args.device:
+        import jax
+
+        jax.config.update("jax_platforms", args.device)
+
+    if args.config:
+        with open(args.config) as f:
+            configs = json.load(f)
+    elif args.op:
+        shapes = [[int(d) for d in grp.split(",")]
+                  for grp in args.shape.split(";") if grp]
+        configs = [{"op": args.op, "args": shapes,
+                    "dtype": args.dtype, "repeat": args.repeat}]
+    else:
+        configs = DEFAULT_CONFIGS
+
+    import jax
+
+    print(json.dumps({"backend": jax.default_backend(),
+                      "device_count": jax.device_count()}))
+    for cfg in configs:
+        try:
+            print(json.dumps(bench_one(cfg)))
+        except Exception as e:  # keep the sweep going; report the failure
+            print(json.dumps({"op": cfg.get("op"), "error": str(e)[:200]}))
+
+
+if __name__ == "__main__":
+    main()
