@@ -37,6 +37,8 @@ def main():
         batch, seq, steps, warmup = 8, 128, 5, 1
     batch = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", batch))
     steps = int(os.environ.get("PADDLE_TPU_BENCH_STEPS", steps))
+    if os.environ.get("PADDLE_TPU_BENCH_AUTOTUNE"):  # flash block-size search
+        paddle.incubate.autotune.set_config({"kernel": {"enable": True}})
     if batch % n_dev:  # batch dim shards over dp_degree = n_dev
         batch = max(n_dev, batch - batch % n_dev)
 

@@ -62,6 +62,8 @@ class AlgorithmsCache:
         k1, k2 = self._k(kernel, key)
         with _lock:
             self._map.setdefault(k1, {})[k2] = choice
+        if self is _cache:
+            _bump()
 
     def size(self) -> int:
         return sum(len(v) for v in self._map.values())
@@ -100,6 +102,27 @@ _config = {
 }
 _step = 0
 _saved = False
+_version = 0  # bumped on config changes / new tunings
+_listeners = []  # callbacks fired on bump (dispatch rule-cache invalidation)
+
+
+def version() -> int:
+    return _version
+
+
+def on_change(cb):
+    """Register a callback for tuning-state changes (new tuned choice, config
+    change). The dispatch rule cache uses this to drop traces that baked in a
+    stale block-size choice — invalidation instead of version-in-key, so an
+    unrelated op's cached rules aren't orphaned by every bump."""
+    _listeners.append(cb)
+
+
+def _bump():
+    global _version
+    _version += 1
+    for cb in _listeners:
+        cb()
 
 
 def cache() -> AlgorithmsCache:
@@ -117,6 +140,7 @@ def enabled() -> bool:
 def set_config(config: Optional[dict] = None):
     """paddle.incubate.autotune.set_config semantics: dict (or json file path)
     with a "kernel" section {enable, tuning_range}."""
+    _bump()
     if config is None:
         _config["kernel"]["enable"] = True
         return

@@ -116,6 +116,87 @@ def _wrap_out(data, stop_gradient):
     return Tensor(data, stop_gradient=stop_gradient)
 
 
+class _Unhashable(Exception):
+    pass
+
+
+def _freeze(v):
+    """Hashable projection of closure/attr values; raises for anything whose
+    change wouldn't be visible in the cache key (arrays, tracers, objects)."""
+    if isinstance(v, (bool, int, float, str, bytes, type(None))):
+        return v
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, np.dtype):
+        return ("npdtype", str(v))
+    if type(v).__module__ == "numpy" and np.isscalar(v):
+        return ("npscalar", str(v.dtype), v.item())  # keep dtype in the key
+    raise _Unhashable
+
+
+# (name, code id, closure values, attrs, arg signature, diff idx, cast) ->
+# (jitted fwd over all args, jitted recompute-backward). The reference pays
+# per-op dispatch via generated C fast paths (op_function_generator.h); here
+# the analogue is jit-caching the per-op forward AND its vjp so steady-state
+# dygraph ops skip Python retracing (FLAGS_eager_op_jit).
+_RULE_CACHE: Dict[tuple, tuple] = {}
+_RULE_CACHE_CAP = 4096
+_UNSEEN = object()
+
+
+def _rule_key(name, kernel, arrays, attrs, diff_idx, cast_to):
+    code = getattr(kernel, "__code__", None)
+    if code is None:
+        return None  # pre-jitted / callable object: no stable identity to key on
+    try:
+        closure_vals = tuple(
+            _freeze(c.cell_contents) for c in (getattr(kernel, "__closure__", None) or ()))
+        akey = tuple(sorted((k, _freeze(v)) for k, v in attrs.items()))
+    except _Unhashable:
+        return None
+    sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+    # flags kernels read at trace time must be part of the key; autotune-state
+    # changes instead CLEAR the cache via autotune.on_change (version-in-key
+    # would orphan every op's rules on each new tuning)
+    trace_flags = (flag("tpu_matmul_precision"), flag("use_flash_attention"),
+                   flag("use_autotune"))
+    return (name, id(code), closure_vals, akey, sig,
+            tuple(diff_idx), str(cast_to), trace_flags)
+
+
+def _has_float0(cts):
+    leaves = cts if isinstance(cts, (tuple, list)) else (cts,)
+    return any(getattr(c, "dtype", None) == jax.dtypes.float0 for c in leaves)
+
+
+def _apply_cast(args, cast_to):
+    """AMP cast shared by the cached and uncached dispatch paths."""
+    if cast_to is None:
+        return list(args)
+    return [a.astype(cast_to) if _is_float_array(a) and a.dtype != cast_to else a
+            for a in args]
+
+
+def _build_rules(kernel, attrs, diff_idx, cast_to):
+    def fwd(arrays_tuple):
+        return kernel(*_apply_cast(arrays_tuple, cast_to), **attrs)
+
+    def bwd(arrays_tuple, cts):
+        def g(*diff_arrays):
+            fa = list(arrays_tuple)
+            for i, a in zip(diff_idx, diff_arrays):
+                fa[i] = a
+            return kernel(*_apply_cast(fa, cast_to), **attrs)
+
+        _, vjp_fn = jax.vjp(g, *[arrays_tuple[i] for i in diff_idx])
+        return vjp_fn(cts)
+
+    # backward recomputes the forward from saved inputs inside one XLA program:
+    # for linear ops XLA DCEs the recompute entirely (residuals are the
+    # inputs); elementwise recompute is cheaper than a Python retrace per call
+    return jax.jit(fwd), jax.jit(bwd)
+
+
 def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=None,
           differentiable: bool = True):
     """Run `kernel(*arrays, **attrs)` with autograd recording.
@@ -143,12 +224,7 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
         full = list(arrays)
         for i, a in zip(diff_idx, diff_arrays):
             full[i] = a
-        if cast_to is not None:
-            full = [
-                a.astype(cast_to) if _is_float_array(a) and a.dtype != cast_to else a
-                for a in full
-            ]
-        return kernel(*full, **attrs)
+        return kernel(*_apply_cast(full, cast_to), **attrs)
 
     diff_arrays = [arrays[i] for i in diff_idx]
 
@@ -158,11 +234,49 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
         and any(not tensor_args[i].stop_gradient for i in diff_idx)
     )
 
-    if need_grad and diff_idx:
-        out_data, vjp_fn = jax.vjp(f, *diff_arrays)
-    else:
-        out_data = f(*diff_arrays)
-        vjp_fn = None
+    rules = None
+    key = None
+    if flag("eager_op_jit"):
+        key = _rule_key(name, kernel, arrays, attrs, diff_idx, cast_to)
+        if key is not None:
+            rules = _RULE_CACHE.get(key, _UNSEEN)
+            if rules is _UNSEEN:
+                if len(_RULE_CACHE) >= _RULE_CACHE_CAP:
+                    _RULE_CACHE.clear()
+                rules = _build_rules(kernel, attrs, diff_idx, cast_to)
+                _RULE_CACHE[key] = rules
+            # rules may be None: key previously proved untraceable
+
+    if rules is not None:
+        arrays_tuple = tuple(arrays)
+        try:
+            out_data = rules[0](arrays_tuple)
+        except jax.errors.ConcretizationTypeError:
+            # value-dependent kernel (shapes depend on array values, e.g.
+            # segment ops sizing by max(ids)): permanently uncacheable — run
+            # eagerly like the reference's non-jittable CPU ops
+            _RULE_CACHE[key] = None
+            rules = None
+        else:
+            if need_grad and diff_idx:
+                bwd = rules[1]
+
+                def vjp_fn(cts, _bwd=bwd, _at=arrays_tuple):
+                    if _has_float0(cts):
+                        # float0 cotangents (int outputs of multi-output ops
+                        # like topk) are not valid jit arguments — take the
+                        # uncached vjp for this rare call
+                        _, vf = jax.vjp(f, *diff_arrays)
+                        return vf(cts)
+                    return _bwd(_at, cts)
+            else:
+                vjp_fn = None
+    if rules is None:
+        if need_grad and diff_idx:
+            out_data, vjp_fn = jax.vjp(f, *diff_arrays)
+        else:
+            out_data = f(*diff_arrays)
+            vjp_fn = None
 
     multi = isinstance(out_data, (tuple, list))
     outs_data = list(out_data) if multi else [out_data]
@@ -249,3 +363,10 @@ def as_tensor(x, dtype=None):
     if a.dtype == np.float64:
         a = a.astype(dtypes.get_default_dtype())
     return Tensor(jnp.array(a), stop_gradient=True)
+
+
+# autotune-state changes invalidate cached rules (flash attention bakes the
+# tuned block choice into its trace)
+from . import autotune as _autotune  # noqa: E402
+
+_autotune.on_change(_RULE_CACHE.clear)

@@ -120,6 +120,7 @@ class TrainStepEngine:
         self._step_count = optimizer._step_count
         self._key = jax.random.key(random_mod.default_generator().initial_seed() or 0)
         self.last_loss = None
+        self._lr_cache = (None, None)  # (python value, device scalar)
 
     # ---- step function construction ----
     def _build(self, batch_avals):
@@ -217,7 +218,10 @@ class TrainStepEngine:
         arrays = [jax.device_put(a, s) for a, s in zip(arrays, self._batch_shardings)]
         self._step_count += 1
         self.optimizer._step_count = self._step_count  # keep ckpt/resume consistent
-        lr = jnp.float32(self.optimizer.get_lr())
+        lr_val = self.optimizer.get_lr()
+        if self._lr_cache[0] != lr_val:  # constant-lr steps reuse the device scalar
+            self._lr_cache = (lr_val, jnp.float32(lr_val))
+        lr = self._lr_cache[1]
         self._key, sub = jax.random.split(self._key)
         loss, self.params, self.opt_state = self._step_fn(
             self.params, self.opt_state, lr, jnp.int32(self._step_count), sub, *arrays)

@@ -49,28 +49,85 @@ def _is_tensorish(x):
         hasattr(x, "aval")
 
 
+def _is_traced(x):
+    """True only for values whose CONTENT is unknown (tracers). Concrete
+    jax arrays have definite values — python control flow on them keeps
+    dygraph semantics (and branch-local UnboundLocal errors) instead of
+    forcing both branches through lax.cond."""
+    if isinstance(x, Tensor):
+        x = x._data
+    return isinstance(x, jax.core.Tracer)
+
+
 # --------------------------------------------------------------- runtime API
-def convert_ifelse(pred, true_fn, false_fn):
+class _Undefined:
+    """Placeholder for branch out-vars with no value before the `if`. Any USE
+    raises the UnboundLocalError plain python would have raised — merely
+    binding it (var assigned in the other branch, never read after) is legal,
+    matching python's read-time semantics."""
+
+    __slots__ = ("_name",)
+
+    def __init__(self, name="<var>"):
+        object.__setattr__(self, "_name", name)
+
+    def __repr__(self):
+        return f"<{object.__getattribute__(self, '_name')} undefined before if>"
+
+    def _raise(self, *a, **k):
+        name = object.__getattribute__(self, "_name")
+        raise UnboundLocalError(
+            f"local variable {name!r} referenced before assignment (it is only "
+            f"assigned in one branch of a converted `if`)")
+
+    __getattr__ = __call__ = __bool__ = __iter__ = __len__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __truediv__ = __rtruediv__ = __getitem__ = __eq__ = __ne__ = _raise
+    __lt__ = __gt__ = __le__ = __ge__ = __neg__ = __matmul__ = _raise
+    __pow__ = __rpow__ = __mod__ = __rmod__ = __divmod__ = _raise
+    __floordiv__ = __rfloordiv__ = __abs__ = __pos__ = __invert__ = _raise
+    __float__ = __int__ = __index__ = __complex__ = __hash__ = _raise
+    __contains__ = __setitem__ = __delitem__ = __and__ = __or__ = _raise
+    # (identity tests `z is None` are the one use python itself can't hook)
+
+
+UNDEFINED = _Undefined()
+
+
+def undefined(name):
+    """init-capture hook for a not-yet-bound branch out-var."""
+    return _Undefined(name)
+
+
+def convert_ifelse(pred, true_fn, false_fn, init_args=()):
     """`if` lowering: lax.cond when the predicate is traced, python otherwise
-    (reference convert_operators.py convert_ifelse)."""
+    (reference convert_operators.py convert_ifelse).
+
+    init_args carry the pre-branch values of every variable the branches
+    assign: the branch functions take them as parameters so (a) a variable
+    both read and written in a branch sees its outer value, and (b) under
+    lax.cond each traced branch starts from the same initial state instead of
+    observing the other branch's mutations."""
     if isinstance(pred, Tensor):
         pred = pred._data
-    if _is_tensorish(pred):
+    if _is_traced(pred):
         import jax.numpy as jnp
 
-        p = pred
-        if isinstance(p, Tensor):
-            p = p._data
-        p = jnp.reshape(p.astype(bool) if p.dtype != bool else p, ())
-        return jax.lax.cond(p, true_fn, false_fn)
-    return true_fn() if pred else false_fn()
+        p = jnp.reshape(pred.astype(bool) if pred.dtype != bool else pred, ())
+        # closures (not operands): an UNDEFINED init must only fail if a
+        # branch actually reads it
+        return jax.lax.cond(p, lambda: true_fn(*init_args),
+                            lambda: false_fn(*init_args))
+    if hasattr(pred, "item"):  # concrete array -> python bool
+        pred = bool(pred)
+    return true_fn(*init_args) if pred else false_fn(*init_args)
 
 
 def convert_while_loop(cond_fn, body_fn, loop_vars):
     """`while` lowering: lax.while_loop when the condition is traced
     (reference convert_while_loop). Loop carries are the assigned names."""
     first = cond_fn(*loop_vars)
-    if isinstance(first, Tensor) or _is_tensorish(first):
+    if _is_traced(first) or any(_is_traced(v) for v in loop_vars):
         import jax.numpy as jnp
 
         def cond(vs):
@@ -143,7 +200,8 @@ def _assigned_names(stmts):
     c = _NameCollector()
     for s in stmts:
         c.visit(s)
-    return c.stored
+    # __dy2st_* temps belong to already-transformed inner blocks, not the user
+    return [n for n in c.stored if not n.startswith("__dy2st_")]
 
 
 class _HasEscape(ast.NodeVisitor):
@@ -233,20 +291,43 @@ class _Dy2Static(ast.NodeTransformer):
         t_name, f_name = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
         ret = ast.Return(value=ast.Tuple(
             elts=[_load(v) for v in out_vars], ctx=ast.Load()))
-        empty_args = ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
-                                   kw_defaults=[], defaults=[])
-        t_def = ast.FunctionDef(name=t_name, args=empty_args,
+        # branches take the out-vars as PARAMETERS carrying their pre-branch
+        # values: a name read-then-written in a branch resolves to the param
+        # (python would otherwise make it an unbound local of the nested fn),
+        # and lax.cond traces both branches from identical initial state
+        branch_args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in out_vars],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        t_def = ast.FunctionDef(name=t_name, args=branch_args,
                                 body=list(node.body) + [ret], decorator_list=[],
                                 type_params=[])
         f_body = list(node.orelse) + [ret]
-        f_def = ast.FunctionDef(name=f_name, args=empty_args, body=f_body,
+        f_def = ast.FunctionDef(name=f_name, args=branch_args, body=f_body,
                                 decorator_list=[], type_params=[])
+        # capture initial values; vars not yet bound become UNDEFINED
+        inits = []
+        init_stmts = []
+        for i, v in enumerate(out_vars):
+            iname = f"__dy2st_init_{uid}_{i}"
+            inits.append(_load(iname))
+            init_stmts.append(ast.Try(
+                body=[ast.Assign(targets=[_store(iname)], value=_load(v))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Tuple(elts=[_load("NameError"),
+                                         _load("UnboundLocalError")],
+                                   ctx=ast.Load()),
+                    name=None,
+                    body=[ast.Assign(
+                        targets=[_store(iname)],
+                        value=_jst_call("undefined", [ast.Constant(value=v)]))])],
+                orelse=[], finalbody=[]))
         assign = ast.Assign(
             targets=[ast.Tuple(elts=[_store(v) for v in out_vars],
                                ctx=ast.Store())],
             value=_jst_call("convert_ifelse",
-                            [node.test, _load(t_name), _load(f_name)]))
-        return [t_def, f_def, assign]
+                            [node.test, _load(t_name), _load(f_name),
+                             ast.Tuple(elts=inits, ctx=ast.Load())]))
+        return init_stmts + [t_def, f_def, assign]
 
     # --- while ---
     def visit_While(self, node):

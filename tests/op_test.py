@@ -67,13 +67,16 @@ def check_grad(op_fn, inputs, attrs=None, input_idx=0, eps=1e-3, rtol=5e-3, atol
     flat = numeric.reshape(-1)
     base = np_inputs[input_idx].reshape(-1)
     for i in range(flat.size):
+        # sync (.item) BEFORE the next in-place mutation of `base`: jax may
+        # defer the host-buffer copy of a jnp.array input under async dispatch,
+        # so mutating before the previous evaluation completes races with it
         orig = base[i]
         base[i] = orig + eps
-        lp, _ = run(np_inputs)
+        lp = float(run(np_inputs)[0].item())
         base[i] = orig - eps
-        lm, _ = run(np_inputs)
+        lm = float(run(np_inputs)[0].item())
         base[i] = orig
-        flat[i] = (float(lp.item()) - float(lm.item())) / (2 * eps)
+        flat[i] = (lp - lm) / (2 * eps)
 
     np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
                                err_msg=f"grad check {getattr(op_fn, '__name__', op_fn)}")

@@ -1,0 +1,146 @@
+"""Eager dispatch rule cache (FLAGS_eager_op_jit): correctness of the cache key.
+
+The cached (fwd, bwd) pair must never alias two semantically different
+kernels — closure scalars, attrs, shapes/dtypes, and trace-time flags are all
+part of the key; anything unhashable (arrays in closures) must bypass caching.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import dispatch
+from paddle_tpu.core.tensor import Tensor
+
+import jax.numpy as jnp
+
+
+def setup_function(_):
+    dispatch._RULE_CACHE.clear()
+
+
+def test_cache_hit_same_kernel():
+    a = Tensor(jnp.ones((4,)), stop_gradient=False)
+
+    def call():
+        return dispatch.apply("t_scale2", lambda x: x * 2.0, [a])
+
+    out1 = call()
+    n1 = len(dispatch._RULE_CACHE)
+    out2 = call()
+    assert len(dispatch._RULE_CACHE) == n1 == 1  # second call hit
+    np.testing.assert_allclose(out2.numpy(), 2 * np.ones(4))
+
+
+def test_closure_scalar_changes_key():
+    a = Tensor(jnp.ones((4,)), stop_gradient=False)
+
+    def make(scale):
+        def kernel(x):
+            return x * scale
+        return kernel
+
+    out2 = dispatch.apply("t_scale", make(2.0), [a])
+    out3 = dispatch.apply("t_scale", make(3.0), [a])
+    np.testing.assert_allclose(out2.numpy(), 2 * np.ones(4))
+    np.testing.assert_allclose(out3.numpy(), 3 * np.ones(4))  # no stale hit
+    assert len(dispatch._RULE_CACHE) == 2
+
+
+def test_array_closure_bypasses_cache():
+    a = Tensor(jnp.ones((4,)), stop_gradient=False)
+    shift = jnp.arange(4.0)
+
+    def kernel(x):
+        return x + shift  # array closure: _freeze must refuse
+
+    out = dispatch.apply("t_shift", kernel, [a])
+    assert len(dispatch._RULE_CACHE) == 0
+    np.testing.assert_allclose(out.numpy(), 1 + np.arange(4.0))
+
+
+def test_attrs_and_shapes_in_key():
+    a = Tensor(jnp.ones((2, 3)), stop_gradient=False)
+    b = Tensor(jnp.ones((3, 2)), stop_gradient=False)
+
+    def kernel(x, axis):
+        return jnp.sum(x, axis=axis)
+
+    o1 = dispatch.apply("t_sum", kernel, [a], {"axis": 0})
+    o2 = dispatch.apply("t_sum", kernel, [b], {"axis": 0})
+    o3 = dispatch.apply("t_sum", kernel, [a], {"axis": 1})
+    assert list(o1.shape) == [3] and list(o2.shape) == [2] and list(o3.shape) == [2]
+    assert len(dispatch._RULE_CACHE) == 3  # distinct shapes/attrs, distinct rules
+
+
+def test_cached_backward_matches_uncached():
+    rng = np.random.RandomState(0)
+    an, bn = rng.randn(8, 8).astype(np.float32), rng.randn(8, 8).astype(np.float32)
+
+    def run(flag_on):
+        paddle.set_flags({"eager_op_jit": flag_on})
+        try:
+            a = paddle.to_tensor(an, stop_gradient=False)
+            b = paddle.to_tensor(bn, stop_gradient=False)
+            loss = (paddle.matmul(a, b) ** 2).mean()
+            loss.backward()
+            return loss.numpy(), a.grad.numpy(), b.grad.numpy()
+        finally:
+            paddle.set_flags({"eager_op_jit": True})
+
+    l1, ga1, gb1 = run(True)
+    l2, ga2, gb2 = run(False)
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)
+    np.testing.assert_allclose(ga1, ga2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(gb1, gb2, rtol=1e-5, atol=1e-6)
+
+
+def test_flag_toggle_invalidates():
+    a = Tensor(jnp.ones((4, 4)), stop_gradient=False)
+    out1 = dispatch.apply("t_mm", lambda x: jnp.matmul(x, x), [a])
+    n1 = len(dispatch._RULE_CACHE)
+    paddle.set_flags({"tpu_matmul_precision": "highest"})
+    try:
+        out2 = dispatch.apply("t_mm", lambda x: jnp.matmul(x, x), [a])
+        assert len(dispatch._RULE_CACHE) == n1 + 1  # new key under new flag
+    finally:
+        paddle.set_flags({"tpu_matmul_precision": "default"})
+
+
+def test_value_dependent_kernel_falls_back():
+    """Kernels whose output shape depends on array VALUES can't be traced;
+    the cache must mark them uncacheable and run them eagerly, forever."""
+    ids = Tensor(jnp.asarray(np.array([0, 0, 1], np.int64)))
+
+    def kernel(i):
+        n = int(jnp.max(i)) + 1  # concretization: untraceable
+        return jnp.zeros((n,))
+
+    out = dispatch.apply("t_valdep", kernel, [ids], differentiable=False)
+    assert list(out.shape) == [2]
+    key = [k for k in dispatch._RULE_CACHE][0]
+    assert dispatch._RULE_CACHE[key] is None  # marked uncacheable
+    out2 = dispatch.apply("t_valdep", kernel, [ids], differentiable=False)
+    assert list(out2.shape) == [2]
+
+
+def test_multi_output_int_cotangent_topk():
+    """topk returns (float, int64) — the int output's float0 cotangent can't
+    enter the jitted cached backward; the wrapper must fall back cleanly."""
+    x = paddle.to_tensor(np.array([3.0, 1.0, 2.0, 5.0], np.float32),
+                         stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    g = x.grad.numpy()
+    np.testing.assert_allclose(g, [1.0, 0.0, 0.0, 1.0])
+
+
+def test_autotune_config_invalidates_rules():
+    from paddle_tpu.core import autotune as at
+
+    a = Tensor(jnp.ones((4, 4)), stop_gradient=False)
+    dispatch.apply("t_at", lambda x: jnp.matmul(x, x), [a])
+    assert len(dispatch._RULE_CACHE) == 1
+    at.set_config({"kernel": {"enable": False}})  # bump -> on_change clears
+    assert len(dispatch._RULE_CACHE) == 0  # stale traces dropped wholesale
+    dispatch.apply("t_at", lambda x: jnp.matmul(x, x), [a])
+    assert len(dispatch._RULE_CACHE) == 1  # rebuilt fresh

@@ -199,3 +199,77 @@ class TestTensorControlFlowUnderToStatic:
         assert tr is paddle.jit.ProgramTranslator()
         code = tr.get_code(lambda x: x)  # lambda: falls back to original
         assert code is not None or code is None  # no crash
+
+
+class TestBranchReadWrite:
+    def test_read_then_write_in_branch(self):
+        """LeNet pattern: `x = f(x)` inside `if` reads the OUTER x (was an
+        UnboundLocalError when branches were hoisted to nested functions)."""
+        import paddle_tpu as paddle
+
+        def fn(x, flag):
+            x = x + 1.0
+            if flag > 0:  # python-static predicate
+                x = x * 2.0
+                x = x + 3.0
+            return x
+
+        st = paddle.jit.to_static(fn)
+        a = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(st(a, 1).numpy(), [7.0])   # (1+1)*2+3
+        np.testing.assert_allclose(st(a, 0).numpy(), [2.0])
+
+    def test_tensor_pred_branch_isolation(self):
+        """Under lax.cond both branches trace; each must see the pre-branch
+        value, not the other branch's mutation. stop_gradient=False forces the
+        kernel through jax tracing so the predicate really is a Tracer."""
+        import paddle_tpu as paddle
+
+        def fn(x):
+            y = x + 1.0
+            if (x.sum() > 0):  # traced predicate -> lax.cond
+                y = y * 10.0
+            else:
+                y = y - 1.0
+            return y
+
+        st = paddle.jit.to_static(fn)
+        pos = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        neg = paddle.to_tensor(np.array([-2.0], np.float32), stop_gradient=False)
+        out = st(pos)
+        np.testing.assert_allclose(out.numpy(), [30.0])
+        np.testing.assert_allclose(st(neg).numpy(), [-2.0])
+        out.sum().backward()
+        np.testing.assert_allclose(pos.grad.numpy(), [10.0])  # grads flow via cond
+
+    def test_var_defined_only_in_branch(self):
+        import paddle_tpu as paddle
+
+        def fn(x, flag):
+            if flag:
+                z = x * 2.0
+            else:
+                z = x * 3.0
+            return z
+
+        st = paddle.jit.to_static(fn)
+        a = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(st(a, True).numpy(), [2.0])
+        np.testing.assert_allclose(st(a, False).numpy(), [3.0])
+
+
+def test_undefined_branch_var_raises_on_use():
+    """A var assigned only in the untaken branch must raise when USED
+    (python read-time semantics), not silently propagate a sentinel."""
+    import paddle_tpu as paddle
+
+    def fn(x, flag):
+        if flag:
+            z = x * 2.0
+        return z + 1.0  # read: must raise when flag is falsy
+
+    st = paddle.jit.to_static(fn)
+    a = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(st(a, True).numpy(), [3.0])
+    with pytest.raises(UnboundLocalError, match="only.*assigned in one branch"):
+        st(a, False)
