@@ -39,6 +39,8 @@ def main():
     steps = int(os.environ.get("PADDLE_TPU_BENCH_STEPS", steps))
     if os.environ.get("PADDLE_TPU_BENCH_AUTOTUNE"):  # flash block-size search
         paddle.incubate.autotune.set_config({"kernel": {"enable": True}})
+    if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LOSS"):  # online LM-loss kernel
+        paddle.set_flags({"use_pallas_lm_loss": True})
     if batch % n_dev:  # batch dim shards over dp_degree = n_dev
         batch = max(n_dev, batch - batch % n_dev)
 

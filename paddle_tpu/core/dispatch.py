@@ -159,7 +159,8 @@ def _rule_key(name, kernel, arrays, attrs, diff_idx, cast_to):
     # changes instead CLEAR the cache via autotune.on_change (version-in-key
     # would orphan every op's rules on each new tuning)
     trace_flags = (flag("tpu_matmul_precision"), flag("use_flash_attention"),
-                   flag("use_autotune"))
+                   flag("use_autotune"), flag("use_pallas_lm_loss"),
+                   flag("pallas_interpret_ok"))
     return (name, id(code), closure_vals, akey, sig,
             tuple(diff_idx), str(cast_to), trace_flags)
 

@@ -56,3 +56,5 @@ define_flag("seed", 0, "global random seed")
 define_flag("apply_ir_passes", True, "run CSE/DCE/fuse passes before lowering static programs")
 define_flag("use_autotune", False, "enable kernel autotune (pallas block-size search + cache)")
 define_flag("enable_unused_var_check", False, "warn when an op kernel never reads a declared input")
+define_flag("use_pallas_lm_loss", False, "route fused LM loss to the online Pallas kernel")
+define_flag("pallas_interpret_ok", False, "allow pallas kernels in interpret mode on CPU (tests)")

@@ -248,6 +248,15 @@ class GPTForPretrainingPipe(nn.Layer):
         from ..ops import linalg as L
         from ..ops import reduction as R
 
+        mp_deg = hcg.degrees["mp"] if hcg is not None else 1
+        if labels is not None and cfg.tie_word_embeddings and mp_deg <= 1:
+            # chunked fused LM loss (ops/fused.py), as in GPTForPretraining
+            from ..ops.fused import fused_linear_cross_entropy
+
+            loss = fused_linear_cross_entropy(h, self.wte.weight, labels,
+                                              transpose_y=True,
+                                              ignore_index=self.loss_fn.ignore_index)
+            return R.mean(loss)
         if cfg.tie_word_embeddings:
             logits = L.matmul(h, self.wte.weight, transpose_y=True)
         else:

@@ -32,6 +32,20 @@ from ._helpers import t_
 _CHUNK = 2048  # rows per scan step: chunk x vocab f32 logits = ~400 MB transient @ 50k vocab
 
 
+def _use_pallas(transpose_y) -> bool:
+    """Route to the online Pallas kernel (pallas/lm_loss.py): tied-embedding
+    layout only, gated by FLAGS_use_pallas_lm_loss (off until measured on
+    chip; interpret mode is test-only). Shape support is checked at the call
+    site via lm_loss.supported()."""
+    from ..core.flags import flag
+
+    if not flag("use_pallas_lm_loss") or not transpose_y:
+        return False
+    import jax
+
+    return jax.default_backend() != "cpu" or flag("pallas_interpret_ok")
+
+
 def _logits_chunk(hc, w, transpose_y):
     """[C, H] x W -> [C, V] f32 (W cast to the activation dtype for MXU rate)."""
     wc = w.astype(hc.dtype) if hc.dtype != w.dtype else w
@@ -128,6 +142,24 @@ def fused_linear_cross_entropy(hidden, weight, label, transpose_y=True,
         n = int(np.prod(lead_shape)) if lead_shape else 1
         h2 = h.reshape(n, hdim)
         lb1 = lb.reshape(n).astype(jnp.int32)
+
+        if _use_pallas(transpose_y):
+            from .pallas.lm_loss import lm_head_cross_entropy, supported
+
+            pad = (-n) % 128  # smallest row tile _pick can choose
+            npad = n + pad
+            if supported(npad, w.shape[0], hdim):
+                ignore = lb1 == ignore_index
+                safe = jnp.where(ignore, 0, lb1)
+                h2p = h2 if not pad else jnp.concatenate(
+                    [h2, jnp.zeros((pad, hdim), h2.dtype)], axis=0)
+                lbp = safe if not pad else jnp.concatenate(
+                    [safe, jnp.zeros((pad,), jnp.int32)], axis=0)
+                loss = lm_head_cross_entropy(h2p, w, lbp)[:n]
+                # where() routes zero cotangent into ignored rows' pallas grads
+                loss = jnp.where(ignore, 0.0, loss)
+                return loss.reshape(lead_shape)
+
         chunk = min(_CHUNK, n)
         pad = (-n) % chunk
         if pad:

@@ -1,0 +1,263 @@
+"""Pallas fused LM-head + softmax-cross-entropy (online over vocab tiles).
+
+The chunked XLA version (ops/fused.py) avoids materializing the full
+[tokens, vocab] logits but still writes each chunk's logits tile to HBM
+between the matmul and the reduction. This kernel keeps every logits tile in
+VMEM — flash-attention's online-softmax trick applied to the classifier:
+
+    fwd:  per (row-block i, vocab-block j): s = h_i @ W_j^T (f32 acc);
+          m/l online logsumexp accumulators; picked logit found in the tile
+          that contains each row's label. loss = m + log(l) - picked.
+    bwd:  recompute s tile-by-tile from (h, W, lse);
+          p = exp(s - lse); dl = (p - onehot(label)) * g;
+          dh kernel accumulates dl @ W_j over j (row-block outer),
+          dW kernel accumulates dl^T @ h_i over i (vocab-block outer)
+          — the same two-kernel split as flash attention's dq / dkdv.
+
+HBM traffic per pass ~ reads of h and W only (W once per row-block), vs the
+chunked version's additional logits-tile writes+reads. Saved residuals:
+per-row logsumexp (f32 [tokens]).
+
+W layout: [vocab, hidden] (tied-embedding layout). Rows must divide into
+block_n, vocab into block_v — the public wrapper in ops/fused.py pads rows
+and only routes here when `supported()` holds. CPU runs interpret mode.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_I0 = np.int32(0)
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _vmem(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+def _pick(n: int, preferred: int) -> int:
+    for b in (preferred, 512, 256, 128):
+        if b <= preferred and n % b == 0 and b <= n:
+            return b
+    return 0
+
+
+def supported(n_rows: int, vocab: int, hidden: int) -> bool:
+    return (_pick(n_rows, 512) > 0 and _pick(vocab, 512) > 0
+            and hidden % 128 == 0)
+
+
+# ---------------------------------------------------------------- forward ----
+
+def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr,
+                *, block_v, v_blocks):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        p_scr[...] = jnp.zeros_like(p_scr)
+
+    h = h_ref[...]                      # [bn, H] storage dtype
+    w = w_ref[...]                      # [bv, H]
+    s = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [bn, bv]
+
+    lab = lab_ref[0]                    # [bn] int32
+    col0 = j * block_v
+    cols = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    hit = cols == lab[:, None]          # row's label inside this tile?
+    # each label lands in exactly one tile: accumulate its logit via sum
+    p_scr[...] += jnp.sum(jnp.where(hit, s, 0.0), axis=1, keepdims=True)
+
+    m_prev = m_scr[...][:, :1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    l_scr[...] = (l_scr[...] * jnp.exp(m_prev - m_new)
+                  + jnp.sum(jnp.exp(s - m_new), axis=1, keepdims=True))
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(j == v_blocks - 1)
+    def _finalize():
+        lse = m_scr[...][:, :1] + jnp.log(l_scr[...][:, :1])
+        loss_ref[0] = (lse - p_scr[...][:, :1])[:, 0]
+        lse_ref[0] = lse[:, 0]
+
+
+def _fwd(h2, w, labels, block_n, block_v):
+    n, hdim = h2.shape
+    v = w.shape[0]
+    if w.dtype != h2.dtype:
+        # one materialized cast (f32 master -> bf16 under amp): tiles then read
+        # at half bandwidth; dW still accumulates f32 in scratch
+        w = w.astype(h2.dtype)
+    grid = (n // block_n, v // block_v)
+    kernel = functools.partial(_fwd_kernel, block_v=block_v,
+                               v_blocks=v // block_v)
+    loss, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
+            pl.BlockSpec((block_v, hdim), lambda i, j: (j, _I0)),
+            pl.BlockSpec((1, block_n), lambda i, j: (i, _I0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_n), lambda i, j: (i, _I0)),
+            pl.BlockSpec((1, block_n), lambda i, j: (i, _I0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n // block_n, block_n), jnp.float32),
+            jax.ShapeDtypeStruct((n // block_n, block_n), jnp.float32),
+        ],
+        scratch_shapes=[_vmem((block_n, 128)), _vmem((block_n, 128)),
+                        _vmem((block_n, 128))],
+        interpret=_interpret(),
+    )(h2, w, labels.reshape(n // block_n, block_n))
+    return loss.reshape(n), lse.reshape(n)
+
+
+# --------------------------------------------------------------- backward ----
+
+def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dh_ref, dh_scr,
+               *, block_v, v_blocks):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dh_scr[...] = jnp.zeros_like(dh_scr)
+
+    h = h_ref[...]
+    w = w_ref[...]
+    s = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    lab = lab_ref[0]
+    lse = lse_ref[0]
+    g = g_ref[0]
+    p = jnp.exp(s - lse[:, None])
+    cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    dl = (p - (cols == lab[:, None])) * g[:, None]       # [bn, bv] f32
+    dh_scr[...] += jax.lax.dot_general(
+        dl.astype(w.dtype), w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(j == v_blocks - 1)
+    def _finalize():
+        dh_ref[...] = dh_scr[...].astype(dh_ref.dtype)
+
+
+def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dw_ref, dw_scr,
+               *, block_v, n_blocks):
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_scr[...] = jnp.zeros_like(dw_scr)
+
+    h = h_ref[...]
+    w = w_ref[...]
+    s = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    lab = lab_ref[0]
+    lse = lse_ref[0]
+    g = g_ref[0]
+    p = jnp.exp(s - lse[:, None])
+    cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    dl = (p - (cols == lab[:, None])) * g[:, None]
+    dw_scr[...] += jax.lax.dot_general(
+        dl.astype(h.dtype), h, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)  # [bv, H]
+
+    @pl.when(i == n_blocks - 1)
+    def _finalize():
+        dw_ref[...] = dw_scr[...].astype(dw_ref.dtype)
+
+
+def _bwd(res, g, block_n, block_v):
+    h2, w, labels, lse = res
+    w_dtype = w.dtype
+    if w.dtype != h2.dtype:
+        w = w.astype(h2.dtype)
+    n, hdim = h2.shape
+    v = w.shape[0]
+    nb, vb = n // block_n, v // block_v
+    lab2 = labels.reshape(nb, block_n)
+    lse2 = lse.reshape(nb, block_n)
+    g2 = g.astype(jnp.float32).reshape(nb, block_n)
+
+    dh = pl.pallas_call(
+        functools.partial(_dh_kernel, block_v=block_v, v_blocks=vb),
+        grid=(nb, vb),
+        in_specs=[
+            pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
+            pl.BlockSpec((block_v, hdim), lambda i, j: (j, _I0)),
+            pl.BlockSpec((1, block_n), lambda i, j: (i, _I0)),
+            pl.BlockSpec((1, block_n), lambda i, j: (i, _I0)),
+            pl.BlockSpec((1, block_n), lambda i, j: (i, _I0)),
+        ],
+        out_specs=pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
+        out_shape=jax.ShapeDtypeStruct((n, hdim), h2.dtype),
+        scratch_shapes=[_vmem((block_n, hdim))],
+        interpret=_interpret(),
+    )(h2, w, lab2, lse2, g2)
+
+    dw = pl.pallas_call(
+        functools.partial(_dw_kernel, block_v=block_v, n_blocks=nb),
+        grid=(vb, nb),
+        in_specs=[
+            pl.BlockSpec((block_n, hdim), lambda j, i: (i, _I0)),
+            pl.BlockSpec((block_v, hdim), lambda j, i: (j, _I0)),
+            pl.BlockSpec((1, block_n), lambda j, i: (i, _I0)),
+            pl.BlockSpec((1, block_n), lambda j, i: (i, _I0)),
+            pl.BlockSpec((1, block_n), lambda j, i: (i, _I0)),
+        ],
+        out_specs=pl.BlockSpec((block_v, hdim), lambda j, i: (j, _I0)),
+        out_shape=jax.ShapeDtypeStruct((v, hdim), jnp.float32),
+        scratch_shapes=[_vmem((block_v, hdim))],
+        interpret=_interpret(),
+    )(h2, w, lab2, lse2, g2)
+    return dh, dw.astype(w_dtype)  # f32 scratch accumulation -> master dtype
+
+
+# ------------------------------------------------------------- public API ----
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _lm_loss(h2, w, labels, block_n, block_v):
+    loss, _ = _fwd(h2, w, labels, block_n, block_v)
+    return loss
+
+
+def _fwd_rule(h2, w, labels, block_n, block_v):
+    loss, lse = _fwd(h2, w, labels, block_n, block_v)
+    return loss, (h2, w, labels, lse)
+
+
+def _bwd_rule(block_n, block_v, res, g):
+    dh, dw = _bwd(res, g, block_n, block_v)
+    dlab = np.zeros(res[2].shape, dtype=jax.dtypes.float0)
+    return dh, dw, dlab
+
+
+_lm_loss.defvjp(_fwd_rule, _bwd_rule)
+
+
+def lm_head_cross_entropy(h2, w, labels):
+    """h2 [N, H], w [V, H], labels [N] int32 (already ignore-masked to a safe
+    index by the caller) -> per-row loss [N] f32. Caller guarantees
+    supported(N, V, H)."""
+    n = h2.shape[0]
+    block_n = _pick(n, 512)
+    block_v = _pick(w.shape[0], 512)
+    return _lm_loss(h2, w, labels.astype(jnp.int32), block_n, block_v)

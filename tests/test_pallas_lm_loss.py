@@ -1,0 +1,129 @@
+"""Online Pallas LM-head cross-entropy (ops/pallas/lm_loss.py) vs dense math,
+and its routing through fused_linear_cross_entropy (interpret mode on CPU)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.lm_loss import lm_head_cross_entropy, supported
+
+
+def _dense(h, w, lab):
+    logits = h @ w.T
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, lab[:, None], axis=1)[:, 0]
+    return lse - picked
+
+
+@pytest.mark.parametrize("dtype,atol", [(jnp.float32, 1e-4), (jnp.bfloat16, 8e-2)])
+def test_kernel_matches_dense(dtype, atol):
+    rng = np.random.RandomState(0)
+    N, V, H = 256, 512, 128
+    h = jnp.asarray(rng.randn(N, H), dtype)
+    w = jnp.asarray(rng.randn(V, H) * 0.05, dtype)
+    lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
+
+    loss = lm_head_cross_entropy(h, w, lab)
+    assert loss.dtype == jnp.float32
+    ref = _dense(h.astype(jnp.float32), w.astype(jnp.float32), lab)
+    np.testing.assert_allclose(loss, ref, atol=atol, rtol=1e-2)
+
+
+def test_kernel_grads_match_dense():
+    rng = np.random.RandomState(1)
+    N, V, H = 128, 256, 128
+    h = jnp.asarray(rng.randn(N, H).astype(np.float32))
+    w = jnp.asarray((rng.randn(V, H) * 0.05).astype(np.float32))
+    lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
+
+    gp = jax.grad(lambda a, b: lm_head_cross_entropy(a, b, lab).mean(),
+                  argnums=(0, 1))(h, w)
+    gr = jax.grad(lambda a, b: _dense(a, b, lab).mean(), argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(gp[0], gr[0], atol=1e-6)
+    np.testing.assert_allclose(gp[1], gr[1], atol=1e-6)
+
+
+def test_supported_predicate():
+    assert supported(512, 50304, 768)     # bench shapes (50304 = 393*128)
+    assert supported(8192, 50304, 768)
+    assert not supported(100, 512, 128)   # rows not tileable
+    assert not supported(512, 500, 128)   # vocab not tileable
+    assert not supported(512, 512, 100)   # hidden not lane-aligned
+
+
+class TestRoutedThroughFused:
+    def setup_method(self, _):
+        paddle.set_flags({"use_pallas_lm_loss": True, "pallas_interpret_ok": True})
+
+    def teardown_method(self, _):
+        paddle.set_flags({"use_pallas_lm_loss": False, "pallas_interpret_ok": False})
+
+    def test_matches_scan_version(self):
+        from paddle_tpu.ops.fused import fused_linear_cross_entropy
+
+        rng = np.random.RandomState(2)
+        b, s, v, hdim = 2, 100, 256, 128  # 200 rows: exercises padding to 512
+        h = paddle.to_tensor(rng.randn(b, s, hdim).astype(np.float32),
+                             stop_gradient=False)
+        w = paddle.to_tensor((rng.randn(v, hdim) * 0.1).astype(np.float32),
+                             stop_gradient=False)
+        ln = rng.randint(0, v, (b, s)).astype(np.int64)
+        ln[0, :7] = -100  # ignore_index rows
+        labels = paddle.to_tensor(ln)
+
+        loss = fused_linear_cross_entropy(h, w, labels)
+        loss.sum().backward()
+        out_p, dh_p, dw_p = loss.numpy(), h.grad.numpy(), w.grad.numpy()
+
+        paddle.set_flags({"use_pallas_lm_loss": False})
+        h2 = paddle.to_tensor(h.numpy(), stop_gradient=False)
+        w2 = paddle.to_tensor(w.numpy(), stop_gradient=False)
+        loss2 = fused_linear_cross_entropy(h2, w2, labels)
+        loss2.sum().backward()
+
+        np.testing.assert_allclose(out_p, loss2.numpy(), atol=1e-5, rtol=1e-5)
+        assert (out_p[0, :7] == 0).all()           # ignored rows: zero loss
+        assert np.abs(dh_p[0, :7]).max() == 0.0    # ...and zero grad
+        np.testing.assert_allclose(dh_p, h2.grad.numpy(), atol=1e-5, rtol=1e-4)
+        np.testing.assert_allclose(dw_p, w2.grad.numpy(), atol=1e-5, rtol=1e-4)
+
+    def test_gpt_forward_with_pallas_loss(self):
+        from paddle_tpu.models import GPTForPretraining, GPTConfig
+
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128)
+        model = GPTForPretraining(cfg)
+        rng = np.random.RandomState(3)
+        ids = paddle.to_tensor(rng.randint(0, 512, (2, 64)).astype(np.int64))
+        labels = paddle.to_tensor(np.roll(ids.numpy(), -1, 1))
+        loss_p = float(model(ids, labels).numpy())
+        paddle.set_flags({"use_pallas_lm_loss": False})
+        loss_s = float(model(ids, labels).numpy())
+        np.testing.assert_allclose(loss_p, loss_s, rtol=1e-5)
+
+
+def test_mixed_dtype_bf16_h_f32_w():
+    """The on-chip amp config: bf16 activations against the f32 master
+    embedding weight — the kernel must unify dtypes, dW back in f32."""
+    paddle.set_flags({"use_pallas_lm_loss": True, "pallas_interpret_ok": True})
+    try:
+        rng = np.random.RandomState(4)
+        N, V, H = 128, 256, 128
+        h = jnp.asarray(rng.randn(N, H), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(V, H) * 0.05, jnp.float32)
+        lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
+
+        loss = lm_head_cross_entropy(h, w, lab)
+        ref = _dense(h.astype(jnp.float32), w, lab)
+        np.testing.assert_allclose(loss, ref, atol=8e-2, rtol=1e-2)
+
+        gh, gw = jax.grad(lambda a, b: lm_head_cross_entropy(a, b, lab).mean(),
+                          argnums=(0, 1))(h, w)
+        assert gh.dtype == jnp.bfloat16 and gw.dtype == jnp.float32
+        gr = jax.grad(lambda a, b: _dense(a.astype(jnp.float32), b, lab).mean(),
+                      argnums=(0, 1))(h, w)
+        np.testing.assert_allclose(gw, gr[1], atol=5e-3, rtol=5e-2)
+    finally:
+        paddle.set_flags({"use_pallas_lm_loss": False, "pallas_interpret_ok": False})
