@@ -44,33 +44,55 @@ def main():
     if batch % n_dev:  # batch dim shards over dp_degree = n_dev
         batch = max(n_dev, batch - batch % n_dev)
 
-    paddle.seed(0)
-    strategy = dist.DistributedStrategy()
-    strategy.hybrid_configs = {"dp_degree": n_dev, "mp_degree": 1}
-    fleet.init(is_collective=True, strategy=strategy)
-
-    model = GPTForPretraining(cfg)
-    n_params = sum(p.size for p in model.parameters())
-    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
-                                 weight_decay=0.01)
-    engine = fleet.distributed_engine(model, opt)
-
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
     labels = np.roll(ids, -1, 1)
-    t_ids, t_labels = paddle.to_tensor(ids), paddle.to_tensor(labels)
 
-    # bf16 matmuls on the MXU (params stay f32, master math in the optimizer is f32)
-    with paddle.amp.auto_cast(enable=on_tpu, dtype="bfloat16"):
-        for _ in range(warmup):
-            loss = engine.step(t_ids, t_labels)
-        float(loss.item())  # D2H sync: drains the dispatch queue (block_until_ready
-        #                     can return early through the remote PJRT tunnel)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = engine.step(t_ids, t_labels)
-        final_loss = float(loss.item())  # sync point ends the timed region
-        dt = time.perf_counter() - t0
+    def run_once():
+        paddle.seed(0)
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": n_dev, "mp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+
+        model = GPTForPretraining(cfg)
+        n_params = sum(p.size for p in model.parameters())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     weight_decay=0.01)
+        engine = fleet.distributed_engine(model, opt)
+        t_ids, t_labels = paddle.to_tensor(ids), paddle.to_tensor(labels)
+
+        # bf16 matmuls on the MXU (params stay f32, optimizer math f32)
+        with paddle.amp.auto_cast(enable=on_tpu, dtype="bfloat16"):
+            for _ in range(warmup):
+                loss = engine.step(t_ids, t_labels)
+            float(loss.item())  # D2H sync: drains the dispatch queue
+            #                     (block_until_ready can return early through
+            #                     the remote PJRT tunnel)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = engine.step(t_ids, t_labels)
+            final_loss = float(loss.item())  # sync ends the timed region
+            dt = time.perf_counter() - t0
+        return n_params, final_loss, dt
+
+    try:
+        n_params, final_loss, dt = run_once()
+        degraded = None
+    except Exception as e:  # e.g. a Mosaic compile failure: degrade, don't zero
+        import sys
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(f"bench: retrying with pallas kernels disabled ({type(e).__name__})",
+              file=sys.stderr)
+        paddle.set_flags({"use_flash_attention": False,
+                          "use_pallas_lm_loss": False})
+        # infra failures (tunnel, OOM) will fail this retry too and surface as
+        # a bench error; the tag names the original exception so a number from
+        # the no-pallas config is never mistaken for the tuned one
+        n_params, final_loss, dt = run_once()
+        degraded = f"pallas_disabled_after_{type(e).__name__}"
 
     tokens_per_sec = steps * batch * seq / dt
     tokens_per_sec_chip = tokens_per_sec / n_dev
@@ -90,6 +112,7 @@ def main():
             "final_loss": round(final_loss, 4),
             "platform": jax.default_backend(), "devices": n_dev,
             "mfu_vs_v5e_bf16_peak": round(mfu, 4) if mfu else None,
+            "degraded": degraded,
         },
     }))
 

@@ -43,7 +43,7 @@ def _use_pallas(transpose_y) -> bool:
         return False
     import jax
 
-    return jax.default_backend() != "cpu" or flag("pallas_interpret_ok")
+    return jax.default_backend() == "tpu" or flag("pallas_interpret_ok")
 
 
 def _logits_chunk(hc, w, transpose_y):

@@ -1,0 +1,33 @@
+"""Shared helpers for the TPU Pallas kernels (flash_attention, lm_loss)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Index-map constants must be i32: the framework enables jax_enable_x64
+# (paddle's int64 default), and a weak `0` literal would trace to i64, which
+# Mosaic rejects.
+I0 = np.int32(0)
+
+NEG_INF = -1e30  # finite (not -inf): keeps exp() and Mosaic happy
+
+
+def interpret() -> bool:
+    """Kernels run in Pallas interpret mode on CPU (tests)."""
+    return jax.default_backend() == "cpu"
+
+
+def vmem(shape, dtype=jnp.float32):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
+
+
+def pick_block(n: int, preferred: int = 512) -> int:
+    """Largest power-of-two tile from (preferred..8) dividing n; falls back to
+    n itself (callers' supported() predicates reject unaligned sizes)."""
+    for b in (preferred, 512, 256, 128, 64, 32, 16, 8):
+        if b <= preferred and n % b == 0 and b <= n:
+            return b
+    return n

@@ -24,22 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-# Index-map constants must be i32: the framework enables jax_enable_x64 (paddle's
-# int64 default), and a weak `0` literal would trace to i64, which Mosaic rejects.
-_I0 = np.int32(0)
-
-NEG_INF = -1e30  # finite (not -inf): keeps exp() and Mosaic happy
-
-
-def _interpret() -> bool:
-    return jax.default_backend() == "cpu"
-
-
-def _pick_block(s: int, preferred: int = 512) -> int:
-    for b in (preferred, 256, 128, 64, 32, 16, 8):
-        if s % b == 0 and b <= s:
-            return b
-    return s
+from ._common import I0 as _I0, NEG_INF, interpret as _interpret, \
+    pick_block as _pick_block, vmem as _vmem
 
 
 def supported(seq_q: int, seq_k: int, head_dim: int) -> bool:
@@ -142,12 +128,6 @@ def _fwd(q, k, v, sm_scale, causal, blocks=None):
         interpret=_interpret(),
     )(q, k, v)
     return o, lse
-
-
-def _vmem(shape):
-    from jax.experimental.pallas import tpu as pltpu
-
-    return pltpu.VMEM(shape, jnp.float32)
 
 
 # --------------------------------------------------------------- backward ----

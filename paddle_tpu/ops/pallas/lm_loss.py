@@ -31,21 +31,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-_I0 = np.int32(0)
-NEG_INF = -1e30
-
-
-def _interpret() -> bool:
-    return jax.default_backend() == "cpu"
-
-
-def _vmem(shape):
-    from jax.experimental.pallas import tpu as pltpu
-
-    return pltpu.VMEM(shape, jnp.float32)
+from ._common import I0 as _I0, NEG_INF, interpret as _interpret, \
+    vmem as _vmem
 
 
 def _pick(n: int, preferred: int) -> int:
+    """Like _common.pick_block but with a 128 floor (lane-width tiles) and a
+    0 'unsupported' sentinel consumed by supported()."""
     for b in (preferred, 512, 256, 128):
         if b <= preferred and n % b == 0 and b <= n:
             return b
