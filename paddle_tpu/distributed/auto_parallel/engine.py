@@ -101,8 +101,10 @@ class Engine:
             state.update(buffers)
             with random_mod.trace_key_scope(key):
                 inputs = [Tensor(b, stop_gradient=True) for b in batch]
-                n_in = max(1, len(inputs) - 1) if loss_fn is not None else \
-                    len(inputs)
+                from ..engine import model_input_count
+
+                n_in = model_input_count(len(inputs)) if loss_fn is not None \
+                    else len(inputs)
                 out, new_state = functional_call_with_state(
                     model, state, *inputs[:n_in])
                 if loss_fn is not None:

@@ -37,6 +37,21 @@ def _divides(n, d):
     return d > 0 and n % d == 0
 
 
+def model_input_count(n_batch_args, num_model_inputs=None):
+    """How many leading batch args feed the model when a loss_fn is present
+    (the rest are labels for loss_fn). Shared by TrainStepEngine and
+    auto_parallel.Engine so the convention cannot drift: default is
+    all-but-last (min 1); num_model_inputs overrides for e.g. multi-input
+    self-supervised models."""
+    if num_model_inputs is not None:
+        if not 1 <= num_model_inputs <= n_batch_args:
+            raise ValueError(
+                f"num_model_inputs={num_model_inputs} out of range for "
+                f"{n_batch_args} batch args")
+        return num_model_inputs
+    return max(1, n_batch_args - 1)
+
+
 def _param_spec(p, shape, hcg) -> P:
     if getattr(p, "dist_attr", None) is not None:
         return p.dist_attr if isinstance(p.dist_attr, P) else P(*p.dist_attr)
@@ -71,17 +86,22 @@ def _default_input_spec(shape, hcg) -> P:
 class TrainStepEngine:
     """Fused distributed train step.
 
-    model: an nn.Layer whose forward returns the scalar loss given the batch
-           (or pass loss_fn to combine model outputs + labels).
+    model: an nn.Layer whose forward returns the scalar loss given the batch.
+           Alternatively pass loss_fn: with >= 2 batch args the model consumes
+           all but the last and loss_fn(model_outputs..., labels) combines
+           them (auto_parallel.Engine convention); with a single batch arg the
+           model consumes it and loss_fn(model_outputs...) is self-supervised.
     optimizer: a paddle_tpu.optimizer.Optimizer (its functional rule is reused).
     """
 
     def __init__(self, model, optimizer, loss_fn: Optional[Callable] = None,
                  hcg: Optional[HybridCommunicateGroup] = None, strategy=None,
-                 input_specs: Optional[List[P]] = None, donate: bool = True):
+                 input_specs: Optional[List[P]] = None, donate: bool = True,
+                 num_model_inputs: Optional[int] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
+        self.num_model_inputs = num_model_inputs
         self.hcg = hcg or get_hybrid_communicate_group() or HybridCommunicateGroup()
         self.mesh: Mesh = self.hcg.mesh
         self.strategy = strategy
@@ -129,6 +149,7 @@ class TrainStepEngine:
         clip = self.optimizer._grad_clip
         model = self.model
         loss_fn = self.loss_fn
+        num_model_inputs = self.num_model_inputs
         buffer_names = self._buffer_names
         buffers = self.buffers
 
@@ -165,9 +186,13 @@ class TrainStepEngine:
                           if sp_deg > 1 else contextlib.nullcontext())
                 with sp_ctx, _amp_ctx(), random_mod.trace_key_scope(key):
                     inputs = [Tensor(b, stop_gradient=True) for b in batch]
-                    out = functional_call(model, state, *inputs)
-                if loss_fn is not None:
-                    out = loss_fn(out) if not isinstance(out, (tuple, list)) else loss_fn(*out)
+                    if loss_fn is None:
+                        out = functional_call(model, state, *inputs)
+                    else:
+                        n_in = model_input_count(len(inputs), num_model_inputs)
+                        out = functional_call(model, state, *inputs[:n_in])
+                        outs = out if isinstance(out, (tuple, list)) else (out,)
+                        out = loss_fn(*outs, *inputs[n_in:])
                 loss = out[0] if isinstance(out, (tuple, list)) else out
                 return loss._data if isinstance(loss, Tensor) else loss
 

@@ -413,3 +413,40 @@ def test_spawn_multiprocess():
 
     procs = pdist.spawn(_spawn_check, nprocs=2, join=True)
     assert all(p.exitcode == 0 for p in procs)
+
+
+def test_engine_num_model_inputs_override():
+    """Multi-input self-supervised model: num_model_inputs routes BOTH batch
+    args to the model while loss_fn sees only the outputs."""
+    paddle.seed(0)
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, a, b):
+            return self.fc(a) - self.fc(b)
+
+    model = TwoIn()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    from paddle_tpu.distributed.engine import TrainStepEngine
+    engine = TrainStepEngine(model, opt,
+                             loss_fn=lambda out: (out ** 2).mean(),
+                             num_model_inputs=2)
+    rng = np.random.RandomState(0)
+    a = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+    b = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+    loss = float(engine.step(a, b).item())
+    assert np.isfinite(loss)
+
+    import pytest as _pytest
+    from paddle_tpu.distributed.engine import model_input_count
+    assert model_input_count(3) == 2
+    assert model_input_count(1) == 1
+    assert model_input_count(3, 3) == 3
+    with _pytest.raises(ValueError):
+        model_input_count(2, 5)
