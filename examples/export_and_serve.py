@@ -1,0 +1,44 @@
+"""Deploy pipeline: jit.save -> portable StableHLO artifact -> Predictor.
+
+Mirrors the reference's jit.save + AnalysisPredictor flow: the artifact
+(.pdmodel = serialized StableHLO + meta, .pdiparams = weights) loads and runs
+WITHOUT the model's Python class — the XLA program is the model.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.static import InputSpec
+from paddle_tpu.vision.models import LeNet
+
+
+def main():
+    paddle.seed(0)
+    model = LeNet()
+    model.eval()
+    path = "/tmp/lenet_infer"
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([4, 1, 28, 28], "float32")])
+    print("exported:", path + ".pdmodel")
+
+    # ---- serve (no model code needed) ----
+    cfg = Config(path + ".pdmodel", path + ".pdiparams")
+    predictor = create_predictor(cfg)
+    in_name = predictor.get_input_names()[0]
+    out_name = predictor.get_output_names()[0]
+
+    imgs = np.random.RandomState(0).randn(4, 1, 28, 28).astype(np.float32)
+    handle = predictor.get_input_handle(in_name)
+    handle.copy_from_cpu(imgs)
+    predictor.run()
+    logits = predictor.get_output_handle(out_name).copy_to_cpu()
+    print("served logits shape:", logits.shape)
+
+    # parity with the in-process model
+    ref = model(paddle.to_tensor(imgs)).numpy()
+    np.testing.assert_allclose(logits, ref, rtol=2e-4, atol=2e-4)
+    print("predictor output matches eager forward")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""ResNet DataParallel: fleet engine with a separate loss_fn.
+
+Single process uses every visible device as the dp axis; under
+`python -m paddle_tpu.distributed.launch --nproc_per_node N` each process owns
+one device and the mesh spans processes (gloo store rendezvous on CPU).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+
+
+def main():
+    import jax
+
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": jax.device_count()}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    model = paddle.vision.models.resnet18(num_classes=10)
+    opt = paddle.optimizer.Momentum(learning_rate=0.02, momentum=0.9,
+                                    parameters=model.parameters())
+    engine = fleet.distributed_engine(model, opt,
+                                      loss_fn=paddle.nn.CrossEntropyLoss())
+
+    rng = np.random.RandomState(0)
+    batch = 8 * jax.device_count()
+    imgs = paddle.to_tensor(rng.randn(batch, 3, 32, 32).astype(np.float32))
+    labels = paddle.to_tensor(rng.randint(0, 10, (batch,)).astype(np.int64))
+    for step in range(8):
+        loss = engine.step(imgs, labels)
+        if step % 2 == 0:
+            print(f"[rank {dist.get_rank()}] step {step}: "
+                  f"loss {float(loss.item()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Wide&Deep in parameter-server mode: C++ sparse tables + dense compute.
+
+Mirrors the reference's fleet PS workflow: servers host sharded embedding
+tables behind a TCP service (core/native/ps_table.cc); trainers pull/push
+sparse rows around the dense train step.
+
+Launch a real 1-server + 1-trainer pod on this host:
+
+  python -m paddle_tpu.distributed.launch --server_num 1 --trainer_num 1 \
+      examples/train_widedeep_ps.py
+
+Standalone (no launcher env) it self-hosts an in-process server — the
+reference's ps_local_client mode.
+"""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import (DistributedEmbedding, PSClient,
+                                       PSServer, SparseTableConfig,
+                                       TheOnePSRuntime)
+
+TABLES = [
+    SparseTableConfig(table_id=0, dim=1, learning_rate=0.1),   # wide
+    SparseTableConfig(table_id=1, dim=8, learning_rate=0.1),   # deep
+]
+
+
+def train(client, barrier=None):
+    from paddle_tpu.models import WideDeep
+
+    paddle.seed(0)
+    model = WideDeep(sparse_feature_dim=100000, embedding_dim=8, num_fields=8,
+                     dense_dim=4, use_ps=True, client=client)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    bce = paddle.nn.BCEWithLogitsLoss()
+
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(0, 100000, (32, 8)).astype(np.int64)
+    dense_np = rng.rand(32, 4).astype(np.float32)
+    lab_np = ((ids_np.sum(1) % 3 == 0)[:, None]).astype(np.float32)
+    for step in range(10):
+        ids = paddle.to_tensor(ids_np)
+        dense = paddle.to_tensor(dense_np)
+        labels = paddle.to_tensor(lab_np)
+        loss = bce(model(ids, dense), labels)
+        loss.backward()     # sparse grads push to the tables
+        opt.step()          # dense params update locally
+        opt.clear_grad()
+        if step % 2 == 0:
+            print(f"step {step}: loss {float(loss.item()):.4f}")
+
+
+def main():
+    if os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST"):
+        # launcher mode: real multi-process pod
+        runtime = TheOnePSRuntime(sparse_tables=TABLES)
+        if runtime.is_server():
+            runtime.init_server()
+            runtime.run_server()
+            return
+        client = runtime.init_worker()
+        train(client)
+        runtime.barrier_worker(generation=1)
+        runtime.stop_worker()
+    else:
+        # standalone: in-process server (reference ps_local_client analogue)
+        server = PSServer(0, TABLES, [])
+        client = PSClient([f"127.0.0.1:{server.port}"])
+        for t in TABLES:
+            client.register_table_dim(t.table_id, t.dim)
+        try:
+            train(client)
+        finally:
+            client.close()
+            server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -26,9 +26,11 @@ from .strategy import Strategy
 class Engine:
     def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
                  strategy: Optional[Strategy] = None,
-                 process_mesh: Optional[ProcessMesh] = None):
+                 process_mesh: Optional[ProcessMesh] = None,
+                 num_model_inputs: Optional[int] = None):
         self.model = model
         self.loss = loss
+        self.num_model_inputs = num_model_inputs
         self.optimizer = optimizer
         self.metrics = metrics if isinstance(metrics, (list, tuple)) else \
             ([metrics] if metrics else [])
@@ -103,8 +105,8 @@ class Engine:
                 inputs = [Tensor(b, stop_gradient=True) for b in batch]
                 from ..engine import model_input_count
 
-                n_in = model_input_count(len(inputs)) if loss_fn is not None \
-                    else len(inputs)
+                n_in = (model_input_count(len(inputs), self.num_model_inputs)
+                        if loss_fn is not None else len(inputs))
                 out, new_state = functional_call_with_state(
                     model, state, *inputs[:n_in])
                 if loss_fn is not None:

@@ -82,7 +82,11 @@ class Predictor:
         self._input_names = [f"input_{i}" for i in range(n_in)]
         self._inputs: Dict[str, _IOHandle] = {
             n: _IOHandle(n) for n in self._input_names}
-        self._outputs: List[_IOHandle] = []
+        # output arity is known from the exported program's signature, so
+        # GetOutputNames works BEFORE the first Run (reference semantics)
+        n_out = len(getattr(self._layer._exported, "out_avals", ())) or 1
+        self._outputs: List[_IOHandle] = [
+            _IOHandle(f"output_{i}") for i in range(n_out)]
 
     def get_input_names(self) -> List[str]:
         return list(self._input_names)
@@ -105,11 +109,14 @@ class Predictor:
             "feed every input via copy_from_cpu before run()"
         out = self._layer(*args)
         outs = out if isinstance(out, (list, tuple)) else [out]
-        self._outputs = []
-        for i, o in enumerate(outs):
-            h = _IOHandle(f"output_{i}")
+        # populate the PERSISTENT handles (ZeroCopyTensor semantics: a handle
+        # fetched before Run() must see the results), growing if the program
+        # returned more outputs than the signature promised
+        while len(self._outputs) < len(outs):
+            self._outputs.append(_IOHandle(f"output_{len(self._outputs)}"))
+        del self._outputs[len(outs):]
+        for h, o in zip(self._outputs, outs):
             h.copy_from_cpu(o.numpy())
-            self._outputs.append(h)
         if inputs is not None:
             return [h.copy_to_cpu() for h in self._outputs]
 
