@@ -83,14 +83,21 @@ class Node:
     grad-slot meta so missing cotangents can be zero-filled (GradTensorHolder behavior).
     """
 
-    __slots__ = ("vjp_fn", "inputs", "out_avals", "n_outputs", "name", "__weakref__")
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "n_outputs", "name",
+                 "bwd_spec", "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, out_avals, name=""):
+    def __init__(self, vjp_fn, inputs, out_avals, name="", bwd_spec=None):
         self.vjp_fn = vjp_fn
         self.inputs = tuple(inputs)  # Tensors (strong refs keep the graph alive)
         self.out_avals = out_avals  # [(shape, dtype), ...]
         self.n_outputs = len(out_avals)
         self.name = name
+        # (bwd_callable, all_input_tensors): set by the dispatch rule cache.
+        # bwd(all_input_arrays, cotangents) is a PURE function (it recomputes
+        # the forward from its inputs), which is what makes create_graph /
+        # double grad possible — closure-style vjp_fns bake residual arrays
+        # in and cannot be re-differentiated wrt the inputs.
+        self.bwd_spec = bwd_spec
 
     def __repr__(self):
         return f"<Node {self.name} n_out={self.n_outputs}>"
@@ -128,13 +135,22 @@ def _is_float0(x) -> bool:
     return getattr(x, "dtype", None) == jax.dtypes.float0
 
 
-def run_backward(tensors, grad_tensors=None, retain_graph: bool = False, grad_sink=None):
+def run_backward(tensors, grad_tensors=None, retain_graph: bool = False, grad_sink=None,
+                 create_graph: bool = False):
     """Engine entry: the analogue of `egr::RunBackward` (eager/backward.cc:522).
 
     grad_sink: optional {id(tensor): [accumulated_array_or_None]} — when given
     (paddle.grad functional mode), gradients are deposited ONLY into the sink and
     `.grad` of leaves is left untouched (egr::RunPartialGrad behavior).
+
+    create_graph: run the backward itself THROUGH the dispatcher so every
+    produced gradient carries a tape (second-order grads). Requires each node
+    to have a pure bwd_spec (set by the dispatch rule cache); cotangent math
+    happens on Tensors instead of raw arrays.
     """
+    if create_graph:
+        return _run_backward_on_tape(tensors, grad_tensors, grad_sink,
+                                     retain_graph=retain_graph)
     from .tensor import Tensor
 
     if not isinstance(tensors, (list, tuple)):
@@ -230,6 +246,146 @@ def run_backward(tensors, grad_tensors=None, retain_graph: bool = False, grad_si
         node_cots.pop(id(node), None)
 
 
+def _run_backward_on_tape(tensors, grad_tensors, grad_sink, retain_graph=True):
+    """create_graph mode: identical walk to run_backward, but every cotangent
+    is a Tensor and each node's backward executes as a dispatched op
+    (grad::<name>) whose kernel is the node's pure bwd — so the produced
+    grads are themselves differentiable (paddle.grad(create_graph=True),
+    the egr::RunBackward create_graph path)."""
+    import jax.numpy as jnp
+
+    from .tensor import Tensor
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    node_cots = {}
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError("backward() on a stop_gradient tensor")
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    f"grad must be provided for non-scalar tensor of shape {t.shape}")
+            g = Tensor(jnp.ones_like(t._data), stop_gradient=True)
+        elif not isinstance(g, Tensor):
+            g = Tensor(jnp.asarray(g), stop_gradient=True)
+        if t._node is None:
+            _deposit_grad_tensor(t, g, grad_sink)
+        else:
+            slots = node_cots.setdefault(id(t._node), [None] * t._node.n_outputs)
+            i = t._out_index
+            slots[i] = g if slots[i] is None else slots[i] + g
+            roots.append(t._node)
+
+    if not roots:
+        return
+
+    order, seen = [], set()
+    for r in roots:
+        for n in _topo_order(r):
+            if id(n) not in seen:
+                seen.add(id(n))
+                order.append(n)
+    order = _kahn_sort(order)
+
+    from .dispatch import apply
+
+    for node in order:
+        slots = node_cots.get(id(node))
+        if slots is None:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through the graph a second time; "
+                "first backward ran with retain_graph=False")
+        if node.bwd_spec is None:
+            raise NotImplementedError(
+                f"create_graph: op '{node.name}' has no pure backward rule "
+                f"(it was dispatched outside the rule cache — e.g. a "
+                f"value-dependent or RNG-closure kernel, or "
+                f"FLAGS_eager_op_jit=0); second-order grads need the "
+                f"recompute-style backward")
+        cots = []
+        for aval, s in zip(node.out_avals, slots):
+            if s is None:
+                shape, dt = aval
+                if np.issubdtype(dt, np.integer) or dt == np.bool_:
+                    raise NotImplementedError(
+                        f"create_graph through integer output of '{node.name}' "
+                        f"is not supported")
+                s = Tensor(jnp.zeros(shape, dt), stop_gradient=True)
+            cots.append(s)
+
+        bwd, all_inputs = node.bwd_spec
+
+        def make_kernel(bwd, n_all, n_out):
+            # real closure over the PjitFunction: the dispatch rule cache
+            # refuses to key on it, so per-node kernels can never alias
+            def bwd_kernel(*arrs):
+                ins = tuple(arrs[:n_all])
+                cts = arrs[n_all:]
+                ct_arg = tuple(cts) if n_out > 1 else cts[0]
+                res = tuple(bwd(ins, ct_arg))
+                # unwrap 1-tuples: a jax.vjp cotangent for this kernel must
+                # mirror its output pytree exactly
+                return res if len(res) > 1 else res[0]
+            return bwd_kernel
+
+        in_cots = apply(f"grad::{node.name}",
+                        make_kernel(bwd, len(all_inputs), node.n_outputs),
+                        list(all_inputs) + cots)
+        if not isinstance(in_cots, (tuple, list)):
+            in_cots = (in_cots,)
+
+        for inp, ic in zip(node.inputs, in_cots):
+            if inp.stop_gradient or ic is None:
+                continue
+            for hook in inp._hooks:
+                out = hook(ic)
+                if out is not None:
+                    if not isinstance(out, Tensor):
+                        import warnings
+
+                        warnings.warn(
+                            f"tensor hook on an input of '{node.name}' returned "
+                            f"a raw array during create_graph backward; it is "
+                            f"treated as a CONSTANT and severs second-order "
+                            f"grads through this edge — return a Tensor "
+                            f"computed from the hook argument to keep the tape",
+                            stacklevel=2)
+                        out = Tensor(out, stop_gradient=True)
+                    ic = out
+            prod = inp._node
+            if prod is None:
+                _deposit_grad_tensor(inp, ic, grad_sink)
+            else:
+                slots2 = node_cots.setdefault(id(prod), [None] * prod.n_outputs)
+                j = inp._out_index
+                slots2[j] = ic if slots2[j] is None else slots2[j] + ic
+                if inp._retain_grads or (grad_sink is not None and id(inp) in grad_sink):
+                    _deposit_grad_tensor(inp, ic, grad_sink)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.bwd_spec = None
+        node_cots.pop(id(node), None)
+
+
+def _deposit_grad_tensor(t, g, grad_sink=None):
+    """Tensor-mode deposit: the stored grad KEEPS its graph (create_graph)."""
+    if grad_sink is not None:
+        slot = grad_sink.get(id(t))
+        if slot is not None:
+            slot[0] = g if slot[0] is None else slot[0] + g
+        return
+    t._grad = g if t._grad is None else t._grad + g
+
+
 def _kahn_sort(nodes: List[Node]) -> List[Node]:
     node_set = {id(n): n for n in nodes}
     # edge consumer -> producer; process consumer first
@@ -278,19 +434,20 @@ def grad(
     allow_unused: bool = False,
 ):
     """Functional paddle.grad: returns grads of `outputs` wrt `inputs` without
-    touching `.grad`. (create_graph / double-grad is deferred; see TODO.)"""
+    touching `.grad`. With create_graph=True the returned grads carry a tape
+    and can be differentiated again (gradient-penalty / double-grad flows);
+    requires ops dispatched through the rule cache (FLAGS_eager_op_jit)."""
     from .tensor import Tensor
 
-    from .tensor import Tensor
-
-    if create_graph:
-        raise NotImplementedError("double grad not yet supported on the eager tape")
     if not isinstance(outputs, (list, tuple)):
         outputs = [outputs]
     if not isinstance(inputs, (list, tuple)):
         inputs = [inputs]
+    if retain_graph is None:
+        retain_graph = create_graph  # paddle/torch default
     sink = {id(t): [None] for t in inputs}
-    run_backward(outputs, grad_outputs, retain_graph=bool(retain_graph), grad_sink=sink)
+    run_backward(outputs, grad_outputs, retain_graph=bool(retain_graph),
+                 grad_sink=sink, create_graph=create_graph)
     result = []
     for t in inputs:
         g = sink[id(t)][0]
@@ -299,5 +456,10 @@ def grad(
                 "one of the input tensors received no gradient; "
                 "pass allow_unused=True to get None instead"
             )
-        result.append(None if g is None else Tensor(g, stop_gradient=True))
+        if g is None:
+            result.append(None)
+        elif isinstance(g, Tensor):
+            result.append(g)  # create_graph mode: keeps its tape
+        else:
+            result.append(Tensor(g, stop_gradient=True))
     return result

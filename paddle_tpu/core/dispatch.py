@@ -100,6 +100,11 @@ def _autocast_dtype_for(name: str, arrays):
     ctx = amp_ctx()
     if ctx is None:
         return None
+    if name.startswith("grad::"):
+        # create_graph backward ops: the replayed bwd already embeds the
+        # forward's own autocast; re-casting here would squeeze black-listed
+        # ops' f32 backward through bf16
+        return None
     if ctx.level == "O2":
         # pure low-precision except black list
         if name in ctx.black:
@@ -131,6 +136,33 @@ def _freeze(v):
         return ("npdtype", str(v))
     if type(v).__module__ == "numpy" and np.isscalar(v):
         return ("npscalar", str(v.dtype), v.item())  # keep dtype in the key
+    if isinstance(v, jax.Array) or isinstance(v, jax.core.Tracer):
+        raise _Unhashable  # data-carrying: can never key a trace
+    import types
+
+    if isinstance(v, types.FunctionType):
+        # function-valued closure cells (e.g. the jnp.power inside a binary
+        # op's scalar fast path): key = code identity + recursively frozen
+        # closure + defaults. Safe because the cached rule's jitted closure
+        # PINS the code object, so its id cannot be recycled while the entry
+        # exists (clear() drops entry + pin together); any array hiding in a
+        # nested cell or default raises and disables caching.
+        return ("fn", id(v.__code__),
+                tuple(_freeze(c.cell_contents) for c in (v.__closure__ or ())),
+                _freeze(v.__defaults__ or ()))
+    if isinstance(v, types.BuiltinFunctionType) or type(v).__name__ == "ufunc":
+        return ("builtin", id(v))  # stateless module-level callables
+    import functools
+
+    if isinstance(v, functools.partial):
+        return ("partial", _freeze(v.func), _freeze(tuple(v.args)),
+                tuple(sorted((k, _freeze(x)) for k, x in v.keywords.items())))
+    mod = type(v).__module__ or ""
+    if callable(v) and not hasattr(v, "__self__") and (
+            mod.startswith("jax") or mod.startswith("numpy")):
+        # jax/numpy callable objects (PjitFunction like jnp.tanh, jnp ufunc
+        # wrappers): stateless, module-owned, pinned by the cached rule
+        return ("jaxfn", id(v))
     raise _Unhashable
 
 
@@ -151,6 +183,7 @@ def _rule_key(name, kernel, arrays, attrs, diff_idx, cast_to):
     try:
         closure_vals = tuple(
             _freeze(c.cell_contents) for c in (getattr(kernel, "__closure__", None) or ()))
+        defaults = _freeze(getattr(kernel, "__defaults__", None) or ())
         akey = tuple(sorted((k, _freeze(v)) for k, v in attrs.items()))
     except _Unhashable:
         return None
@@ -161,7 +194,7 @@ def _rule_key(name, kernel, arrays, attrs, diff_idx, cast_to):
     trace_flags = (flag("tpu_matmul_precision"), flag("use_flash_attention"),
                    flag("use_autotune"), flag("use_pallas_lm_loss"),
                    flag("pallas_interpret_ok"))
-    return (name, id(code), closure_vals, akey, sig,
+    return (name, id(code), closure_vals, defaults, akey, sig,
             tuple(diff_idx), str(cast_to), trace_flags)
 
 
@@ -237,6 +270,7 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
 
     rules = None
     key = None
+    bwd_spec = None
     if flag("eager_op_jit"):
         key = _rule_key(name, kernel, arrays, attrs, diff_idx, cast_to)
         if key is not None:
@@ -261,6 +295,14 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
         else:
             if need_grad and diff_idx:
                 bwd = rules[1]
+                # pure bwd: double-grad-able. Nondiff inputs are stored
+                # DETACHED — their value feeds the recompute but their own
+                # upstream graphs (e.g. the argmax producing index inputs)
+                # must not be pinned for the lifetime of this node.
+                diff_set = set(diff_idx)
+                bwd_spec = (bwd, tuple(
+                    t if i in diff_set else t.detach()
+                    for i, t in enumerate(tensor_args)))
 
                 def vjp_fn(cts, _bwd=bwd, _at=arrays_tuple):
                     if _has_float0(cts):
@@ -295,6 +337,7 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
             [tensor_args[i] for i in diff_idx],
             [(tuple(d.shape), np.dtype(d.dtype)) for d in outs_data],
             name=name,
+            bwd_spec=bwd_spec,
         )
         for i, o in enumerate(outs):
             o._node = node

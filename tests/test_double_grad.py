@@ -1,0 +1,123 @@
+"""Double backward: paddle.grad(create_graph=True) on the eager tape.
+
+Reference: egr::RunBackward's create_graph path (eager/backward.cc) powering
+gradient-penalty training (WGAN-GP style). Here the backward replays through
+the dispatcher using each node's pure recompute-backward (dispatch rule
+cache), so first-order grads carry a tape of grad::<op> nodes.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import grad
+
+
+def test_second_derivative_of_cubic():
+    x = paddle.to_tensor(np.array([1.0, 2.0, -3.0], np.float32),
+                         stop_gradient=False)
+    y = (x ** 3).sum()
+    (g,) = grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g.numpy(), 3 * x.numpy() ** 2, rtol=1e-6)
+    assert not g.stop_gradient  # carries the tape
+
+    (gg,) = grad(g.sum(), [x])
+    np.testing.assert_allclose(gg.numpy(), 6 * x.numpy(), rtol=1e-6)
+
+
+def test_mixed_partials_matmul():
+    rng = np.random.RandomState(0)
+    xn = rng.randn(3, 4).astype(np.float32)
+    wn = rng.randn(4, 2).astype(np.float32)
+    x = paddle.to_tensor(xn, stop_gradient=False)
+    w = paddle.to_tensor(wn, stop_gradient=False)
+
+    y = (paddle.matmul(x, w) ** 2).sum()
+    (gx,) = grad(y, [x], create_graph=True)
+    # d/dw of sum(gx) — mixed second-order partial
+    (gw,) = grad(gx.sum(), [w])
+
+    def jax_ref(xn, wn):
+        f = lambda x, w: ((x @ w) ** 2).sum()
+        gx_fn = jax.grad(f, argnums=0)
+        return jax.grad(lambda w: gx_fn(jnp.asarray(xn), w).sum())(jnp.asarray(wn))
+
+    np.testing.assert_allclose(gw.numpy(), np.asarray(jax_ref(xn, wn)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gradient_penalty_through_backward():
+    """WGAN-GP shape: penalty on the input-grad norm, optimized via .backward()."""
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.Tanh(),
+                               paddle.nn.Linear(8, 1))
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(5, 4).astype(np.float32), stop_gradient=False)
+
+    out = net(x).sum()
+    (gx,) = grad(out, [x], create_graph=True)
+    gp = ((gx.square().sum(axis=1).sqrt() - 1.0) ** 2).mean()
+    gp.backward()  # second-order: reaches the net's weights
+
+    w0 = net[0].weight
+    assert w0.grad is not None
+    assert np.isfinite(w0.grad.numpy()).all()
+    assert np.abs(w0.grad.numpy()).max() > 0
+
+    # numeric check of d(gp)/d(w0[0,0])
+    eps = 1e-3
+
+    def gp_value():
+        x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+        out = net(x2).sum()
+        (g2,) = grad(out, [x2], create_graph=True)
+        return float(((g2.square().sum(axis=1).sqrt() - 1.0) ** 2).mean().item())
+
+    base = w0.numpy().copy()
+    w0._data = jnp.asarray(base).at[0, 0].add(eps)
+    hi = gp_value()
+    w0._data = jnp.asarray(base).at[0, 0].add(-eps)
+    lo = gp_value()
+    w0._data = jnp.asarray(base)
+    numeric = (hi - lo) / (2 * eps)
+    np.testing.assert_allclose(w0.grad.numpy()[0, 0], numeric, rtol=5e-2,
+                               atol=5e-4)
+
+
+def test_create_graph_needs_rule_cache():
+    paddle.set_flags({"eager_op_jit": False})
+    try:
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = (x ** 2).sum()
+        with pytest.raises(NotImplementedError, match="pure backward rule"):
+            grad(y, [x], create_graph=True)
+    finally:
+        paddle.set_flags({"eager_op_jit": True})
+
+
+def test_plain_grad_unchanged():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    (g,) = grad((x ** 2).sum(), [x])
+    assert g.stop_gradient
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+
+
+def test_freed_graph_raises_in_create_graph_mode():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x ** 2).sum()
+    grad(y, [x])  # frees the graph (retain_graph defaults False)
+    with pytest.raises(RuntimeError, match="second time"):
+        grad(y, [x], create_graph=True)
+
+
+def test_amp_does_not_recast_grad_ops():
+    """Black-listed ops' second-order backward must stay f32 under amp O2."""
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(np.float32),
+                         stop_gradient=False)
+    with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+        y = (paddle.nn.functional.softmax(x, axis=-1) ** 2).sum()
+        (g,) = grad(y, [x], create_graph=True)
+        (gg,) = grad(g.sum(), [x])
+    assert gg.numpy().dtype == np.float32
+    assert np.isfinite(gg.numpy()).all()
