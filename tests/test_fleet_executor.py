@@ -186,7 +186,7 @@ def test_dist_model_two_processes(tmp_path):
         procs.append(subprocess.Popen(
             [sys.executable, str(script)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = [p.communicate(timeout=120)[0] for p in procs]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
     assert all(p.returncode == 0 for p in procs), outs
     assert "STAGE0_DONE" in outs[0], outs[0]
     assert "STAGE1_OK" in outs[1], outs[1]

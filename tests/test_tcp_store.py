@@ -108,7 +108,7 @@ def test_multiprocess_rendezvous(tmp_path):
              for r in range(world)]
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=120)
+        out, _ = p.communicate(timeout=300)
         outs.append(out)
         assert p.returncode == 0, f"worker failed:\n{out}"
     assert all("OK" in o for o in outs)
