@@ -16,6 +16,14 @@ import numpy as np
 
 
 def main():
+    import os
+
+    degraded = os.environ.get("PADDLE_TPU_BENCH_DEGRADED_TAG") or None
+    if os.environ.get("PADDLE_TPU_BENCH_DEVICE") == "cpu":
+        from paddle_tpu.device.probe import force_cpu_platform
+
+        force_cpu_platform()
+
     import jax
 
     import paddle_tpu as paddle
@@ -25,8 +33,6 @@ def main():
 
     on_tpu = jax.default_backend() != "cpu"
     n_dev = jax.device_count()
-
-    import os
 
     if on_tpu:
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
@@ -79,7 +85,6 @@ def main():
     first_error = None
     try:
         n_params, final_loss, dt = run_once()
-        degraded = None
     except Exception as e:  # e.g. a Mosaic compile failure: degrade, don't zero
         import sys
         import traceback
@@ -97,7 +102,8 @@ def main():
         paddle.set_flags({"use_flash_attention": False,
                           "use_pallas_lm_loss": False})
         n_params, final_loss, dt = run_once()
-        degraded = f"pallas_disabled_after_{first_error}"
+        degraded = "+".join(filter(None, [
+            degraded, f"pallas_disabled_after_{first_error}"]))
 
     tokens_per_sec = steps * batch * seq / dt
     tokens_per_sec_chip = tokens_per_sec / n_dev
@@ -122,5 +128,70 @@ def main():
     }))
 
 
+def _orchestrate():
+    """Print ONE JSON line no matter what state the TPU tunnel is in.
+
+    The tunnel can wedge such that any in-process backend init (or a mid-run
+    device sync) blocks forever in a C call that Python signals cannot
+    interrupt — so the real-TPU attempt runs in a killable subprocess, and a
+    dead/hung attempt degrades to an inline CPU run tagged in extra.degraded.
+    """
+    import os
+    import subprocess
+    import sys
+
+    from paddle_tpu.device.probe import tpu_alive
+
+    def cpu_run(tag):
+        os.environ["PADDLE_TPU_BENCH_DEVICE"] = "cpu"
+        if tag:
+            os.environ["PADDLE_TPU_BENCH_DEGRADED_TAG"] = tag
+        main()
+
+    if os.environ.get("PADDLE_TPU_BENCH_DEVICE") == "cpu":  # explicit choice
+        return cpu_run(None)
+    probe_t = float(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "90"))
+    if not tpu_alive(timeout=probe_t):
+        return cpu_run("tpu_unavailable")
+
+    wall = float(os.environ.get("PADDLE_TPU_BENCH_WALL_TIMEOUT", "420"))
+    out, tag = "", None
+    try:
+        p = subprocess.run([sys.executable, __file__, "--inline"],
+                           capture_output=True, text=True, timeout=wall)
+        out, err, tag = p.stdout or "", p.stderr, f"tpu_run_rc{p.returncode}"
+    except subprocess.TimeoutExpired as e:
+        def _s(b):
+            return b.decode("utf-8", "replace") if isinstance(b, bytes) else (b or "")
+        out, err, tag = _s(e.stdout), _s(e.stderr), "tpu_run_hung"
+    if err:
+        sys.stderr.write(err)
+    for line in reversed(out.splitlines()):  # the JSON line is the last print
+        try:
+            payload = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(payload, dict) and "metric" in payload:
+            print(line)
+            return
+    cpu_run(tag)  # TPU attempt produced no JSON: tagged CPU fallback
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--inline" in sys.argv:
+        main()  # parent orchestrator handles failures
+    else:
+        try:
+            _orchestrate()
+        except BaseException:  # last resort: the line must always parse
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({
+                "metric": "gpt_pretrain_tokens_per_sec_per_chip",
+                "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": None,
+                "extra": {"degraded": "bench_error"},
+            }))
+            sys.exit(0)

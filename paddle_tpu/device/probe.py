@@ -1,0 +1,74 @@
+"""Bounded accelerator-health probes.
+
+The TPU chip is reached through a remote PJRT tunnel that can wedge: when it
+does, *any* jax backend initialization (``jax.devices()``,
+``jax.default_backend()``) blocks forever in the current process. These probes
+pay for safety with a subprocess: the child inherits the same sitecustomize /
+frozen-platform config as the parent, so a hang in the child is exactly the
+hang the parent would have hit — but bounded by a timeout and killable.
+
+Reference parity: plays the role of paddle's GPU-health preflight
+(`paddle/fluid/platform/device/gpu/gpu_info.cc` GetGPUDeviceCount guards);
+here the failure mode is a dead tunnel rather than a lost CUDA context.
+"""
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+_PROBE_CODE = "import jax; print('BACKEND', jax.default_backend())"
+_DEVCOUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+
+
+def accelerator_backend(timeout: float = 90.0) -> str | None:
+    """Return the default jax backend name ("tpu", "axon", "cpu", ...) probed
+    in a killable subprocess, or None if initialization hangs/crashes."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if p.returncode != 0:
+        return None
+    for line in (p.stdout or "").splitlines():
+        if line.startswith("BACKEND "):
+            return line.split(None, 1)[1].strip()
+    return None
+
+
+def tpu_alive(timeout: float = 90.0) -> bool:
+    """True iff a non-CPU accelerator backend initializes within `timeout` s."""
+    backend = accelerator_backend(timeout)
+    return backend is not None and backend != "cpu"
+
+
+def force_cpu_platform(virtual_devices: int | None = None) -> None:
+    """Force the CPU platform before (or despite) a frozen JAX_PLATFORMS.
+
+    Must run before jax backend init to be effective; uses jax.config.update
+    because a sitecustomize hook may have frozen the env value into jax config
+    (env vars alone are ignored in that case).
+    """
+    if virtual_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = _DEVCOUNT_RE.search(flags)
+        if m is None:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={virtual_devices}"
+            ).strip()
+        elif int(m.group(1)) < virtual_devices:
+            os.environ["XLA_FLAGS"] = _DEVCOUNT_RE.sub(
+                f"--xla_force_host_platform_device_count={virtual_devices}",
+                flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already up; callers decide via jax.default_backend()

@@ -1,0 +1,58 @@
+"""Driver-contract tests: bench.py and __graft_entry__ must produce their
+artifacts even when the accelerator tunnel is wedged (VERDICT r1 item #1).
+
+The wedge is simulated by probe timeouts — a hung backend init and a
+0-second-timeout probe are indistinguishable to the caller (both return None).
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_probe_timeout_reads_as_dead():
+    from paddle_tpu.device.probe import accelerator_backend, tpu_alive
+
+    assert accelerator_backend(timeout=0.05) is None
+    assert not tpu_alive(timeout=0.05)
+
+
+def test_probe_never_hangs_the_caller():
+    from paddle_tpu.device.probe import tpu_alive
+
+    # Whatever state the machine's accelerator is in (absent, healthy-CPU-only,
+    # or a wedged tunnel that ignores JAX_PLATFORMS env), the caller gets an
+    # answer within the timeout instead of hanging.
+    assert tpu_alive(timeout=15) in (True, False)
+
+
+def test_bench_emits_json_when_tpu_dead(tmp_path):
+    env = {**os.environ,
+           "PADDLE_TPU_BENCH_PROBE_TIMEOUT": "0.05",  # wedged-tunnel stand-in
+           "PADDLE_TPU_BENCH_STEPS": "2",
+           "PADDLE_TPU_BENCH_BATCH": "2"}
+    p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, timeout=600, env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    line = p.stdout.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert payload["value"] > 0
+    assert payload["unit"] == "tokens/s/chip"
+    assert payload["extra"]["degraded"] == "tpu_unavailable"
+    assert payload["extra"]["platform"] == "cpu"
+
+
+def test_dryrun_multichip_forces_virtual_cpu_mesh():
+    # Fresh interpreter WITHOUT the conftest forcing: simulates the driver
+    # process where a sitecustomize may freeze a dead accelerator platform.
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    code = ("import __graft_entry__ as g\n"
+            "g.dryrun_multichip(4)\n"
+            "print('DRYRUN_DONE')\n")
+    p = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=600, env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "DRYRUN_DONE" in p.stdout
