@@ -149,10 +149,13 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 def maxout(x, groups, axis=1, name=None):
     def kernel(a, groups, axis):
+        # consecutive channels form a group: out[c] = max_g in[c*groups + g]
+        # (reference: paddle/fluid/operators/math/maxouting.cc:48 input_idx)
+        axis = axis % a.ndim  # paddle allows axis=-1 for NHWC
         shape = list(a.shape)
         c = shape[axis]
-        new_shape = shape[:axis] + [groups, c // groups] + shape[axis + 1:]
-        return jnp.max(a.reshape(new_shape), axis=axis)
+        new_shape = shape[:axis] + [c // groups, groups] + shape[axis + 1:]
+        return jnp.max(a.reshape(new_shape), axis=axis + 1)
 
     return apply("maxout", kernel, [t_(x)], {"groups": groups, "axis": axis})
 

@@ -525,9 +525,10 @@ def strided_slice(x, axes, starts, ends, strides, name=None):
     x = t_(x)
 
     def kernel(a, axes, starts, ends, strides):
-        idx = [slice(None)] * a.ndim
+        # builtins.slice: the module-level `slice` op shadows the builtin here
+        idx = [builtins.slice(None)] * a.ndim
         for ax, s, e, st in zip(axes, starts, ends, strides):
-            idx[ax] = slice(s, e, st)
+            idx[ax] = builtins.slice(s, e, st)
         return a[tuple(idx)]
 
     return apply("strided_slice", kernel, [x],

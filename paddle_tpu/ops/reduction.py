@@ -1,6 +1,7 @@
 """Reduction ops. Reference parity: python/paddle/tensor/math.py reduce_* + stat.py."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core import dtype as dtypes
@@ -149,6 +150,41 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
-    import scipy.stats  # noqa
+    """Most frequent value (and an index of it) along `axis`.
 
-    raise NotImplementedError("mode: deferred (rare op)")
+    Reference: python/paddle/tensor/search.py mode + mode_op; tie semantics per
+    the reference numpy oracle (test_mode_op.py:_mode1D): among equally
+    frequent values the smallest wins, and the returned index is the original
+    position of that value's last occurrence.
+
+    TPU-first: fully vectorized — stable sort along the axis, segmented
+    run-length count via a cumulative max of run-start positions, then a
+    single argmax over run-end frequencies (first-max tie-breaking lands on
+    the smallest value because the axis is sorted ascending).
+    """
+    x = t_(x)
+    ax = normalize_axis(axis, x.ndim)
+    data = jnp.moveaxis(x._data, ax, -1)
+    n = data.shape[-1]
+    order = jnp.argsort(data, axis=-1, stable=True)
+    svals = jnp.take_along_axis(data, order, axis=-1)
+
+    pos = jnp.arange(n)
+    is_start = jnp.concatenate(
+        [jnp.ones(data.shape[:-1] + (1,), bool),
+         svals[..., 1:] != svals[..., :-1]], axis=-1)
+    last_start = jax.lax.cummax(jnp.where(is_start, pos, 0), axis=data.ndim - 1)
+    run_len = pos - last_start + 1
+    is_end = jnp.concatenate(
+        [svals[..., 1:] != svals[..., :-1],
+         jnp.ones(data.shape[:-1] + (1,), bool)], axis=-1)
+    freq = jnp.where(is_end, run_len, 0)
+    best = jnp.argmax(freq, axis=-1)  # first max: earliest run = smallest value
+
+    mv = jnp.take_along_axis(svals, best[..., None], axis=-1)
+    mi = jnp.take_along_axis(order, best[..., None], axis=-1)
+    if keepdim:
+        mv, mi = jnp.moveaxis(mv, -1, ax), jnp.moveaxis(mi, -1, ax)
+    else:
+        mv, mi = mv[..., 0], mi[..., 0]
+    return Tensor(mv), Tensor(mi.astype(jnp.int64))

@@ -8,6 +8,8 @@ and a learnable signal, the same hermetic pattern as vision/datasets.
 from .datasets import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
                        WMT14, WMT16)
 from .faster_tokenizer import FasterTokenizer, wordpiece_tokenize
+from .viterbi import ViterbiDecoder, viterbi_decode
 
 __all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st",
-           "WMT14", "WMT16", "FasterTokenizer", "wordpiece_tokenize"]
+           "WMT14", "WMT16", "FasterTokenizer", "wordpiece_tokenize",
+           "ViterbiDecoder", "viterbi_decode"]

@@ -1,0 +1,116 @@
+"""Viterbi decoding (CRF max-sum inference), TPU-native.
+
+Reference surface: python/paddle/text/viterbi_decode.py:24 (`viterbi_decode`,
+`ViterbiDecoder`) backed by the C++ viterbi_decode op
+(paddle/phi/kernels/cpu/viterbi_decode_kernel.cc). Here the whole decode is two
+`lax.scan`s — a forward max-sum recursion carrying (alpha, remaining-length)
+and a backward backpointer trace — so one XLA computation handles the padded
+batch with static shapes; no per-timestep host loop.
+
+Shape note (XLA static shapes): under tracing the returned path is padded to
+the full time dimension [B, T] (entries past each sequence's length are 0); in
+eager mode it is sliced to max(lengths) exactly like the reference op.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from ..ops.creation import to_tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Decode the highest-scoring tag sequence.
+
+    Args:
+        potentials: [batch, seq_len, num_tags] unary emission scores.
+        transition_params: [num_tags, num_tags] transition scores.
+        lengths: [batch] int64 valid lengths.
+        include_bos_eos_tag: if True, the last tag index is treated as BOS
+            (forced start) and the second-to-last as EOS (its transition row is
+            added at each sequence's final step).
+
+    Returns:
+        (scores [batch], paths [batch, seq_len]) — best path score and tags.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    pot = potentials._data if isinstance(potentials, Tensor) else jnp.asarray(potentials)
+    trans = (transition_params._data if isinstance(transition_params, Tensor)
+             else jnp.asarray(transition_params))
+    lens = lengths._data if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+
+    n_tags = pot.shape[-1]
+    left = lens[:, None].astype(jnp.int32)  # remaining steps, [B, 1]
+
+    def max_sum_step(carry, logit):
+        """One forward step: alpha[j] <- max_k(alpha[k] + trans[k, j]) + e_j,
+        frozen once a sequence is exhausted; EOS row added at its last step."""
+        alpha, remaining = carry
+        scored = alpha[:, :, None] + trans[None]           # [B, K_prev, K_next]
+        best = jnp.max(scored, axis=1) + logit
+        backptr = jnp.argmax(scored, axis=1)               # [B, K_next]
+        active = (remaining > 0).astype(alpha.dtype)
+        alpha = active * best + (1 - active) * alpha
+        if include_bos_eos_tag:
+            alpha = alpha + (remaining == 1) * trans[-2][None, :]
+        return (alpha, remaining - 1), backptr
+
+    if include_bos_eos_tag:
+        # Exact forced start (reference: phi viterbi_decode_kernel.cc:244
+        # AddFloat(logit0, start_trans)): alpha = e_0 + trans[BOS], with the
+        # EOS row added immediately for length-1 sequences.
+        alpha = pot[:, 0] + trans[-1][None, :]
+        alpha = alpha + (left == 1) * trans[-2][None, :]
+        left = left - 1
+    else:
+        alpha, left = pot[:, 0], left - 1
+
+    (alpha, left), backptrs = lax.scan(
+        max_sum_step, (alpha, left), jnp.swapaxes(pot, 0, 1)[1:])
+
+    scores = jnp.max(alpha, axis=1)
+    last_ids = jnp.argmax(alpha, axis=1).astype(jnp.int32)
+    left = left[:, 0]
+
+    def trace_step(carry, backptr):
+        """Backward trace; sequences shorter than the padded length emit 0
+        until their own final step is reached (left counts back up to 0)."""
+        ids, remaining = carry
+        remaining = remaining + 1
+        prev = jnp.take_along_axis(backptr, ids[:, None], axis=1)[:, 0]
+        prev = prev.astype(jnp.int32) * (remaining > 0)
+        prev = jnp.where(remaining == 0, ids, prev)
+        ids = jnp.where(remaining < 0, prev + ids, prev)
+        return (ids, remaining), prev
+
+    tail = last_ids * (left >= 0)
+    (_, _), path_rev = lax.scan(trace_step, (last_ids, left), backptrs,
+                                reverse=True)
+    path = jnp.concatenate([path_rev.swapaxes(0, 1), tail[:, None]], axis=1)
+
+    try:  # eager: trim padding to max(lengths), matching the reference op
+        max_len = int(jnp.max(lens))
+    except Exception:  # traced length: keep the static padded shape
+        max_len = None
+    if max_len is not None:
+        path = path[:, :max_len]
+    return Tensor(scores), Tensor(path.astype(jnp.int64))
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper over :func:`viterbi_decode` holding the transition
+    matrix (reference: python/paddle/text/viterbi_decode.py ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = (transitions if isinstance(transitions, Tensor)
+                            else to_tensor(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+    forward = __call__

@@ -37,7 +37,11 @@ def check_grad(op_fn, inputs, attrs=None, input_idx=0, eps=1e-3, rtol=5e-3, atol
                reduce_to_scalar=True):
     """Numeric (central difference) vs analytic (tape backward) gradient check."""
     attrs = attrs or {}
-    np_inputs = [np.asarray(i, np.float64) for i in inputs]
+    # integer inputs (indices) keep their dtype and never get differentiated
+    np_inputs = [np.asarray(i) if np.issubdtype(np.asarray(i).dtype, np.integer)
+                 else np.asarray(i, np.float64) for i in inputs]
+    assert np.issubdtype(np_inputs[input_idx].dtype, np.floating), (
+        "check_grad target input must be floating point")
 
     def run(np_vals):
         import jax.numpy as jnp
@@ -50,7 +54,8 @@ def check_grad(op_fn, inputs, attrs=None, input_idx=0, eps=1e-3, rtol=5e-3, atol
             # rounding (x64 is enabled by paddle_tpu; to_tensor would demote to f32).
             # jnp.array (not asarray): asarray can alias the numpy buffer zero-copy on
             # CPU, and this harness mutates the buffers in the numeric-diff loop.
-            t = _T(jnp.array(v, jnp.float64))
+            t = _T(jnp.array(v, None if np.issubdtype(v.dtype, np.integer)
+                             else jnp.float64))
             t.stop_gradient = k != input_idx
             tensors.append(t)
         out = op_fn(*tensors, **attrs)

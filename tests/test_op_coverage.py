@@ -1,0 +1,39 @@
+"""Guard: the full reference api.yaml surface (235 forward APIs + 182 grads,
+snapshot in tools/api_surface.json) stays implemented, stub-free, and
+referenced by at least one test (VERDICT r1 item #3's done-condition)."""
+import os
+import re
+import sys
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(TESTS_DIR), "tools"))
+
+from op_coverage import audit  # noqa: E402
+
+
+def test_api_yaml_surface_fully_covered():
+    rep = audit()
+    assert rep["missing"] == [], f"unimplemented APIs: {rep['missing']}"
+    assert rep["stubs"] == [], f"stub APIs: {rep['stubs']}"
+    assert rep["backward_missing"] == [], (
+        f"grads without forward: {rep['backward_missing']}")
+    # every waiver must carry a reason
+    for name, reason in rep["waived"].items():
+        assert reason and len(reason) > 10, f"waiver for {name} has no reason"
+
+
+def test_every_api_is_referenced_by_some_test():
+    rep = audit()
+    blob = ""
+    for fn in os.listdir(TESTS_DIR):
+        if fn.endswith(".py") and fn != os.path.basename(__file__):
+            with open(os.path.join(TESTS_DIR, fn)) as f:
+                blob += f.read()
+    untested = []
+    for name, path in rep["implemented"].items():
+        leaf = path.split(".")[-1]
+        if not (re.search(r"\b" + re.escape(leaf) + r"\b", blob)
+                or re.search(r"\b" + re.escape(name) + r"\b", blob)):
+            untested.append(f"{name}->{path}")
+    assert untested == [], (
+        f"{len(untested)} APIs with no test reference: {untested}")

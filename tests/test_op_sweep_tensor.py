@@ -1,0 +1,223 @@
+"""Numeric sweep 2/2 — manipulation, indexing, linalg ops from the reference
+api.yaml surface that had no per-op test (VERDICT r1 weak #5). Same op_test
+pattern as test_op_sweep_math.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+def t(a):
+    return paddle.to_tensor(a)
+
+
+def _rand(shape, lo=-1.0, hi=1.0, seed=0):
+    rng = np.random.RandomState(seed)
+    return (lo + (hi - lo) * rng.rand(*shape)).astype(np.float32)
+
+
+# ---- indexing / rearrangement ----------------------------------------------
+def test_argmin_argsort():
+    x = _rand((3, 5))
+    check_output(paddle.argmin, lambda a, axis: np.argmin(a, axis),
+                 [x], {"axis": 1})
+    check_output(paddle.argsort, lambda a, axis: np.argsort(a, axis),
+                 [x], {"axis": 1})
+
+
+def test_flip_diagonal_unbind():
+    x = _rand((2, 3, 4))
+    check_output(paddle.flip, lambda a, axis: np.flip(a, axis),
+                 [x], {"axis": [0, 2]})
+    check_output(paddle.diagonal,
+                 lambda a, offset, axis1, axis2: np.diagonal(a, offset, axis1, axis2),
+                 [x], {"offset": 1, "axis1": 1, "axis2": 2})
+    outs = paddle.unbind(t(x), axis=1)
+    assert len(outs) == 3
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.numpy(), x[:, i])
+    check_grad(paddle.diagonal, [x.astype(np.float64)[0]],
+               {"offset": 0, "axis1": 0, "axis2": 1})
+
+
+def test_expand_as_meshgrid():
+    x = _rand((1, 3))
+    y = np.zeros((4, 3), np.float32)
+    np.testing.assert_allclose(paddle.expand_as(t(x), t(y)).numpy(),
+                               np.broadcast_to(x, (4, 3)))
+    a, b = np.arange(3, dtype=np.float32), np.arange(2, dtype=np.float32)
+    ga, gb = paddle.meshgrid(t(a), t(b))
+    ea, eb = np.meshgrid(a, b, indexing="ij")
+    np.testing.assert_allclose(ga.numpy(), ea)
+    np.testing.assert_allclose(gb.numpy(), eb)
+
+
+def test_gather_nd_index_select_index_sample():
+    x = _rand((3, 4, 5))
+    idx = np.array([[0, 1], [2, 3]], np.int64)
+    check_output(paddle.gather_nd,
+                 lambda a, i: a[tuple(np.moveaxis(i, -1, 0))],
+                 [x, idx])
+    sel = np.array([2, 0], np.int64)
+    check_output(paddle.index_select,
+                 lambda a, i, axis: np.take(a, i, axis),
+                 [x, sel], {"axis": 1})
+    m = _rand((3, 6))
+    samp = np.array([[0, 5], [2, 2], [1, 0]], np.int64)
+    check_output(paddle.index_sample,
+                 lambda a, i: np.take_along_axis(a, i, 1), [m, samp])
+    check_grad(paddle.gather_nd, [x.astype(np.float64)[0], idx])
+
+
+def test_put_along_axis_scatter_nd_add():
+    x = _rand((3, 4))
+    idx = np.array([[0, 2], [1, 3], [2, 0]], np.int64)
+    val = _rand((3, 2), seed=3)
+
+    def np_put(a, i, v, axis):
+        out = a.copy()
+        np.put_along_axis(out, i, v, axis)
+        return out
+
+    check_output(lambda a, i, v, axis: paddle.put_along_axis(a, i, v, axis),
+                 np_put, [x, idx, val], {"axis": 1})
+
+    base = _rand((4, 3))
+    nd_idx = np.array([[1], [3], [1]], np.int64)
+    upd = _rand((3, 3), seed=5)
+
+    def np_scatter_nd_add(a, i, u):
+        out = a.copy()
+        for r in range(i.shape[0]):
+            out[tuple(i[r])] += u[r]
+        return out
+
+    check_output(paddle.scatter_nd_add, np_scatter_nd_add,
+                 [base, nd_idx, upd])
+    check_grad(paddle.scatter_nd_add,
+               [base.astype(np.float64), nd_idx, upd.astype(np.float64)],
+               input_idx=2)
+
+
+def test_searchsorted_strided_slice():
+    edges = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+    q = np.array([0.0, 3.0, 8.0], np.float32)
+    check_output(paddle.searchsorted,
+                 lambda s, v: np.searchsorted(s, v, side="left"), [edges, q])
+    check_output(lambda s, v: paddle.searchsorted(s, v, right=True),
+                 lambda s, v: np.searchsorted(s, v, side="right"), [edges, q])
+    x = _rand((6, 8))
+    got = paddle.strided_slice(t(x), axes=[0, 1], starts=[1, 0],
+                               ends=[5, 8], strides=[2, 3]).numpy()
+    np.testing.assert_allclose(got, x[1:5:2, 0:8:3])
+
+
+def test_unique_full():
+    x = np.array([2, 3, 3, 1, 5, 3], np.int64)
+    out, index, inverse, counts = paddle.unique(
+        t(x), return_index=True, return_inverse=True, return_counts=True)
+    e_out, e_idx, e_inv, e_cnt = np.unique(
+        x, return_index=True, return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(out.numpy(), e_out)
+    np.testing.assert_array_equal(index.numpy(), e_idx)
+    np.testing.assert_array_equal(inverse.numpy(), e_inv)
+    np.testing.assert_array_equal(counts.numpy(), e_cnt)
+
+
+def test_kthvalue_mode_histogram():
+    x = _rand((3, 7))
+    v, i = paddle.kthvalue(t(x), k=3, axis=1)
+    expect = np.sort(x, 1)[:, 2]
+    np.testing.assert_allclose(v.numpy(), expect, rtol=1e-6)
+    np.testing.assert_allclose(np.take_along_axis(x, i.numpy()[:, None], 1)[:, 0],
+                               expect, rtol=1e-6)
+
+    m = np.array([[1, 2, 2, 3], [4, 4, 5, 5]], np.float32)
+    mv, mi = paddle.mode(t(m), axis=1)
+    np.testing.assert_allclose(mv.numpy(), [2.0, 4.0])  # ties -> smallest value
+    np.testing.assert_allclose(
+        np.take_along_axis(m, mi.numpy()[:, None], 1)[:, 0], mv.numpy())
+    mk, _ = paddle.mode(t(m), axis=0, keepdim=True)
+    assert tuple(mk.shape) == (1, 4)
+
+    h = np.array([1.0, 2.0, 1.0, 2.9], np.float32)
+    check_output(lambda a, bins, min, max: paddle.histogram(a, bins=bins, min=min, max=max),
+                 lambda a, bins, min, max: np.histogram(a, bins, (min, max))[0],
+                 [h], {"bins": 3, "min": 0.0, "max": 3.0})
+
+
+def test_multiplex_shard_index():
+    a, b = _rand((4, 3)), _rand((4, 3), seed=1)
+    idx = np.array([0, 1, 1, 0], np.int64)
+
+    def np_multiplex(x1, x2, i):
+        stacked = np.stack([x1, x2])
+        return stacked[i, np.arange(len(i))]
+
+    check_output(lambda x1, x2, i: paddle.multiplex([x1, x2], i),
+                 np_multiplex, [a, b, idx])
+
+    ids = np.array([[1], [7], [15]], np.int64)
+
+    def np_shard(i, index_num, nshards, shard_id, ignore_value=-1):
+        size = (index_num + nshards - 1) // nshards
+        out = np.where(i // size == shard_id, i % size, ignore_value)
+        return out
+
+    check_output(
+        lambda i, **kw: paddle.shard_index(i, **kw), np_shard, [ids],
+        {"index_num": 16, "nshards": 2, "shard_id": 1})
+
+
+# ---- linalg ----------------------------------------------------------------
+def test_kron_dot_addmm():
+    a, b = _rand((2, 3)), _rand((3, 2), seed=1)
+    check_output(paddle.kron, np.kron, [a, b])
+    v1, v2 = _rand((5,)), _rand((5,), seed=2)
+    check_output(paddle.dot, np.dot, [v1, v2])
+    inp, x, y = _rand((2, 4)), _rand((2, 3), seed=3), _rand((3, 4), seed=4)
+    check_output(
+        lambda i, m1, m2, beta, alpha: paddle.addmm(i, m1, m2, beta=beta, alpha=alpha),
+        lambda i, m1, m2, beta, alpha: beta * i + alpha * (m1 @ m2),
+        [inp, x, y], {"beta": 0.5, "alpha": 2.0}, rtol=1e-5)
+    check_grad(paddle.kron, [a.astype(np.float64), b.astype(np.float64)])
+
+
+def test_matrix_power():
+    x = _rand((3, 3), 0.1, 1.0) + 2 * np.eye(3, dtype=np.float32)
+    for n in (0, 1, 3, -1):
+        check_output(lambda a, n: paddle.linalg.matrix_power(a, n),
+                     lambda a, n: np.linalg.matrix_power(a, n),
+                     [x], {"n": n}, rtol=1e-4, atol=1e-5)
+
+
+def test_triangular_solve():
+    A = np.triu(_rand((3, 3), 0.5, 2.0)) + np.eye(3, dtype=np.float32)
+    b = _rand((3, 2), seed=1)
+    got = paddle.linalg.triangular_solve(t(A), t(b), upper=True).numpy()
+    np.testing.assert_allclose(A @ got, b, rtol=1e-4, atol=1e-5)
+    L = np.tril(_rand((3, 3), 0.5, 2.0)) + np.eye(3, dtype=np.float32)
+    got = paddle.linalg.triangular_solve(t(L), t(b), upper=False).numpy()
+    np.testing.assert_allclose(L @ got, b, rtol=1e-4, atol=1e-5)
+
+
+def test_eigh_properties():
+    rng = np.random.RandomState(0)
+    A = rng.randn(4, 4).astype(np.float32)
+    A = (A + A.T) / 2
+    w, v = paddle.linalg.eigh(t(A))
+    w, v = w.numpy(), v.numpy()
+    np.testing.assert_allclose(np.sort(w), w, rtol=1e-5)  # ascending
+    np.testing.assert_allclose(A @ v, v * w[None, :], atol=1e-4)
+    np.testing.assert_allclose(v.T @ v, np.eye(4), atol=1e-5)
+    np.testing.assert_allclose(w, np.linalg.eigvalsh(A), rtol=1e-4, atol=1e-5)
+
+
+def test_matrix_rank_with_tol():
+    A = np.diag([5.0, 1.0, 1e-7, 0.0]).astype(np.float32)
+    assert int(paddle.linalg.matrix_rank(t(A))) == 2
+    assert int(paddle.linalg.matrix_rank(t(A), tol=0.5)) == 2
+    assert int(paddle.linalg.matrix_rank(t(A), tol=1e-8)) == 3
+    B = _rand((3, 5))
+    assert int(paddle.linalg.matrix_rank(t(B))) == 3

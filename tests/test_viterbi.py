@@ -1,0 +1,92 @@
+"""viterbi_decode vs a brute-force all-paths numpy oracle.
+
+Reference semantics: python/paddle/text/viterbi_decode.py + the op test's
+decoder (python/paddle/fluid/tests/unittests/test_viterbi_decode_op.py:20).
+Instead of mirroring that recurrence, the oracle enumerates every tag sequence,
+which independently pins down the scoring convention:
+  score(path) = sum_t emit[t, y_t] + sum_t trans[y_{t-1}, y_t]
+                (+ trans[BOS, y_0] and + trans[EOS_row, y_last] with tags on).
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def brute_force(pot, trans, lengths, use_tag):
+    bz, _, n = pot.shape
+    scores, paths = [], []
+    max_len = int(lengths.max())
+    for b in range(bz):
+        L = int(lengths[b])
+        best, best_path = -np.inf, None
+        for path in itertools.product(range(n), repeat=L):
+            s = pot[b, 0, path[0]]
+            if use_tag:
+                s += trans[-1, path[0]]  # forced BOS start
+            for t in range(1, L):
+                s += pot[b, t, path[t]] + trans[path[t - 1], path[t]]
+            if use_tag:
+                s += trans[-2, path[-1]]  # EOS row added at the final step
+            if s > best:
+                best, best_path = s, path
+        scores.append(best)
+        paths.append(list(best_path) + [0] * (max_len - L))
+    return np.array(scores), np.array(paths, np.int64)
+
+
+@pytest.mark.parametrize("use_tag", [True, False])
+def test_viterbi_matches_brute_force(use_tag):
+    rng = np.random.RandomState(7)
+    bz, T, n = 4, 5, 3
+    pot = rng.randn(bz, T, n).astype(np.float32)
+    trans = rng.randn(n, n).astype(np.float32)
+    lengths = np.array([5, 3, 1, 4], np.int64)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths), include_bos_eos_tag=use_tag)
+    exp_scores, exp_paths = brute_force(pot, trans, lengths, use_tag)
+    np.testing.assert_allclose(scores.numpy(), exp_scores, rtol=1e-5)
+    np.testing.assert_array_equal(paths.numpy(), exp_paths)
+
+
+def test_viterbi_forbidden_transitions_respect_forced_bos():
+    # CRF constraint masking: trans[BOS, j] = -10000 forbids starting at j.
+    # A soft BOS init (-1e4 penalty) would leak a non-BOS start here; the
+    # exact init (reference phi viterbi_decode_kernel.cc:244) must not.
+    n = 4
+    pot = np.zeros((1, 3, n), np.float32)
+    trans = np.full((n, n), 5.0, np.float32)
+    trans[-1, :] = -10000.0  # BOS row: every start forbidden...
+    trans[-1, 0] = 0.0       # ...except tag 0
+    lengths = np.array([3], np.int64)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths), include_bos_eos_tag=True)
+    exp_scores, exp_paths = brute_force(pot, trans, lengths, True)
+    np.testing.assert_allclose(scores.numpy(), exp_scores, rtol=1e-5)
+    np.testing.assert_array_equal(paths.numpy(), exp_paths)
+    assert paths.numpy()[0, 0] == 0  # must start at the only allowed tag
+
+
+def test_viterbi_decoder_layer_and_jit():
+    import jax
+
+    rng = np.random.RandomState(0)
+    pot = rng.randn(2, 4, 3).astype(np.float32)
+    trans = rng.randn(3, 3).astype(np.float32)
+    lengths = np.array([4, 2], np.int64)
+    dec = paddle.text.ViterbiDecoder(paddle.to_tensor(trans))
+    s_eager, p_eager = dec(paddle.to_tensor(pot), paddle.to_tensor(lengths))
+
+    def fn(p, t, l):
+        s, pa = paddle.text.viterbi_decode(p, t, l)
+        return s._data, pa._data
+
+    s_jit, p_jit = jax.jit(fn)(pot, trans, lengths)
+    np.testing.assert_allclose(np.asarray(s_jit), s_eager.numpy(), rtol=1e-6)
+    # traced path is padded to T; eager is trimmed to max(lengths)
+    np.testing.assert_array_equal(
+        np.asarray(p_jit)[:, :p_eager.shape[1]], p_eager.numpy())
