@@ -126,6 +126,12 @@ class TrainStepEngine:
         self.buffers = {n: state[n]._data for n in self._buffer_names}
 
         rule = optimizer._rule
+        # offload (GroupShardedOptimizerStage2(offload=True), reference
+        # group_sharded_optimizer_stage2.py:48): optimizer state lives in host
+        # memory between steps — XLA streams it to HBM for the update and back,
+        # freeing per-device HBM at the cost of host<->device traffic.
+        self._opt_memory_kind = ("pinned_host"
+                                 if getattr(optimizer, "_offload", False) else None)
         self.opt_specs = {}
         self.opt_state = {}
         for n in self._param_names:
@@ -134,13 +140,21 @@ class TrainStepEngine:
                                    use_sharding)
             self.opt_specs[n] = spec
             self.opt_state[n] = tuple(
-                jax.device_put(s, NamedSharding(self.mesh, spec)) for s in st)
+                jax.device_put(s, self._opt_sharding(spec)) for s in st)
 
         self._step_fn = None
         self._step_count = optimizer._step_count
         self._key = jax.random.key(random_mod.default_generator().initial_seed() or 0)
         self.last_loss = None
         self._lr_cache = (None, None)  # (python value, device scalar)
+
+    def _opt_sharding(self, spec):
+        """NamedSharding for one optimizer-state leaf; host-memory-resident
+        when the optimizer requested offload."""
+        if self._opt_memory_kind:
+            return NamedSharding(self.mesh, spec,
+                                 memory_kind=self._opt_memory_kind)
+        return NamedSharding(self.mesh, spec)
 
     # ---- step function construction ----
     def _build(self, batch_avals):
@@ -202,8 +216,11 @@ class TrainStepEngine:
             return loss, new_params, new_opt
 
         param_shardings = {n: NamedSharding(self.mesh, s) for n, s in self.param_specs.items()}
+        # the jitted step is all-device; offload transfers happen at the
+        # python boundary in step() (jax 0.9 dropped in-jit memory transfers)
         opt_shardings = {
-            n: tuple(NamedSharding(self.mesh, self.opt_specs[n]) for _ in self.opt_state[n])
+            n: tuple(NamedSharding(self.mesh, self.opt_specs[n])
+                     for _ in self.opt_state[n])
             for n in self._param_names}
         if self.input_specs is not None:
             batch_shardings = tuple(NamedSharding(self.mesh, s) for s in self.input_specs)
@@ -248,8 +265,21 @@ class TrainStepEngine:
             self._lr_cache = (lr_val, jnp.float32(lr_val))
         lr = self._lr_cache[1]
         self._key, sub = jax.random.split(self._key)
-        loss, self.params, self.opt_state = self._step_fn(
-            self.params, self.opt_state, lr, jnp.int32(self._step_count), sub, *arrays)
+        opt_state = self.opt_state
+        if self._opt_memory_kind:
+            # offload: state lives in host memory between steps; stream it to
+            # HBM for the update (async device_put pipelines with dispatch)
+            opt_state = {
+                n: tuple(jax.device_put(s, NamedSharding(self.mesh,
+                                                         self.opt_specs[n]))
+                         for s in st) for n, st in opt_state.items()}
+        loss, self.params, new_opt = self._step_fn(
+            self.params, opt_state, lr, jnp.int32(self._step_count), sub, *arrays)
+        if self._opt_memory_kind:
+            new_opt = {
+                n: tuple(jax.device_put(s, self._opt_sharding(self.opt_specs[n]))
+                         for s in st) for n, st in new_opt.items()}
+        self.opt_state = new_opt
         self.last_loss = Tensor(loss)
         return self.last_loss
 

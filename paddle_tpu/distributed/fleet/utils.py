@@ -109,16 +109,24 @@ def recompute(function, *args, **kwargs):
 
 
 def fused_allreduce_gradients(parameter_list, hcg):
-    """Reference hybrid_parallel_util.py:142 — under the pjit engine this is the XLA
-    allreduce from batch-sharded grads; eagerly (multi-process) allreduce per param."""
-    from .. import collective
+    """Reference hybrid_parallel_util.py:142 — under the pjit engine this is the
+    XLA allreduce from batch-sharded grads; eagerly (multi-process) it fuses
+    grads into comm-buffer buckets and runs one collective per bucket
+    (meta_parallel.data_parallel.Reducer, reference reducer.cc)."""
+    from ..meta_parallel.data_parallel import Reducer
 
     group = hcg.get_data_parallel_group() if hcg else None
     if group is None or group.nranks <= 1:
         return
-    for p in parameter_list:
-        if p.grad is not None:
-            collective.all_reduce(p.grad, op=collective.ReduceOp.AVG, group=group)
+    params = list(parameter_list)
+    key = (tuple(id(p) for p in params), id(group))
+    red = _reducer_cache.get(key)
+    if red is None:  # bucket building is O(n_params): once per param set
+        red = _reducer_cache[key] = Reducer(params, group=group)
+    red.sync()
+
+
+_reducer_cache = {}
 
 
 def broadcast_mp_parameters(model, hcg):

@@ -20,6 +20,96 @@ from ..env import get_world_size
 from ..mesh import get_hybrid_communicate_group
 
 
+class Reducer:
+    """Bucketed fused gradient allreduce.
+
+    Reference: paddle/fluid/imperative/reducer.cc (reducer.h:126) — trainable
+    parameters are grouped in REVERSE registration order (grads become ready
+    back-to-front during backward) into dtype-homogeneous buckets capped at
+    ``comm_buffer_size`` MB (the final bucket re-split to
+    ``last_comm_buffer_size`` MB so the front-of-model flush stays small).
+    ``sync()`` flattens each bucket's grads into one buffer, runs ONE
+    collective per bucket, and scatters the averaged slices back — so the
+    collective count is ceil(total_grad_MB / comm_buffer_size), not the
+    parameter count.
+
+    ``find_unused_parameters=True`` contributes zeros for parameters whose
+    grad is None (unused in this step's graph), keeping every rank's
+    collective schedule identical even when usage diverges — and, like the
+    reference, writes back the group average so a parameter used by ANY rank
+    steps on ALL ranks. With False, grad-less parameters are skipped; as in
+    the reference, ranks must then agree on which parameters got grads.
+    """
+
+    def __init__(self, parameters, group=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False):
+        self.params = [p for p in parameters if not p.stop_gradient and p.size]
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        self.n_collectives = 0  # stats for tests/profiling
+        self._buckets = self._build_buckets(
+            comm_buffer_size * (1 << 20), last_comm_buffer_size * (1 << 20))
+
+    def _build_buckets(self, cap, last_cap):
+        import numpy as np
+
+        buckets, cur, cur_bytes, cur_dtype = [], [], 0, None
+        for p in reversed(self.params):
+            nbytes = p.size * np.dtype(str(p._data.dtype)).itemsize
+            if cur and (cur_dtype != p._data.dtype or cur_bytes + nbytes > cap):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(p)
+            cur_bytes, cur_dtype = cur_bytes + nbytes, p._data.dtype
+        if cur:
+            buckets.append(cur)
+        # keep ONLY the final flush (front-of-model params) small: peel params
+        # off the end of the last bucket into one <=last_cap chunk (reference
+        # reducer.cc applies the small group_size_limit to a single group)
+        if len(buckets) > 0 and last_cap < cap and len(buckets[-1]) > 1:
+            tail = list(buckets[-1])
+            small, bytes_ = [], 0
+            while tail:
+                nbytes = tail[-1].size * np.dtype(
+                    str(tail[-1]._data.dtype)).itemsize
+                if bytes_ + nbytes > last_cap:
+                    break
+                small.insert(0, tail.pop())
+                bytes_ += nbytes
+            if small and tail:
+                buckets[-1] = tail
+                buckets.append(small)
+        return buckets
+
+    def sync(self):
+        """Allreduce-AVG every bucket; returns the number of collectives."""
+        import jax.numpy as jnp
+
+        if self.group is None or getattr(self.group, "nranks", 1) <= 1:
+            return 0
+        calls = 0
+        for bucket in self._buckets:
+            if self.find_unused_parameters:
+                live = bucket
+            else:
+                live = [p for p in bucket if p.grad is not None]
+            if not live:
+                continue
+            flats = [p.grad._data.reshape(-1) if p.grad is not None
+                     else jnp.zeros((p.size,), p._data.dtype) for p in live]
+            buf = Tensor(jnp.concatenate(flats) if len(flats) > 1 else flats[0])
+            collective.all_reduce(buf, op=collective.ReduceOp.AVG,
+                                  group=self.group)
+            calls += 1
+            offset = 0
+            for p in live:
+                p.grad = Tensor(
+                    buf._data[offset:offset + p.size].reshape(tuple(p.shape)))
+                offset += p.size
+        self.n_collectives += calls
+        return calls
+
+
 class DataParallel(nn.Layer):
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False, group=None):
@@ -28,11 +118,17 @@ class DataParallel(nn.Layer):
         object.__setattr__(self, "_layers", layers)
         self.find_unused_parameters = find_unused_parameters
         self.comm_buffer_size = comm_buffer_size
+        self.last_comm_buffer_size = last_comm_buffer_size
         self._grads_synced = True
         self._enable_sync = True
         hcg = get_hybrid_communicate_group()
         self.group = group or (hcg.get_data_parallel_group() if hcg else None)
         self._world = self.group.nranks if self.group else get_world_size()
+        self._reducer = Reducer(
+            list(layers.parameters()), group=self.group,
+            comm_buffer_size=comm_buffer_size,
+            last_comm_buffer_size=last_comm_buffer_size,
+            find_unused_parameters=find_unused_parameters)
 
     def forward(self, *inputs, **kwargs):
         out = self._layers(*inputs, **kwargs)
@@ -50,13 +146,23 @@ class DataParallel(nn.Layer):
             self._enable_sync = prev
 
     def sync_gradients(self):
-        """Bucketed grad allreduce (the Reducer's job). Called by optimizer glue or
-        explicitly after backward in eager multi-rank mode."""
+        """Bucketed fused grad allreduce via the Reducer. Called by optimizer
+        glue or explicitly after backward in eager multi-rank mode."""
         if self._world <= 1:
             return
-        for p in self._layers.parameters():
-            if p.grad is not None:
-                collective.all_reduce(p.grad, op=collective.ReduceOp.AVG, group=self.group)
+        # params un/re-frozen (stop_gradient flipped) or added after wrapping
+        # must not be silently skipped: rebuild buckets on membership change
+        trainable = [p for p in self._layers.parameters()
+                     if not p.stop_gradient and p.size]
+        if [id(p) for p in trainable] != [id(p) for p in self._reducer.params]:
+            stats = self._reducer.n_collectives
+            self._reducer = Reducer(
+                trainable, group=self.group,
+                comm_buffer_size=self.comm_buffer_size,
+                last_comm_buffer_size=self.last_comm_buffer_size,
+                find_unused_parameters=self.find_unused_parameters)
+            self._reducer.n_collectives = stats
+        self._reducer.sync()
         self._grads_synced = True
 
     def scale_loss(self, loss):

@@ -19,7 +19,12 @@ from ..mesh import get_hybrid_communicate_group
 
 
 class GroupShardedOptimizerStage2:
-    """Wraps an optimizer: optimizer states will be sharded over the sharding axis."""
+    """Wraps an optimizer: optimizer states will be sharded over the sharding
+    axis. ``offload=True`` (reference group_sharded_optimizer_stage2.py:48)
+    keeps optimizer state host-resident between steps: eager mode stores the
+    state tuples as numpy (host RAM), the pjit engine places them with
+    pinned_host memory-kind shardings — either way per-device HBM holds no
+    optimizer state between steps."""
 
     def __init__(self, params, optim, group=None, offload=False, device="tpu", **kw):
         self._optim = optim
@@ -27,6 +32,7 @@ class GroupShardedOptimizerStage2:
         self.offload = offload
         self.zero_stage = 2
         optim._zero_stage = 2
+        optim._offload = bool(offload)
 
     def __getattr__(self, name):
         return getattr(self._optim, name)
@@ -72,12 +78,17 @@ class GroupShardedStage3(nn.Layer):
         self.add_sublayer("_layers", layer)
         object.__setattr__(self, "_layers", layer)
         self._optim = optimizer
+        self.segment_size = segment_size
         hcg = get_hybrid_communicate_group()
         deg = hcg.degrees["sharding"] if hcg else 1
         if deg > 1:
             for p in layer.parameters():
                 if getattr(p, "dist_attr", None) is not None:
                     continue  # TP-sharded params keep their annotation
+                if p.size <= segment_size:
+                    continue  # small params stay whole, exactly the reference
+                    #           unslice rule (group_sharded_stage3.py:314
+                    #           `p._numel() > self._segment_size`)
                 shape = p.shape
                 for i, s in enumerate(shape):
                     if s % deg == 0:
@@ -87,6 +98,7 @@ class GroupShardedStage3(nn.Layer):
                         break
         if optimizer is not None:
             optimizer._zero_stage = 3
+            optimizer._offload = bool(offload)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

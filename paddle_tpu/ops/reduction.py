@@ -140,13 +140,17 @@ def nanquantile(x, q, axis=None, keepdim=False, name=None):
 def kthvalue(x, k, axis=-1, keepdim=False, name=None):
     x = t_(x)
     ax = normalize_axis(axis, x.ndim)
-    vals = jnp.sort(x._data, axis=ax)
-    inds = jnp.argsort(x._data, axis=ax)
-    tv = jnp.take(vals, k - 1, axis=ax)
-    ti = jnp.take(inds, k - 1, axis=ax)
+    inds = jnp.take(jnp.argsort(x._data, axis=ax), k - 1, axis=ax)
+    # values gathered through the differentiable take_along_axis op so the
+    # tape records the kthvalue grad (scatter into the selected slot) —
+    # reference kthvalue_grad (backward.yaml)
+    from .manipulation import squeeze, take_along_axis
+
+    tv = take_along_axis(x, Tensor(jnp.expand_dims(inds, ax)), ax)
+    ti = jnp.expand_dims(inds, ax).astype(jnp.int64)
     if keepdim:
-        tv, ti = jnp.expand_dims(tv, ax), jnp.expand_dims(ti, ax)
-    return Tensor(tv), Tensor(ti.astype(jnp.int64))
+        return tv, Tensor(ti)
+    return squeeze(tv, ax), Tensor(jnp.squeeze(ti, ax))
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
@@ -181,10 +185,14 @@ def mode(x, axis=-1, keepdim=False, name=None):
     freq = jnp.where(is_end, run_len, 0)
     best = jnp.argmax(freq, axis=-1)  # first max: earliest run = smallest value
 
-    mv = jnp.take_along_axis(svals, best[..., None], axis=-1)
-    mi = jnp.take_along_axis(order, best[..., None], axis=-1)
+    mi = jnp.take_along_axis(order, best[..., None], axis=-1)  # original index
+    # values gathered through the differentiable take_along_axis op so the
+    # tape records the mode grad (scatter into the mode's slot) — reference
+    # mode_grad (backward.yaml)
+    from .manipulation import squeeze, take_along_axis
+
+    mi_orig = jnp.moveaxis(mi, -1, ax)
+    mv = take_along_axis(x, Tensor(mi_orig), ax)
     if keepdim:
-        mv, mi = jnp.moveaxis(mv, -1, ax), jnp.moveaxis(mi, -1, ax)
-    else:
-        mv, mi = mv[..., 0], mi[..., 0]
-    return Tensor(mv), Tensor(mi.astype(jnp.int64))
+        return mv, Tensor(mi_orig.astype(jnp.int64))
+    return squeeze(mv, ax), Tensor(jnp.squeeze(mi_orig, ax).astype(jnp.int64))

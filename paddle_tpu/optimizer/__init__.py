@@ -20,6 +20,12 @@ from .lr import LRScheduler
 class Optimizer:
     _rule = "sgd"
     _hyper = {}
+    # offload (reference group_sharded_optimizer_stage2.py:48 offload=True):
+    # eager-mode optimizer states are pulled to host RAM (numpy) after every
+    # update, so only params+grads stay device-resident between steps. Set via
+    # GroupShardedOptimizerStage2(..., offload=True); the pjit engine instead
+    # maps this to pinned_host memory-kind shardings.
+    _offload = False
 
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, **kwargs):
@@ -105,6 +111,10 @@ class Optimizer:
             new_data, new_state = rule(p._data, g._data, st,
                                        jnp.float32(lr_val), jnp.int32(self._step_count))
             p._data = new_data
+            if self._offload:  # host-resident between steps (frees HBM)
+                import numpy as _np
+
+                new_state = tuple(_np.asarray(s) for s in new_state)
             self._states[id(p)] = (p, new_state)
 
     minimize_step = step
@@ -133,7 +143,7 @@ class Optimizer:
             entry = self._states.get(id(p))
             if entry is not None:
                 for j, s in enumerate(entry[1]):
-                    out[f"param{i}_state{j}"] = Tensor(s)
+                    out[f"param{i}_state{j}"] = Tensor(jnp.asarray(s))
         if isinstance(self._learning_rate, LRScheduler):
             out["LR_Scheduler"] = self._learning_rate.state_dict()
         return out

@@ -83,7 +83,7 @@ def viterbi_decode(potentials, transition_params, lengths,
         prev = jnp.take_along_axis(backptr, ids[:, None], axis=1)[:, 0]
         prev = prev.astype(jnp.int32) * (remaining > 0)
         prev = jnp.where(remaining == 0, ids, prev)
-        ids = jnp.where(remaining < 0, prev + ids, prev)
+        ids = jnp.where(remaining < 0, ids, prev)  # before seq start: hold ids
         return (ids, remaining), prev
 
     tail = last_ids * (left >= 0)

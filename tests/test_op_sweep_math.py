@@ -153,7 +153,59 @@ def test_empty_full_like_assign_increment():
     np.testing.assert_allclose(y.numpy(), [3.0])
 
 
+def test_logit_equal_dist_cross_trace_pad():
+    p = _rand((2, 4), 0.05, 0.95)
+    check_output(paddle.logit, lambda x: np.log(x / (1 - x)), [p], rtol=1e-5)
+    check_grad(paddle.logit, [p.astype(np.float64)])
+
+    a = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    b = np.array([[1.0, 3.0], [3.0, 4.0]], np.float32)
+    check_output(paddle.equal, np.equal, [a, b])
+
+    x, y = _rand((3, 4)), _rand((3, 4), seed=1)
+    np.testing.assert_allclose(paddle.dist(t(x), t(y), p=2).numpy(),
+                               np.linalg.norm((x - y).ravel()), rtol=1e-5)
+    np.testing.assert_allclose(paddle.dist(t(x), t(y), p=float("inf")).numpy(),
+                               np.abs(x - y).max(), rtol=1e-6)
+
+    u, v = _rand((4, 3)), _rand((4, 3), seed=2)
+    check_output(paddle.cross, lambda m, n, axis: np.cross(m, n, axis=axis),
+                 [u, v], {"axis": 1})
+
+    sq = _rand((4, 4))
+    check_output(paddle.trace, lambda m: np.trace(m), [sq])
+    check_grad(paddle.trace, [sq.astype(np.float64)])
+
+    check_output(lambda m, pad, value: paddle.nn.functional.pad(
+                     m, pad, mode="constant", value=value),
+                 lambda m, pad, value: np.pad(
+                     m, [(pad[0], pad[1]), (pad[2], pad[3])],
+                     constant_values=value),
+                 [_rand((2, 3))], {"pad": [1, 1, 0, 2], "value": 0.5})
+
+
+def test_batch_norm_functional():
+    F = paddle.nn.functional
+    x = _rand((4, 3, 2, 2))
+    rm = np.zeros((3,), np.float32)
+    rv = np.ones((3,), np.float32)
+    w = _rand((3,), 0.5, 1.5, seed=1)
+    b = _rand((3,), -0.5, 0.5, seed=2)
+    out = F.batch_norm(t(x), t(rm), t(rv), weight=t(w), bias=t(b),
+                       training=False, epsilon=1e-5).numpy()
+    expect = ((x - rm[None, :, None, None]) /
+              np.sqrt(rv[None, :, None, None] + 1e-5) *
+              w[None, :, None, None] + b[None, :, None, None])
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
 # ---- random ops: distributional checks (deterministic under paddle.seed) ---
+def test_uniform_moments():
+    paddle.seed(21)
+    s = paddle.uniform([20000], min=-2.0, max=4.0).numpy()
+    assert s.min() >= -2.0 and s.max() <= 4.0
+    assert abs(s.mean() - 1.0) < 0.1
+
 def test_normal_moments():
     paddle.seed(1234)
     s = paddle.normal(mean=1.0, std=2.0, shape=[20000]).numpy()

@@ -141,6 +141,20 @@ def test_kthvalue_mode_histogram():
     mk, _ = paddle.mode(t(m), axis=0, keepdim=True)
     assert tuple(mk.shape) == (1, 4)
 
+    # grads flow through the selected slots (reference kthvalue_grad/mode_grad)
+    check_grad(lambda a: paddle.kthvalue(a, k=2, axis=1)[0],
+               [_rand((2, 4), seed=9).astype(np.float64)])
+    # mode's numeric diff is ill-posed (perturbing a tied element changes the
+    # selection discontinuously): assert the analytic grad is the one-hot
+    # scatter into the selected slot instead
+    xm = t(np.array([[1.0, 3.0, 3.0, 2.0], [5.0, 4.0, 4.0, 6.0]], np.float32))
+    xm.stop_gradient = False
+    mv2, mi2 = paddle.mode(xm, axis=1)
+    mv2.sum().backward()
+    expect_g = np.zeros((2, 4), np.float32)
+    expect_g[np.arange(2), mi2.numpy()] = 1.0
+    np.testing.assert_allclose(xm.grad.numpy(), expect_g)
+
     h = np.array([1.0, 2.0, 1.0, 2.9], np.float32)
     check_output(lambda a, bins, min, max: paddle.histogram(a, bins=bins, min=min, max=max),
                  lambda a, bins, min, max: np.histogram(a, bins, (min, max))[0],
