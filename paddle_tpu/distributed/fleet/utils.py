@@ -118,15 +118,20 @@ def fused_allreduce_gradients(parameter_list, hcg):
     group = hcg.get_data_parallel_group() if hcg else None
     if group is None or group.nranks <= 1:
         return
-    params = list(parameter_list)
-    key = (tuple(id(p) for p in params), id(group))
-    red = _reducer_cache.get(key)
-    if red is None:  # bucket building is O(n_params): once per param set
-        red = _reducer_cache[key] = Reducer(params, group=group)
-    red.sync()
+    # one cache slot per group, keyed by the TRAINABLE membership: a
+    # stop_gradient flip (un/refreezing) rebuilds the buckets, a new model on
+    # the same group replaces the slot (so discarded models aren't pinned)
+    params = [p for p in parameter_list
+              if not getattr(p, "stop_gradient", True) and p.size]
+    key = tuple(id(p) for p in params)
+    slot = _reducer_cache.get(id(group))
+    if slot is None or slot[0] != key:
+        slot = (key, Reducer(params, group=group))
+        _reducer_cache[id(group)] = slot
+    slot[1].sync()
 
 
-_reducer_cache = {}
+_reducer_cache = {}  # id(group) -> (trainable-ids, Reducer)
 
 
 def broadcast_mp_parameters(model, hcg):

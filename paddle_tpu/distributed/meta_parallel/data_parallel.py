@@ -51,16 +51,17 @@ class Reducer:
             comm_buffer_size * (1 << 20), last_comm_buffer_size * (1 << 20))
 
     def _build_buckets(self, cap, last_cap):
-        import numpy as np
+        def nbytes(p):
+            return int(p._data.nbytes)
 
         buckets, cur, cur_bytes, cur_dtype = [], [], 0, None
         for p in reversed(self.params):
-            nbytes = p.size * np.dtype(str(p._data.dtype)).itemsize
-            if cur and (cur_dtype != p._data.dtype or cur_bytes + nbytes > cap):
+            if cur and (cur_dtype != p._data.dtype
+                        or cur_bytes + nbytes(p) > cap):
                 buckets.append(cur)
                 cur, cur_bytes = [], 0
             cur.append(p)
-            cur_bytes, cur_dtype = cur_bytes + nbytes, p._data.dtype
+            cur_bytes, cur_dtype = cur_bytes + nbytes(p), p._data.dtype
         if cur:
             buckets.append(cur)
         # keep ONLY the final flush (front-of-model params) small: peel params
@@ -69,13 +70,9 @@ class Reducer:
         if len(buckets) > 0 and last_cap < cap and len(buckets[-1]) > 1:
             tail = list(buckets[-1])
             small, bytes_ = [], 0
-            while tail:
-                nbytes = tail[-1].size * np.dtype(
-                    str(tail[-1]._data.dtype)).itemsize
-                if bytes_ + nbytes > last_cap:
-                    break
+            while tail and bytes_ + nbytes(tail[-1]) <= last_cap:
+                bytes_ += nbytes(tail[-1])
                 small.insert(0, tail.pop())
-                bytes_ += nbytes
             if small and tail:
                 buckets[-1] = tail
                 buckets.append(small)

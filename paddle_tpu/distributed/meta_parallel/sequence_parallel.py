@@ -89,35 +89,73 @@ def _chunk_attn(q, k, v, sm_scale, mask):
     return acc, m, l
 
 
+def _ring_use_flash(s_loc: int, d: int) -> bool:
+    """Per-shard block compute runs the Pallas flash kernel when the shapes
+    qualify (SURVEY §5.7's Pallas-ring requirement). On CPU the kernel only
+    exists in slow interpret mode, so it is opt-in there (tests set
+    PADDLE_TPU_RING_FLASH=1)."""
+    import os
+
+    from ...ops.pallas.flash_attention import supported
+
+    if not supported(s_loc, s_loc, d):
+        return False
+    if jax.default_backend() == "cpu":
+        return os.environ.get("PADDLE_TPU_RING_FLASH") == "1"
+    return True
+
+
+def _block_attn_normalized(q, kc, vc, sm_scale, *, diag, use_flash):
+    """One KV-block attention -> (o [b,h,sq,d] f32 normalized, lse [b,h,sq]).
+
+    diag=True applies the within-block causal mask (ring diagonal block, where
+    q and kv share global offsets). Pallas flash kernel when available; jnp
+    chunk attention otherwise.
+    """
+    if use_flash:
+        from ...ops.pallas.flash_attention import flash_attention_with_lse
+
+        o, lse = flash_attention_with_lse(q, kc, vc, causal=diag,
+                                          sm_scale=sm_scale)
+        return jnp.swapaxes(o, 1, 2).astype(jnp.float32), lse
+    mask = None
+    if diag:
+        sq = q.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sq)[None, :]
+    acc, m, l = _chunk_attn(q, kc, vc, sm_scale, mask)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return acc / safe_l[..., None], m + jnp.log(safe_l)
+
+
 def _ring_shard(q, k, v, *, axis, causal, sm_scale):
     """Per-shard ring attention body (runs under shard_map, manual over `axis`).
 
-    q,k,v: [b, s_local, h, d] — this rank's sequence shard.
+    q,k,v: [b, s_local, h, d] — this rank's sequence shard. Partial results
+    are carried normalized with their logsumexp and merged as
+    o <- w1*o_acc + w2*o_t, w_i = exp(lse_i - logaddexp(lse_acc, lse_t)),
+    so the Pallas flash kernel (which returns normalized output + lse) drops
+    straight into the loop.
     """
     p_size = jax.lax.axis_size(axis)
     my_idx = jax.lax.axis_index(axis)
     b, s_loc, h, d = q.shape
-
-    qpos = jnp.arange(s_loc)
-    kpos = jnp.arange(s_loc)
+    use_flash = _ring_use_flash(s_loc, d)
 
     def body(t, carry):
-        o_acc, m_acc, l_acc, kc, vc = carry
+        o_acc, lse_acc, kc, vc = carry
 
-        def merge(stats, mask):
-            o_acc, m_acc, l_acc = stats
-            acc, m, l = _chunk_attn(q, kc, vc, sm_scale, mask)
-            m_new = jnp.maximum(m_acc, m)
-            a1 = jnp.exp(m_acc - m_new)
-            a2 = jnp.exp(m - m_new)
-            return (o_acc * a1[..., None] + acc * a2[..., None],
-                    m_new, l_acc * a1 + l * a2)
+        def merge(stats, diag):
+            o_acc, lse_acc = stats
+            o_t, lse_t = _block_attn_normalized(q, kc, vc, sm_scale,
+                                                diag=diag, use_flash=use_flash)
+            lse_new = jnp.logaddexp(lse_acc, lse_t)
+            w1 = jnp.exp(lse_acc - lse_new)
+            w2 = jnp.exp(lse_t - lse_new)
+            return o_acc * w1[..., None] + o_t * w2[..., None], lse_new
 
-        stats = (o_acc, m_acc, l_acc)
+        stats = (o_acc, lse_acc)
         if causal:
             kv_idx = (my_idx - t) % p_size  # whose block we currently hold
-            qg = my_idx * s_loc + qpos[:, None]
-            kg = kv_idx * s_loc + kpos[None, :]
             # 3-way block dispatch: entirely-future blocks skip compute, the
             # diagonal block masks within, past blocks run unmasked
             stats = jax.lax.cond(
@@ -125,27 +163,24 @@ def _ring_shard(q, k, v, *, axis, causal, sm_scale):
                 lambda s: s,
                 lambda s: jax.lax.cond(
                     kv_idx == my_idx,
-                    lambda s2: merge(s2, qg >= kg),
-                    lambda s2: merge(s2, None),
+                    lambda s2: merge(s2, True),
+                    lambda s2: merge(s2, False),
                     s),
                 stats)
         else:
-            stats = merge(stats, None)
-        o_acc, m_acc, l_acc = stats
+            stats = merge(stats, False)
+        o_acc, lse_acc = stats
         # rotate kv to the next rank (neighbor exchange on the ICI ring)
         perm = [(i, (i + 1) % p_size) for i in range(p_size)]
         kc = jax.lax.ppermute(kc, axis, perm)
         vc = jax.lax.ppermute(vc, axis, perm)
-        return o_acc, m_acc, l_acc, kc, vc
+        return o_acc, lse_acc, kc, vc
 
     o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
-    m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
-    o, m, l, _, _ = jax.lax.fori_loop(
-        0, p_size, body, (o0, m0, l0, k, v), unroll=True)
-    l = jnp.where(l == 0.0, 1.0, l)
-    out = (o / l[..., None]).astype(q.dtype)            # [b,h,sq,d]
-    return jnp.swapaxes(out, 1, 2)                      # [b,sq,h,d]
+    lse0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    o, lse, _, _ = jax.lax.fori_loop(
+        0, p_size, body, (o0, lse0, k, v), unroll=True)
+    return jnp.swapaxes(o.astype(q.dtype), 1, 2)        # [b,sq,h,d]
 
 
 def ring_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,

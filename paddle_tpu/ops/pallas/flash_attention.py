@@ -218,7 +218,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd(res, g, sm_scale, causal, blocks=None):
+def _bwd(res, g, sm_scale, causal, blocks=None, g_lse=None):
     q, k, v, o, lse = res
     do = g
     bh, sq, d = q.shape
@@ -227,6 +227,10 @@ def _bwd(res, g, sm_scale, causal, blocks=None):
     q_blocks, kv_blocks = sq // bq, sk // bk
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        # lse cotangent folds into delta: dS = P*(dP - delta) + P*g_lse
+        #                                    = P*(dP - (delta - g_lse))
+        delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (bh, sq, 128))  # lane-broadcast layout
 
     dkdv_kernel = functools.partial(
@@ -295,6 +299,52 @@ def _flash_bwd_rule(sm_scale, causal, blocks, res, g):
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd_lse(q, k, v, sm_scale, causal, blocks):
+    """Like _flash_bhsd but also returns the per-row logsumexp [bh, sq] —
+    the residual ring attention needs to merge partial blocks; both outputs
+    carry cotangents (lse's folds into delta in _bwd)."""
+    o, lse = _fwd(q, k, v, sm_scale, causal, blocks)
+    return o, lse[..., 0]
+
+
+def _flash_lse_fwd_rule(q, k, v, sm_scale, causal, blocks):
+    o, lse = _fwd(q, k, v, sm_scale, causal, blocks)
+    return (o, lse[..., 0]), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd_rule(sm_scale, causal, blocks, res, g):
+    g_o, g_lse = g
+    return _bwd(res, g_o, sm_scale, causal, blocks, g_lse=g_lse)
+
+
+_flash_bhsd_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, sm_scale=None):
+    """q,k,v: [b, s, h, d]. Returns (out [b, sq, h, d], lse [b, h, sq] f32).
+
+    The (out, lse) pair is what a ring-attention shard needs to merge partial
+    KV-block results with online softmax (SURVEY §5.7); both are
+    differentiable through the Pallas backward kernels.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    def to_bhsd(x):
+        s = x.shape[1]
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, s, x.shape[-1])
+
+    blocks = _tuned_blocks(b * h, sq, sk, d, q.dtype, float(sm_scale),
+                           bool(causal))
+    o, lse = _flash_bhsd_lse(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                             float(sm_scale), bool(causal), tuple(blocks))
+    return (jnp.swapaxes(o.reshape(b, h, sq, d), 1, 2),
+            lse.reshape(b, h, sq))
 
 
 def _tuned_blocks(bh, sq, sk, d, dtype, sm_scale, causal):
