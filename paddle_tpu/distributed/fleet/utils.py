@@ -118,20 +118,23 @@ def fused_allreduce_gradients(parameter_list, hcg):
     group = hcg.get_data_parallel_group() if hcg else None
     if group is None or group.nranks <= 1:
         return
-    # one cache slot per group, keyed by the TRAINABLE membership: a
-    # stop_gradient flip (un/refreezing) rebuilds the buckets, a new model on
-    # the same group replaces the slot (so discarded models aren't pinned)
+    # small per-group LRU keyed by TRAINABLE membership: a stop_gradient flip
+    # (un/refreezing) rebuilds the buckets; a handful of models sharing one
+    # group (e.g. GAN generator/discriminator) each stay cached; anything
+    # older is evicted so discarded models aren't pinned forever
     params = [p for p in parameter_list
               if not getattr(p, "stop_gradient", True) and p.size]
     key = tuple(id(p) for p in params)
-    slot = _reducer_cache.get(id(group))
-    if slot is None or slot[0] != key:
-        slot = (key, Reducer(params, group=group))
-        _reducer_cache[id(group)] = slot
-    slot[1].sync()
+    slots = _reducer_cache.setdefault(id(group), {})
+    red = slots.get(key)
+    if red is None:
+        while len(slots) >= 4:  # bounded: evict oldest (dict = insertion order)
+            slots.pop(next(iter(slots)))
+        red = slots[key] = Reducer(params, group=group)
+    red.sync()
 
 
-_reducer_cache = {}  # id(group) -> (trainable-ids, Reducer)
+_reducer_cache = {}  # id(group) -> {trainable-ids: Reducer} (LRU, max 4)
 
 
 def broadcast_mp_parameters(model, hcg):

@@ -116,8 +116,23 @@ def convert_ifelse(pred, true_fn, false_fn, init_args=()):
         p = jnp.reshape(pred.astype(bool) if pred.dtype != bool else pred, ())
         # closures (not operands): an UNDEFINED init must only fail if a
         # branch actually reads it
-        return jax.lax.cond(p, lambda: true_fn(*init_args),
-                            lambda: false_fn(*init_args))
+        try:
+            return jax.lax.cond(p, lambda: true_fn(*init_args),
+                                lambda: false_fn(*init_args))
+        except TypeError as e:
+            # only re-label when an undefined init is the plausible root
+            # cause — a user TypeError mentioning "structure" must pass
+            # through untouched
+            if any(isinstance(a, _Undefined) for a in init_args) and (
+                    "_Undefined" in str(e) or "structure" in str(e)):
+                names = [object.__getattribute__(a, "_name")
+                         for a in init_args if isinstance(a, _Undefined)]
+                raise UnboundLocalError(
+                    f"dy2static: variable(s) {names} are assigned in only "
+                    f"one branch of a traced `if`; initialize them before "
+                    f"the `if` so both lax.cond branches produce the same "
+                    f"structure") from e
+            raise
     if hasattr(pred, "item"):  # concrete array -> python bool
         pred = bool(pred)
     return true_fn(*init_args) if pred else false_fn(*init_args)
@@ -129,6 +144,14 @@ def convert_while_loop(cond_fn, body_fn, loop_vars):
     first = cond_fn(*loop_vars)
     if _is_traced(first) or any(_is_traced(v) for v in loop_vars):
         import jax.numpy as jnp
+
+        bad = [object.__getattribute__(v, "_name") for v in loop_vars
+               if isinstance(v, _Undefined)]
+        if bad:
+            raise UnboundLocalError(
+                f"dy2static: loop variable(s) {bad} are read in a traced "
+                f"`while` before being assigned; initialize them before the "
+                f"loop (lax.while_loop carries need a defined initial value)")
 
         def cond(vs):
             c = cond_fn(*vs)
@@ -233,6 +256,46 @@ def _has_escape(stmts) -> bool:
     return v.found
 
 
+class _EscapeScan(ast.NodeVisitor):
+    """break/continue belonging to THIS loop level (nested loops swallow their
+    own) + return at any depth (excluding nested functions)."""
+
+    def __init__(self):
+        self.brk = self.cont = self.ret = False
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _nested_loop(self, node):
+        inner = _scan_level(node.body + node.orelse)
+        self.ret = self.ret or inner.ret
+
+    visit_While = visit_For = _nested_loop
+
+    def visit_Return(self, node):
+        self.ret = True
+
+    def visit_Break(self, node):
+        self.brk = True
+
+    def visit_Continue(self, node):
+        self.cont = True
+
+
+def _scan_level(stmts) -> _EscapeScan:
+    v = _EscapeScan()
+    for s in stmts:
+        v.visit(s)
+    return v
+
+
+def _contains_return(stmts) -> bool:
+    return _scan_level(stmts if isinstance(stmts, list) else [stmts]).ret
+
+
 def _load(name):
     return ast.Name(id=name, ctx=ast.Load())
 
@@ -248,6 +311,231 @@ def _jst_call(fn_name, args):
 
 
 # ---------------------------------------------------------- the transformer
+def _range_for_to_while(node, uid: str):
+    """`for i in range(...)` -> (init_stmts, ast.While) or None if not
+    range-style. Shared by _Dy2Static.visit_For and the escape lowering."""
+    if (not isinstance(node.iter, ast.Call)
+            or not isinstance(node.iter.func, ast.Name)
+            or node.iter.func.id != "range"
+            or not isinstance(node.target, ast.Name)
+            or not 1 <= len(node.iter.args) <= 3):
+        return None
+    i = node.target.id
+    start_n, stop_n, step_n = (f"__dy2st_start_{uid}", f"__dy2st_stop_{uid}",
+                               f"__dy2st_step_{uid}")
+    a = node.iter.args
+    start = a[0] if len(a) >= 2 else ast.Constant(value=0)
+    stop = a[1] if len(a) >= 2 else a[0]
+    step = a[2] if len(a) == 3 else ast.Constant(value=1)
+    init = [
+        ast.Assign(targets=[_store(start_n)], value=start),
+        ast.Assign(targets=[_store(stop_n)], value=stop),
+        ast.Assign(targets=[_store(step_n)], value=step),
+        ast.Assign(targets=[_store(i)], value=_load(start_n)),
+    ]
+    # i*sign < stop*sign: python-level sign check for constant steps; tensor
+    # steps assume positive
+    if isinstance(step, ast.Constant) and isinstance(step.value, int) and \
+            step.value < 0:
+        test = ast.Compare(left=_load(i), ops=[ast.Gt()],
+                           comparators=[_load(stop_n)])
+    else:
+        test = ast.Compare(left=_load(i), ops=[ast.Lt()],
+                           comparators=[_load(stop_n)])
+    incr = ast.AugAssign(target=_store(i), op=ast.Add(), value=_load(step_n))
+    # incr returned separately: escape lowering must keep it OUTSIDE the
+    # continue-guard (python's `continue` jumps TO the increment)
+    return init, ast.While(test=test, body=list(node.body), orelse=[]), incr
+
+
+def _warn_fallback(what: str, why: str):
+    import warnings
+
+    warnings.warn(
+        f"dy2static: {what} falls back to plain Python ({why}); under tracing "
+        f"this leaves the one-XLA-computation world", stacklevel=2)
+
+
+def _returns_always(stmts) -> bool:
+    """Every path through `stmts` ends in a return (conservative)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return (_returns_always(last.body) and last.orelse
+                and _returns_always(last.orelse))
+    return False
+
+
+class _ReturnCPS:
+    """Early-`return` lowering (reference return_transformer.py): rewrite the
+    function body in continuation-passing style so every path assigns the
+    single return slot exactly once and the function ends with one `return`.
+    `if` statements containing returns get the continuation inlined into both
+    branches — so under tracing both lax.cond branches produce the return
+    value and no undefined-variable pytree mismatch arises.
+
+    Returns inside loops are NOT lowered (the return value would need a
+    shape-known loop carry before tracing); those functions keep the Python
+    fallback with a warning.
+    """
+
+    RV = "__esc_rv"
+
+    @classmethod
+    def applicable(cls, fdef) -> bool:
+        body = fdef.body
+        if not _contains_return(body):
+            return False
+        if len(body) and isinstance(body[-1], ast.Return) \
+                and not _contains_return(body[:-1]):
+            return False  # single tail return: nothing to lower
+        if not _returns_always(body):
+            # a fall-through path returns implicit None, which cannot mix with
+            # tensor returns under lax.cond — keep the python fallback
+            _warn_fallback(f"function {fdef.name!r}",
+                           "may fall through without an explicit return")
+            return False
+        # walk WITHOUT descending into nested function definitions
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, (ast.While, ast.For)) \
+                    and _contains_return(node.body + node.orelse):
+                _warn_fallback(f"function {fdef.name!r}",
+                               "return inside a loop body")
+                return False
+            if isinstance(node, (ast.Try, ast.With)) \
+                    and _contains_return(getattr(node, "body", [])):
+                _warn_fallback(f"function {fdef.name!r}",
+                               "return inside try/with")
+                return False
+            stack.extend(ast.iter_child_nodes(node))
+        return True
+
+    @classmethod
+    def lower(cls, fdef):
+        final = [ast.Assign(targets=[_store(cls.RV)],
+                            value=ast.Constant(value=None))]
+        fdef.body = cls._cps(fdef.body, final) + [
+            ast.Return(value=_load(cls.RV))]
+
+    @classmethod
+    def _cps(cls, stmts, continuation):
+        if not stmts:
+            return list(continuation)
+        s, rest = stmts[0], stmts[1:]
+        if isinstance(s, ast.Return):
+            val = s.value if s.value is not None else ast.Constant(value=None)
+            return [ast.Assign(targets=[_store(cls.RV)], value=val)]
+        if isinstance(s, ast.If) and _contains_return([s]):
+            k2 = cls._cps(rest, continuation)
+            return [ast.If(test=s.test, body=cls._cps(s.body, k2),
+                           orelse=cls._cps(s.orelse, k2))]
+        return [s] + cls._cps(rest, continuation)
+
+
+class _BreakContinueLowering(ast.NodeTransformer):
+    """break/continue lowering (reference break_continue_transformer.py):
+    rewrite them into boolean flag assignments, guard the statements after a
+    potential escape with `if not flag:`, and fold `not break_flag` into the
+    loop condition — after which the loop body is escape-free and the While
+    transformer lowers the whole loop to lax.while_loop (flags are plain bool
+    loop carries).
+    """
+
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return f"esc{self._n}"
+
+    def visit_While(self, node):
+        self.generic_visit(node)  # innermost loops first
+        scan = _scan_level(node.body)
+        if not (scan.brk or scan.cont):
+            return node
+        if scan.ret:
+            _warn_fallback("while loop", "return inside the loop body")
+            return node
+        if node.orelse:
+            _warn_fallback("while loop", "while/else with break")
+            return node
+        return self._lower(node)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        scan = _scan_level(node.body)
+        if not (scan.brk or scan.cont):
+            return node
+        if scan.ret:
+            _warn_fallback("for loop", "return inside the loop body")
+            return node
+        if node.orelse:
+            _warn_fallback("for loop", "for/else with break")
+            return node
+        conv = _range_for_to_while(node, f"bc_{self._uid()}")
+        if conv is None:
+            _warn_fallback("for loop", "break/continue in a non-range for")
+            return node
+        init, loop, incr = conv
+        return init + self._lower(loop, trailing=[incr])
+
+    def _lower(self, node, trailing=()):
+        uid = self._uid()
+        brk, cont = f"__esc_brk_{uid}", f"__esc_cont_{uid}"
+        body = [ast.Assign(targets=[_store(cont)],
+                           value=ast.Constant(value=False))]
+        body += self._guard(node.body, brk, cont)
+        # trailing (a for-range increment) runs on EVERY iteration, even after
+        # `continue` — outside the guard, exactly where python's continue jumps
+        body += list(trailing)
+        test = ast.BoolOp(op=ast.And(), values=[
+            ast.UnaryOp(op=ast.Not(), operand=_load(brk)), node.test])
+        init = [ast.Assign(targets=[_store(n)], value=ast.Constant(value=False))
+                for n in (brk, cont)]
+        return init + [ast.While(test=test, body=body, orelse=node.orelse)]
+
+    def _guard(self, stmts, brk, cont):
+        out = []
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(ast.Assign(targets=[_store(brk)],
+                                      value=ast.Constant(value=True)))
+                escaped = True
+            elif isinstance(s, ast.Continue):
+                out.append(ast.Assign(targets=[_store(cont)],
+                                      value=ast.Constant(value=True)))
+                escaped = True
+            elif isinstance(s, ast.If):
+                scan = _scan_level(s.body + s.orelse)
+                if scan.brk or scan.cont:
+                    out.append(ast.If(test=s.test,
+                                      body=self._guard(s.body, brk, cont) or
+                                      [ast.Pass()],
+                                      orelse=self._guard(s.orelse, brk, cont)))
+                    escaped = True
+                else:
+                    out.append(s)
+                    escaped = False
+            else:
+                out.append(s)
+                escaped = False
+            if escaped and idx + 1 < len(stmts):
+                rest = self._guard(stmts[idx + 1:], brk, cont)
+                alive = ast.UnaryOp(op=ast.Not(), operand=ast.BoolOp(
+                    op=ast.Or(), values=[_load(brk), _load(cont)]))
+                out.append(ast.If(test=alive, body=rest, orelse=[]))
+                break
+        return out
+
+
 class _Dy2Static(ast.NodeTransformer):
     def __init__(self):
         self._n = 0
@@ -283,6 +571,9 @@ class _Dy2Static(ast.NodeTransformer):
     def visit_If(self, node):
         self.generic_visit(node)
         if _has_escape(node.body) or _has_escape(node.orelse):
+            # escapes the lowering passes could not remove (e.g. inside
+            # try/with): loud fallback, not silence
+            _warn_fallback("if statement", "unlowered return/break/continue")
             return node  # python fallback (concrete predicates only)
         out_vars = _assigned_names(node.body + node.orelse)
         if not out_vars:
@@ -333,6 +624,8 @@ class _Dy2Static(ast.NodeTransformer):
     def visit_While(self, node):
         self.generic_visit(node)
         if _has_escape(node.body) or node.orelse:
+            _warn_fallback("while loop",
+                           "unlowered escape statement or while/else")
             return node
         loop_vars = _assigned_names(node.body)
         if not loop_vars:
@@ -363,39 +656,16 @@ class _Dy2Static(ast.NodeTransformer):
     # --- for i in range(...) ---
     def visit_For(self, node):
         self.generic_visit(node)
-        if (_has_escape(node.body) or node.orelse
-                or not isinstance(node.iter, ast.Call)
-                or not isinstance(node.iter.func, ast.Name)
-                or node.iter.func.id != "range"
-                or not isinstance(node.target, ast.Name)
-                or not 1 <= len(node.iter.args) <= 3):
+        if _has_escape(node.body):
+            _warn_fallback("for loop", "unlowered escape statement")
             return node
-        uid = self._uid()
-        i = node.target.id
-        start_n, stop_n, step_n = (f"__dy2st_start_{uid}", f"__dy2st_stop_{uid}",
-                                   f"__dy2st_step_{uid}")
-        a = node.iter.args
-        start = a[0] if len(a) >= 2 else ast.Constant(value=0)
-        stop = a[1] if len(a) >= 2 else a[0]
-        step = a[2] if len(a) == 3 else ast.Constant(value=1)
-        init = [
-            ast.Assign(targets=[_store(start_n)], value=start),
-            ast.Assign(targets=[_store(stop_n)], value=stop),
-            ast.Assign(targets=[_store(step_n)], value=step),
-            ast.Assign(targets=[_store(i)], value=_load(start_n)),
-        ]
-        # while i*sign < stop*sign:  body;  i += step   (sign via step>0 check
-        # is python-level for constant steps; tensor steps assume positive)
-        if isinstance(step, ast.Constant) and isinstance(step.value, int) and \
-                step.value < 0:
-            test = ast.Compare(left=_load(i), ops=[ast.Gt()],
-                               comparators=[_load(stop_n)])
-        else:
-            test = ast.Compare(left=_load(i), ops=[ast.Lt()],
-                               comparators=[_load(stop_n)])
-        incr = ast.AugAssign(target=_store(i), op=ast.Add(),
-                             value=_load(step_n))
-        loop = ast.While(test=test, body=list(node.body) + [incr], orelse=[])
+        if node.orelse:
+            return node
+        conv = _range_for_to_while(node, self._uid())
+        if conv is None:
+            return node
+        init, loop, incr = conv
+        loop.body = loop.body + [incr]
         out = init + [self.visit_While(loop)]
         flat = []
         for o in out:
@@ -428,6 +698,11 @@ def _convert(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
     fdef.decorator_list = []  # don't re-apply @to_static etc.
+    # escape lowering first (reference break_continue/return transformers),
+    # so the If/While transformers below see escape-free blocks
+    if _ReturnCPS.applicable(fdef):
+        _ReturnCPS.lower(fdef)
+    tree = _BreakContinueLowering().visit(tree)
     new_tree = _Dy2Static().visit(tree)
     ast.fix_missing_locations(new_tree)
 
