@@ -169,3 +169,62 @@ class ElasticManager:
         for t in (self._hb_thread, self._watch_thread):
             if t is not None:
                 t.join(timeout=2 * self.heartbeat_interval)
+
+    # ---- preemption notices (SURVEY §5.3: TPU slices get maintenance/preempt
+    # notices; the store key is the transport — on real infra a metadata-server
+    # watcher writes the same key) ----
+    def announce_preemption(self, host: Optional[str] = None,
+                            deadline_s: float = 30.0) -> None:
+        """Publish a preemption notice for `host` (default: this node)."""
+        target = host or self.host
+        self.store.set(f"{self.job_id}/preempt/{target}",
+                       json.dumps({"host": target, "deadline_s": deadline_s,
+                                   "seq": self._beat_seq}))
+
+    def preemption_notice(self, host: Optional[str] = None) -> Optional[dict]:
+        """The pending notice for `host` (default: this node), or None."""
+        target = host or self.host
+        try:
+            raw = self.store.get(f"{self.job_id}/preempt/{target}", wait=False)
+        except KeyError:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    def clear_preemption(self, host: Optional[str] = None) -> None:
+        try:
+            self.store.delete_key(f"{self.job_id}/preempt/{host or self.host}")
+        except Exception:
+            pass
+
+    def on_preemption(self, callback: Callable[[dict], None]) -> None:
+        """Run `callback(notice)` (checkpoint-and-drain hook) when a notice for
+        this node appears. Fires once per notice."""
+        def _poll():
+            while not self._stop.wait(self.heartbeat_interval / 2):
+                notice = self.preemption_notice()
+                if notice is not None:
+                    try:
+                        callback(notice)
+                    except Exception:  # a failing checkpoint hook must not
+                        import traceback  # kill the watcher: later notices
+                        #                   still need handling
+                        traceback.print_exc()
+                    finally:
+                        self.clear_preemption()
+        t = threading.Thread(target=_poll, daemon=True)
+        t.start()
+
+
+def preemption_requested() -> bool:
+    """Trainer-side check: True when the launcher (or infra) has signalled
+    this worker to checkpoint and exit (reference elastic manager signals
+    workers before restart; on TPU this mirrors the slice maintenance-notice
+    contract). The launcher points PADDLE_ELASTIC_PREEMPT_FILE at a per-worker
+    flag file it touches when a preemption notice arrives."""
+    import os
+
+    path = os.environ.get("PADDLE_ELASTIC_PREEMPT_FILE")
+    return bool(path) and os.path.exists(path)

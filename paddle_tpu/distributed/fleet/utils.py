@@ -126,11 +126,12 @@ def fused_allreduce_gradients(parameter_list, hcg):
               if not getattr(p, "stop_gradient", True) and p.size]
     key = tuple(id(p) for p in params)
     slots = _reducer_cache.setdefault(id(group), {})
-    red = slots.get(key)
+    red = slots.pop(key, None)  # pop+reinsert: dict order = recency
     if red is None:
-        while len(slots) >= 4:  # bounded: evict oldest (dict = insertion order)
+        while len(slots) >= 4:  # bounded: evict least recently used
             slots.pop(next(iter(slots)))
-        red = slots[key] = Reducer(params, group=group)
+        red = Reducer(params, group=group)
+    slots[key] = red
     red.sync()
 
 

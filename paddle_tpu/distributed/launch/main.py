@@ -94,17 +94,23 @@ class ProcList:
         return -1 if all_done else None
 
     def terminate(self):
-        for p in self.procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        deadline = time.time() + 10
-        for p in self.procs:
+        self.terminate_alive(grace=10.0)
+        for s in self.specs:
+            s["file"].close()
+
+    def terminate_alive(self, grace: float = 5.0):
+        """SIGTERM then SIGKILL stragglers, keeping log files open so the
+        procs can be respawned (terminate() additionally closes the pool)."""
+        alive = [p for p in self.procs if p.poll() is None]
+        for p in alive:
+            p.send_signal(signal.SIGTERM)
+        deadline = time.time() + grace
+        for p in alive:
             try:
                 p.wait(max(0.1, deadline - time.time()))
             except subprocess.TimeoutExpired:
                 p.kill()
-        for s in self.specs:
-            s["file"].close()
+                p.wait()
 
     def tail_log(self, i: int, n: int = 30) -> str:
         try:
@@ -234,6 +240,11 @@ def launch(argv=None) -> int:
         })
         if devices:
             env["FLAGS_selected_tpus"] = devices[local_rank % len(devices)]
+        if args.elastic_level > 0:
+            # per-worker preemption flag file: the launcher touches it when a
+            # notice arrives; workers poll fleet.elastic.preemption_requested()
+            env["PADDLE_ELASTIC_PREEMPT_FILE"] = os.path.join(
+                args.log_dir, f".preempt.{role.lower()}.{local_rank}")
         return env
 
     if args.run_mode == "ps":
@@ -268,9 +279,63 @@ def launch(argv=None) -> int:
             procs.spawn(script_cmd + args.training_script_args, worker_env(i),
                         f"workerlog.{i}")
 
+    def _preemption_notice():
+        """Pending preemption notice for THIS node: a `preempt.notice` file in
+        log_dir (single-node / tests / local infra hook) or the elastic store
+        key `<job>/preempt/<node_rank>` (multi-node; SURVEY §5.3 maintenance-
+        notice contract)."""
+        fpath = os.path.join(args.log_dir, "preempt.notice")
+        if os.path.exists(fpath):
+            return {"source": fpath}
+        if store is not None:
+            # ElasticManager.announce_preemption keys by HOST; rank is also
+            # accepted for infra that addresses nodes by index
+            for who in (_advertised_host(), str(node_rank)):
+                try:
+                    store.get(f"{args.job_id}/preempt/{who}", wait=False)
+                    return {"source": f"store:{args.job_id}/preempt/{who}"}
+                except Exception:
+                    pass
+        return None
+
+    def _drain_and_respawn():
+        """Checkpoint-and-respawn: flag every worker, give it a grace window
+        to checkpoint and exit, then restart the whole local pod."""
+        for spec in procs.specs:
+            flag = spec["env"].get("PADDLE_ELASTIC_PREEMPT_FILE")
+            if flag:
+                open(flag, "w").close()
+        deadline = time.time() + 30.0
+        while time.time() < deadline and any(
+                p.poll() is None for p in procs.procs):
+            time.sleep(0.2)
+        procs.terminate_alive()
+        fpath = os.path.join(args.log_dir, "preempt.notice")
+        if os.path.exists(fpath):
+            os.unlink(fpath)
+        if store is not None:
+            for who in (_advertised_host(), str(node_rank)):
+                try:
+                    store.delete_key(f"{args.job_id}/preempt/{who}")
+                except Exception:
+                    pass
+        for spec in procs.specs:
+            flag = spec["env"].get("PADDLE_ELASTIC_PREEMPT_FILE")
+            if flag and os.path.exists(flag):
+                os.unlink(flag)
+        for i in range(len(procs.procs)):
+            procs.respawn(i)
+
     restarts = 0
     try:
         while True:
+            if args.elastic_level > 0 and restarts < args.max_restarts \
+                    and _preemption_notice() is not None:
+                restarts += 1
+                print(f"paddle_tpu.launch: preemption notice — checkpoint-and-"
+                      f"respawn ({restarts}/{args.max_restarts})", flush=True)
+                _drain_and_respawn()
+                continue
             status = procs.poll()
             if status is None:
                 time.sleep(0.5)

@@ -262,6 +262,7 @@ class _EscapeScan(ast.NodeVisitor):
 
     def __init__(self):
         self.brk = self.cont = self.ret = False
+        self.trapped = False  # escape inside try/with: _guard can't rewrite it
 
     def visit_FunctionDef(self, node):
         pass
@@ -274,6 +275,18 @@ class _EscapeScan(ast.NodeVisitor):
         self.ret = self.ret or inner.ret
 
     visit_While = visit_For = _nested_loop
+
+    def _trap(self, node):
+        inner = _EscapeScan()
+        for child in ast.iter_child_nodes(node):
+            inner.visit(child)
+        if inner.brk or inner.cont or inner.ret:
+            self.trapped = True
+        self.brk = self.brk or inner.brk
+        self.cont = self.cont or inner.cont
+        self.ret = self.ret or inner.ret
+
+    visit_Try = visit_With = _trap
 
     def visit_Return(self, node):
         self.ret = True
@@ -464,6 +477,9 @@ class _BreakContinueLowering(ast.NodeTransformer):
         if scan.ret:
             _warn_fallback("while loop", "return inside the loop body")
             return node
+        if scan.trapped:
+            _warn_fallback("while loop", "break/continue inside try/with")
+            return node
         if node.orelse:
             _warn_fallback("while loop", "while/else with break")
             return node
@@ -476,6 +492,9 @@ class _BreakContinueLowering(ast.NodeTransformer):
             return node
         if scan.ret:
             _warn_fallback("for loop", "return inside the loop body")
+            return node
+        if scan.trapped:
+            _warn_fallback("for loop", "break/continue inside try/with")
             return node
         if node.orelse:
             _warn_fallback("for loop", "for/else with break")
@@ -493,9 +512,13 @@ class _BreakContinueLowering(ast.NodeTransformer):
         body = [ast.Assign(targets=[_store(cont)],
                            value=ast.Constant(value=False))]
         body += self._guard(node.body, brk, cont)
-        # trailing (a for-range increment) runs on EVERY iteration, even after
-        # `continue` — outside the guard, exactly where python's continue jumps
-        body += list(trailing)
+        # trailing (a for-range increment) runs after `continue` (python's
+        # continue jumps to the increment) but NOT after `break` (which exits
+        # immediately, leaving the loop variable at its python value)
+        if trailing:
+            body.append(ast.If(
+                test=ast.UnaryOp(op=ast.Not(), operand=_load(brk)),
+                body=list(trailing), orelse=[]))
         test = ast.BoolOp(op=ast.And(), values=[
             ast.UnaryOp(op=ast.Not(), operand=_load(brk)), node.test])
         init = [ast.Assign(targets=[_store(n)], value=ast.Constant(value=False))

@@ -126,6 +126,51 @@ def test_for_range_continue_actually_skips():
         out.numpy(), fn_for_continue_skips(t(np.float32(2.0))).numpy())
 
 
+def fn_break_leaves_loop_var(x):
+    j = t(np.int64(0))
+    for i in range(10):
+        j = j + 1
+        if j >= 3:
+            break
+    return j
+
+
+def test_break_does_not_run_trailing_increment():
+    # regression: the for-range increment must NOT run on the break iteration
+    # (python leaves the loop variable at its break-time value)
+    st = to_static(fn_break_leaves_loop_var)
+    out = st(t(np.float32(0.0)))
+    np.testing.assert_allclose(out.numpy(),
+                               fn_break_leaves_loop_var(t(np.float32(0.0))).numpy())
+    np.testing.assert_allclose(out.numpy(), 3)
+
+
+def fn_continue_in_try(x):
+    s = 0.0
+    for i in range(4):
+        try:
+            if i == 1:
+                continue
+        finally:
+            pass
+        s = s + 1.0
+    return t(np.float32(s))
+
+
+def test_escape_inside_try_falls_back_with_warning():
+    # _guard cannot rewrite a continue inside try/finally: loud python fallback
+    from paddle_tpu.jit.dy2static import _CONVERTED_CACHE
+
+    _CONVERTED_CACHE.pop(fn_continue_in_try, None)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        st = to_static(fn_continue_in_try)
+        out = st(t(np.float32(0.0)))
+    np.testing.assert_allclose(out.numpy(), 3.0)  # python semantics preserved
+    assert any("try/with" in str(w.message) for w in rec), (
+        [str(w.message) for w in rec])
+
+
 # ---- early return -----------------------------------------------------------
 def fn_early_return(x):
     if x.sum() > 0.0:       # traced predicate
