@@ -228,6 +228,9 @@ def run_backward(tensors, grad_tensors=None, retain_graph: bool = False, grad_si
         in_cots = node.vjp_fn(cot_arg)
         if not retain_graph:
             node.vjp_fn = None
+            # bwd_spec pins strong refs to every input (incl. large nondiff
+            # index tensors): release with the vjp so HBM buffers can die
+            node.bwd_spec = None
         for inp, ic in zip(node.inputs, in_cots):
             if inp.stop_gradient or _is_float0(ic) or ic is None:
                 continue

@@ -414,3 +414,20 @@ def as_tensor(x, dtype=None):
 from . import autotune as _autotune  # noqa: E402
 
 _autotune.on_change(_RULE_CACHE.clear)
+
+# flags listed in the cache key are safe; any OTHER flag change conservatively
+# clears the cache, so a future kernel reading a new flag at trace time can
+# never be served a stale trace (ADVICE r1)
+_TRACE_KEY_FLAGS = frozenset({"tpu_matmul_precision", "use_flash_attention",
+                              "use_autotune", "use_pallas_lm_loss",
+                              "pallas_interpret_ok"})
+
+
+def _on_flag_change(name):
+    if name not in _TRACE_KEY_FLAGS:
+        _RULE_CACHE.clear()
+
+
+from . import flags as _flags  # noqa: E402
+
+_flags.on_change(_on_flag_change)

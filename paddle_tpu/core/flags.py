@@ -27,12 +27,26 @@ def define_flag(name: str, default, help_: str = ""):
     _REGISTRY[name] = default
 
 
+_on_change = []
+
+
+def on_change(callback):
+    """Register callback(flag_name) fired whenever a flag value changes —
+    caches keyed on flag values (dispatch rule cache) subscribe here so an
+    unlisted flag can never serve a stale trace."""
+    _on_change.append(callback)
+
+
 def set_flags(flags: Dict[str, Any]):
     for k, v in flags.items():
         k = k.removeprefix("FLAGS_")
         if k not in _REGISTRY:
             raise KeyError(f"unknown flag {k!r}; known: {sorted(_REGISTRY)}")
+        changed = _REGISTRY[k] != v
         _REGISTRY[k] = v
+        if changed:
+            for cb in _on_change:
+                cb(k)
 
 
 def get_flags(names):

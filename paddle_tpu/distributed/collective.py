@@ -73,13 +73,12 @@ def _axis_in_scope(axis: str) -> bool:
         return False
 
 
-def _sharded_over(data, axis_name):
-    """Check if a global array is sharded over the given mesh axis."""
-    sharding = getattr(data, "sharding", None)
-    if sharding is None or not hasattr(sharding, "spec"):
+def spec_has_axis(spec, axis_name) -> bool:
+    """Axis membership in a PartitionSpec (flattening tuple entries)."""
+    if spec is None:
         return False
     flat = []
-    for e in sharding.spec:
+    for e in spec:
         if e is None:
             continue
         if isinstance(e, tuple):
@@ -89,6 +88,14 @@ def _sharded_over(data, axis_name):
     return axis_name in flat
 
 
+def _sharded_over(data, axis_name):
+    """Check if a global array is sharded over the given mesh axis."""
+    sharding = getattr(data, "sharding", None)
+    if sharding is None or not hasattr(sharding, "spec"):
+        return False
+    return spec_has_axis(sharding.spec, axis_name)
+
+
 def _eager_axis_collective(x, axis, fn_traced):
     """Run a collective over a mesh axis on an axis-sharded global array via shard_map."""
     from jax import shard_map
@@ -96,7 +103,10 @@ def _eager_axis_collective(x, axis, fn_traced):
 
     mesh = fleet_default_mesh()
     spec = x.sharding.spec if hasattr(x.sharding, "spec") else P()
-    f = shard_map(fn_traced, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    # check_vma=False: ops like broadcast (all_gather + index) produce values
+    # that ARE replicated but can't be statically inferred as such
+    f = shard_map(fn_traced, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                  check_vma=False)
     return f(x)
 
 

@@ -42,7 +42,12 @@ def model_input_count(n_batch_args, num_model_inputs=None):
     (the rest are labels for loss_fn). Shared by TrainStepEngine and
     auto_parallel.Engine so the convention cannot drift: default is
     all-but-last (min 1); num_model_inputs overrides for e.g. multi-input
-    self-supervised models."""
+    self-supervised models.
+
+    BREAKING (round 1 -> 2, ADVICE r1): previously the model received EVERY
+    batch arg and loss_fn only the outputs; now the last arg is the label and
+    loss_fn receives (outputs..., labels). Callers on the old convention must
+    pass num_model_inputs=n_batch_args."""
     if num_model_inputs is not None:
         if not 1 <= num_model_inputs <= n_batch_args:
             raise ValueError(

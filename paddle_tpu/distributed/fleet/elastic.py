@@ -199,13 +199,22 @@ class ElasticManager:
         except Exception:
             pass
 
-    def on_preemption(self, callback: Callable[[dict], None]) -> None:
+    def on_preemption(self, callback: Callable[[dict], None],
+                      clear: bool = False) -> None:
         """Run `callback(notice)` (checkpoint-and-drain hook) when a notice for
-        this node appears. Fires once per notice."""
+        this node appears. Fires once per distinct notice.
+
+        clear=False (default) leaves the store key in place: the LAUNCHER is
+        the notice's owner and deletes it after draining the pod — a worker
+        clearing it would starve the launcher's own poll and skip the
+        respawn/re-layout. Pass clear=True only when no launcher is watching.
+        """
         def _poll():
+            seen = None
             while not self._stop.wait(self.heartbeat_interval / 2):
                 notice = self.preemption_notice()
-                if notice is not None:
+                if notice is not None and notice != seen:
+                    seen = notice
                     try:
                         callback(notice)
                     except Exception:  # a failing checkpoint hook must not
@@ -213,7 +222,8 @@ class ElasticManager:
                         #                   still need handling
                         traceback.print_exc()
                     finally:
-                        self.clear_preemption()
+                        if clear:
+                            self.clear_preemption()
         t = threading.Thread(target=_poll, daemon=True)
         t.start()
 

@@ -138,16 +138,40 @@ def fused_allreduce_gradients(parameter_list, hcg):
 _reducer_cache = {}  # id(group) -> {trainable-ids: Reducer} (LRU, max 4)
 
 
+def _broadcast_group_parameters(model, group, skip_axis=None):
+    """Broadcast params from the group's first rank (reference
+    hybrid_parallel_util.py broadcast_*_parameters). Single-controller mode is
+    a no-op — every replica IS the same global array. Multi-controller mode
+    (jax.distributed processes) really broadcasts, except params sharded over
+    `skip_axis`, which intentionally differ per rank."""
+    import jax
+
+    if group is None or getattr(group, "nranks", 1) <= 1:
+        return
+    if jax.process_count() == 1:
+        return
+    from .. import collective
+
+    for p in model.parameters():
+        spec = getattr(p, "dist_attr", None)
+        if skip_axis is not None and collective.spec_has_axis(spec, skip_axis):
+            continue
+        collective.broadcast(p, src=group.ranks[0], group=group)
+
+
 def broadcast_mp_parameters(model, hcg):
-    pass  # single-controller: replicas identical by construction
+    # replicated (non-mp-sharded) params must agree across the mp group;
+    # mp-sharded ones (dist_attr over 'mp') differ by construction
+    _broadcast_group_parameters(model, hcg.get_model_parallel_group(),
+                                skip_axis="mp")
 
 
 def broadcast_dp_parameters(model, hcg):
-    pass
+    _broadcast_group_parameters(model, hcg.get_data_parallel_group())
 
 
 def broadcast_sharding_parameters(model, hcg):
-    pass
+    _broadcast_group_parameters(model, hcg.get_sharding_parallel_group())
 
 
 from . import fs  # noqa: E402,F401

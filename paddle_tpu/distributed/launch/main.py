@@ -290,12 +290,27 @@ def launch(argv=None) -> int:
         if store is not None:
             # ElasticManager.announce_preemption keys by HOST; rank is also
             # accepted for infra that addresses nodes by index
-            for who in (_advertised_host(), str(node_rank)):
+            for who in _notice_ids:
                 try:
                     store.get(f"{args.job_id}/preempt/{who}", wait=False)
                     return {"source": f"store:{args.job_id}/preempt/{who}"}
                 except Exception:
                     pass
+        return None
+
+    # host resolved ONCE (DNS can stall); store round-trips throttled to every
+    # 4th watch tick so steady-state polling stays cheap
+    _notice_ids = ((_advertised_host() if store is not None else ""),
+                   str(node_rank))
+    _notice_tick = [0]
+
+    def _preemption_notice_throttled():
+        _notice_tick[0] += 1
+        fpath = os.path.join(args.log_dir, "preempt.notice")
+        if os.path.exists(fpath):
+            return {"source": fpath}
+        if _notice_tick[0] % 4 == 0:
+            return _preemption_notice()
         return None
 
     def _drain_and_respawn():
@@ -330,7 +345,7 @@ def launch(argv=None) -> int:
     try:
         while True:
             if args.elastic_level > 0 and restarts < args.max_restarts \
-                    and _preemption_notice() is not None:
+                    and _preemption_notice_throttled() is not None:
                 restarts += 1
                 print(f"paddle_tpu.launch: preemption notice — checkpoint-and-"
                       f"respawn ({restarts}/{args.max_restarts})", flush=True)

@@ -1,20 +1,66 @@
-"""paddle.onnx (reference python/paddle/onnx/export.py wraps paddle2onnx).
+"""paddle.onnx — native ONNX export.
 
-This build's native interchange format is StableHLO (paddle.jit.save) —
-portable and runnable without model code. ONNX export additionally requires
-the `onnx` package; when it's importable a minimal graph (inputs/outputs/
-initializers via jit tracing) is emitted, otherwise a clear error points to
-jit.save."""
+Reference: python/paddle/onnx/export.py (wraps paddle2onnx's op mappers).
+TPU-native: the Layer's forward is traced to a jaxpr (the same trace jit
+compiles), and each primitive maps to an ONNX op — so coverage follows the
+primitive set, not a hand-enumerated layer list. The protobuf is hand-encoded
+(paddle_tpu/onnx/_proto.py): no dependency on the `onnx` package. Models whose
+forward uses unsupported primitives get a clear NotImplementedError pointing
+to paddle.jit.save (StableHLO) as the full-fidelity alternative.
+"""
 from __future__ import annotations
 
+__all__ = ["export"]
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise RuntimeError(
-            "paddle.onnx.export needs the `onnx` package, which is not "
-            "installed in this environment. Use paddle.jit.save for the "
-            "portable StableHLO artifact instead.") from e
-    raise NotImplementedError(
-        "onnx emission is not implemented; use paddle.jit.save (StableHLO)")
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export `layer` to `{path}.onnx`.
+
+    input_spec: list of InputSpec (concrete shapes) or example Tensors.
+    Dynamic (None) dims are not supported — ONNX Reshape/Expand shape
+    initializers are baked from the traced shapes.
+    """
+    import jax
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..jit import functional_call
+    from ._export import export_jaxpr
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec "
+                         "(InputSpec list or example Tensors)")
+
+    examples = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            examples.append(np.asarray(spec._data))
+            continue
+        shape = [int(d) if d is not None and int(d) != -1 else None
+                 for d in spec.shape]
+        if any(d is None for d in shape):
+            raise ValueError(
+                f"paddle.onnx.export: dynamic dim in {spec.shape} — ONNX "
+                f"emission bakes shapes; pass concrete dims")
+        dtype = getattr(spec, "dtype", "float32")
+        examples.append(np.zeros(shape, str(dtype).replace("paddle.", "")))
+
+    state = layer.state_dict(include_non_persistable_buffer=True)
+    param_names = sorted(state.keys())
+    param_arrays = [np.asarray(state[n]._data) for n in param_names]
+
+    def fn(params, *inputs):
+        out = functional_call(layer, dict(zip(param_names, params)),
+                              *[Tensor(i) for i in inputs])
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+
+    closed = jax.make_jaxpr(fn)(param_arrays, *examples)
+    input_names = [f"input_{i}" for i in range(len(examples))]
+    blob = export_jaxpr(closed, param_names, param_arrays, input_names,
+                        opset_version=opset_version,
+                        graph_name=type(layer).__name__)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return out_path

@@ -1,0 +1,271 @@
+"""jaxpr -> ONNX graph emission.
+
+The exporter traces the Layer's forward (params as explicit inputs, so they
+become named initializers) to a ClosedJaxpr, then maps each equation's
+primitive onto ONNX ops. Anything outside the supported set raises a clear
+NotImplementedError naming the primitive — no silent mis-translation.
+
+Reference parity: python/paddle/onnx/export.py (paddle2onnx's op mappers);
+here the source of truth is the traced jaxpr, so every nn.Layer whose forward
+lowers to the supported primitive set exports, not a hand-enumerated layer
+list.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import _proto as P
+
+_UNARY = {"neg": "Neg", "exp": "Exp", "log": "Log", "tanh": "Tanh",
+          "logistic": "Sigmoid", "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign",
+          "floor": "Floor", "ceil": "Ceil", "round": "Round", "erf": "Erf",
+          "sin": "Sin", "cos": "Cos", "is_finite": "IsInf"}
+_BINARY = {"add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+           "max": "Max", "min": "Min", "pow": "Pow", "rem": "Mod",
+           "atan2": "Atan2"}
+
+_JAX2ONNX_DTYPE = {"float32": "float32", "float64": "float64",
+                   "int32": "int32", "int64": "int64", "bool": "bool",
+                   "float16": "float16", "bfloat16": "bfloat16",
+                   "uint8": "uint8", "int8": "int8"}
+
+
+class _Graph:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self.names = {}     # jaxpr Var -> onnx value name
+        self.counter = 0
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def name_of(self, var):
+        from jax._src.core import Literal
+
+        if isinstance(var, Literal):
+            return self.const(np.asarray(var.val))
+        if var not in self.names:
+            self.names[var] = self.fresh("v")
+        return self.names[var]
+
+    def const(self, array, hint="const"):
+        name = self.fresh(hint)
+        self.initializers.append(P.tensor(name, np.ascontiguousarray(array)))
+        return name
+
+    def emit(self, op, inputs, n_out=1, **attrs):
+        outs = [self.fresh(op.lower()) for _ in range(n_out)]
+        self.nodes.append(P.node(op, inputs, outs, **attrs))
+        return outs if n_out > 1 else outs[0]
+
+    def alias(self, var, name):
+        self.names[var] = name
+
+
+def _dtype_of(aval) -> str:
+    return _JAX2ONNX_DTYPE[str(aval.dtype)]
+
+
+def _emit_eqn(g: _Graph, eqn):
+    prim = eqn.primitive.name
+    ins = [g.name_of(v) for v in eqn.invars]
+    params = eqn.params
+
+    def out(name):
+        g.alias(eqn.outvars[0], name)
+
+    if prim in _UNARY:
+        out(g.emit(_UNARY[prim], [ins[0]]))
+    elif prim in _BINARY:
+        out(g.emit(_BINARY[prim], ins))
+    elif prim == "rsqrt":
+        out(g.emit("Reciprocal", [g.emit("Sqrt", [ins[0]])]))
+    elif prim == "integer_pow":
+        y = g.const(np.asarray(params["y"],
+                               str(eqn.invars[0].aval.dtype)), "exponent")
+        out(g.emit("Pow", [ins[0], y]))
+    elif prim == "stop_gradient" or prim == "copy":
+        out(g.emit("Identity", [ins[0]]))
+    elif prim == "convert_element_type":
+        to = P.DTYPE[_JAX2ONNX_DTYPE[str(params["new_dtype"])]]
+        out(g.emit("Cast", [ins[0]], to=to))
+    elif prim == "select_n":
+        if len(eqn.invars) != 3:
+            raise NotImplementedError("onnx export: select_n with >2 cases")
+        # select_n(pred, on_false, on_true) -> Where(pred, on_true, on_false)
+        out(g.emit("Where", [ins[0], ins[2], ins[1]]))
+    elif prim == "reshape":
+        shape = g.const(np.asarray(params["new_sizes"], np.int64), "shape")
+        out(g.emit("Reshape", [ins[0], shape]))
+    elif prim == "squeeze":
+        axes = g.const(np.asarray(params["dimensions"], np.int64), "axes")
+        out(g.emit("Squeeze", [ins[0], axes]))
+    elif prim == "transpose":
+        out(g.emit("Transpose", [ins[0]],
+                   perm=[int(p) for p in params["permutation"]]))
+    elif prim == "broadcast_in_dim":
+        shape, bdims = params["shape"], params["broadcast_dimensions"]
+        # insert singleton axes at the target rank, then Expand
+        inter = [1] * len(shape)
+        for src, dst in enumerate(bdims):
+            inter[dst] = eqn.invars[0].aval.shape[src]
+        rs = g.const(np.asarray(inter, np.int64), "shape")
+        mid = g.emit("Reshape", [ins[0], rs])
+        ex = g.const(np.asarray(shape, np.int64), "shape")
+        out(g.emit("Expand", [mid, ex]))
+    elif prim == "concatenate":
+        out(g.emit("Concat", ins, axis=int(params["dimension"])))
+    elif prim == "slice":
+        starts, limits = params["start_indices"], params["limit_indices"]
+        strides = params["strides"] or [1] * len(starts)
+        axes = list(range(len(starts)))
+        args = [ins[0]] + [g.const(np.asarray(a, np.int64), h) for a, h in
+                           [(starts, "starts"), (limits, "ends"),
+                            (axes, "axes"), (strides, "steps")]]
+        out(g.emit("Slice", args))
+    elif prim == "reduce_sum":
+        axes = g.const(np.asarray(params["axes"], np.int64), "axes")
+        out(g.emit("ReduceSum", [ins[0], axes], keepdims=0))
+    elif prim in ("reduce_max", "reduce_min"):
+        op = "ReduceMax" if prim == "reduce_max" else "ReduceMin"
+        out(g.emit(op, [ins[0]], axes=[int(a) for a in params["axes"]],
+                   keepdims=0))
+    elif prim == "argmax":
+        axes = params["axes"]
+        if len(axes) != 1:
+            raise NotImplementedError("onnx export: multi-axis argmax")
+        am = g.emit("ArgMax", [ins[0]], axis=int(axes[0]), keepdims=0)
+        to = P.DTYPE[_JAX2ONNX_DTYPE[str(eqn.outvars[0].aval.dtype)]]
+        out(g.emit("Cast", [am], to=to))
+    elif prim == "dot_general":
+        out(_emit_dot_general(g, eqn, ins))
+    elif prim == "conv_general_dilated":
+        out(_emit_conv(g, eqn, ins))
+    elif prim == "reduce_window_max":
+        out(_emit_pool(g, eqn, ins, "MaxPool"))
+    elif prim == "reduce_window_sum":
+        out(_emit_pool(g, eqn, ins, "SumPool"))
+    elif prim in ("jit", "pjit", "closed_call", "custom_jvp_call",
+                  "custom_vjp_call", "remat", "checkpoint",
+                  "custom_jvp_call_jaxpr"):
+        inner = params.get("jaxpr") or params.get("call_jaxpr") \
+            or params.get("fun_jaxpr")
+        if inner is None:
+            raise NotImplementedError(f"onnx export: {prim} without jaxpr")
+        _inline(g, inner, eqn.invars, eqn.outvars)
+    else:
+        raise NotImplementedError(
+            f"onnx export: primitive {prim!r} has no ONNX mapping yet; "
+            f"use paddle.jit.save (StableHLO) for full-fidelity export")
+
+
+def _emit_dot_general(g, eqn, ins):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    if len(lc) != 1 or len(rc) != 1:
+        raise NotImplementedError("onnx export: multi-dim dot_general")
+    lname, rname = ins
+    # ONNX MatMul = numpy matmul: contracts lhs[-1] with rhs[-2] (rhs[0] if 2D)
+    if tuple(lc) != (lhs.ndim - 1,):
+        raise NotImplementedError("onnx export: lhs contraction not innermost")
+    if tuple(lb) != tuple(range(len(lb))) or tuple(rb) != tuple(range(len(rb))):
+        raise NotImplementedError("onnx export: non-leading batch dims")
+    expected_rc = 0 if rhs.ndim == 2 else rhs.ndim - 2
+    if rc[0] != expected_rc:
+        if rhs.ndim == 2:  # weight stored [out, in]: transpose once
+            rname = g.emit("Transpose", [rname], perm=[1, 0])
+        else:
+            raise NotImplementedError("onnx export: rhs contraction layout")
+    return g.emit("MatMul", [lname, rname])
+
+
+def _emit_conv(g, eqn, ins):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    spec = (dn.lhs_spec, dn.rhs_spec, dn.out_spec) if hasattr(dn, "lhs_spec") \
+        else dn
+    nd = len(p["window_strides"])
+    iota = tuple(range(2 + nd))
+    if tuple(spec[0]) != iota or tuple(spec[1]) != iota or tuple(spec[2]) != iota:
+        raise NotImplementedError("onnx export: conv layout != NCHW/OIHW")
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise NotImplementedError("onnx export: transposed conv")
+    pads = [pad[0] for pad in p["padding"]] + [pad[1] for pad in p["padding"]]
+    return g.emit(
+        "Conv", ins,
+        strides=[int(s) for s in p["window_strides"]],
+        dilations=[int(d) for d in p["rhs_dilation"]],
+        pads=[int(x) for x in pads],
+        group=int(p["feature_group_count"]))
+
+
+def _emit_pool(g, eqn, ins, kind):
+    p = eqn.params
+    window = p["window_dimensions"]
+    strides = p["window_strides"]
+    padding = p["padding"]
+    if len(window) < 3 or window[0] != 1 or window[1] != 1:
+        raise NotImplementedError("onnx export: pool window not NCHW-spatial")
+    if any(d != 1 for d in p.get("window_dilation", [1])) or \
+            any(d != 1 for d in p.get("base_dilation", [1])):
+        raise NotImplementedError("onnx export: dilated pooling")
+    spatial = len(window) - 2
+    kernel = [int(w) for w in window[2:]]
+    pads = [int(pad[0]) for pad in padding[2:]] + \
+           [int(pad[1]) for pad in padding[2:]]
+    attrs = dict(kernel_shape=kernel, strides=[int(s) for s in strides[2:]],
+                 pads=pads)
+    if kind == "MaxPool":
+        return g.emit("MaxPool", ins, **attrs)
+    # reduce_window_sum -> AveragePool(count_include_pad=1) * window_size
+    avg = g.emit("AveragePool", ins, count_include_pad=1, **attrs)
+    n = g.const(np.asarray(float(np.prod(kernel)),
+                           str(eqn.invars[0].aval.dtype)), "window_elems")
+    return g.emit("Mul", [avg, n])
+
+
+def _inline(g, closed, outer_in, outer_out):
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    consts = getattr(closed, "consts", getattr(closed, "literals", []))
+    for cv, cval in zip(jaxpr.constvars, consts):
+        g.alias(cv, g.const(np.asarray(cval)))
+    for iv, ov in zip(jaxpr.invars, outer_in):
+        g.alias(iv, g.name_of(ov))
+    for eqn in jaxpr.eqns:
+        _emit_eqn(g, eqn)
+    from jax._src.core import Literal
+
+    for inner_out, outer in zip(jaxpr.outvars, outer_out):
+        if isinstance(inner_out, Literal):
+            g.alias(outer, g.const(np.asarray(inner_out.val)))
+        else:
+            g.alias(outer, g.name_of(inner_out))
+
+
+def export_jaxpr(closed_jaxpr, param_names, param_arrays, input_names,
+                 opset_version=13, graph_name="paddle_tpu"):
+    """ClosedJaxpr (invars = params then inputs) -> ONNX ModelProto bytes."""
+    g = _Graph()
+    jaxpr = closed_jaxpr.jaxpr
+    for cv, cval in zip(jaxpr.constvars, closed_jaxpr.consts):
+        g.alias(cv, g.const(np.asarray(cval)))
+    n_params = len(param_names)
+    for var, pname, arr in zip(jaxpr.invars[:n_params], param_names,
+                               param_arrays):
+        g.alias(var, pname)
+        g.initializers.append(P.tensor(pname, np.ascontiguousarray(arr)))
+    inputs = []
+    for var, iname in zip(jaxpr.invars[n_params:], input_names):
+        g.alias(var, iname)
+        inputs.append(P.value_info(iname, _dtype_of(var.aval),
+                                   var.aval.shape))
+    for eqn in jaxpr.eqns:
+        _emit_eqn(g, eqn)
+    outputs = []
+    for i, var in enumerate(jaxpr.outvars):
+        outputs.append(P.value_info(g.name_of(var), _dtype_of(var.aval),
+                                    var.aval.shape))
+    gmsg = P.graph(graph_name, g.nodes, inputs, outputs, g.initializers)
+    return P.model(gmsg, opset_version=opset_version)

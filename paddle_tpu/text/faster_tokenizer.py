@@ -88,6 +88,14 @@ def _is_punct(c: int) -> bool:
             or 0xFF3B <= c <= 0xFF40 or 0xFF5B <= c <= 0xFF65)
 
 
+def _is_ws(c: int) -> bool:
+    # EXACT mirror of core/native/tokenizer.cc is_ws — python's str.isspace()
+    # covers more codepoints (U+1680, U+205F, U+2029, ...) and would make
+    # token ids differ between the C++ and fallback paths on the same text
+    return (c in (0x20, 0x09, 0x0A, 0x0D, 0xA0, 0x2028, 0x3000)
+            or 0x2000 <= c <= 0x200A)
+
+
 def _basic_tokenize(text: str, lower: bool) -> List[str]:
     words, cur = [], []
     for ch in text:
@@ -96,7 +104,7 @@ def _basic_tokenize(text: str, lower: bool) -> List[str]:
         if c in (0, 0xFFFD) or (c < 0x20 and ch not in "\t\n\r") or c == 0x7F \
                 or 0x80 <= c <= 0x9F:
             continue
-        if ch.isspace() or c in (0xA0, 0x3000):
+        if _is_ws(c):
             if cur:
                 words.append("".join(cur)); cur = []
         elif _is_cjk(c) or _is_punct(c):

@@ -14,3 +14,12 @@ if os.environ.get("PADDLE_TPU_TEST_DEVICE", "cpu") == "cpu":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # fast subset for 1-core bench boxes (README "Testing"):
+    #   python -m pytest tests -m "not slow" -q     (~ minutes)
+    # full suite spawns subprocess clusters and e2e training runs (~20 min).
+    config.addinivalue_line(
+        "markers", "slow: subprocess-cluster / end-to-end tests; deselect "
+        "with -m 'not slow' on constrained machines")

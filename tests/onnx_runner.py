@@ -1,0 +1,257 @@
+"""Independent ONNX validator for tests: a generic protobuf wire-format
+decoder plus a tiny numpy evaluator for the op set paddle_tpu.onnx emits.
+Deliberately separate from the exporter's encoder — round-tripping through
+this reader catches wire-format mistakes, and executing the graph catches
+semantic mis-mappings."""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+
+def decode_message(buf: bytes):
+    """protobuf wire -> {field: [raw values]} (varint ints, bytes, f32)."""
+    out = {}
+    i = 0
+    while i < len(buf):
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            val = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"wire type {wire}")
+        out.setdefault(field, []).append(val)
+    return out
+
+
+def _read_varint(buf, i):
+    shift = val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+_NP_DTYPE = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+             7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64}
+
+
+def decode_tensor(buf: bytes):
+    m = decode_message(buf)
+    dims = [int(d) for d in m.get(1, [])]
+    dt = _NP_DTYPE[m[2][0]]
+    name = m[8][0].decode() if 8 in m else ""
+    if 9 in m:
+        arr = np.frombuffer(m[9][0], dtype=dt).reshape(dims)
+    else:
+        raise ValueError("tensor without raw_data")
+    return name, arr
+
+
+def _decode_packed_int64(buf: bytes):
+    vals, i = [], 0
+    while i < len(buf):
+        v, i = _read_varint(buf, i)
+        if v >= 1 << 63:
+            v -= 1 << 64
+        vals.append(v)
+    return vals
+
+
+def decode_attr(buf: bytes):
+    m = decode_message(buf)
+    name = m[1][0].decode()
+    atype = m.get(20, [0])[0]
+    if atype == 1:
+        return name, m[2][0]
+    if atype == 2:
+        v = m[3][0]
+        if v >= 1 << 63:
+            v -= 1 << 64
+        return name, v
+    if atype == 3:
+        return name, m[4][0].decode()
+    if atype == 4:
+        return name, decode_tensor(m[5][0])[1]
+    if atype == 6:
+        raw = m[7][0]
+        return name, [struct.unpack("<f", raw[i:i + 4])[0]
+                      for i in range(0, len(raw), 4)]
+    if atype == 7:
+        return name, _decode_packed_int64(m[8][0])
+    raise ValueError(f"attr type {atype}")
+
+
+def load_model(path):
+    with open(path, "rb") as f:
+        m = decode_message(f.read())
+    graph = decode_message(m[7][0])
+    nodes = []
+    for nb in graph.get(1, []):
+        nm = decode_message(nb)
+        attrs = dict(decode_attr(a) for a in nm.get(5, []))
+        nodes.append({
+            "inputs": [s.decode() for s in nm.get(1, [])],
+            "outputs": [s.decode() for s in nm.get(2, [])],
+            "op": nm[4][0].decode(), "attrs": attrs})
+    inits = dict(decode_tensor(t) for t in graph.get(5, []))
+    def vi_name(b):
+        return decode_message(b)[1][0].decode()
+    return {"nodes": nodes,
+            "inputs": [vi_name(b) for b in graph.get(11, [])],
+            "outputs": [vi_name(b) for b in graph.get(12, [])],
+            "initializers": inits,
+            "opset": decode_message(m[8][0])[2][0]}
+
+
+# ------------------------------------------------------------------ evaluate
+
+
+def _conv(x, w, strides, pads, dilations, group):
+    n, cin, h, wd = x.shape
+    cout, cpg, kh, kw = w.shape
+    ph0, pw0, ph1, pw1 = pads
+    xp = np.pad(x, [(0, 0), (0, 0), (ph0, ph1), (pw0, pw1)])
+    oh = (xp.shape[2] - (dilations[0] * (kh - 1) + 1)) // strides[0] + 1
+    ow = (xp.shape[3] - (dilations[1] * (kw - 1) + 1)) // strides[1] + 1
+    out = np.zeros((n, cout, oh, ow), x.dtype)
+    cin_per_g = cin // group
+    cout_per_g = cout // group
+    for oc in range(cout):
+        gidx = oc // cout_per_g
+        for i in range(oh):
+            for j in range(ow):
+                hs, ws = i * strides[0], j * strides[1]
+                patch = xp[:, gidx * cin_per_g:(gidx + 1) * cin_per_g,
+                           hs:hs + dilations[0] * (kh - 1) + 1:dilations[0],
+                           ws:ws + dilations[1] * (kw - 1) + 1:dilations[1]]
+                out[:, oc, i, j] = np.einsum("nchw,chw->n", patch, w[oc])
+    return out
+
+
+def _pool(x, kernel, strides, pads, mode):
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    ph0, pw0, ph1, pw1 = pads
+    fill = -np.inf if mode == "max" else 0.0
+    xp = np.pad(x, [(0, 0), (0, 0), (ph0, ph1), (pw0, pw1)],
+                constant_values=fill)
+    oh = (xp.shape[2] - kh) // strides[0] + 1
+    ow = (xp.shape[3] - kw) // strides[1] + 1
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * strides[0]:i * strides[0] + kh,
+                     j * strides[1]:j * strides[1] + kw]
+            out[:, :, i, j] = win.max((2, 3)) if mode == "max" \
+                else win.mean((2, 3))
+    return out
+
+
+_ONNX2NP = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
+            11: np.float64}
+
+
+def run_model(path, feeds):
+    g = load_model(path)
+    env = dict(g["initializers"])
+    env.update(feeds)
+    for nd in g["nodes"]:
+        ins = [env[i] for i in nd["inputs"]]
+        a = nd["attrs"]
+        op = nd["op"]
+        if op == "MatMul":
+            r = ins[0] @ ins[1]
+        elif op == "Add":
+            r = ins[0] + ins[1]
+        elif op == "Sub":
+            r = ins[0] - ins[1]
+        elif op == "Mul":
+            r = ins[0] * ins[1]
+        elif op == "Div":
+            r = ins[0] / ins[1]
+        elif op == "Max":
+            r = np.maximum(ins[0], ins[1])
+        elif op == "Min":
+            r = np.minimum(ins[0], ins[1])
+        elif op == "Pow":
+            r = np.power(ins[0], ins[1])
+        elif op == "Neg":
+            r = -ins[0]
+        elif op == "Exp":
+            r = np.exp(ins[0])
+        elif op == "Log":
+            r = np.log(ins[0])
+        elif op == "Tanh":
+            r = np.tanh(ins[0])
+        elif op == "Sigmoid":
+            r = 1 / (1 + np.exp(-ins[0]))
+        elif op == "Sqrt":
+            r = np.sqrt(ins[0])
+        elif op == "Erf":
+            import scipy.special as sps
+            r = sps.erf(ins[0])
+        elif op == "Reciprocal":
+            r = 1 / ins[0]
+        elif op == "Identity":
+            r = ins[0]
+        elif op == "Cast":
+            r = ins[0].astype(_ONNX2NP[a["to"]])
+        elif op == "Where":
+            r = np.where(ins[0], ins[1], ins[2])
+        elif op == "Reshape":
+            r = ins[0].reshape([int(d) for d in ins[1]])
+        elif op == "Expand":
+            r = np.broadcast_to(ins[0], [int(d) for d in ins[1]]).copy()
+        elif op == "Squeeze":
+            r = np.squeeze(ins[0], tuple(int(d) for d in ins[1]))
+        elif op == "Transpose":
+            r = np.transpose(ins[0], a["perm"])
+        elif op == "Concat":
+            r = np.concatenate(ins, axis=a["axis"])
+        elif op == "Slice":
+            x, starts, ends, axes, steps = ins
+            idx = [slice(None)] * x.ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                idx[int(ax)] = slice(int(s), int(e), int(st))
+            r = x[tuple(idx)]
+        elif op == "ReduceSum":
+            r = ins[0].sum(tuple(int(d) for d in ins[1]),
+                           keepdims=bool(a.get("keepdims", 1)))
+        elif op == "ReduceMax":
+            r = ins[0].max(tuple(a["axes"]),
+                           keepdims=bool(a.get("keepdims", 1)))
+        elif op == "ReduceMin":
+            r = ins[0].min(tuple(a["axes"]),
+                           keepdims=bool(a.get("keepdims", 1)))
+        elif op == "ArgMax":
+            r = np.argmax(ins[0], axis=a["axis"])
+        elif op == "Conv":
+            r = _conv(ins[0], ins[1], a["strides"], a["pads"],
+                      a["dilations"], a.get("group", 1))
+            if len(ins) == 3:
+                r = r + ins[2].reshape(1, -1, 1, 1)
+        elif op == "MaxPool":
+            r = _pool(ins[0], a["kernel_shape"], a["strides"], a["pads"],
+                      "max")
+        elif op == "AveragePool":
+            r = _pool(ins[0], a["kernel_shape"], a["strides"], a["pads"],
+                      "avg")
+        else:
+            raise NotImplementedError(f"runner: op {op}")
+        env[nd["outputs"][0]] = r
+    return [env[o] for o in g["outputs"]]

@@ -4,10 +4,14 @@ artifacts even when the accelerator tunnel is wedged (VERDICT r1 item #1).
 The wedge is simulated by probe timeouts — a hung backend init and a
 0-second-timeout probe are indistinguishable to the caller (both return None).
 """
+import pytest
 import json
 import os
 import subprocess
 import sys
+
+
+pytestmark = pytest.mark.slow  # subprocess/e2e heavy: -m "not slow" skips
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -11,6 +11,9 @@ import sys
 
 import pytest
 
+
+pytestmark = pytest.mark.slow  # subprocess/e2e heavy: -m "not slow" skips
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _BOOT = "import jax; jax.config.update('jax_platforms', 'cpu'); " \

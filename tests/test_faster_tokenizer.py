@@ -73,6 +73,29 @@ def test_native_matches_python_fallback(tok):
         assert native == py, (t, native, py)
 
 
+def test_native_matches_python_on_exotic_unicode(tok):
+    """ADVICE r1 (medium): the python fallback's whitespace/fold predicates
+    must mirror the C++ tables EXACTLY — str.isspace() covers U+1680/U+205F/
+    U+2029 etc. which the C++ is_ws does not, silently producing different
+    token ids per machine. Sweep the divergence-prone codepoints."""
+    exotic = ["a\u1680b", "a\u205fb", "a\u2028b", "a\u2029b", "a\u2007b",
+              "a\u200ab", "a\u3000b", "a\x0bb", "a\x0cb", "a\x85b",
+              "\u0391\u0392 \u03b1\u03b2",   # Greek upper/lower
+              "\u0416\u0423 \u0436\u0443",   # Cyrillic upper/lower
+              "\u0130stanbul \u0131",          # Turkish dotted/dotless I
+              "\ufb01 \ufb02 ligatures",       # fi/fl ligature codepoints
+              "caf\xe9 CAF\xc9 \xdcber",      # Latin-1 fold targets
+              "\uff21\uff22\uff1a\uff23",    # fullwidth forms
+              "a\u200bb", "a\ufeffb"]          # zero-width space / BOM
+    v = tok.vocab
+    for t in exotic:
+        native = tok._native.tokenize(t)
+        py = []
+        for w in _basic_tokenize(t, True):
+            py.extend(wordpiece_tokenize(w, v, tok.unk_id))
+        assert native == py, (t, native, py)
+
+
 def test_wordpiece_greedy_longest():
     v = {t: i for i, t in enumerate(VOCAB)}
     assert wordpiece_tokenize("unaffable", v, 1) == [v["un"], v["##aff"], v["##able"]]

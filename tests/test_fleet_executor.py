@@ -16,6 +16,9 @@ from paddle_tpu.distributed.fleet_executor import (
     TaskNode, _make_bus)
 
 
+
+pytestmark = pytest.mark.slow  # subprocess/e2e heavy: -m "not slow" skips
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))

@@ -14,6 +14,9 @@ import pytest
 from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
 from paddle_tpu.distributed.store import TCPStore
 
+
+pytestmark = pytest.mark.slow  # subprocess/e2e heavy: -m "not slow" skips
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -280,12 +283,16 @@ def test_manager_preemption_scale_in_two_nodes(store, tmp_path):
 
     # infra preempts node-b; its watcher checkpoints + exits
     drained = []
-    mb.on_preemption(lambda notice: drained.append(notice))
+    # clear=True: no launcher owns this notice in the manager-only scenario
+    mb.on_preemption(lambda notice: drained.append(notice), clear=True)
     ma.announce_preemption(host="node-b", deadline_s=5.0)
     deadline = time.monotonic() + 5.0
     while time.monotonic() < deadline and not drained:
         time.sleep(0.05)
     assert drained and drained[0]["deadline_s"] == 5.0
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline and mb.preemption_notice() is not None:
+        time.sleep(0.05)
     assert mb.preemption_notice() is None       # watcher cleared it
     mb.exit()
 

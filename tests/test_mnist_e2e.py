@@ -1,5 +1,6 @@
 """BASELINE config 1: MNIST LeNet dygraph end-to-end — loss decreases, accuracy above chance.
 (Reference book test: recognize_digits; loss-parity harness per SURVEY.md §4.)"""
+import pytest
 import numpy as np
 
 import paddle_tpu as paddle
@@ -8,6 +9,9 @@ from paddle_tpu.io import DataLoader
 from paddle_tpu.vision.datasets import MNIST
 from paddle_tpu.vision.models import LeNet
 
+
+
+pytestmark = pytest.mark.slow  # subprocess/e2e heavy: -m "not slow" skips
 
 def test_lenet_mnist_training():
     paddle.seed(42)

@@ -10,6 +10,9 @@ import textwrap
 import numpy as np
 import pytest
 
+
+pytestmark = pytest.mark.slow  # subprocess/e2e heavy: -m "not slow" skips
+
 _TRAIN = """
     import os
     import numpy as np

@@ -1,0 +1,71 @@
+"""paddle.onnx.export: emitted bytes are decoded by an INDEPENDENT reader
+(tests/onnx_runner.py) and executed with numpy against eager outputs —
+validating both the hand-rolled protobuf wire format and the jaxpr->ONNX op
+mapping (VERDICT r1 item #9: the ONNX stub had to become real or die)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from onnx_runner import load_model, run_model
+
+
+def test_mlp_export_runs_identically(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                        nn.Sigmoid())
+    x = np.random.RandomState(0).rand(3, 8).astype(np.float32)
+    path = paddle.onnx.export(net, str(tmp_path / "mlp"),
+                              input_spec=[paddle.to_tensor(x)])
+    assert path.endswith(".onnx")
+    eager = net(paddle.to_tensor(x)).numpy()
+    (got,) = run_model(path, {"input_0": x})
+    np.testing.assert_allclose(got, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_lenet_export_runs_identically(tmp_path):
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    net = LeNet()
+    net.eval()
+    x = np.random.RandomState(1).rand(2, 1, 28, 28).astype(np.float32)
+    path = paddle.onnx.export(net, str(tmp_path / "lenet"),
+                              input_spec=[paddle.to_tensor(x)])
+    eager = net(paddle.to_tensor(x)).numpy()
+    (got,) = run_model(path, {"input_0": x})
+    np.testing.assert_allclose(got, eager, rtol=1e-4, atol=1e-5)
+
+
+def test_model_structure_and_opset(tmp_path):
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    path = paddle.onnx.export(net, str(tmp_path / "lin"),
+                              input_spec=[paddle.static.InputSpec([3, 4],
+                                                                  "float32")])
+    g = load_model(path)
+    assert g["opset"] == 13
+    assert g["inputs"] == ["input_0"]
+    assert len(g["outputs"]) == 1
+    assert "weight" in " ".join(g["initializers"])  # params are initializers
+    ops = {n["op"] for n in g["nodes"]}
+    assert "MatMul" in ops
+
+
+def test_unsupported_primitive_raises_clearly(tmp_path):
+    class Fancy(nn.Layer):
+        def forward(self, x):
+            return paddle.linalg.svd(x)[0]
+
+    with pytest.raises(NotImplementedError, match="primitive"):
+        paddle.onnx.export(Fancy(), str(tmp_path / "f"),
+                           input_spec=[paddle.to_tensor(
+                               np.eye(3, dtype=np.float32))])
+
+
+def test_dynamic_dim_rejected(tmp_path):
+    net = nn.Linear(4, 2)
+    with pytest.raises(ValueError, match="dynamic"):
+        paddle.onnx.export(net, str(tmp_path / "d"),
+                           input_spec=[paddle.static.InputSpec([None, 4],
+                                                               "float32")])

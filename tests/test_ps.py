@@ -17,6 +17,9 @@ from paddle_tpu.distributed.ps import (DenseTableConfig, DistributedEmbedding,
 from paddle_tpu.distributed.ps.runtime import DenseSync
 
 
+
+pytestmark = pytest.mark.slow  # subprocess/e2e heavy: -m "not slow" skips
+
 @pytest.fixture()
 def cluster():
     """Two in-process servers + one client (reference ps_local_client mode)."""

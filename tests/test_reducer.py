@@ -18,6 +18,9 @@ import paddle_tpu.nn as nn
 from paddle_tpu.distributed.meta_parallel.data_parallel import Reducer
 
 
+
+pytestmark = pytest.mark.slow  # subprocess/e2e heavy: -m "not slow" skips
+
 class _FakeGroup:
     nranks = 2
 
@@ -202,3 +205,56 @@ def test_two_process_bucketed_dp_matches_single(tmp_path):
     dp_losses = [float(v) for v in lines["0"].split(",")]
     sp_losses = [float(v) for v in single.split(",")]
     np.testing.assert_allclose(dp_losses, sp_losses, rtol=2e-4)
+
+
+_BCAST = """
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.utils import broadcast_dp_parameters
+
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    rank = dist.get_rank()
+
+    paddle.seed(100 + rank)               # DIVERGENT init per rank
+    net = nn.Linear(4, 4)
+    pre = float(np.abs(net.weight.numpy()).sum())
+    broadcast_dp_parameters(net, hcg)     # multi-controller: really broadcasts
+    post = float(np.abs(net.weight.numpy()).sum())
+    print(f"RANK {rank} PRE {pre:.6f} POST {post:.6f}", flush=True)
+"""
+
+
+def test_two_process_broadcast_dp_parameters(tmp_path):
+    """broadcast_dp_parameters must make divergent ranks agree (rank 0 wins)
+    in multi-controller mode — it was a silent `pass` in round 1."""
+    script = tmp_path / "bcast.py"
+    script.write_text(textwrap.dedent(_BCAST))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "log"),
+         str(script)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    out = res.stdout
+    for f in (tmp_path / "log").glob("*.log"):
+        out += f.read_text()
+    rows = {}
+    for ln in out.splitlines():
+        if ln.startswith("RANK"):
+            parts = ln.split()
+            rows[parts[1]] = (parts[3], parts[5])
+    assert set(rows) == {"0", "1"}, out[-1500:]
+    assert rows["0"][0] != rows["1"][0]      # inits diverged
+    assert rows["0"][1] == rows["1"][1]      # broadcast converged them
+    assert rows["0"][0] == rows["0"][1]      # rank 0 is the source

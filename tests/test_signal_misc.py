@@ -160,6 +160,7 @@ class TestSmallNamespaces:
             "def get():\n    return helpers.VALUE\n")
         assert paddle.hub.load(str(tmp_path), "get") == 42
 
-    def test_onnx_gated(self):
-        with pytest.raises((RuntimeError, NotImplementedError)):
+    def test_onnx_requires_input_spec(self):
+        # export is real now (test_onnx_export.py); missing spec errors clearly
+        with pytest.raises(ValueError, match="input_spec"):
             paddle.onnx.export(None, "/tmp/x")

@@ -12,6 +12,9 @@ import paddle_tpu.distributed as dist
 from paddle_tpu import static
 
 
+
+pytestmark = pytest.mark.slow  # subprocess/e2e heavy: -m "not slow" skips
+
 def _write_dense_file(path, rows, seed):
     """Slots: x (4 dense floats), y (1 float). y = x @ w_true + 0.1."""
     rs = np.random.RandomState(seed)

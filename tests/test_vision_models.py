@@ -9,6 +9,9 @@ import paddle_tpu as paddle
 from paddle_tpu.vision import models
 
 
+
+pytestmark = pytest.mark.slow  # subprocess/e2e heavy: -m "not slow" skips
+
 def _check(model, num_classes=10, size=64, in_ch=3, tuple_out=False):
     x = paddle.to_tensor(np.random.RandomState(0).randn(2, in_ch, size, size)
                          .astype("float32"))
