@@ -15,6 +15,7 @@ per-device program (partitioner), and inserts collectives where specs change
 """
 from .process_mesh import ProcessMesh
 from .api import shard_tensor, shard_op, reshard
+from .resharder import Resharder, transfer_engine_state
 from .engine import Engine
 from .strategy import Strategy
 from .dist_saver import (  # noqa: F401
@@ -23,4 +24,4 @@ from .dist_saver import (  # noqa: F401
 )
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "reshard", "Engine",
-           "Strategy"]
+           "Strategy", "Resharder", "transfer_engine_state"]

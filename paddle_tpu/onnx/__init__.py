@@ -30,6 +30,11 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     if input_spec is None:
         raise ValueError("paddle.onnx.export requires input_spec "
                          "(InputSpec list or example Tensors)")
+    if opset_version < 13:
+        raise ValueError(
+            f"paddle.onnx.export emits opset-13 op forms (Slice/Squeeze/"
+            f"ReduceSum with input-tensors); opset_version={opset_version} "
+            f"would declare an opset the graph does not conform to")
 
     examples = []
     for spec in input_spec:

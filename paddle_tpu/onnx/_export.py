@@ -19,10 +19,12 @@ from . import _proto as P
 _UNARY = {"neg": "Neg", "exp": "Exp", "log": "Log", "tanh": "Tanh",
           "logistic": "Sigmoid", "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign",
           "floor": "Floor", "ceil": "Ceil", "round": "Round", "erf": "Erf",
-          "sin": "Sin", "cos": "Cos", "is_finite": "IsInf"}
+          "sin": "Sin", "cos": "Cos"}
 _BINARY = {"add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
-           "max": "Max", "min": "Min", "pow": "Pow", "rem": "Mod",
-           "atan2": "Atan2"}
+           "max": "Max", "min": "Min", "pow": "Pow",
+           "eq": "Equal", "lt": "Less", "le": "LessOrEqual",
+           "gt": "Greater", "ge": "GreaterOrEqual",
+           "and": "And", "or": "Or", "xor": "Xor"}
 
 _JAX2ONNX_DTYPE = {"float32": "float32", "float64": "float64",
                    "int32": "int32", "int64": "int64", "bool": "bool",
@@ -82,6 +84,19 @@ def _emit_eqn(g: _Graph, eqn):
         out(g.emit(_BINARY[prim], ins))
     elif prim == "rsqrt":
         out(g.emit("Reciprocal", [g.emit("Sqrt", [ins[0]])]))
+    elif prim == "is_finite":
+        # finite = not (isinf or isnan); IsInf alone has wrong NaN semantics
+        isinf = g.emit("IsInf", [ins[0]])
+        isnan = g.emit("IsNaN", [ins[0]])
+        out(g.emit("Not", [g.emit("Or", [isinf, isnan])]))
+    elif prim == "ne":
+        out(g.emit("Not", [g.emit("Equal", ins)]))
+    elif prim == "not":
+        out(g.emit("Not", [ins[0]]))
+    elif prim == "rem":
+        # lax.rem is C-style truncated remainder (sign of the dividend):
+        # ONNX Mod needs fmod=1 (fmod=0 is divisor-signed and integer-only)
+        out(g.emit("Mod", ins, fmod=1))
     elif prim == "integer_pow":
         y = g.const(np.asarray(params["y"],
                                str(eqn.invars[0].aval.dtype)), "exponent")

@@ -207,6 +207,30 @@ def run_model(path, feeds):
             r = sps.erf(ins[0])
         elif op == "Reciprocal":
             r = 1 / ins[0]
+        elif op == "Mod":
+            r = np.fmod(ins[0], ins[1]) if a.get("fmod") else np.mod(ins[0], ins[1])
+        elif op == "IsInf":
+            r = np.isinf(ins[0])
+        elif op == "IsNaN":
+            r = np.isnan(ins[0])
+        elif op == "Not":
+            r = np.logical_not(ins[0])
+        elif op == "Or":
+            r = np.logical_or(ins[0], ins[1])
+        elif op == "And":
+            r = np.logical_and(ins[0], ins[1])
+        elif op == "Xor":
+            r = np.logical_xor(ins[0], ins[1])
+        elif op == "Equal":
+            r = np.equal(ins[0], ins[1])
+        elif op == "Less":
+            r = np.less(ins[0], ins[1])
+        elif op == "LessOrEqual":
+            r = np.less_equal(ins[0], ins[1])
+        elif op == "Greater":
+            r = np.greater(ins[0], ins[1])
+        elif op == "GreaterOrEqual":
+            r = np.greater_equal(ins[0], ins[1])
         elif op == "Identity":
             r = ins[0]
         elif op == "Cast":

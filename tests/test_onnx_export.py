@@ -52,6 +52,32 @@ def test_model_structure_and_opset(tmp_path):
     assert "MatMul" in ops
 
 
+def test_rem_and_isfinite_semantics(tmp_path):
+    class M(nn.Layer):
+        def forward(self, x, y):
+            r = paddle.remainder(x, y)
+            return paddle.where(paddle.isfinite(r), r,
+                                paddle.zeros_like(r))
+
+    x = np.array([-7.0, 7.0, np.inf, 5.5], np.float32)
+    y = np.array([3.0, -3.0, 2.0, 2.0], np.float32)
+    m = M()
+    path = paddle.onnx.export(m, str(tmp_path / "rem"),
+                              input_spec=[paddle.to_tensor(x),
+                                          paddle.to_tensor(y)])
+    eager = m(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+    (got,) = run_model(path, {"input_0": x, "input_1": y})
+    np.testing.assert_allclose(got, eager, rtol=1e-6)
+
+
+def test_old_opset_rejected(tmp_path):
+    with pytest.raises(ValueError, match="opset"):
+        paddle.onnx.export(nn.Linear(2, 2), str(tmp_path / "o"),
+                           input_spec=[paddle.static.InputSpec([1, 2],
+                                                               "float32")],
+                           opset_version=9)
+
+
 def test_unsupported_primitive_raises_clearly(tmp_path):
     class Fancy(nn.Layer):
         def forward(self, x):
