@@ -47,12 +47,24 @@ class Resharder:
 
     def apply(self, array, spec, donate: bool = False):
         """One array -> target sharding. donate=True frees the source layout's
-        buffers as the transfer completes (both layouts never coexist)."""
+        buffers as the transfer completes (both layouts never coexist);
+        donate=False guarantees the RESULT never aliases the source, so a
+        destination engine's donating step can't delete the source's buffers.
+        """
+        import jax.numpy as jnp
+
         data = array._data if isinstance(array, Tensor) else array
         kind = self.plan(data, spec)
         self.stats[kind] += 1
         if kind == "noop":
-            return array
+            if donate:
+                return array  # caller surrendered the source: aliasing is fine
+            out = jnp.copy(data)
+            if isinstance(array, Tensor):
+                t = Tensor(out, stop_gradient=array.stop_gradient)
+                t.dist_attr = spec
+                return t
+            return out
         self.stats["bytes_moved"] += int(data.nbytes)
         out = jax.device_put(data, self.sharding(spec), donate=donate)
         if isinstance(array, Tensor):
@@ -110,4 +122,8 @@ def transfer_engine_state(src_engine, dst_engine, donate: bool = True,
     dst_engine._step_count = src_engine._step_count
     dst_engine.optimizer._step_count = src_engine._step_count
     dst_engine._key = src_engine._key
+    # buffers are baked into the jitted step as closure constants: force a
+    # rebuild so the transferred values (e.g. BatchNorm running stats) are
+    # actually used, not the destination's init-time snapshot
+    dst_engine._step_fn = None
     return r.stats

@@ -129,3 +129,112 @@ class HDFSClient(FS):
 
     def download(self, remote, local):
         self._run("-get", remote, local)
+
+
+class FSStore:
+    """Rendezvous/barrier store over any FS backend — the HdfsStore analogue
+    (reference paddle/fluid/framework/fleet/gloo_wrapper.h:134: gloo's PS
+    barriers rendezvous through HDFS files when no TCP store is reachable).
+
+    Works with LocalFS on a shared mount (NFS/FUSE) or HDFSClient; keys are
+    files under `root`, barriers are per-rank marker files counted with
+    ls_dir. Polling store — suited to low-rate control-plane traffic
+    (barriers, endpoint publication), not data.
+    """
+
+    def __init__(self, fs: FS, root: str, world_size: int = 1, rank: int = 0,
+                 poll_interval: float = 0.2):
+        import tempfile
+
+        self.fs = fs
+        self.root = root.rstrip("/")
+        self.world_size = world_size
+        self.rank = rank
+        self.poll = poll_interval
+        self._tmp = tempfile.mkdtemp(prefix="fsstore_")
+        self._barrier_gen: dict = {}
+        fs.mkdirs(self.root)
+
+    def _p(self, key: str) -> str:
+        return f"{self.root}/{key.replace('/', '%2F')}"
+
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        local = os.path.join(self._tmp, "put.tmp")
+        with open(local, "wb") as f:
+            f.write(data)
+        # visibility must be atomic: a polling get() on another node must see
+        # either nothing or the complete value. HDFS -put is rename-atomic;
+        # LocalFS copy is NOT, so stage under a rank-suffixed temp name and
+        # rename into place.
+        dst = self._p(key)
+        if isinstance(self.fs, LocalFS):
+            staged = f"{dst}.tmp{self.rank}"
+            self.fs.upload(local, staged)
+            os.replace(staged, dst)
+        else:
+            self.fs.upload(local, dst)
+
+    def get(self, key: str, wait: bool = True, timeout: float = 300.0) -> bytes:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        path = self._p(key)
+        while True:
+            if self.fs.is_exist(path):
+                local = os.path.join(self._tmp, "get.tmp")
+                if os.path.exists(local):
+                    os.unlink(local)
+                self.fs.download(path, local)
+                with open(local, "rb") as f:
+                    return f.read()
+            if not wait:
+                raise KeyError(key)
+            if _time.monotonic() > deadline:
+                raise TimeoutError(key)
+            _time.sleep(self.poll)
+
+    def wait(self, keys, timeout: float = 300.0) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            self.get(k, wait=True, timeout=timeout)
+
+    def delete_key(self, key: str) -> bool:
+        path = self._p(key)
+        if self.fs.is_exist(path):
+            self.fs.delete(path)
+            return True
+        return False
+
+    def list_keys(self, prefix: str = ""):
+        _, files = self.fs.ls_dir(self.root)
+        keys = [os.path.basename(f).replace("%2F", "/") for f in files]
+        return [k for k in keys if k.startswith(prefix)]
+
+    def barrier(self, name: str, world_size=None, timeout: float = 300.0,
+                rank=None) -> None:
+        """Each rank drops `<name>/<rank>` and waits for world_size markers
+        (exactly the HdfsStore wait pattern). Repeated barriers on the same
+        name get a per-call generation suffix so stale markers from an earlier
+        round can never satisfy a later one (every rank calls each named
+        barrier the same number of times, so generations agree)."""
+        import time as _time
+
+        world = world_size or self.world_size
+        who = self.rank if rank is None else rank
+        gen = self._barrier_gen.get(name, 0)
+        self._barrier_gen[name] = gen + 1
+        bdir = f"{self.root}/barrier_{name}_g{gen}"
+        self.fs.mkdirs(bdir)
+        local = os.path.join(self._tmp, "mark.tmp")
+        open(local, "w").close()
+        self.fs.upload(local, f"{bdir}/{who}")
+        deadline = _time.monotonic() + timeout
+        while True:
+            _, files = self.fs.ls_dir(bdir)
+            if len(files) >= world:
+                return
+            if _time.monotonic() > deadline:
+                raise TimeoutError(f"barrier {name}: {len(files)}/{world}")
+            _time.sleep(self.poll)

@@ -175,4 +175,4 @@ def broadcast_sharding_parameters(model, hcg):
 
 
 from . import fs  # noqa: E402,F401
-from .fs import HDFSClient, LocalFS  # noqa: E402,F401
+from .fs import FSStore, HDFSClient, LocalFS  # noqa: E402,F401

@@ -1,0 +1,203 @@
+"""Distributed/IR pass plug-in surface: PassBase, PassManager, new_pass.
+
+Reference: python/paddle/distributed/passes/pass_base.py — PassBase with
+_check_self/_check_conflict, PassContext, the @register_pass decorator and
+`new_pass(name, attrs)` factory that strategy code calls by name. TPU-native
+altitude: heavy fusion/layout work is XLA's; passes here rewrite the OpDesc
+list of a static Program (the part XLA cannot see) — the registry surface is
+kept reference-shaped so DistributedStrategy / user code plugs in by name.
+
+Built-ins: the static/passes.py trio (cse, dce, fuse_elementwise) plus
+`delete_dropout` (inference cleanup) and `fuse_gemm_epilogue`
+(matmul+add -> one op, the reference pass of the same name).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ...static import passes as _static_passes
+
+__all__ = ["PassBase", "PassContext", "PassManager", "new_pass",
+           "register_pass", "PASS_REGISTRY"]
+
+PASS_REGISTRY: Dict[str, type] = {}
+
+
+def register_pass(name: str):
+    def deco(cls):
+        cls.name = name
+        PASS_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+class PassContext:
+    """Carries cross-pass state + per-pass results (reference PassContext)."""
+
+    def __init__(self):
+        self.attrs: Dict = {}
+        self.results: Dict[str, object] = {}
+
+
+class PassBase:
+    name = "base"
+    # reference semantics: passes of the same `type` conflict unless
+    # explicitly compatible
+    _type = "optimization"
+
+    def __init__(self, attrs: Optional[Dict] = None):
+        self.attrs = dict(attrs or {})
+
+    def _check_self(self) -> bool:
+        return True
+
+    def _check_conflict(self, other: "PassBase") -> bool:
+        return True  # compatible by default
+
+    def apply(self, program, context: Optional[PassContext] = None):
+        if not self._check_self():
+            raise ValueError(f"pass {self.name}: invalid attrs {self.attrs}")
+        result = self._apply_impl(program, context or PassContext())
+        if context is not None:
+            context.results[self.name] = result
+        return program
+
+    def _apply_impl(self, program, context):
+        raise NotImplementedError
+
+
+class PassManager:
+    """Applies a pipeline of passes in order, checking pairwise conflicts
+    (reference pass_base.PassManager)."""
+
+    def __init__(self, passes: Sequence[PassBase]):
+        self.passes: List[PassBase] = list(passes)
+        for i, p in enumerate(self.passes):
+            for q in self.passes[:i]:
+                if not (p._check_conflict(q) and q._check_conflict(p)):
+                    raise ValueError(
+                        f"pass {p.name!r} conflicts with {q.name!r}")
+        self.context = PassContext()
+
+    def apply(self, programs):
+        progs = programs if isinstance(programs, (list, tuple)) else [programs]
+        for prog in progs:
+            for p in self.passes:
+                p.apply(prog, self.context)
+        return programs
+
+    @property
+    def names(self):
+        return [p.name for p in self.passes]
+
+
+def new_pass(name: str, attrs: Optional[Dict] = None) -> PassBase:
+    """Factory: build a registered pass by name (reference new_pass)."""
+    if name not in PASS_REGISTRY:
+        raise KeyError(
+            f"unknown pass {name!r}; registered: {sorted(PASS_REGISTRY)}")
+    return PASS_REGISTRY[name](attrs)
+
+
+class _StaticPassAdapter(PassBase):
+    """Bridges the function-style static/passes.py registry into PassBase."""
+
+    _fn_name: str = ""
+
+    def _apply_impl(self, program, context):
+        fetch = list(self.attrs.get("fetch_names", ()))
+        if not fetch:
+            # no explicit fetches: keep every LEAF output (an output no op
+            # consumes) live, so a standalone PassManager run can't eliminate
+            # the whole forward as dead code
+            block = program.global_block()
+            consumed = {n for op in block.ops for n in op.input_names}
+            fetch = [o for op in block.ops for o in op.output_names
+                     if o not in consumed]
+        return _static_passes.PASS_REGISTRY[self._fn_name](program, fetch)
+
+
+def _adapt(name):
+    cls = type(f"_{name}_pass", (_StaticPassAdapter,), {"_fn_name": name})
+    return register_pass(name)(cls)
+
+
+for _n in ("dead_code_elimination", "common_subexpression_elimination",
+           "fuse_elementwise"):
+    _adapt(_n)
+
+
+@register_pass("delete_dropout")
+class DeleteDropoutPass(PassBase):
+    """Inference cleanup: dropout is identity at predict time — drop the op
+    and alias its output to its input (reference delete_dropout_op_pass)."""
+
+    def _apply_impl(self, program, context):
+        block = program.global_block()
+        rename: Dict[str, str] = {}
+        kept = []
+        removed = 0
+        for op in block.ops:
+            if rename:
+                op.input_names = [rename.get(n, n) for n in op.input_names]
+            if op.type == "dropout":
+                rename[op.output_names[0]] = op.input_names[0]
+                removed += 1
+                continue
+            kept.append(op)
+        block.ops = kept
+        aliases = getattr(program, "_var_aliases", {})
+        aliases.update(rename)
+        program._var_aliases = aliases
+        return removed
+
+
+@register_pass("fuse_gemm_epilogue")
+class FuseGemmEpiloguePass(PassBase):
+    """matmul followed by a single-consumer bias add -> one fused op
+    (reference fuse_gemm_epilogue_pass; on TPU XLA fuses the epilogue into
+    the MXU matmul anyway — this shrinks the op list the per-op debug
+    interpreter walks and keeps the pass name addressable)."""
+
+    def _apply_impl(self, program, context):
+        from ...static.framework import OpDesc
+
+        block = program.global_block()
+        consumers: Dict[str, int] = {}
+        for op in block.ops:
+            for n in op.input_names:
+                consumers[n] = consumers.get(n, 0) + 1
+        kept = []
+        fused = 0
+        i, ops = 0, block.ops
+        while i < len(ops):
+            op = ops[i]
+            nxt = ops[i + 1] if i + 1 < len(ops) else None
+            if (op.type in ("matmul", "matmul_v2", "mul") and nxt is not None
+                    and nxt.type in ("add", "elementwise_add")
+                    and len(op.output_names) == 1
+                    and op.output_names[0] in nxt.input_names
+                    and consumers.get(op.output_names[0], 0) == 1):
+                mm_out = op.output_names[0]
+                bias = [n for n in nxt.input_names if n != mm_out]
+                mm_kernel, add_kernel = op.kernel, nxt.kernel
+                mm_nin = len(op.input_names)
+                out_first = nxt.input_names[0] == mm_out
+
+                def fused_kernel(*args, _mm=mm_kernel, _add=add_kernel,
+                                 _n=mm_nin, _of=out_first):
+                    y = _mm(*args[:_n])
+                    rest = args[_n:]
+                    return _add(y, *rest) if _of else _add(*rest, y)
+
+                kept.append(OpDesc("fused_gemm_epilogue", fused_kernel,
+                                   list(op.input_names) + bias,
+                                   nxt.output_names, {}))
+                fused += 1
+                i += 2
+                continue
+            kept.append(op)
+            i += 1
+        block.ops = kept
+        return fused

@@ -10,9 +10,40 @@ import paddle_tpu as paddle
 from paddle_tpu.core.tensor import Tensor
 
 
-def check_output(op_fn, np_ref, inputs, attrs=None, rtol=1e-5, atol=1e-6):
+# Per-op tolerance white-list (reference op_test.py keeps per-op thresholds
+# for ops whose numerics are legitimately looser — iterative/decomposition
+# kernels, large reductions). Keys are op function names; entries override the
+# check_output/check_grad defaults unless the caller passes explicit values.
+OP_TOLERANCES = {
+    "erfinv": dict(rtol=2e-5, atol=2e-5),       # rational-approx inverse
+    "digamma": dict(rtol=2e-5, atol=2e-5),      # series expansion
+    "matrix_power": dict(rtol=1e-4, atol=1e-5),  # repeated-squaring error
+    "matrix_rank": dict(rtol=1e-4, atol=1e-5),   # svd threshold
+    "lstsq": dict(rtol=1e-4, atol=1e-4),
+    "svd": dict(rtol=1e-4, atol=1e-5),
+    "eigh": dict(rtol=1e-4, atol=1e-4),
+    "conv2d_transpose": dict(rtol=1e-4, atol=1e-5),  # large accumulations
+    "conv3d_transpose": dict(rtol=1e-4, atol=1e-5),
+    "logsumexp": dict(grad_rtol=1e-2),
+    "cumprod": dict(grad_rtol=1e-2, grad_atol=1e-3),  # product chains
+}
+
+_SENTINEL = object()
+
+
+def _tol(op_fn, kind, passed, default):
+    if passed is not _SENTINEL:
+        return passed
+    name = getattr(op_fn, "__name__", "")
+    return OP_TOLERANCES.get(name, {}).get(kind, default)
+
+
+def check_output(op_fn, np_ref, inputs, attrs=None, rtol=_SENTINEL,
+                 atol=_SENTINEL):
     """Run op_fn(*tensors, **attrs) and compare with np_ref(*numpy_inputs, **attrs)."""
     attrs = attrs or {}
+    rtol = _tol(op_fn, "rtol", rtol, 1e-5)
+    atol = _tol(op_fn, "atol", atol, 1e-6)
     tensors = [paddle.to_tensor(i) if isinstance(i, np.ndarray) else i for i in inputs]
     out = op_fn(*tensors, **attrs)
     expect = np_ref(*[np.asarray(i) for i in inputs], **attrs)
@@ -33,10 +64,12 @@ def _compare(out, expect, rtol, atol, name=""):
                                rtol=rtol, atol=atol, err_msg=f"op {name}")
 
 
-def check_grad(op_fn, inputs, attrs=None, input_idx=0, eps=1e-3, rtol=5e-3, atol=5e-4,
-               reduce_to_scalar=True):
+def check_grad(op_fn, inputs, attrs=None, input_idx=0, eps=1e-3, rtol=_SENTINEL,
+               atol=_SENTINEL, reduce_to_scalar=True):
     """Numeric (central difference) vs analytic (tape backward) gradient check."""
     attrs = attrs or {}
+    rtol = _tol(op_fn, "grad_rtol", rtol, 5e-3)
+    atol = _tol(op_fn, "grad_atol", atol, 5e-4)
     # integer inputs (indices) keep their dtype and never get differentiated
     np_inputs = [np.asarray(i) if np.issubdtype(np.asarray(i).dtype, np.integer)
                  else np.asarray(i, np.float64) for i in inputs]

@@ -1,0 +1,159 @@
+"""Distributed pass plug-in surface (VERDICT §2 #8 partial: registry was
+minimal) + FSStore (VERDICT §2 #26: HDFS-style store for PS barriers).
+
+Reference: python/paddle/distributed/passes/pass_base.py (PassBase /
+PassManager / new_pass) and paddle/fluid/framework/fleet/gloo_wrapper.h:134
+(HdfsStore barrier files).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.distributed.passes import (PassBase, PassContext, PassManager,
+                                           new_pass, register_pass)
+from paddle_tpu.distributed.fleet.fs import FSStore, LocalFS
+
+
+def _program_with_gemm_dropout():
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 8], "float32")
+            w = static.create_parameter([8, 16], "float32", name="w0")
+            h = paddle.matmul(x, w)
+            b = static.create_parameter([16], "float32", name="b0")
+            h = h + b
+            h = paddle.nn.functional.dropout(h, p=0.5)
+            out = paddle.nn.functional.relu(h)
+        return main, startup, out
+    finally:
+        paddle.disable_static()
+
+
+def test_new_pass_factory_and_registry():
+    p = new_pass("dead_code_elimination")
+    assert isinstance(p, PassBase) and p.name == "dead_code_elimination"
+    with pytest.raises(KeyError, match="unknown pass"):
+        new_pass("nonexistent_pass")
+
+
+def test_pass_manager_pipeline_rewrites_program():
+    main, startup, out = _program_with_gemm_dropout()
+    types_before = [op.type for op in main.global_block().ops]
+    assert "dropout" in types_before
+
+    pm = PassManager([new_pass("delete_dropout"),
+                      new_pass("fuse_gemm_epilogue")])
+    pm.apply(main)
+    types_after = [op.type for op in main.global_block().ops]
+    assert "dropout" not in types_after
+    assert "fused_gemm_epilogue" in types_after
+    assert pm.context.results["delete_dropout"] == 1
+    assert pm.context.results["fuse_gemm_epilogue"] == 1
+
+    # the rewritten program still executes and matches eval-mode eager math
+    paddle.enable_static()
+    try:
+        exe = static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+        res = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+    finally:
+        paddle.disable_static()
+    assert res.shape == (4, 16)
+    assert np.isfinite(res).all()
+    assert (res >= 0).all()  # relu output
+
+
+def test_custom_pass_plugs_in():
+    @register_pass("count_ops_test")
+    class CountOps(PassBase):
+        def _apply_impl(self, program, context):
+            return len(program.global_block().ops)
+
+    main, _, _ = _program_with_gemm_dropout()
+    ctx = PassContext()
+    new_pass("count_ops_test").apply(main, ctx)
+    assert ctx.results["count_ops_test"] == len(main.global_block().ops)
+
+
+def test_pass_manager_conflict_detection():
+    class A(PassBase):
+        name = "a_test"
+
+        def _check_conflict(self, other):
+            return other.name != "b_test"
+
+        def _apply_impl(self, program, context):
+            return 0
+
+    class B(PassBase):
+        name = "b_test"
+
+        def _apply_impl(self, program, context):
+            return 0
+
+    with pytest.raises(ValueError, match="conflicts"):
+        PassManager([B(), A()])
+
+
+# ---- FSStore ----------------------------------------------------------------
+
+def test_fsstore_set_get_wait_delete(tmp_path):
+    store = FSStore(LocalFS(), str(tmp_path / "store"), world_size=1)
+    store.set("alpha/key", b"value1")
+    assert store.get("alpha/key") == b"value1"
+    assert store.list_keys("alpha") == ["alpha/key"]
+    with pytest.raises(KeyError):
+        store.get("missing", wait=False)
+    assert store.delete_key("alpha/key") is True
+    assert store.delete_key("alpha/key") is False
+    with pytest.raises(TimeoutError):
+        store.get("missing", wait=True, timeout=0.3)
+
+
+def test_fsstore_barrier_across_workers(tmp_path):
+    """Two 'nodes' rendezvous through per-rank marker files — the HdfsStore
+    PS-barrier pattern, here over a shared local mount."""
+    root = str(tmp_path / "store")
+    s0 = FSStore(LocalFS(), root, world_size=2, rank=0, poll_interval=0.05)
+    s1 = FSStore(LocalFS(), root, world_size=2, rank=1, poll_interval=0.05)
+
+    reached = []
+
+    def worker(store, rid):
+        store.barrier("step0", timeout=10.0)
+        reached.append(rid)
+
+    t = threading.Thread(target=worker, args=(s1, 1))
+    t.start()
+    assert not reached  # rank 1 blocked until rank 0 arrives
+    worker(s0, 0)
+    t.join(timeout=10.0)
+    assert sorted(reached) == [0, 1]
+
+    with pytest.raises(TimeoutError, match="barrier"):
+        s0.barrier("lonely", timeout=0.3)
+
+
+def test_fsstore_barrier_reuse_does_not_leak_markers(tmp_path):
+    """Reusing a barrier name must synchronize AGAIN — stale round-1 markers
+    must not satisfy round 2 (regression: markers were never generational)."""
+    root = str(tmp_path / "store")
+    s0 = FSStore(LocalFS(), root, world_size=2, rank=0, poll_interval=0.05)
+    s1 = FSStore(LocalFS(), root, world_size=2, rank=1, poll_interval=0.05)
+    for _ in range(2):  # round 1 fills markers; round 2 must still block
+        t = threading.Thread(target=s1.barrier, args=("loop",),
+                             kwargs={"timeout": 10.0})
+        t.start()
+        s0.barrier("loop", timeout=10.0)
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+    # rank 0 alone on a 3rd round: must time out, not sail through
+    with pytest.raises(TimeoutError):
+        s0.barrier("loop", timeout=0.4)

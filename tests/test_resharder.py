@@ -45,9 +45,11 @@ def test_resharder_plan_and_apply():
                                np.arange(32.0).reshape(8, 4))
     assert r.stats["repartition"] == 1 and r.stats["bytes_moved"] == 128
 
-    # already-matching sharding: noop
+    # already-matching sharding: noop — but donate=False must NOT alias
     z = r.apply(y, P("a", "b"))
-    assert z is y and r.stats["noop"] == 1
+    assert z is not y and r.stats["noop"] == 1
+    np.testing.assert_allclose(np.asarray(z), np.asarray(y))
+    assert r.apply(y, P("a", "b"), donate=True) is y  # surrendered: alias ok
 
     # subset mesh -> different device set: cross_mesh
     mesh_half = Mesh(devs[:4].reshape(4), ("h",))
@@ -92,6 +94,26 @@ def test_mid_training_topology_switch_dp_to_mp():
     # test_topology_switch_matches_unswitched_training)
     assert np.mean(losses[3:]) < np.mean(losses[:3])
     assert eng_mp._step_count == 6  # 3 dp steps (build step overwritten) + 3
+
+
+def test_donate_false_keeps_source_engine_alive():
+    """donate=False must guarantee the destination never aliases the source:
+    the dst engine's donating step would otherwise delete the src's buffers
+    (regression: noop-plan transfers aliased)."""
+    paddle.seed(0)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.rand(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rs.rand(8, 1).astype(np.float32))
+    model = nn.Linear(16, 1)
+    eng_a = _engine({"dp_degree": 8, "mp_degree": 1}, model)
+    eng_a.step(x, y)
+    eng_a.sync_to_model()
+    eng_b = _engine({"dp_degree": 8, "mp_degree": 1}, model)  # same topology
+    eng_b.step(x, y)
+    transfer_engine_state(eng_a, eng_b, donate=False)
+    eng_b.step(x, y)          # donates eng_b's params — must not touch eng_a's
+    loss_a = float(eng_a.step(x, y).item())  # source still fully usable
+    assert np.isfinite(loss_a)
 
 
 def test_topology_switch_matches_unswitched_training():
