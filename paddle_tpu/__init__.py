@@ -63,6 +63,7 @@ _sys.modules[__name__ + ".linalg"] = linalg  # importable paddle_tpu.linalg, lik
 del _sys
 from . import distribution  # noqa: E402
 from . import sparse  # noqa: E402
+from . import strings  # noqa: E402
 from . import text  # noqa: E402
 from . import incubate  # noqa: E402
 from . import metric  # noqa: E402

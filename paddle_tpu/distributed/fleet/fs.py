@@ -158,9 +158,16 @@ class FSStore:
     def _p(self, key: str) -> str:
         return f"{self.root}/{key.replace('/', '%2F')}"
 
+    def _local_tmp(self) -> str:
+        import tempfile
+
+        fd, path = tempfile.mkstemp(dir=self._tmp)  # per-call: thread-safe
+        os.close(fd)
+        return path
+
     def set(self, key: str, value) -> None:
         data = value if isinstance(value, bytes) else str(value).encode()
-        local = os.path.join(self._tmp, "put.tmp")
+        local = self._local_tmp()
         with open(local, "wb") as f:
             f.write(data)
         # visibility must be atomic: a polling get() on another node must see
@@ -182,12 +189,14 @@ class FSStore:
         path = self._p(key)
         while True:
             if self.fs.is_exist(path):
-                local = os.path.join(self._tmp, "get.tmp")
-                if os.path.exists(local):
-                    os.unlink(local)
+                local = self._local_tmp()
+                os.unlink(local)  # download targets must not pre-exist
                 self.fs.download(path, local)
-                with open(local, "rb") as f:
-                    return f.read()
+                try:
+                    with open(local, "rb") as f:
+                        return f.read()
+                finally:
+                    os.unlink(local)
             if not wait:
                 raise KeyError(key)
             if _time.monotonic() > deadline:
@@ -208,8 +217,11 @@ class FSStore:
         return False
 
     def list_keys(self, prefix: str = ""):
+        import re
+
         _, files = self.fs.ls_dir(self.root)
-        keys = [os.path.basename(f).replace("%2F", "/") for f in files]
+        keys = [os.path.basename(f).replace("%2F", "/") for f in files
+                if not re.search(r"\.tmp\d+$", f)]  # in-flight staged writes
         return [k for k in keys if k.startswith(prefix)]
 
     def barrier(self, name: str, world_size=None, timeout: float = 300.0,
@@ -227,8 +239,7 @@ class FSStore:
         self._barrier_gen[name] = gen + 1
         bdir = f"{self.root}/barrier_{name}_g{gen}"
         self.fs.mkdirs(bdir)
-        local = os.path.join(self._tmp, "mark.tmp")
-        open(local, "w").close()
+        local = self._local_tmp()
         self.fs.upload(local, f"{bdir}/{who}")
         deadline = _time.monotonic() + timeout
         while True:

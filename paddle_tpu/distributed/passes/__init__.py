@@ -168,6 +168,9 @@ class FuseGemmEpiloguePass(PassBase):
         for op in block.ops:
             for n in op.input_names:
                 consumers[n] = consumers.get(n, 0) + 1
+        # fetched intermediates must survive: callers fetching the matmul
+        # output pass fetch_names (as the static-pass adapter does)
+        protected = set(self.attrs.get("fetch_names", ()))
         kept = []
         fused = 0
         i, ops = 0, block.ops
@@ -177,6 +180,7 @@ class FuseGemmEpiloguePass(PassBase):
             if (op.type in ("matmul", "matmul_v2", "mul") and nxt is not None
                     and nxt.type in ("add", "elementwise_add")
                     and len(op.output_names) == 1
+                    and op.output_names[0] not in protected
                     and op.output_names[0] in nxt.input_names
                     and consumers.get(op.output_names[0], 0) == 1):
                 mm_out = op.output_names[0]

@@ -68,6 +68,9 @@ class SparseCooTensor(Tensor):
     def is_sparse_coo(self):
         return True
 
+    def is_sparse_csr(self):
+        return False
+
     def to_dense(self) -> Tensor:
         return Tensor(self._bcoo.todense())
 
@@ -101,12 +104,14 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
                       stop_gradient=True):
-    """CSR input surface; stored as BCOO (XLA has one sparse path)."""
+    """CSR input surface; stored as BCOO (XLA has one sparse path) behind a
+    SparseCsrTensor view exposing crows()/cols() with CSR semantics."""
     crows_np = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
     cols_np = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
     rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
-    return sparse_coo_tensor(np.stack([rows, cols_np]), values, shape, dtype,
-                             stop_gradient)
+    coo = sparse_coo_tensor(np.stack([rows, cols_np]), values, shape, dtype,
+                            stop_gradient)
+    return SparseCsrTensor(coo._bcoo, stop_gradient=stop_gradient)
 
 
 def _as_bcoo(x):
@@ -225,3 +230,46 @@ class SubmConv3D(Conv3D):
     def __init__(self, *args, **kwargs):
         kwargs["subm"] = True
         super().__init__(*args, **kwargs)
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR surface (reference SparseCsrTensor, phi::SparseCsrTensor): storage
+    stays BCOO (XLA has one sparse path — module docstring), crows/cols are
+    derived accessors with CSR semantics."""
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def crows(self) -> Tensor:
+        rows = np.asarray(self._bcoo.indices)[:, 0]
+        n_rows = self.shape[0]
+        counts = np.bincount(rows, minlength=n_rows)
+        return Tensor(jnp.asarray(np.concatenate([[0], np.cumsum(counts)])
+                                  .astype(np.int64)))
+
+    def cols(self) -> Tensor:
+        return Tensor(jnp.asarray(
+            np.asarray(self._bcoo.indices)[:, 1].astype(np.int64)))
+
+
+def _dense_to_coo(x: Tensor, sparse_dim: int = None) -> SparseCooTensor:
+    """Tensor.to_sparse_coo (reference api.yaml dense_to_coo/to_sparse_coo):
+    the leading `sparse_dim` dims become sparse, the rest dense."""
+    n_dense = 0 if sparse_dim is None else x.ndim - sparse_dim
+    bcoo = jsparse.BCOO.fromdense(x._data, n_dense=n_dense)
+    return SparseCooTensor(bcoo, stop_gradient=x.stop_gradient)
+
+
+def _dense_to_csr(x: Tensor) -> SparseCsrTensor:
+    """Tensor.to_sparse_csr (reference to_sparse_csr): 2-D only."""
+    if x.ndim != 2:
+        raise ValueError(f"to_sparse_csr needs a 2-D tensor, got {x.ndim}-D")
+    bcoo = jsparse.BCOO.fromdense(x._data)
+    return SparseCsrTensor(bcoo, stop_gradient=x.stop_gradient)
+
+
+Tensor.to_sparse_coo = _dense_to_coo
+Tensor.to_sparse_csr = _dense_to_csr

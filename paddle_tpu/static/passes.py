@@ -26,7 +26,11 @@ class ProgramView:
             ops=list(program.global_block().ops),
             vars=program.global_block().vars)
         self._train = program._train
-        self._var_aliases: Dict[str, str] = {}
+        # seed from aliases recorded by passes applied directly to the
+        # PROGRAM (e.g. PassManager delete_dropout before lowering): a fetch
+        # of a removed var must resolve through them on this path too
+        self._var_aliases: Dict[str, str] = dict(
+            getattr(program, "_var_aliases", {}))
 
     def global_block(self):
         return self._block

@@ -1,0 +1,87 @@
+"""paddle.strings — string-tensor ops.
+
+Reference: python/paddle/utils/code_gen/strings_api.yaml (empty, empty_like,
+lower, upper) over phi::StringTensor (paddle/phi/core/string_tensor.h), whose
+kernels are CPU-only in the reference too — strings never touch the
+accelerator. TPU-natively the same is true: a StringTensor is a host-side
+numpy unicode array; lower/upper follow the reference's utf8/ascii split
+(strings_lower_upper_kernel: ascii fast path vs full utf8 case mapping).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor", "empty", "empty_like",
+           "lower", "upper"]
+
+
+class StringTensor:
+    """Host-resident tensor of unicode strings."""
+
+    def __init__(self, data):
+        self._data = np.asarray(data, dtype=object)
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+    def __eq__(self, other):
+        other = other._data if isinstance(other, StringTensor) else other
+        return bool(np.array_equal(self._data, np.asarray(other, dtype=object)))
+
+
+def to_string_tensor(data) -> StringTensor:
+    return data if isinstance(data, StringTensor) else StringTensor(data)
+
+
+def empty(shape, name=None) -> StringTensor:
+    """strings_api.yaml `empty`: a string tensor of empty strings."""
+    return StringTensor(np.full(tuple(shape), "", dtype=object))
+
+
+def empty_like(x, name=None) -> StringTensor:
+    return empty(to_string_tensor(x).shape)
+
+
+def _map(x, fn):
+    src = to_string_tensor(x)._data
+    out = np.empty_like(src)
+    for idx in np.ndindex(src.shape):
+        out[idx] = fn(src[idx])
+    return StringTensor(out)
+
+
+def _ascii_only(fn_name):
+    # reference ascii fast path: only [A-Za-z] change case, other bytes kept
+    lo = ord("a") - ord("A")
+
+    def f(s):
+        if fn_name == "lower":
+            return "".join(chr(ord(c) + lo) if "A" <= c <= "Z" else c
+                           for c in s)
+        return "".join(chr(ord(c) - lo) if "a" <= c <= "z" else c for c in s)
+
+    return f
+
+
+def lower(x, use_utf8_encoding: bool = False, name=None) -> StringTensor:
+    """strings_api.yaml `lower` (strings_lower_upper_kernel): ascii fast path
+    by default; use_utf8_encoding=True applies the full unicode mapping."""
+    if use_utf8_encoding:
+        return _map(x, str.lower)
+    return _map(x, _ascii_only("lower"))
+
+
+def upper(x, use_utf8_encoding: bool = False, name=None) -> StringTensor:
+    if use_utf8_encoding:
+        return _map(x, str.upper)
+    return _map(x, _ascii_only("upper"))

@@ -17,6 +17,10 @@ def test_api_yaml_surface_fully_covered():
     assert rep["stubs"] == [], f"stub APIs: {rep['stubs']}"
     assert rep["backward_missing"] == [], (
         f"grads without forward: {rep['backward_missing']}")
+    assert rep["sparse_missing"] == [], (
+        f"sparse_api.yaml gaps: {rep['sparse_missing']}")
+    assert rep["strings_missing"] == [], (
+        f"strings_api.yaml gaps: {rep['strings_missing']}")
     # every waiver must carry a reason
     for name, reason in rep["waived"].items():
         assert reason and len(reason) > 10, f"waiver for {name} has no reason"

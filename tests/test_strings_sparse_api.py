@@ -1,0 +1,50 @@
+"""strings_api.yaml + sparse conversion surface (reference
+python/paddle/utils/code_gen/{strings,sparse}_api.yaml)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import strings
+
+
+def test_strings_empty_and_like():
+    e = strings.empty([2, 3])
+    assert e.shape == [2, 3] and e.tolist() == [[""] * 3] * 2
+    el = strings.empty_like(strings.StringTensor([["x", "y"]]))
+    assert el.shape == [1, 2] and el.tolist() == [["", ""]]
+
+
+def test_strings_lower_upper_ascii_vs_utf8():
+    x = strings.StringTensor(["Hello World", "CAF\xc9 \xdcber", "mixed123!"])
+    # ascii fast path: accented codepoints untouched (reference default)
+    lo = strings.lower(x)
+    assert lo.tolist() == ["hello world", "caf\xc9 \xdcber", "mixed123!"]
+    up = strings.upper(x)
+    assert up.tolist() == ["HELLO WORLD", "CAF\xc9 \xdcBER", "MIXED123!"]
+    # utf8 path: full unicode case mapping
+    lo8 = strings.lower(x, use_utf8_encoding=True)
+    assert lo8.tolist() == ["hello world", "caf\xe9 \xfcber", "mixed123!"]
+    up8 = strings.upper(x, use_utf8_encoding=True)
+    assert up8.tolist() == ["HELLO WORLD", "CAF\xc9 \xdcBER", "MIXED123!"]
+
+
+def test_dense_to_sparse_roundtrips():
+    x = paddle.to_tensor(np.array([[0.0, 1.5], [2.5, 0.0], [0.0, 3.5]],
+                                  np.float32))
+    coo = x.to_sparse_coo(2)
+    np.testing.assert_allclose(coo.to_dense().numpy(), x.numpy())
+    np.testing.assert_allclose(np.sort(coo.values().numpy()), [1.5, 2.5, 3.5])
+
+    csr = x.to_sparse_csr()
+    np.testing.assert_allclose(csr.to_dense().numpy(), x.numpy())
+    np.testing.assert_array_equal(csr.crows().numpy(), [0, 1, 2, 3])
+    np.testing.assert_array_equal(csr.cols().numpy(), [1, 0, 1])
+    # csr round-trips through the csr constructor too
+    rebuilt = paddle.sparse.sparse_csr_tensor(
+        csr.crows(), csr.cols(), csr.values(), x.shape)
+    np.testing.assert_allclose(rebuilt.to_dense().numpy(), x.numpy())
+
+
+def test_partial_sparse_dim():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(2, 2, 3))
+    sp = x.to_sparse_coo(2)  # last dim stays dense
+    np.testing.assert_allclose(sp.to_dense().numpy(), x.numpy())

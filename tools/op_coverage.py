@@ -154,18 +154,45 @@ def parse_yaml_api_names(path, key):
     return names
 
 
+# sparse_api.yaml / strings_api.yaml entries -> their public dotted paths
+SPARSE_ALIASES = {
+    "conv3d": "sparse.Conv3D",
+    "coo_to_dense": "sparse.SparseCooTensor.to_dense",
+    "coo_values": "sparse.SparseCooTensor.values",
+    "create_sparse_coo_tensor": "sparse.sparse_coo_tensor",
+    "csr_values": "sparse.SparseCsrTensor.values",
+    "dense_to_coo": "Tensor.to_sparse_coo",
+    "relu": "sparse.relu",
+    "to_dense": "sparse.SparseCooTensor.to_dense",
+    "to_sparse_coo": "Tensor.to_sparse_coo",
+    "to_sparse_csr": "Tensor.to_sparse_csr",
+}
+STRINGS_ALIASES = {
+    "empty": "strings.empty",
+    "empty_like": "strings.empty_like",
+    "lower": "strings.lower",
+    "upper": "strings.upper",
+}
+
+
 def load_surface(yaml_dir):
-    """Forward + backward op names, from the reference checkout if present,
-    else from the bundled snapshot (tools/api_surface.json)."""
+    """Forward + backward + sparse + strings op names, from the reference
+    checkout if present, else from the bundled snapshot
+    (tools/api_surface.json)."""
     api_yaml = os.path.join(yaml_dir, "api.yaml")
-    bwd_yaml = os.path.join(yaml_dir, "backward.yaml")
     if os.path.exists(api_yaml):
         apis = parse_yaml_api_names(api_yaml, "api")
-        bwds = parse_yaml_api_names(bwd_yaml, "backward_api")
-        return apis, bwds
+        bwds = parse_yaml_api_names(
+            os.path.join(yaml_dir, "backward.yaml"), "backward_api")
+        sparse = parse_yaml_api_names(
+            os.path.join(yaml_dir, "sparse_api.yaml"), "api")
+        strings = parse_yaml_api_names(
+            os.path.join(yaml_dir, "strings_api.yaml"), "api")
+        return apis, bwds, sparse, strings
     with open(_BUNDLED) as f:
         snap = json.load(f)
-    return snap["apis"], snap["backward_apis"]
+    return (snap["apis"], snap["backward_apis"],
+            snap.get("sparse_apis", []), snap.get("strings_apis", []))
 
 
 def looks_like_stub(obj):
@@ -211,7 +238,7 @@ def audit(yaml_dir=DEFAULT_YAML_DIR):
         pass
     import paddle_tpu as paddle
 
-    apis, bwds = load_surface(yaml_dir)
+    apis, bwds, sparse_apis, strings_apis = load_surface(yaml_dir)
     report = {"implemented": {}, "waived": {}, "missing": [], "stubs": []}
     for name in apis:
         path = resolve(paddle, name)
@@ -241,11 +268,28 @@ def audit(yaml_dir=DEFAULT_YAML_DIR):
             if p is None:
                 bwd_missing.append(bname)
     report["backward_missing"] = sorted(set(bwd_missing))
+
+    # sparse/strings sub-surfaces: alias tables map entry -> dotted path
+    report["sparse_missing"] = []
+    for name in sparse_apis:
+        dotted = SPARSE_ALIASES.get(name)
+        if dotted is None or resolve(paddle, dotted) is None:
+            report["sparse_missing"].append(name)
+    report["strings_missing"] = []
+    for name in strings_apis:
+        dotted = STRINGS_ALIASES.get(name)
+        if dotted is None or resolve(paddle, dotted) is None:
+            report["strings_missing"].append(name)
+
     report["counts"] = {
         "apis": len(apis), "implemented": len(report["implemented"]),
         "waived": len(report["waived"]), "missing": len(report["missing"]),
         "backward_apis": len(bwds),
         "backward_missing": len(report["backward_missing"]),
+        "sparse_apis": len(sparse_apis),
+        "sparse_missing": len(report["sparse_missing"]),
+        "strings_apis": len(strings_apis),
+        "strings_missing": len(report["strings_missing"]),
     }
     return report
 
