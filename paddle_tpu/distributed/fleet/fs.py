@@ -168,19 +168,24 @@ class FSStore:
     def set(self, key: str, value) -> None:
         data = value if isinstance(value, bytes) else str(value).encode()
         local = self._local_tmp()
-        with open(local, "wb") as f:
-            f.write(data)
-        # visibility must be atomic: a polling get() on another node must see
-        # either nothing or the complete value. HDFS -put is rename-atomic;
-        # LocalFS copy is NOT, so stage under a rank-suffixed temp name and
-        # rename into place.
-        dst = self._p(key)
-        if isinstance(self.fs, LocalFS):
-            staged = f"{dst}.tmp{self.rank}"
-            self.fs.upload(local, staged)
-            os.replace(staged, dst)
-        else:
-            self.fs.upload(local, dst)
+        try:
+            with open(local, "wb") as f:
+                f.write(data)
+            # visibility must be atomic: a polling get() on another node must
+            # see nothing or the complete value. HDFS -put is rename-atomic;
+            # LocalFS copy is NOT, so stage under the reserved .__stage prefix
+            # (which _p() can never produce — "/" escapes to %2F, and
+            # list_keys hides it) and rename into place.
+            dst = self._p(key)
+            if isinstance(self.fs, LocalFS):
+                staged = os.path.join(
+                    self.root, f".__stage.{self.rank}.{os.path.basename(local)}")
+                self.fs.upload(local, staged)
+                os.replace(staged, dst)
+            else:
+                self.fs.upload(local, dst)
+        finally:
+            os.unlink(local)
 
     def get(self, key: str, wait: bool = True, timeout: float = 300.0) -> bytes:
         import time as _time
@@ -190,13 +195,17 @@ class FSStore:
         while True:
             if self.fs.is_exist(path):
                 local = self._local_tmp()
-                os.unlink(local)  # download targets must not pre-exist
-                self.fs.download(path, local)
+                # download to a DERIVED name: unlinking the mkstemp
+                # reservation itself would let a concurrent call reuse it
+                dl = local + ".dl"
                 try:
-                    with open(local, "rb") as f:
+                    self.fs.download(path, dl)
+                    with open(dl, "rb") as f:
                         return f.read()
                 finally:
                     os.unlink(local)
+                    if os.path.exists(dl):
+                        os.unlink(dl)
             if not wait:
                 raise KeyError(key)
             if _time.monotonic() > deadline:
@@ -217,11 +226,9 @@ class FSStore:
         return False
 
     def list_keys(self, prefix: str = ""):
-        import re
-
         _, files = self.fs.ls_dir(self.root)
         keys = [os.path.basename(f).replace("%2F", "/") for f in files
-                if not re.search(r"\.tmp\d+$", f)]  # in-flight staged writes
+                if not os.path.basename(f).startswith(".__stage.")]
         return [k for k in keys if k.startswith(prefix)]
 
     def barrier(self, name: str, world_size=None, timeout: float = 300.0,
@@ -240,7 +247,10 @@ class FSStore:
         bdir = f"{self.root}/barrier_{name}_g{gen}"
         self.fs.mkdirs(bdir)
         local = self._local_tmp()
-        self.fs.upload(local, f"{bdir}/{who}")
+        try:
+            self.fs.upload(local, f"{bdir}/{who}")
+        finally:
+            os.unlink(local)
         deadline = _time.monotonic() + timeout
         while True:
             _, files = self.fs.ls_dir(bdir)

@@ -71,15 +71,45 @@ class GPTAttention(nn.Layer):
                                           input_is_parallel=True)
         self.attn_dropout = config.attention_dropout
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)  # [b, s, 3h] (h sharded over mp)
         qkv = P.reshape(qkv, (b, s, 3, self.num_heads, self.head_dim))
         q, k, v = P.unbind(qkv, axis=2)  # heads dim sharded over mp under pjit
+        if cache is None:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.attn_dropout,
+                training=self.training)
+            out = P.reshape(out, (b, s, self.hidden_size))
+            return self.out_proj(out)
+
+        # KV-cache decode (TPU-native: fixed [b, T, nh, hd] buffers updated
+        # with dynamic_update_slice, so the whole decode loop is one static-
+        # shape scan). cache = (k_cache, v_cache, offset): offset is the count
+        # of already-cached positions; the new chunk writes [offset, offset+s).
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        k_cache, v_cache, offset = cache
+        kc, vc = k_cache._data, v_cache._data
+        off = offset._data if isinstance(offset, Tensor) else offset
+        off = off.astype(jnp.int32)
+        zero = jnp.int32(0)
+        kc = jax.lax.dynamic_update_slice(
+            kc, k._data.astype(kc.dtype), (zero, off, zero, zero))
+        vc = jax.lax.dynamic_update_slice(
+            vc, v._data.astype(vc.dtype), (zero, off, zero, zero))
+        total = kc.shape[1]
+        qpos = off + jnp.arange(s)                       # [s]
+        mask = jnp.arange(total)[None, :] <= qpos[:, None]  # [s, T] causal+len
         out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.attn_dropout, training=self.training)
+            q, Tensor(kc), Tensor(vc), attn_mask=Tensor(mask),
+            dropout_p=0.0, training=False)
         out = P.reshape(out, (b, s, self.hidden_size))
-        return self.out_proj(out)
+        return self.out_proj(out), (Tensor(kc), Tensor(vc),
+                                    Tensor(off + jnp.int32(s)))
 
 
 class GPTMLP(nn.Layer):
@@ -108,7 +138,11 @@ class GPTBlock(nn.Layer):
         h = x + F.dropout(self.attn(self.ln1(x)), self.dropout, training=self.training)
         return h + F.dropout(self.mlp(self.ln2(h)), self.dropout, training=self.training)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln1(x), cache=cache)
+            h = x + a
+            return h + self.mlp(self.ln2(h)), new_cache
         if self.use_recompute and self.training:
             return recompute(self._forward, x)
         return self._forward(x)
@@ -124,11 +158,26 @@ class GPTModel(nn.Layer):
         self.blocks = nn.LayerList([GPTBlock(config) for _ in range(config.num_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None):
         s = input_ids.shape[1]
-        pos = C.arange(0, s, dtype="int64")
+        if caches is not None:
+            from ..core.tensor import Tensor
+
+            off = caches[0][2]
+            off_arr = off._data if isinstance(off, Tensor) else off
+            import jax.numpy as jnp
+
+            pos = Tensor(off_arr + jnp.arange(s, dtype=jnp.int64))
+        else:
+            pos = C.arange(0, s, dtype="int64")
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
+        if caches is not None:
+            new_caches = []
+            for blk, cache in zip(self.blocks, caches):
+                x, c = blk(x, cache=cache)
+                new_caches.append(c)
+            return self.ln_f(x), new_caches
         for blk in self.blocks:
             x = blk(x)
         return self.ln_f(x)
@@ -347,3 +396,127 @@ class GPTForPretraining(nn.Layer):
         hcg = get_hybrid_communicate_group()
         # vocab-sharded weight (mp > 1) keeps the vocab-parallel psum loss path
         return hcg is None or hcg.degrees["mp"] <= 1
+
+    def _head_logits(self, h):
+        """Hidden states -> vocab logits (shared by forward and decode)."""
+        if self.lm_head is None:
+            from ..ops import linalg as L
+
+            return L.matmul(h, self.gpt.wte.weight, transpose_y=True)
+        return self.lm_head(h)
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=0, top_p=1.0, eos_token_id=None, seed=0):
+        """Autoregressive decode with KV cache — ONE jitted program: prefill
+        fills fixed [b, total, nh, hd] cache buffers, then a lax.scan emits a
+        token per step (static shapes end to end, the TPU-native decode loop).
+        Greedy when temperature == 0; top-k/top-p nucleus sampling otherwise.
+        After eos_token_id every subsequent position repeats eos.
+
+        Single-replica inference path (mp decode would shard the head and
+        psum logits; see PARITY row 49). Returns [b, prompt + max_new_tokens].
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..jit import functional_call
+
+        cfg = self.config
+        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        b, prompt = ids.shape
+        total = prompt + max_new_tokens
+        if total > cfg.max_seq_len:
+            raise ValueError(f"prompt {prompt} + max_new_tokens "
+                             f"{max_new_tokens} exceeds max_seq_len "
+                             f"{cfg.max_seq_len}")
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        state = self.state_dict(include_non_persistable_buffer=True)
+        params = {k: v._data for k, v in state.items()}
+        cache_dtype = self.gpt.wte.weight._data.dtype
+        was_training = self.training
+        self.eval()
+
+        def sample(logits, key):
+            if temperature == 0:
+                return jnp.argmax(logits, axis=-1)
+            logits = logits / jnp.float32(max(temperature, 1e-6))
+            if top_k and top_k > 0:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p < 1.0:
+                sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(sorted_l, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                # smallest set with cumulative mass >= top_p
+                cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+                cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], 1)
+                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1)
+
+        from ..core.autograd import no_grad
+        from ..jit import _swapped_state, _tracing
+
+        def head(params, h_arr):
+            """last-position hidden -> logits, with weights from `params`."""
+            with _swapped_state(self, params), _tracing(), no_grad():
+                return self._head_logits(Tensor(h_arr))._data
+
+        def run(params, ids, key):
+            # derive the submodule view from the TRACED params argument — a
+            # closure over the concrete arrays would bake every weight into
+            # the executable as a constant
+            gpt_params = {k[len("gpt."):]: v for k, v in params.items()
+                          if k.startswith("gpt.")}
+            caches = [(Tensor(jnp.zeros((b, total, nh, hd), cache_dtype)),
+                       Tensor(jnp.zeros((b, total, nh, hd), cache_dtype)),
+                       Tensor(jnp.int32(0))) for _ in range(cfg.num_layers)]
+            h, caches = functional_call(self.gpt, gpt_params, Tensor(ids),
+                                        caches=caches)
+            logits = head(params, h._data[:, -1])
+            key, sub = jax.random.split(key)
+            tok = sample(logits, sub).astype(ids.dtype)
+            done = (jnp.zeros((b,), bool) if eos_token_id is None
+                    else tok == eos_token_id)
+            flat = jax.tree_util.tree_map(lambda t: t._data, caches,
+                                          is_leaf=lambda t: isinstance(t, Tensor))
+
+            def step(carry, _):
+                flat_caches, tok, key, done = carry
+                caches = jax.tree_util.tree_map(Tensor, flat_caches)
+                h, caches = functional_call(self.gpt, gpt_params,
+                                            Tensor(tok[:, None]),
+                                            caches=caches)
+                logits = head(params, h._data[:, 0])
+                key, sub = jax.random.split(key)
+                nxt = sample(logits, sub).astype(tok.dtype)
+                if eos_token_id is not None:
+                    nxt = jnp.where(done, eos_token_id, nxt)
+                    done = done | (nxt == eos_token_id)
+                flat_caches = jax.tree_util.tree_map(
+                    lambda t: t._data, caches,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+                return (flat_caches, nxt, key, done), nxt
+
+            if max_new_tokens > 1:
+                _, toks = jax.lax.scan(step, (flat, tok, key, done), None,
+                                       length=max_new_tokens - 1)
+                out = jnp.concatenate([ids, tok[:, None], toks.T], axis=1)
+            else:
+                out = jnp.concatenate([ids, tok[:, None]], axis=1)
+            return out
+
+        try:
+            # one compiled decode program per sampling configuration — a fresh
+            # jax.jit wrapper each call would recompile every generate()
+            cache_key = (b, prompt, max_new_tokens, float(temperature),
+                         int(top_k), float(top_p), eos_token_id)
+            jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
+            fn = jit_cache.get(cache_key)
+            if fn is None:
+                fn = jit_cache[cache_key] = jax.jit(run)
+            out = fn(params, ids, jax.random.key(seed))
+        finally:
+            if was_training:
+                self.train()
+        return Tensor(out)
