@@ -24,6 +24,7 @@ _BOOT = "import jax; jax.config.update('jax_platforms', 'cpu'); " \
     ("static_train_from_dataset.py", "eval mse (no update):"),
     ("train_widedeep_ps.py", "step 8: loss"),
     ("export_and_serve.py", "predictor output matches eager forward"),
+    ("generate_gpt.py", "decode ok: prompt"),
 ])
 def test_example_runs(example, expect):
     path = os.path.join(REPO, "examples", example)
