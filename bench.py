@@ -148,33 +148,81 @@ def _orchestrate():
             os.environ["PADDLE_TPU_BENCH_DEGRADED_TAG"] = tag
         main()
 
-    if os.environ.get("PADDLE_TPU_BENCH_DEVICE") == "cpu":  # explicit choice
-        return cpu_run(None)
-    probe_t = float(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "90"))
-    if not tpu_alive(timeout=probe_t):
-        return cpu_run("tpu_unavailable")
+    # test hook: exercise the sweep machinery with CPU attempts (no TPU probe)
+    force_sweep_cpu = os.environ.get("PADDLE_TPU_BENCH_FORCE_SWEEP_CPU") == "1"
 
-    wall = float(os.environ.get("PADDLE_TPU_BENCH_WALL_TIMEOUT", "420"))
-    out, tag = "", None
-    try:
-        p = subprocess.run([sys.executable, __file__, "--inline"],
-                           capture_output=True, text=True, timeout=wall)
-        out, err, tag = p.stdout or "", p.stderr, f"tpu_run_rc{p.returncode}"
-    except subprocess.TimeoutExpired as e:
-        def _s(b):
-            return b.decode("utf-8", "replace") if isinstance(b, bytes) else (b or "")
-        out, err, tag = _s(e.stdout), _s(e.stderr), "tpu_run_hung"
-    if err:
-        sys.stderr.write(err)
-    for line in reversed(out.splitlines()):  # the JSON line is the last print
+    if os.environ.get("PADDLE_TPU_BENCH_DEVICE") == "cpu" and not force_sweep_cpu:
+        return cpu_run(None)
+    if not force_sweep_cpu:
+        probe_t = float(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "90"))
+        if not tpu_alive(timeout=probe_t):
+            return cpu_run("tpu_unavailable")
+
+    import time as _time
+
+    def attempt(extra_env, timeout):
+        """One killable TPU bench attempt; returns (payload|None, tag)."""
+        env = {**os.environ, **extra_env}
+        if force_sweep_cpu:
+            env["PADDLE_TPU_BENCH_DEVICE"] = "cpu"
         try:
-            payload = json.loads(line)
-        except ValueError:
+            p = subprocess.run([sys.executable, __file__, "--inline"],
+                               capture_output=True, text=True, timeout=timeout,
+                               env=env)
+            out, err, tag = p.stdout or "", p.stderr, f"tpu_run_rc{p.returncode}"
+        except subprocess.TimeoutExpired as e:
+            def _s(b):
+                return b.decode("utf-8", "replace") if isinstance(b, bytes) \
+                    else (b or "")
+            out, err, tag = _s(e.stdout), _s(e.stderr), "tpu_run_hung"
+        if err:
+            sys.stderr.write(err)
+        for line in reversed(out.splitlines()):  # JSON line is the last print
+            try:
+                payload = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(payload, dict) and "metric" in payload:
+                return payload, tag
+        return None, tag
+
+    # Self-sweeping: the BASELINE.md configurations run inside the one driver
+    # invocation (safest first — a wedge mid-sweep still reports the best
+    # completed attempt). PADDLE_TPU_BENCH_SWEEP=0 reverts to single-attempt.
+    configs = [("default", {})]
+    user_tuned = any(k in os.environ for k in (
+        "PADDLE_TPU_BENCH_BATCH", "PADDLE_TPU_BENCH_PALLAS_LOSS",
+        "PADDLE_TPU_BENCH_AUTOTUNE"))  # explicit env: honor it, don't sweep
+    if os.environ.get("PADDLE_TPU_BENCH_SWEEP", "1") != "0" and not user_tuned:
+        configs += [
+            ("batch16", {"PADDLE_TPU_BENCH_BATCH": "16"}),
+            ("batch16_pallas_loss", {"PADDLE_TPU_BENCH_BATCH": "16",
+                                     "PADDLE_TPU_BENCH_PALLAS_LOSS": "1"}),
+        ]
+    per_attempt = float(os.environ.get("PADDLE_TPU_BENCH_WALL_TIMEOUT", "420"))
+    budget = float(os.environ.get("PADDLE_TPU_BENCH_SWEEP_BUDGET", "600"))
+    t0 = _time.monotonic()
+    best, last_tag, sweep_log = None, None, []
+    for name, extra_env in configs:
+        remaining = budget - (_time.monotonic() - t0)
+        if best is not None and remaining < 60:
+            sweep_log.append({"config": name, "result": "skipped_no_budget"})
             continue
-        if isinstance(payload, dict) and "metric" in payload:
-            print(line)
-            return
-    cpu_run(tag)  # TPU attempt produced no JSON: tagged CPU fallback
+        payload, tag = attempt(extra_env, min(per_attempt, max(remaining, 60)))
+        last_tag = tag
+        if payload is None:
+            sweep_log.append({"config": name, "result": tag})
+            continue
+        sweep_log.append({"config": name,
+                          "result": round(payload.get("value", 0.0), 1)})
+        if best is None or payload.get("value", 0) > best.get("value", 0):
+            best = payload
+    if best is not None:
+        if len(sweep_log) > 1:
+            best.setdefault("extra", {})["sweep"] = sweep_log
+        print(json.dumps(best))
+        return
+    cpu_run(last_tag)  # no TPU attempt produced JSON: tagged CPU fallback
 
 
 if __name__ == "__main__":

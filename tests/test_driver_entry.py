@@ -48,6 +48,27 @@ def test_bench_emits_json_when_tpu_dead(tmp_path):
     assert payload["extra"]["platform"] == "cpu"
 
 
+def test_bench_sweep_picks_best_and_logs(tmp_path):
+    """The self-sweeping orchestrator (BASELINE.md configs inside one driver
+    invocation) must run every config within the generous budget and report
+    the best attempt with a per-config sweep log."""
+    env = {**os.environ,
+           "PADDLE_TPU_BENCH_FORCE_SWEEP_CPU": "1",
+           "PADDLE_TPU_BENCH_STEPS": "1",
+           "PADDLE_TPU_BENCH_SWEEP_BUDGET": "3600"}
+    env.pop("PADDLE_TPU_BENCH_BATCH", None)  # user-tuned env disables the sweep
+    p = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, timeout=900, env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    payload = json.loads(p.stdout.strip().splitlines()[-1])
+    sweep = payload["extra"]["sweep"]
+    names = [s["config"] for s in sweep]
+    assert names[0] == "default" and "batch16" in names, sweep
+    ran = [s for s in sweep if isinstance(s["result"], (int, float))]
+    assert ran, sweep
+    assert payload["value"] == max(s["result"] for s in ran)
+
+
 def test_dryrun_multichip_forces_virtual_cpu_mesh():
     # Fresh interpreter WITHOUT the conftest forcing: simulates the driver
     # process where a sitecustomize may freeze a dead accelerator platform.
