@@ -49,6 +49,8 @@ def main():
         paddle.incubate.autotune.set_config({"kernel": {"enable": True}})
     if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LOSS"):  # online LM-loss kernel
         paddle.set_flags({"use_pallas_lm_loss": True})
+    if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LN"):  # fused LayerNorm kernel
+        paddle.set_flags({"use_pallas_layernorm": True})
     if batch % n_dev:  # batch dim shards over dp_degree = n_dev
         batch = max(n_dev, batch - batch % n_dev)
 

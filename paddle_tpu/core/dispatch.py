@@ -49,7 +49,8 @@ AMP_WHITE = {
 AMP_BLACK = {
     "exp", "log", "log2", "log10", "log1p", "softmax", "log_softmax",
     "cross_entropy", "softmax_with_cross_entropy", "mean", "sum", "norm",
-    "layer_norm", "batch_norm", "group_norm", "instance_norm", "cumsum",
+    "layer_norm", "layer_norm_pallas", "batch_norm", "group_norm",
+    "instance_norm", "cumsum",
     "pow", "rsqrt", "sigmoid_cross_entropy_with_logits", "binary_cross_entropy",
     "nll_loss", "kl_div", "erf", "logsumexp", "var", "std",
 }
