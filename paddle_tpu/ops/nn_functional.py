@@ -870,7 +870,9 @@ def _use_flash(q, k) -> bool:
     from ..core import flags as _flags
     if not _flags.flag("use_flash_attention"):
         return False
-    if _jax.default_backend() != "tpu":  # Mosaic kernels; interpret is test-only
+    # Mosaic kernels on TPU; interpret mode only when explicitly allowed
+    # (tests + HLO perf gates), same gate as the layer_norm / lm_loss routes
+    if _jax.default_backend() != "tpu" and not _flags.flag("pallas_interpret_ok"):
         return False
     from .pallas.flash_attention import supported
 

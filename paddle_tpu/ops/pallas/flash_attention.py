@@ -92,7 +92,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     @pl.when(ki == kv_blocks - 1)
     def _finalize():
         l = l_scr[...][:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros, not NaN
+        # fully-masked rows -> zeros, not NaN. ones_like (not a python 1.0
+        # literal): under jax_enable_x64 the weak literal promotes through
+        # f64 and Mosaic has no f64->f32 cast — caught by the TPU-export gate
+        l = jnp.where(l == 0.0, jnp.ones_like(l), l)
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
         # lse broadcast across the 128-lane dim (TPU block layout for row stats)
         lse_ref[0] = jnp.broadcast_to(m_scr[...][:, :1] + jnp.log(l), lse_ref.shape[1:])

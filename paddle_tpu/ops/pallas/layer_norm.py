@@ -5,8 +5,9 @@ welford + affine CUDA kernel). TPU-native: rows tile over the grid, each
 program normalizes a [block_rows, hidden] tile in VMEM with f32 statistics —
 one HBM read per tensor in each pass instead of XLA's separate
 mean/var/normalize ops. Backward recomputes xhat from saved (mu, rstd) and
-produces dx in one pass plus per-tile partial (dgamma, dbeta) that XLA sums —
-the standard split that avoids cross-program atomics.
+produces dx in one pass; dgamma/dbeta accumulate across the sequential TPU
+grid into one revisited [1, hidden] output block (the Mosaic reduction idiom —
+no atomics, no partials array).
 
 Used by nn.functional.layer_norm when FLAGS_use_pallas_layernorm is on and
 the shapes qualify (last-dim normalization, hidden % 128 == 0); off by
@@ -58,6 +59,7 @@ def _infer_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
 
 def _bwd_kernel(x_ref, g_ref, dy_ref, mu_ref, rstd_ref,
                 dx_ref, dg_ref, db_ref):
+    i = pl.program_id(0)
     x = x_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32)
     dy = dy_ref[...].astype(jnp.float32)
@@ -68,9 +70,17 @@ def _bwd_kernel(x_ref, g_ref, dy_ref, mu_ref, rstd_ref,
     c1 = jnp.mean(wdy, axis=1, keepdims=True)
     c2 = jnp.mean(wdy * xhat, axis=1, keepdims=True)
     dx_ref[...] = ((wdy - c1 - xhat * c2) * rstd).astype(dx_ref.dtype)
-    # per-tile partials; the caller sums across tiles (no atomics on TPU)
-    dg_ref[...] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-    db_ref[...] = jnp.sum(dy, axis=0, keepdims=True)
+    # dgamma/dbeta: accumulate into one revisited [1, h] output block — TPU
+    # grid steps run sequentially, so += across iterations is the Mosaic
+    # reduction idiom (a [tiles, h] partials array with [1, h] blocks violates
+    # the (8, 128) block-tiling rule — caught by the TPU-export gate)
+    @pl.when(i == 0)
+    def _init():
+        dg_ref[...] = jnp.zeros_like(dg_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    dg_ref[...] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_ref[...] += jnp.sum(dy, axis=0, keepdims=True)
 
 
 def _fwd(x2d, g, b, eps):
@@ -134,17 +144,17 @@ def _bwd(x2d, g, dy, mu, rstd):
         ],
         out_specs=[
             pl.BlockSpec((rows, h), lambda i: (i, 0)),
-            pl.BlockSpec((1, h), lambda i: (i, 0)),
-            pl.BlockSpec((1, h), lambda i: (i, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, h), x2d.dtype),
-            jax.ShapeDtypeStruct((tiles, h), jnp.float32),
-            jax.ShapeDtypeStruct((tiles, h), jnp.float32),
+            jax.ShapeDtypeStruct((1, h), jnp.float32),
+            jax.ShapeDtypeStruct((1, h), jnp.float32),
         ],
         interpret=_interpret(),
     )(x2d, g[None, :], dy, mu, rstd)
-    return dx, dg_part.sum(0), db_part.sum(0)
+    return dx, dg_part[0], db_part[0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))

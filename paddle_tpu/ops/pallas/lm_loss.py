@@ -66,12 +66,17 @@ def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr,
     s = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # [bn, bv]
 
-    lab = lab_ref[0]                    # [bn] int32
+    lab = lab_ref[...]                  # [bn] int32 (1D block: a [nb, bn]
+    #                                     2D layout with [1, bn] blocks breaks
+    #                                     Mosaic's (8, 128) block-tiling rule)
     col0 = j * block_v
     cols = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     hit = cols == lab[:, None]          # row's label inside this tile?
     # each label lands in exactly one tile: accumulate its logit via sum
-    p_scr[...] += jnp.sum(jnp.where(hit, s, 0.0), axis=1, keepdims=True)
+    # zeros_like, not a 0.0 literal: under jax_enable_x64 the weak literal
+    # promotes through f64 and Mosaic has no f64->f32 cast
+    p_scr[...] += jnp.sum(jnp.where(hit, s, jnp.zeros_like(s)), axis=1,
+                          keepdims=True)
 
     m_prev = m_scr[...][:, :1]
     m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -83,8 +88,8 @@ def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr,
     @pl.when(j == v_blocks - 1)
     def _finalize():
         lse = m_scr[...][:, :1] + jnp.log(l_scr[...][:, :1])
-        loss_ref[0] = (lse - p_scr[...][:, :1])[:, 0]
-        lse_ref[0] = lse[:, 0]
+        loss_ref[...] = (lse - p_scr[...][:, :1])[:, 0]
+        lse_ref[...] = lse[:, 0]
 
 
 def _fwd(h2, w, labels, block_n, block_v):
@@ -103,21 +108,21 @@ def _fwd(h2, w, labels, block_n, block_v):
         in_specs=[
             pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
             pl.BlockSpec((block_v, hdim), lambda i, j: (j, _I0)),
-            pl.BlockSpec((1, block_n), lambda i, j: (i, _I0)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_n), lambda i, j: (i, _I0)),
-            pl.BlockSpec((1, block_n), lambda i, j: (i, _I0)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n // block_n, block_n), jnp.float32),
-            jax.ShapeDtypeStruct((n // block_n, block_n), jnp.float32),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
         ],
         scratch_shapes=[_vmem((block_n, 128)), _vmem((block_n, 128)),
                         _vmem((block_n, 128))],
         interpret=_interpret(),
-    )(h2, w, labels.reshape(n // block_n, block_n))
-    return loss.reshape(n), lse.reshape(n)
+    )(h2, w, labels)
+    return loss, lse
 
 
 # --------------------------------------------------------------- backward ----
@@ -134,9 +139,9 @@ def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dh_ref, dh_scr,
     w = w_ref[...]
     s = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-    lab = lab_ref[0]
-    lse = lse_ref[0]
-    g = g_ref[0]
+    lab = lab_ref[...]
+    lse = lse_ref[...]
+    g = g_ref[...]
     p = jnp.exp(s - lse[:, None])
     cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     dl = (p - (cols == lab[:, None])) * g[:, None]       # [bn, bv] f32
@@ -162,9 +167,9 @@ def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dw_ref, dw_scr,
     w = w_ref[...]
     s = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-    lab = lab_ref[0]
-    lse = lse_ref[0]
-    g = g_ref[0]
+    lab = lab_ref[...]
+    lse = lse_ref[...]
+    g = g_ref[...]
     p = jnp.exp(s - lse[:, None])
     cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     dl = (p - (cols == lab[:, None])) * g[:, None]
@@ -185,9 +190,7 @@ def _bwd(res, g, block_n, block_v):
     n, hdim = h2.shape
     v = w.shape[0]
     nb, vb = n // block_n, v // block_v
-    lab2 = labels.reshape(nb, block_n)
-    lse2 = lse.reshape(nb, block_n)
-    g2 = g.astype(jnp.float32).reshape(nb, block_n)
+    g32 = g.astype(jnp.float32)
 
     dh = pl.pallas_call(
         functools.partial(_dh_kernel, block_v=block_v, v_blocks=vb),
@@ -195,15 +198,15 @@ def _bwd(res, g, block_n, block_v):
         in_specs=[
             pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
             pl.BlockSpec((block_v, hdim), lambda i, j: (j, _I0)),
-            pl.BlockSpec((1, block_n), lambda i, j: (i, _I0)),
-            pl.BlockSpec((1, block_n), lambda i, j: (i, _I0)),
-            pl.BlockSpec((1, block_n), lambda i, j: (i, _I0)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
         ],
         out_specs=pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
         out_shape=jax.ShapeDtypeStruct((n, hdim), h2.dtype),
         scratch_shapes=[_vmem((block_n, hdim))],
         interpret=_interpret(),
-    )(h2, w, lab2, lse2, g2)
+    )(h2, w, labels, lse, g32)
 
     dw = pl.pallas_call(
         functools.partial(_dw_kernel, block_v=block_v, n_blocks=nb),
@@ -211,15 +214,15 @@ def _bwd(res, g, block_n, block_v):
         in_specs=[
             pl.BlockSpec((block_n, hdim), lambda j, i: (i, _I0)),
             pl.BlockSpec((block_v, hdim), lambda j, i: (j, _I0)),
-            pl.BlockSpec((1, block_n), lambda j, i: (i, _I0)),
-            pl.BlockSpec((1, block_n), lambda j, i: (i, _I0)),
-            pl.BlockSpec((1, block_n), lambda j, i: (i, _I0)),
+            pl.BlockSpec((block_n,), lambda j, i: (i,)),
+            pl.BlockSpec((block_n,), lambda j, i: (i,)),
+            pl.BlockSpec((block_n,), lambda j, i: (i,)),
         ],
         out_specs=pl.BlockSpec((block_v, hdim), lambda j, i: (j, _I0)),
         out_shape=jax.ShapeDtypeStruct((v, hdim), jnp.float32),
         scratch_shapes=[_vmem((block_v, hdim))],
         interpret=_interpret(),
-    )(h2, w, lab2, lse2, g2)
+    )(h2, w, labels, lse, g32)
     return dh, dw.astype(w_dtype)  # f32 scratch accumulation -> master dtype
 
 

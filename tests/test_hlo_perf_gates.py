@@ -1,0 +1,300 @@
+"""HLO-level performance regression gates, runnable without a TPU.
+
+VERDICT r2 #1a: perf must be *verifiable* on CPU even when the chip is away.
+Each test pins a compiler-level property that the on-chip numbers depend on:
+
+- the dp engine step emits ONE fused (variadic) gradient all-reduce, not one
+  per parameter (XLA AllReduceCombiner over the bucketed layout — the
+  reference's Reducer contract, `paddle/fluid/imperative/reducer.cc`);
+- the Pallas kernel flags actually route (pallas_call present in the jaxpr)
+  AND the kernels Mosaic-compile for the TPU target (jax.export platforms=
+  ["tpu"] embeds a tpu_custom_call) — this gate caught three real on-chip
+  compile bugs in round 3 that interpret-mode tests had masked;
+- recompute (remat) shrinks autodiff saved-residual bytes;
+- the chunked fused LM loss avoids materializing [N, V] logits (temp bytes);
+- buffer donation aliases the param+opt arguments (no double buffering).
+
+Thresholds are pinned from measured values; regressions fail loudly.
+"""
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+# ensure the kernel SUBMODULES are importable (the package __init__ re-exports
+# shadow same-named functions)
+import paddle_tpu.ops.pallas.flash_attention  # noqa: F401
+import paddle_tpu.ops.pallas.layer_norm  # noqa: F401
+import paddle_tpu.ops.pallas.lm_loss  # noqa: F401
+
+_FA = sys.modules["paddle_tpu.ops.pallas.flash_attention"]
+_LN = sys.modules["paddle_tpu.ops.pallas.layer_norm"]
+_LM = sys.modules["paddle_tpu.ops.pallas.lm_loss"]
+
+# matches real all-reduce OP definitions (the result type of a combined
+# gradient all-reduce is a tuple "(f32[..], ...)" which contains spaces, so
+# match on the op name token, not "= <type> all-reduce(")
+_ALL_REDUCE_OP = re.compile(r"^\s*%?all-reduce[.\d]*\s*=", re.MULTILINE)
+
+
+def _dp8_engine(n_linear=12):
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+    layers = []
+    for _ in range(n_linear):
+        layers += [paddle.nn.Linear(64, 64), paddle.nn.ReLU()]
+    net = paddle.nn.Sequential(*layers[:-1])
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    eng = fleet.distributed_engine(net, opt, loss_fn=paddle.nn.MSELoss())
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 64).astype("float32"))
+    y = jnp.asarray(np.random.RandomState(1).randn(16, 64).astype("float32"))
+    return eng, [x, y]
+
+
+def _compile_step(eng, arrays):
+    jf = eng._build(arrays)
+    return jf.lower(eng.params, eng.opt_state, jnp.float32(1e-3),
+                    jnp.int32(1), jax.random.key(0), *arrays).compile()
+
+
+def test_dp_allreduce_is_fused():
+    """24 params -> a handful of combined all-reduces, NOT one per param."""
+    eng, arrays = _dp8_engine(n_linear=12)
+    comp = _compile_step(eng, arrays)
+    n_ops = len(_ALL_REDUCE_OP.findall(comp.as_text()))
+    n_params = len(eng.params)
+    assert n_params == 24
+    assert 1 <= n_ops <= 4, (
+        f"{n_ops} all-reduce ops for {n_params} params — gradient all-reduce "
+        f"combining regressed (expected one variadic fused all-reduce)")
+
+
+def test_engine_donation_aliases_param_and_opt_buffers():
+    """donate_argnums must alias params+opt state: peak = 1x state, not 2x."""
+    eng, arrays = _dp8_engine(n_linear=4)
+    comp = _compile_step(eng, arrays)
+    ma = comp.memory_analysis()
+    state_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                      for a in eng.params.values())
+    state_bytes += sum(int(np.prod(s.shape)) * s.dtype.itemsize
+                       for st in eng.opt_state.values() for s in st)
+    # per-device view: arguments are replicated here (dp), so full size
+    assert ma.alias_size_in_bytes >= 0.9 * state_bytes, (
+        f"alias {ma.alias_size_in_bytes} < state {state_bytes}: buffer "
+        f"donation regressed — training would double-buffer params in HBM")
+
+
+def test_train_step_flops_accounting():
+    """cost_analysis flops of the fused step covers the 6*N*T analytic
+    minimum the MFU claim in bench.py is computed from."""
+    eng, arrays = _dp8_engine(n_linear=4)
+    comp = _compile_step(eng, arrays)
+    flops = comp.cost_analysis()["flops"]
+    n_params = sum(int(np.prod(a.shape)) for a in eng.params.values())
+    # cost_analysis is per-device; the batch dim is sharded over dp=8
+    tokens = arrays[0].shape[0] // 8
+    assert flops >= 0.5 * 6 * n_params * tokens, (
+        "compiled flops below the fwd+bwd analytic bound — the step is not "
+        "computing what the MFU accounting assumes")
+
+
+# ---------------------------------------------------------- pallas routing ----
+
+def _flash_jaxpr(seq=256):
+    from paddle_tpu.ops import nn_functional as F
+
+    def att(qd):
+        t = Tensor(qd)
+        return F.scaled_dot_product_attention(t, t, t)._data
+
+    q = jnp.zeros((2, seq, 4, 64), jnp.float32)
+    return str(jax.make_jaxpr(att)(q))
+
+
+def test_flash_attention_routes_to_pallas_when_flagged():
+    paddle.set_flags({"use_flash_attention": True, "pallas_interpret_ok": True})
+    assert "pallas_call" in _flash_jaxpr()
+    paddle.set_flags({"use_flash_attention": False})
+    assert "pallas_call" not in _flash_jaxpr()
+
+
+def test_layernorm_routes_to_pallas_when_flagged():
+    from paddle_tpu.ops import nn_functional as F
+
+    w = paddle.to_tensor(np.ones(256, "float32"))
+    b = paddle.to_tensor(np.zeros(256, "float32"))
+    x = jnp.zeros((64, 256), jnp.float32)
+
+    def trace():
+        # fresh function object per trace: jax's trace cache keys on the
+        # callable's identity, and the flag is a hidden trace-time input
+        def ln(xd):
+            return F.layer_norm(Tensor(xd), normalized_shape=[256],
+                                weight=w, bias=b)._data
+
+        return str(jax.make_jaxpr(ln)(x))
+
+    paddle.set_flags({"use_pallas_layernorm": True, "pallas_interpret_ok": True})
+    assert "pallas_call" in trace()
+    paddle.set_flags({"use_pallas_layernorm": False})
+    assert "pallas_call" not in trace()
+
+
+def test_lm_loss_routes_to_pallas_when_flagged():
+    from paddle_tpu.ops.fused import fused_linear_cross_entropy
+
+    h = paddle.to_tensor(np.zeros((512, 128), "float32"))
+    w = paddle.to_tensor(np.zeros((1024, 128), "float32"))
+    lab = paddle.to_tensor(np.zeros(512, "int64"))
+
+    def trace():
+        def f(hd):
+            return fused_linear_cross_entropy(
+                Tensor(hd), w, lab, transpose_y=True)._data
+
+        return str(jax.make_jaxpr(f)(h._data))
+
+    paddle.set_flags({"use_pallas_lm_loss": True, "pallas_interpret_ok": True})
+    assert "pallas_call" in trace()
+    paddle.set_flags({"use_pallas_lm_loss": False})
+    assert "pallas_call" not in trace()
+
+
+# ------------------------------------------------- Mosaic TPU compilation ----
+
+def _export_tpu(fn, *avals):
+    from jax import export
+
+    return export.export(jax.jit(fn), platforms=["tpu"])(*avals).mlir_module()
+
+
+@pytest.mark.slow
+def test_flash_attention_mosaic_compiles_for_tpu(monkeypatch):
+    """Lower fwd+bwd for the REAL TPU target (Mosaic) from the CPU host.
+
+    Interpret-mode tests verify numerics but not Mosaic legality; this caught
+    an f64 weak-literal cast in the masked-row fix that would have failed on
+    chip (flash_attention.py:_finalize)."""
+    monkeypatch.setattr(_FA, "_interpret", lambda: False)
+    paddle.set_flags({"use_flash_attention": True, "pallas_interpret_ok": True})
+    from paddle_tpu.ops import nn_functional as F
+
+    def att_loss(qd):
+        t = Tensor(qd)
+        return F.scaled_dot_product_attention(t, t, t, is_causal=True)._data.sum()
+
+    mod = _export_tpu(jax.grad(att_loss),
+                      jax.ShapeDtypeStruct((2, 256, 4, 64), jnp.float32))
+    assert "tpu_custom_call" in mod
+
+
+@pytest.mark.slow
+def test_lm_loss_mosaic_compiles_for_tpu(monkeypatch):
+    monkeypatch.setattr(_LM, "_interpret", lambda: False)
+    lab = jnp.zeros((1024,), jnp.int32)
+
+    def f(h, w):
+        return _LM.lm_head_cross_entropy(h, w, lab).mean()
+
+    mod = _export_tpu(jax.grad(f, argnums=(0, 1)),
+                      jax.ShapeDtypeStruct((1024, 128), jnp.float32),
+                      jax.ShapeDtypeStruct((8192, 128), jnp.float32))
+    assert "tpu_custom_call" in mod
+
+
+@pytest.mark.slow
+def test_layer_norm_mosaic_compiles_for_tpu(monkeypatch):
+    monkeypatch.setattr(_LN, "_interpret", lambda: False)
+
+    def f(x, g, b):
+        return _LN.layer_norm(x, g, b, eps=1e-5).sum()
+
+    mod = _export_tpu(jax.grad(f, argnums=(0, 1, 2)),
+                      jax.ShapeDtypeStruct((512, 256), jnp.float32),
+                      jax.ShapeDtypeStruct((256,), jnp.float32),
+                      jax.ShapeDtypeStruct((256,), jnp.float32))
+    assert "tpu_custom_call" in mod
+
+
+# -------------------------------------------------------- memory behavior ----
+
+def _gpt_loss_fn(use_recompute):
+    from paddle_tpu.jit import functional_call
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=4, num_heads=4,
+                    max_seq_len=256, use_recompute=use_recompute)
+    model = GPTForPretraining(cfg)
+    model.train()
+    state = model.state_dict(include_non_persistable_buffer=True)
+    arrays = {k: v._data for k, v in state.items()}
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 512, (4, 256)).astype(np.int64))
+    labels = jnp.asarray(np.roll(np.asarray(ids), -1, 1))
+
+    def f(params):
+        loss = functional_call(model, params, Tensor(ids), Tensor(labels))
+        return loss._data if isinstance(loss, Tensor) else loss
+
+    return f, arrays
+
+
+def _saved_residual_bytes(f, arrays):
+    from jax._src.ad_checkpoint import saved_residuals
+
+    res = saved_residuals(f, arrays)
+    return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+               for a, _ in res if hasattr(a, "shape"))
+
+
+def test_recompute_shrinks_saved_residuals():
+    """use_recompute=True (jax.checkpoint per block) must cut what autodiff
+    saves — the measured ratio is ~0.06; gate at 0.25 for headroom."""
+    f0, a0 = _gpt_loss_fn(False)
+    b_no = _saved_residual_bytes(f0, a0)
+    f1, a1 = _gpt_loss_fn(True)
+    b_yes = _saved_residual_bytes(f1, a1)
+    assert b_yes < 0.25 * b_no, (
+        f"remat saved-residuals {b_yes}B vs {b_no}B without — recompute no "
+        f"longer reduces activation memory")
+
+
+def test_fused_lm_loss_avoids_logits_materialization():
+    """Chunked fused CE must compile to far less temp memory than the naive
+    [N, V] logits path (measured 34 MB vs 134 MB at these shapes)."""
+    from paddle_tpu.ops import fused as fused_mod
+
+    rng = np.random.RandomState(0)
+    h = jnp.asarray(rng.randn(2048, 128).astype(np.float32))
+    w = jnp.asarray(rng.randn(8192, 128).astype(np.float32))
+    lab = jnp.asarray(rng.randint(0, 8192, 2048).astype(np.int32))
+
+    def fused(hh, ww):
+        return fused_mod._fused_lce(hh, ww, lab, True, 512, -100).mean()
+
+    def naive(hh, ww):
+        logits = hh @ ww.T
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, lab[:, None], axis=-1)[:, 0]
+        return (lse - picked).mean()
+
+    def temp_bytes(f):
+        comp = jax.jit(jax.value_and_grad(f, argnums=(0, 1))).lower(h, w).compile()
+        return comp.memory_analysis().temp_size_in_bytes
+
+    t_fused, t_naive = temp_bytes(fused), temp_bytes(naive)
+    assert t_fused < 0.5 * t_naive, (
+        f"fused CE temp {t_fused}B !< half of naive {t_naive}B — the chunked "
+        f"loss is materializing logits again")
