@@ -28,6 +28,14 @@ def define_flag(name: str, default, help_: str = ""):
 
 
 _on_change = []
+_explicitly_set: set = set()  # flags a user/test set via set_flags (vs defaults)
+
+
+def was_set(name: str) -> bool:
+    """True when the flag was explicitly assigned through set_flags — lets a
+    default-on flag (use_flash_attention) distinguish 'deliberately enabled'
+    from 'never touched' for test-only paths like interpret-mode routing."""
+    return name.removeprefix("FLAGS_") in _explicitly_set
 
 
 def on_change(callback):
@@ -44,6 +52,7 @@ def set_flags(flags: Dict[str, Any]):
             raise KeyError(f"unknown flag {k!r}; known: {sorted(_REGISTRY)}")
         changed = _REGISTRY[k] != v
         _REGISTRY[k] = v
+        _explicitly_set.add(k)
         if changed:
             for cb in _on_change:
                 cb(k)

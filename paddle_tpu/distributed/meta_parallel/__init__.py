@@ -12,4 +12,4 @@ from .sharding import (  # noqa: F401
     GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
     group_sharded_parallel,
 )
-from .moe import MoELayer  # noqa: F401
+from .moe import GShardGate, MoELayer, NaiveGate, SwitchGate  # noqa: F401

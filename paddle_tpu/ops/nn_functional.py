@@ -871,8 +871,14 @@ def _use_flash(q, k) -> bool:
     if not _flags.flag("use_flash_attention"):
         return False
     # Mosaic kernels on TPU; interpret mode only when explicitly allowed
-    # (tests + HLO perf gates), same gate as the layer_norm / lm_loss routes
-    if _jax.default_backend() != "tpu" and not _flags.flag("pallas_interpret_ok"):
+    # (tests + HLO perf gates). Unlike layer_norm/lm_loss, this route's flag
+    # defaults ON — so the CPU interpret path additionally requires that
+    # use_flash_attention was DELIBERATELY set, or enabling interpret_ok for
+    # another kernel would silently reroute all attention through the
+    # (orders-of-magnitude slower) interpreted kernel.
+    if _jax.default_backend() != "tpu" and not (
+            _flags.flag("pallas_interpret_ok")
+            and _flags.was_set("use_flash_attention")):
         return False
     from .pallas.flash_attention import supported
 

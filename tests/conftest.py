@@ -45,6 +45,9 @@ def _isolate_global_state():
     changed = {k: v for k, v in snap.items() if _flags._REGISTRY.get(k) != v}
     if changed:
         _flags.set_flags(changed)
+    # the restore itself must not count as "explicitly set" (flags.was_set)
+    _flags._explicitly_set.clear()
+    _flags._explicitly_set.update(_EXPLICIT_SNAPSHOT)
     _dtype._default_float_dtype = _dtype.float32
     paddle.seed(0)
     yield
@@ -63,8 +66,9 @@ def pytest_collection_modifyitems(config, items):
 def pytest_configure(config):
     from paddle_tpu.core import flags as _flags
 
-    global _FLAG_SNAPSHOT
+    global _FLAG_SNAPSHOT, _EXPLICIT_SNAPSHOT
     _FLAG_SNAPSHOT = dict(_flags._REGISTRY)
+    _EXPLICIT_SNAPSHOT = frozenset(_flags._explicitly_set)
     # fast subset for 1-core bench boxes (README "Testing"):
     #   python -m pytest tests -m "not slow" -q     (~ minutes)
     # full suite spawns subprocess clusters and e2e training runs (~20 min).
