@@ -12,8 +12,9 @@ from .env import (  # noqa: F401
     ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized,
 )
 from .collective import (  # noqa: F401
-    ReduceOp, all_gather, all_reduce, all_to_all, alltoall, barrier, broadcast,
-    irecv, isend, new_group, recv, reduce, reduce_scatter, scatter, send, wait,
+    P2POp, ReduceOp, all_gather, all_reduce, all_to_all, alltoall, barrier,
+    batch_isend_irecv, broadcast, irecv, isend, new_group, recv, reduce,
+    reduce_scatter, scatter, send, wait,
 )
 from .mesh import (  # noqa: F401
     CommGroup, HybridCommunicateGroup, build_mesh, get_hybrid_communicate_group,

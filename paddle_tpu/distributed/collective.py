@@ -17,6 +17,7 @@ reference's fast path when a group has one rank.
 """
 from __future__ import annotations
 
+import functools as _functools
 from typing import Optional
 
 import jax
@@ -277,24 +278,269 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 alltoall = all_to_all
 
 
+# ---- eager point-to-point (ProcessGroup::Send/Recv,
+# /root/reference/paddle/fluid/distributed/collective/ProcessGroup.h:104,110) ----
+#
+# TPU-native design: the payload moves DEVICE-to-device through a ppermute
+# program compiled over a 2-row submesh containing ONLY the two endpoints'
+# devices — uninvolved processes never participate (no world-sized barrier),
+# and on a TPU slice the permute rides ICI exactly like the reference's NCCL
+# send/recv rides NVLink. Only shape/dtype metadata goes through the
+# coordinator KV service (the TCPStore analogue), which is how recv
+# "negotiates" when its buffer is not preallocated. Per-(src,dst) sequence
+# numbers keep transfers matched; programs on the same endpoint pair must be
+# issued in the same order on both processes (SPMD launch-order rule — the
+# same constraint NCCL puts on a stream). For bidirectional/neighbor
+# exchange use batch_isend_irecv, which fuses all ops into ONE program.
+
+_p2p_seq = {}
+
+
+def _kv_client():
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client
+    except Exception:
+        return None
+
+
+def _p2p_pair_program(src: int, dst: int, shape, dtype_str: str):
+    """Compiled single-direction transfer over the {src, dst} pair submesh.
+
+    Cached per (pair, direction, shape, dtype): pipeline loops re-issuing
+    same-shape transfers must not pay a retrace per call."""
+    return _p2p_program_cached(src, dst, tuple(shape), dtype_str)
+
+
+@_functools.lru_cache(maxsize=256)
+def _p2p_program_cached(src, dst, shape, dtype_str):
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # one device per endpoint process (rank = process; a multi-chip host
+    # stages its payload on its first device — a local D2D move at most)
+    def first_dev(proc):
+        return min((d for d in jax.devices() if d.process_index == proc),
+                   key=lambda d: d.id)
+
+    mesh = jax.sharding.Mesh(np.array([first_dev(src), first_dev(dst)]),
+                             ("pair",))
+    sharding = NamedSharding(mesh, P("pair"))
+
+    def f(v):  # v: [1, *shape] — this endpoint's row; src=pair-index 0
+        moved = jax.lax.ppermute(v, "pair", [(0, 1)])
+        keep = jax.lax.axis_index("pair") == 1
+        return jnp.where(keep, moved, v)
+
+    fn = jax.jit(shard_map(f, mesh=mesh, in_specs=(P("pair"),),
+                           out_specs=P("pair"), check_vma=False))
+    return fn, mesh, sharding
+
+
+def _p2p_local_row(x, sharding):
+    """This process's [1, *shape] shard on its endpoint device, avoiding a
+    host round-trip when the payload is already a device array."""
+    dev = next(d for d in sharding.mesh.devices.flat
+               if d.process_index == jax.process_index())
+    row = jax.device_put(jnp.asarray(x)[None], jax.sharding.SingleDeviceSharding(dev))
+    return row
+
+
+def _p2p_transfer(x, src: int, dst: int):
+    """Run the pair program; returns this process's (post-transfer) row."""
+    fn, mesh, sharding = _p2p_pair_program(src, dst, x.shape, str(x.dtype))
+    row = _p2p_local_row(x, sharding)
+    glob = jax.make_array_from_single_device_arrays(
+        (2,) + tuple(x.shape), sharding, [row])
+    out = fn(glob)
+    shard = out.addressable_shards[0]
+    return jnp.asarray(shard.data)[0]
+
+
+def _p2p_rank_bounds(rank: int, other: int, op: str):
+    world = jax.process_count()
+    if world <= 1:
+        raise ValueError(
+            f"{op}: point-to-point needs a multi-process environment "
+            f"(init_parallel_env/launch); within one controller move data "
+            f"with reshard()/ppermute instead")
+    if not 0 <= other < world:
+        raise ValueError(f"{op}: peer rank {other} out of range [0, {world})")
+    if other == rank:
+        raise ValueError(f"{op}: peer rank {other} is this process")
+
+
+def _p2p_meta_key(src: int, dst: int, seq: int) -> str:
+    return f"paddle_tpu_p2p/{src}->{dst}/{seq}"
+
+
+def _p2p_get_meta(src: int, rank: int, seq: int, timeout_ms: int = 60_000):
+    """Blocking metadata fetch; returns None only when no coordinator KV
+    service exists. Timeouts and malformed values raise — silently skipping
+    negotiation converts shape mismatches into undebuggable hangs."""
+    client = _kv_client()
+    if client is None:
+        return None
+    raw = client.blocking_key_value_get(_p2p_meta_key(src, rank, seq),
+                                        timeout_ms)
+    shape_s, dtype_s = raw.split("|")
+    return tuple(int(s) for s in shape_s.split(",") if s), dtype_s
+
+
+class P2POp:
+    """Transfer handle (paddle isend/irecv contract). The SPMD program has
+    already synchronized both endpoints by construction, so wait() is a
+    no-op; the class also serves as the op descriptor for batch_isend_irecv
+    (op="isend"/"irecv")."""
+
+    def __init__(self, op, tensor=None, peer=None, group=None):
+        # descriptor form: P2POp(dist.isend | "isend", tensor, peer) — op is
+        # a string/callable, never a Tensor (Tensor.__eq__ is elementwise)
+        if isinstance(op, str) or callable(op):
+            self.op = getattr(op, "__name__", op)
+            self.tensor = tensor
+            self.peer = peer
+            self.group = group
+        else:  # completed-handle form: P2POp(result_tensor)
+            self.op = "done"
+            self.tensor = op
+
+    def wait(self):
+        return self.tensor
+
+    def is_completed(self):
+        return True
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "p2p send/recv map to ppermute inside pipeline schedules "
-        "(meta_parallel/pp_layers); standalone eager p2p lands with multi-controller")
+    rank = jax.process_index()
+    _p2p_rank_bounds(rank, dst, "send")
+    x = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    seq = _p2p_seq.get((rank, dst), 0) + 1
+    client = _kv_client()
+    if client is not None:
+        client.key_value_set(
+            _p2p_meta_key(rank, dst, seq),
+            f"{','.join(map(str, x.shape))}|{x.dtype}")
+    _p2p_seq[(rank, dst)] = seq  # committed: the transfer WILL be dispatched
+    _p2p_transfer(x, rank, dst)
+    return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "p2p send/recv map to ppermute inside pipeline schedules "
-        "(meta_parallel/pp_layers); standalone eager p2p lands with multi-controller")
+    rank = jax.process_index()
+    _p2p_rank_bounds(rank, src, "recv")
+    seq = _p2p_seq.get((src, rank), 0) + 1
+    meta = _p2p_get_meta(src, rank, seq)  # raises on timeout: seq NOT consumed,
+    #                                       a retried recv still matches the sender
+    if tensor is None:
+        if meta is None:
+            raise ValueError(
+                "recv: pass a preallocated tensor (metadata negotiation "
+                "needs the jax coordinator KV service)")
+        local = jnp.zeros(meta[0], dtype=meta[1])
+    else:
+        local = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+        if meta is not None and (tuple(local.shape) != meta[0]
+                                 or str(local.dtype) != meta[1]):
+            raise ValueError(
+                f"recv: buffer {tuple(local.shape)}/{local.dtype} does not "
+                f"match sent {meta[0]}/{meta[1]} (negotiated via coordinator)")
+    _p2p_seq[(src, rank)] = seq
+    got = _p2p_transfer(local, src, rank)
+    if isinstance(tensor, Tensor):
+        tensor._data = got
+        return tensor
+    return Tensor(got)
 
 
 def isend(tensor, dst=0, group=None):
-    return send(tensor, dst, group)
+    return P2POp(send(tensor, dst, group))
 
 
 def irecv(tensor, src=0, group=None):
-    return recv(tensor, src, group)
+    return P2POp(recv(tensor, src, group))
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Fuse a set of P2POp("isend"/"irecv") descriptors into ONE program —
+    the reference's batch_isend_irecv (communication/batch_isend_irecv.py)
+    and the only deadlock-free way to express bidirectional/neighbor
+    exchange: a single ppermute with the full pair list has no cross-program
+    ordering to get wrong. Every process whose rank appears as an endpoint
+    of ANY op must call this with the SAME op set (the reference requires
+    the same of its NCCL group calls).
+    """
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rank = jax.process_index()
+    sends = {}
+    recvs = {}
+    for op in p2p_op_list:
+        if op.op == "isend":
+            sends[(rank, op.peer)] = op
+        elif op.op == "irecv":
+            recvs[(op.peer, rank)] = op
+        else:
+            raise ValueError(f"batch_isend_irecv: bad op {op.op!r}")
+    if not sends and not recvs:
+        return []
+    # all endpoint ranks, ordered: every participant derives the SAME mesh
+    ranks = sorted({r for pair in (*sends, *recvs) for r in pair})
+    for pair in (*sends, *recvs):
+        _p2p_rank_bounds(rank, pair[1] if pair[0] == rank else pair[0],
+                         "batch_isend_irecv")
+    pos = {r: i for i, r in enumerate(ranks)}
+    # publish/fetch the global pair list via KV so perm is identical even
+    # when a rank only sees its own ops? No — contract: same op set given
+    # by every caller; derive perm locally from MY ops plus the implied
+    # mirror (my send (a,b) is b's recv (a,b)); both produce (pos[a],pos[b])
+    perm = sorted({(pos[a], pos[b]) for (a, b) in (*sends, *recvs)})
+    shapes = {}
+    for (a, b), op in {**sends, **recvs}.items():
+        x = op.tensor._data if isinstance(op.tensor, Tensor) else jnp.asarray(op.tensor)
+        shapes[(a, b)] = x
+    # one payload slot per DIRECTED pair, stacked: all tensors must share
+    # shape/dtype (pipeline neighbor exchange does; reference requires
+    # matching tensor lists too)
+    protos = list(shapes.values())
+    if any(p.shape != protos[0].shape or p.dtype != protos[0].dtype
+           for p in protos):
+        raise ValueError("batch_isend_irecv: all tensors must share one "
+                         "shape/dtype in a batch")
+
+    def first_dev(proc):
+        return min((d for d in jax.devices() if d.process_index == proc),
+                   key=lambda d: d.id)
+
+    mesh = jax.sharding.Mesh(np.array([first_dev(r) for r in ranks]), ("p",))
+    sharding = NamedSharding(mesh, P("p"))
+    # each rank contributes ONE row: its outgoing payload (or zeros)
+    my_send = next((x for (a, b), x in shapes.items() if a == rank
+                    and (a, b) in sends), None)
+    local = my_send if my_send is not None else jnp.zeros_like(protos[0])
+    row = jax.device_put(jnp.asarray(local)[None],
+                         jax.sharding.SingleDeviceSharding(first_dev(rank)))
+    glob = jax.make_array_from_single_device_arrays(
+        (len(ranks),) + tuple(protos[0].shape), sharding, [row])
+
+    def f(v):
+        return jax.lax.ppermute(v, "p", perm)
+
+    out = shard_map(f, mesh=mesh, in_specs=(P("p"),), out_specs=P("p"),
+                    check_vma=False)(glob)
+    my_row = jnp.asarray(out.addressable_shards[0].data)[0]
+    results = []
+    for op in p2p_op_list:
+        if op.op == "irecv":
+            if isinstance(op.tensor, Tensor):
+                op.tensor._data = my_row
+            results.append(P2POp(op.tensor))
+        else:
+            results.append(P2POp(op.tensor))
+    return results
 
 
 def barrier(group=None):
