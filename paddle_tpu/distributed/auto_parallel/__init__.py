@@ -17,6 +17,9 @@ from .process_mesh import ProcessMesh
 from .api import shard_tensor, shard_op, reshard
 from .resharder import Resharder, transfer_engine_state
 from .engine import Engine
+from .planner import (  # noqa: F401
+    PlanResult, collective_bytes, enumerate_topologies, plan, score_topology,
+)
 from .strategy import Strategy
 from .dist_saver import (  # noqa: F401
     Converter, load_distributed_checkpoint, load_distributed_state,

@@ -142,9 +142,79 @@ class Engine:
                        out_shardings=(scalar, param_sh, buf_sh, opt_sh),
                        donate_argnums=(0, 1, 2))
 
+    # ---- mesh-shape planning (reference planner.py dist-attr search) ----
+    def plan_mesh(self, sample_batch, dim_names=None, verbose: bool = False):
+        """Pick the mesh SHAPE by AOT cost: every factorization of the device
+        count over the annotation dim names is compiled (never executed) and
+        ranked on the planner's bandwidth-weighted proxy (planner.py —
+        reference auto_parallel planner.py + cost_model.py)."""
+        from .planner import factorizations, score_compiled
+
+        n = jax.device_count()
+        names = list(dim_names or (self._process_mesh.dim_names
+                                   if self._process_mesh else ["dp", "mp"]))
+        sample = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in (sample_batch if isinstance(sample_batch,
+                                                       (list, tuple))
+                            else [sample_batch])]
+        best, best_score, table = None, float("inf"), []
+        for shape in factorizations(n, len(names)):
+            pm = ProcessMesh(np.arange(n).reshape(shape).tolist(), names)
+            if sample and sample[0].ndim >= 1 and \
+                    sample[0].shape[0] % shape[0] != 0:
+                continue  # batch not divisible over the data axis
+            try:
+                self._process_mesh = pm
+                self._prepared = False
+                self.prepare()
+                step = self._build(train=True)
+                arrays = [jax.device_put(
+                    a, NamedSharding(self.mesh, self._data_spec(a.ndim)))
+                    for a in sample]
+                comp = step.lower(self.params, self.buffers, self.opt_state,
+                                  jnp.float32(1e-3), jnp.int32(1),
+                                  jax.random.key(0), *arrays).compile()
+                m = score_compiled(comp)
+            except Exception as e:
+                table.append({"shape": shape, "error": f"{type(e).__name__}"})
+                continue
+            table.append({"shape": shape, **{k: m[k] for k in
+                                             ("score", "hbm_bytes",
+                                              "ici_bytes", "peak_bytes")}})
+            if verbose:
+                print(f"  mesh {dict(zip(names, shape))}: "
+                      f"score={m['score']:.3e} peak={m['peak_bytes']}")
+            if m["score"] < best_score:
+                best, best_score = pm, m["score"]
+        if best is None:
+            raise RuntimeError(f"plan_mesh: no feasible mesh shape: {table}")
+        self._process_mesh = best
+        self._prepared = False
+        self._step_fn = None
+        self.prepare()
+        self.plan_table = table
+        return best
+
     # ---- public API (reference engine.py fit/evaluate/predict) ----
     def fit(self, train_data, epochs: int = 1, batch_size: int = 1,
-            steps_per_epoch: Optional[int] = None, verbose: int = 0):
+            steps_per_epoch: Optional[int] = None, verbose: int = 0,
+            auto: bool = False):
+        if auto and not self._prepared:
+            from ...io import DataLoader, Dataset
+
+            probe = train_data
+            if isinstance(train_data, Dataset):
+                probe = DataLoader(train_data, batch_size=batch_size,
+                                   drop_last=len(train_data) >= batch_size)
+            elif iter(probe) is probe:
+                raise ValueError(
+                    "fit(auto=True) needs a re-iterable data source to "
+                    "probe one batch for planning — pass a Dataset (or "
+                    "call plan_mesh(sample_batch) yourself) instead of a "
+                    "one-shot generator")
+            first = next(iter(probe))
+            first = first if isinstance(first, (list, tuple)) else [first]
+            self.plan_mesh(list(first), verbose=bool(verbose))
         if not self._prepared:
             self.prepare()
         from ...io import DataLoader, Dataset

@@ -463,49 +463,68 @@ def irecv(tensor, src=0, group=None):
     return P2POp(recv(tensor, src, group))
 
 
+_p2p_batch_counter = [0]
+
+
 def batch_isend_irecv(p2p_op_list):
-    """Fuse a set of P2POp("isend"/"irecv") descriptors into ONE program —
-    the reference's batch_isend_irecv (communication/batch_isend_irecv.py)
-    and the only deadlock-free way to express bidirectional/neighbor
-    exchange: a single ppermute with the full pair list has no cross-program
-    ordering to get wrong. Every process whose rank appears as an endpoint
-    of ANY op must call this with the SAME op set (the reference requires
-    the same of its NCCL group calls).
+    """Fuse P2POp("isend"/"irecv") descriptors into ONE world collective —
+    the reference's batch_isend_irecv (communication/batch_isend_irecv.py).
+
+    Contract (matches the reference's NCCL-group requirement): EVERY process
+    in the job calls this at the same point, with its own (possibly empty)
+    op list. Each rank publishes its send pairs through the coordinator KV
+    service; the union forms one ppermute over a world mesh, so asymmetric
+    neighbor topologies (pipeline lines) compile the SAME program on every
+    process — per-pair local derivations cannot deadlock-by-disagreement.
+    Limits: at most one isend and one irecv per rank per batch (one mesh
+    row each way), all tensors one shape/dtype.
     """
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     rank = jax.process_index()
-    sends = {}
-    recvs = {}
-    for op in p2p_op_list:
-        if op.op == "isend":
-            sends[(rank, op.peer)] = op
-        elif op.op == "irecv":
-            recvs[(op.peer, rank)] = op
-        else:
-            raise ValueError(f"batch_isend_irecv: bad op {op.op!r}")
-    if not sends and not recvs:
-        return []
-    # all endpoint ranks, ordered: every participant derives the SAME mesh
-    ranks = sorted({r for pair in (*sends, *recvs) for r in pair})
-    for pair in (*sends, *recvs):
-        _p2p_rank_bounds(rank, pair[1] if pair[0] == rank else pair[0],
-                         "batch_isend_irecv")
-    pos = {r: i for i, r in enumerate(ranks)}
-    # publish/fetch the global pair list via KV so perm is identical even
-    # when a rank only sees its own ops? No — contract: same op set given
-    # by every caller; derive perm locally from MY ops plus the implied
-    # mirror (my send (a,b) is b's recv (a,b)); both produce (pos[a],pos[b])
-    perm = sorted({(pos[a], pos[b]) for (a, b) in (*sends, *recvs)})
-    shapes = {}
-    for (a, b), op in {**sends, **recvs}.items():
-        x = op.tensor._data if isinstance(op.tensor, Tensor) else jnp.asarray(op.tensor)
-        shapes[(a, b)] = x
-    # one payload slot per DIRECTED pair, stacked: all tensors must share
-    # shape/dtype (pipeline neighbor exchange does; reference requires
-    # matching tensor lists too)
-    protos = list(shapes.values())
+    world = jax.process_count()
+    if world <= 1:
+        raise ValueError("batch_isend_irecv: needs a multi-process "
+                         "environment (init_parallel_env/launch)")
+    sends = [op for op in p2p_op_list if op.op == "isend"]
+    recvs = [op for op in p2p_op_list if op.op == "irecv"]
+    if len(sends) + len(recvs) != len(p2p_op_list):
+        bad = [op.op for op in p2p_op_list
+               if op.op not in ("isend", "irecv")]
+        raise ValueError(f"batch_isend_irecv: bad op(s) {bad!r}")
+    if len(sends) > 1 or len(recvs) > 1:
+        raise ValueError(
+            "batch_isend_irecv: at most one isend and one irecv per rank "
+            "per batch (one ppermute row each way); split into several "
+            "batches for multi-peer fan-out")
+    for op in sends + recvs:
+        _p2p_rank_bounds(rank, op.peer, "batch_isend_irecv")
+
+    client = _kv_client()
+    if client is None:
+        raise RuntimeError(
+            "batch_isend_irecv: the jax coordinator KV service is required "
+            "to agree on the global pair list")
+    # every process calls every batch, so a local counter is globally
+    # consistent — it names this batch's KV namespace
+    _p2p_batch_counter[0] += 1
+    bidx = _p2p_batch_counter[0]
+    my_pair = f"{rank}->{sends[0].peer}" if sends else ""
+    client.key_value_set(f"paddle_tpu_p2p_batch/{bidx}/{rank}", my_pair)
+    perm = set()
+    for r in range(world):
+        raw = client.blocking_key_value_get(
+            f"paddle_tpu_p2p_batch/{bidx}/{r}", 60_000)
+        if raw:
+            a, b = raw.split("->")
+            perm.add((int(a), int(b)))
+    perm = sorted(perm)
+
+    # payload prototype: my tensors, else negotiated from any sender's
+    # metadata (all tensors in a batch share shape/dtype)
+    protos = [op.tensor._data if isinstance(op.tensor, Tensor)
+              else jnp.asarray(op.tensor) for op in sends + recvs]
     if any(p.shape != protos[0].shape or p.dtype != protos[0].dtype
            for p in protos):
         raise ValueError("batch_isend_irecv: all tensors must share one "
@@ -515,16 +534,45 @@ def batch_isend_irecv(p2p_op_list):
         return min((d for d in jax.devices() if d.process_index == proc),
                    key=lambda d: d.id)
 
-    mesh = jax.sharding.Mesh(np.array([first_dev(r) for r in ranks]), ("p",))
+    mesh = jax.sharding.Mesh(np.array([first_dev(r) for r in range(world)]),
+                             ("p",))
     sharding = NamedSharding(mesh, P("p"))
-    # each rank contributes ONE row: its outgoing payload (or zeros)
-    my_send = next((x for (a, b), x in shapes.items() if a == rank
-                    and (a, b) in sends), None)
-    local = my_send if my_send is not None else jnp.zeros_like(protos[0])
+    if protos:
+        shape, dtype = tuple(protos[0].shape), protos[0].dtype
+    else:  # pure bystander: learn the payload shape from any sender
+        if not perm:
+            return []
+        src0 = perm[0][0]
+        seqs = client.blocking_key_value_get(
+            f"paddle_tpu_p2p_batch_meta/{bidx}/{src0}", 60_000)
+        shape_s, dtype_s = seqs.split("|")
+        shape = tuple(int(s) for s in shape_s.split(",") if s)
+        dtype = dtype_s
+    if sends:
+        client.key_value_set(
+            f"paddle_tpu_p2p_batch_meta/{bidx}/{rank}",
+            f"{','.join(map(str, protos[0].shape))}|{protos[0].dtype}")
+    if recvs:
+        if (recvs[0].peer, rank) not in perm:
+            raise ValueError(
+                f"batch_isend_irecv: irecv from {recvs[0].peer} has no "
+                f"matching isend in this batch (pairs: {perm})")
+        raw = client.blocking_key_value_get(
+            f"paddle_tpu_p2p_batch_meta/{bidx}/{recvs[0].peer}", 60_000)
+        shape_s, dtype_s = raw.split("|")
+        sent = (tuple(int(s) for s in shape_s.split(",") if s), dtype_s)
+        if tuple(shape) != sent[0] or str(dtype) != sent[1]:
+            raise ValueError(
+                f"batch_isend_irecv: recv buffer {tuple(shape)}/{dtype} "
+                f"does not match sent {sent[0]}/{sent[1]}")
+    local = (sends[0].tensor._data if sends and isinstance(sends[0].tensor,
+                                                           Tensor)
+             else jnp.asarray(sends[0].tensor) if sends
+             else jnp.zeros(shape, dtype))
     row = jax.device_put(jnp.asarray(local)[None],
                          jax.sharding.SingleDeviceSharding(first_dev(rank)))
     glob = jax.make_array_from_single_device_arrays(
-        (len(ranks),) + tuple(protos[0].shape), sharding, [row])
+        (world,) + tuple(shape), sharding, [row])
 
     def f(v):
         return jax.lax.ppermute(v, "p", perm)
@@ -537,7 +585,9 @@ def batch_isend_irecv(p2p_op_list):
         if op.op == "irecv":
             if isinstance(op.tensor, Tensor):
                 op.tensor._data = my_row
-            results.append(P2POp(op.tensor))
+                results.append(P2POp(op.tensor))
+            else:  # raw-array buffer: hand back the received Tensor
+                results.append(P2POp(Tensor(my_row)))
         else:
             results.append(P2POp(op.tensor))
     return results

@@ -130,13 +130,44 @@ class Fleet:
         self._applied_meta_list = applied
         return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
 
-    def distributed_engine(self, model, optimizer, loss_fn=None, **kw):
-        """TPU-native: build the fused pjit train step for this fleet config."""
+    def distributed_engine(self, model, optimizer, loss_fn=None,
+                           auto=False, sample_batch=None, **kw):
+        """TPU-native: build the fused pjit train step for this fleet config.
+
+        auto=True (+ sample_batch): ignore the configured topology and let
+        the planner (auto_parallel/planner.py — the reference planner.py/
+        cost_model.py analogue) pick the cheapest feasible hybrid config by
+        AOT-compiling candidates; this fleet is re-initialized on the winner.
+        """
         from ..engine import TrainStepEngine
 
         inner = optimizer
         while hasattr(inner, "_inner_opt"):  # unwrap hybrid + meta chain
             inner = inner._inner_opt
+        if auto:
+            if sample_batch is None:
+                raise ValueError(
+                    "distributed_engine(auto=True) needs sample_batch= to "
+                    "compile candidate topologies against")
+            from ..auto_parallel.planner import plan
+
+            opt_cls, opt_kw = type(inner), dict(
+                learning_rate=inner.get_lr(),
+                parameters=model.parameters())
+            best, results = plan(
+                lambda: model,  # compile-only: the model is never executed
+                lambda m: opt_cls(**opt_kw),
+                sample_batch, loss_fn=loss_fn)
+            strategy = DistributedStrategy()  # fresh: hybrid_configs merge
+            strategy.hybrid_configs = dict(best)
+            if best.get("sharding_degree", 1) > 1:
+                strategy.sharding = True
+            from ..mesh import set_hybrid_communicate_group
+
+            set_hybrid_communicate_group(None)
+            self._is_initialized = False
+            self.init(is_collective=True, strategy=strategy)
+            self.plan_results = results
         return TrainStepEngine(model, inner, loss_fn=loss_fn, hcg=self._hcg,
                                strategy=self._strategy, **kw)
 

@@ -158,3 +158,47 @@ def test_batch_isend_irecv_bidirectional(tmp_path):
     logs = _launch(tmp_path, _SCRIPT_BATCH, 2)
     assert "EXCHANGE ok" in logs[0]
     assert "EXCHANGE ok" in logs[1]
+
+
+_SCRIPT_LINE = """
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 3}
+    fleet.init(is_collective=True, strategy=strategy)
+    rank = dist.get_rank()
+
+    # asymmetric pipeline line 0 -> 1 -> 2: every rank sees a DIFFERENT op
+    # set; the fused batch must still compile one identical world program
+    mine = np.full((2, 2), float(rank + 10), np.float32)
+    ops = []
+    buf = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    if rank == 0:
+        ops = [dist.P2POp("isend", paddle.to_tensor(mine), peer=1)]
+    elif rank == 1:
+        ops = [dist.P2POp("irecv", buf, peer=0),
+               dist.P2POp("isend", paddle.to_tensor(mine), peer=2)]
+    else:
+        ops = [dist.P2POp("irecv", buf, peer=1)]
+    dist.batch_isend_irecv(ops)
+    if rank == 1:
+        assert np.allclose(buf.numpy(), 10.0), buf.numpy()
+    if rank == 2:
+        assert np.allclose(buf.numpy(), 11.0), buf.numpy()
+    print("RANK", rank, "LINE ok", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_batch_isend_irecv_pipeline_line(tmp_path):
+    """3-rank line topology (rank op sets all differ) — the case per-pair
+    program derivation deadlocks on."""
+    logs = _launch(tmp_path, _SCRIPT_LINE, 3)
+    for r in range(3):
+        assert "LINE ok" in logs[r], logs[r]
