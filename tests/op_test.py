@@ -60,6 +60,13 @@ def _compare(out, expect, rtol, atol, name=""):
     got = out.numpy() if isinstance(out, Tensor) else np.asarray(out)
     expect = np.asarray(expect)
     assert got.shape == expect.shape, f"{name}: shape {got.shape} vs {expect.shape}"
+    if np.iscomplexobj(got) or np.iscomplexobj(expect):
+        # keep complex: casting to float64 would silently drop the imaginary
+        # part and make e.g. a conj check vacuous
+        np.testing.assert_allclose(got.astype(np.complex128),
+                                   expect.astype(np.complex128),
+                                   rtol=rtol, atol=atol, err_msg=f"op {name}")
+        return
     np.testing.assert_allclose(got.astype(np.float64), expect.astype(np.float64),
                                rtol=rtol, atol=atol, err_msg=f"op {name}")
 

@@ -48,3 +48,35 @@ def test_every_api_is_referenced_by_some_test():
             untested.append(f"{name}->{path}")
     assert untested == [], (
         f"{len(untested)} APIs with no test call-site: {untested}")
+
+
+def test_numeric_coverage_partition_is_total():
+    """VERDICT r2 #5: every implemented forward API is either NUMERICALLY
+    exercised (check_output/check_grad or statistical/structural check) by
+    the test file the manifest points at, or carries an explicit waiver."""
+    import numeric_coverage as nc
+
+    rep = audit()
+    impl = set(rep["implemented"])
+    covered = set(nc.COVERED)
+    waived = set(nc.NUMERIC_WAIVERS)
+    assert not (covered & waived), sorted(covered & waived)
+    # audit() computes the partition — assert its verdict, don't re-derive
+    assert rep["numeric_untested"] == [], (
+        f"{len(rep['numeric_untested'])} ops numerically untested and "
+        f"unwaived: {rep['numeric_untested']}")
+    stale = covered - impl
+    assert stale == set(), f"manifest entries for unknown ops: {sorted(stale)}"
+    for name, reason in nc.NUMERIC_WAIVERS.items():
+        assert reason and len(reason) > 10, f"numeric waiver {name}: no reason"
+    # pointers must be real: the file exists and names the op (by api name
+    # or its public leaf) somewhere — keeps the manifest honest
+    for name, fn in nc.COVERED.items():
+        path = os.path.join(TESTS_DIR, fn)
+        assert os.path.exists(path), f"{name}: {fn} does not exist"
+        with open(path) as f:
+            txt = f.read()
+        leaf = rep["implemented"][name].split(".")[-1]
+        assert any(re.search(r"\b" + re.escape(c) + r"\b", txt)
+                   for c in {name, leaf}), (
+            f"{name}: neither '{name}' nor '{leaf}' appears in {fn}")
