@@ -84,6 +84,8 @@ def _emit_eqn(g: _Graph, eqn):
         out(g.emit(_BINARY[prim], ins))
     elif prim == "rsqrt":
         out(g.emit("Reciprocal", [g.emit("Sqrt", [ins[0]])]))
+    elif prim == "square":
+        out(g.emit("Mul", [ins[0], ins[0]]))
     elif prim == "is_finite":
         # finite = not (isinf or isnan); IsInf alone has wrong NaN semantics
         isinf = g.emit("IsInf", [ins[0]])
@@ -132,6 +134,24 @@ def _emit_eqn(g: _Graph, eqn):
         out(g.emit("Expand", [mid, ex]))
     elif prim == "concatenate":
         out(g.emit("Concat", ins, axis=int(params["dimension"])))
+    elif prim == "iota":
+        # static shapes at export: bake the index ramp as an initializer
+        shape = [int(s) for s in params["shape"]]
+        dim = int(params["dimension"])
+        ramp = np.arange(shape[dim], dtype=str(params["dtype"]))
+        bshape = [1] * len(shape)
+        bshape[dim] = shape[dim]
+        out(g.const(np.broadcast_to(ramp.reshape(bshape), shape), "iota"))
+    elif prim == "rev":
+        # lax.rev (kernel flip in transposed conv) -> Slice with step -1
+        dims = [int(d) for d in params["dimensions"]]
+        shape = eqn.invars[0].aval.shape
+        args = [ins[0]] + [g.const(np.asarray(a, np.int64), h) for a, h in [
+            ([-1] * len(dims), "starts"),
+            ([-(int(shape[d]) + 1) for d in dims], "ends"),
+            (dims, "axes"),
+            ([-1] * len(dims), "steps")]]
+        out(g.emit("Slice", args))
     elif prim == "slice":
         starts, limits = params["start_indices"], params["limit_indices"]
         strides = params["strides"] or [1] * len(starts)
@@ -179,21 +199,66 @@ def _emit_eqn(g: _Graph, eqn):
 def _emit_dot_general(g, eqn, ins):
     (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
     lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
-    if len(lc) != 1 or len(rc) != 1:
-        raise NotImplementedError("onnx export: multi-dim dot_general")
     lname, rname = ins
-    # ONNX MatMul = numpy matmul: contracts lhs[-1] with rhs[-2] (rhs[0] if 2D)
-    if tuple(lc) != (lhs.ndim - 1,):
-        raise NotImplementedError("onnx export: lhs contraction not innermost")
-    if tuple(lb) != tuple(range(len(lb))) or tuple(rb) != tuple(range(len(rb))):
-        raise NotImplementedError("onnx export: non-leading batch dims")
-    expected_rc = 0 if rhs.ndim == 2 else rhs.ndim - 2
-    if rc[0] != expected_rc:
+    # fast path: numpy-matmul-shaped contractions emit one MatMul
+    if (len(lc) == 1 and len(rc) == 1
+            and tuple(lc) == (lhs.ndim - 1,)
+            and tuple(lb) == tuple(range(len(lb)))
+            and tuple(rb) == tuple(range(len(rb)))):
+        expected_rc = 0 if rhs.ndim == 2 else rhs.ndim - 2
+        if rc[0] == expected_rc:
+            return g.emit("MatMul", [lname, rname])
         if rhs.ndim == 2:  # weight stored [out, in]: transpose once
             rname = g.emit("Transpose", [rname], perm=[1, 0])
-        else:
-            raise NotImplementedError("onnx export: rhs contraction layout")
-    return g.emit("MatMul", [lname, rname])
+            return g.emit("MatMul", [lname, rname])
+    # general case: canonicalize to ONE batched MatMul —
+    # transpose to (batch, free, contract) x (batch, contract, free),
+    # flatten each group, contract, reshape to jax's output layout
+    # (batch..., lhs_free..., rhs_free...)
+    l_free = [d for d in range(lhs.ndim) if d not in lb and d not in lc]
+    r_free = [d for d in range(rhs.ndim) if d not in rb and d not in rc]
+    bshape = [int(lhs.shape[d]) for d in lb]
+    mshape = [int(lhs.shape[d]) for d in l_free]
+    kshape = [int(lhs.shape[d]) for d in lc]
+    nshape = [int(rhs.shape[d]) for d in r_free]
+    B = int(np.prod(bshape)) if bshape else 1
+    M, K, N = (int(np.prod(s)) if s else 1 for s in (mshape, kshape, nshape))
+
+    lt = g.emit("Transpose", [lname], perm=[int(d) for d in
+                                            (*lb, *l_free, *lc)])
+    rt = g.emit("Transpose", [rname], perm=[int(d) for d in
+                                            (*rb, *rc, *r_free)])
+    l2 = g.emit("Reshape", [lt, g.const(np.asarray([B, M, K], np.int64),
+                                        "shape")])
+    r2 = g.emit("Reshape", [rt, g.const(np.asarray([B, K, N], np.int64),
+                                        "shape")])
+    mm = g.emit("MatMul", [l2, r2])
+    out_shape = bshape + mshape + nshape
+    return g.emit("Reshape", [mm, g.const(np.asarray(out_shape, np.int64),
+                                          "shape")])
+
+
+def _zero_interleave(g, name, shape, axis, d, dtype):
+    """Insert d-1 zeros between elements along `axis` (static shapes):
+    [.., H, ..] -> [.., (H-1)*d+1, ..]. This is lax's lhs_dilation (the
+    fractional stride of a transposed conv) expressed in plain ONNX ops."""
+    H = shape[axis]
+    un_shape = list(shape[:axis + 1]) + [1] + list(shape[axis + 1:])
+    x = g.emit("Reshape", [name, g.const(np.asarray(un_shape, np.int64),
+                                         "shape")])
+    z_shape = list(shape[:axis + 1]) + [d - 1] + list(shape[axis + 1:])
+    zeros = g.const(np.zeros(z_shape, dtype), "zeros")
+    x = g.emit("Concat", [x, zeros], axis=axis + 1)
+    full = list(shape)
+    full[axis] = H * d
+    x = g.emit("Reshape", [x, g.const(np.asarray(full, np.int64), "shape")])
+    starts = g.const(np.asarray([0], np.int64), "starts")
+    ends = g.const(np.asarray([H * d - (d - 1)], np.int64), "ends")
+    axes = g.const(np.asarray([axis], np.int64), "axes")
+    steps = g.const(np.asarray([1], np.int64), "steps")
+    x = g.emit("Slice", [x, starts, ends, axes, steps])
+    full[axis] = (H - 1) * d + 1
+    return x, full
 
 
 def _emit_conv(g, eqn, ins):
@@ -205,14 +270,46 @@ def _emit_conv(g, eqn, ins):
     iota = tuple(range(2 + nd))
     if tuple(spec[0]) != iota or tuple(spec[1]) != iota or tuple(spec[2]) != iota:
         raise NotImplementedError("onnx export: conv layout != NCHW/OIHW")
+    lname = ins[0]
     if any(d != 1 for d in p["lhs_dilation"]):
-        raise NotImplementedError("onnx export: transposed conv")
-    pads = [pad[0] for pad in p["padding"]] + [pad[1] for pad in p["padding"]]
+        # transposed conv: lax lowers it as a fractionally-strided conv
+        # (lhs_dilation = stride). Decompose generically — zero-interleave
+        # the input per spatial axis, then a plain Conv — instead of
+        # pattern-matching our own lowering onto ConvTranspose.
+        shape = [int(s) for s in eqn.invars[0].aval.shape]
+        dtype = str(eqn.invars[0].aval.dtype)
+        for i, d in enumerate(p["lhs_dilation"]):
+            if d != 1:
+                lname, shape = _zero_interleave(g, lname, shape, 2 + i,
+                                                int(d), dtype)
+    padding = [(int(lo), int(hi)) for lo, hi in p["padding"]]
+    if any(lo < 0 or hi < 0 for lo, hi in padding):
+        # XLA allows negative conv padding (a crop — Conv2DTranspose with
+        # padding > k-1 lowers this way); ONNX Conv does not. Crop with a
+        # Slice first, then clamp the pads to >= 0.
+        shape = [int(s) for s in eqn.invars[0].aval.shape]
+        if any(d != 1 for d in p["lhs_dilation"]):
+            for i, d in enumerate(p["lhs_dilation"]):  # post-interleave size
+                if d != 1:
+                    shape[2 + i] = (shape[2 + i] - 1) * int(d) + 1
+        starts, ends, axes = [], [], []
+        for i, (lo, hi) in enumerate(padding):
+            if lo < 0 or hi < 0:
+                ax = 2 + i
+                starts.append(max(0, -lo))
+                ends.append(shape[ax] - max(0, -hi))
+                axes.append(ax)
+        args = [lname] + [g.const(np.asarray(a, np.int64), h) for a, h in [
+            (starts, "starts"), (ends, "ends"), (axes, "axes"),
+            ([1] * len(axes), "steps")]]
+        lname = g.emit("Slice", args)
+        padding = [(max(0, lo), max(0, hi)) for lo, hi in padding]
+    pads = [lo for lo, _ in padding] + [hi for _, hi in padding]
     return g.emit(
-        "Conv", ins,
+        "Conv", [lname] + ins[1:],
         strides=[int(s) for s in p["window_strides"]],
         dilations=[int(d) for d in p["rhs_dilation"]],
-        pads=[int(x) for x in pads],
+        pads=pads,
         group=int(p["feature_group_count"]))
 
 
@@ -223,9 +320,13 @@ def _emit_pool(g, eqn, ins, kind):
     padding = p["padding"]
     if len(window) < 3 or window[0] != 1 or window[1] != 1:
         raise NotImplementedError("onnx export: pool window not NCHW-spatial")
-    if any(d != 1 for d in p.get("window_dilation", [1])) or \
-            any(d != 1 for d in p.get("base_dilation", [1])):
-        raise NotImplementedError("onnx export: dilated pooling")
+    if any(d != 1 for d in p.get("base_dilation", [1])):
+        raise NotImplementedError("onnx export: base-dilated pooling")
+    dil = [int(d) for d in p.get("window_dilation", [1] * len(window))][2:]
+    if any(d != 1 for d in dil) and kind != "MaxPool":
+        # ONNX AveragePool only grows dilations at opset 19; MaxPool has
+        # them since 10 (our opset is 13)
+        raise NotImplementedError("onnx export: dilated sum/avg pooling")
     spatial = len(window) - 2
     kernel = [int(w) for w in window[2:]]
     pads = [int(pad[0]) for pad in padding[2:]] + \
@@ -233,6 +334,8 @@ def _emit_pool(g, eqn, ins, kind):
     attrs = dict(kernel_shape=kernel, strides=[int(s) for s in strides[2:]],
                  pads=pads)
     if kind == "MaxPool":
+        if any(d != 1 for d in dil):
+            attrs["dilations"] = dil
         return g.emit("MaxPool", ins, **attrs)
     # reduce_window_sum -> AveragePool(count_include_pad=1) * window_size
     avg = g.emit("AveragePool", ins, count_include_pad=1, **attrs)

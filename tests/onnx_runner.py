@@ -143,20 +143,22 @@ def _conv(x, w, strides, pads, dilations, group):
     return out
 
 
-def _pool(x, kernel, strides, pads, mode):
+def _pool(x, kernel, strides, pads, mode, dilations=(1, 1)):
     n, c, h, w = x.shape
     kh, kw = kernel
+    dh, dw = dilations
     ph0, pw0, ph1, pw1 = pads
     fill = -np.inf if mode == "max" else 0.0
     xp = np.pad(x, [(0, 0), (0, 0), (ph0, ph1), (pw0, pw1)],
                 constant_values=fill)
-    oh = (xp.shape[2] - kh) // strides[0] + 1
-    ow = (xp.shape[3] - kw) // strides[1] + 1
+    eh, ew = dh * (kh - 1) + 1, dw * (kw - 1) + 1  # effective window extent
+    oh = (xp.shape[2] - eh) // strides[0] + 1
+    ow = (xp.shape[3] - ew) // strides[1] + 1
     out = np.zeros((n, c, oh, ow), x.dtype)
     for i in range(oh):
         for j in range(ow):
-            win = xp[:, :, i * strides[0]:i * strides[0] + kh,
-                     j * strides[1]:j * strides[1] + kw]
+            win = xp[:, :, i * strides[0]:i * strides[0] + eh:dh,
+                     j * strides[1]:j * strides[1] + ew:dw]
             out[:, :, i, j] = win.max((2, 3)) if mode == "max" \
                 else win.mean((2, 3))
     return out
@@ -271,7 +273,7 @@ def run_model(path, feeds):
                 r = r + ins[2].reshape(1, -1, 1, 1)
         elif op == "MaxPool":
             r = _pool(ins[0], a["kernel_shape"], a["strides"], a["pads"],
-                      "max")
+                      "max", tuple(a.get("dilations", (1, 1))))
         elif op == "AveragePool":
             r = _pool(ins[0], a["kernel_shape"], a["strides"], a["pads"],
                       "avg")
