@@ -271,12 +271,12 @@ def _emit_conv(g, eqn, ins):
     if tuple(spec[0]) != iota or tuple(spec[1]) != iota or tuple(spec[2]) != iota:
         raise NotImplementedError("onnx export: conv layout != NCHW/OIHW")
     lname = ins[0]
+    shape = [int(s) for s in eqn.invars[0].aval.shape]
     if any(d != 1 for d in p["lhs_dilation"]):
         # transposed conv: lax lowers it as a fractionally-strided conv
         # (lhs_dilation = stride). Decompose generically — zero-interleave
         # the input per spatial axis, then a plain Conv — instead of
         # pattern-matching our own lowering onto ConvTranspose.
-        shape = [int(s) for s in eqn.invars[0].aval.shape]
         dtype = str(eqn.invars[0].aval.dtype)
         for i, d in enumerate(p["lhs_dilation"]):
             if d != 1:
@@ -286,12 +286,8 @@ def _emit_conv(g, eqn, ins):
     if any(lo < 0 or hi < 0 for lo, hi in padding):
         # XLA allows negative conv padding (a crop — Conv2DTranspose with
         # padding > k-1 lowers this way); ONNX Conv does not. Crop with a
-        # Slice first, then clamp the pads to >= 0.
-        shape = [int(s) for s in eqn.invars[0].aval.shape]
-        if any(d != 1 for d in p["lhs_dilation"]):
-            for i, d in enumerate(p["lhs_dilation"]):  # post-interleave size
-                if d != 1:
-                    shape[2 + i] = (shape[2 + i] - 1) * int(d) + 1
+        # Slice first, then clamp the pads to >= 0. `shape` already tracks
+        # the post-interleave sizes.
         starts, ends, axes = [], [], []
         for i, (lo, hi) in enumerate(padding):
             if lo < 0 or hi < 0:
