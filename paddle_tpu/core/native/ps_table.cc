@@ -91,6 +91,12 @@ bool recv_i64(int fd, int64_t* v) {
 enum Cmd : uint8_t {
   kPullSparse = 0, kPushSparse = 1, kPullDense = 2, kPushDense = 3,
   kSave = 4, kLoad = 5, kBarrier = 6, kStop = 7, kPushDenseParam = 8,
+  // geo-SGD delta aggregation (reference memory_sparse_geo_table.cc): the
+  // server ADDS trainer deltas to the parameter — no server-side optimizer
+  kPushDenseDelta = 9, kPushSparseDelta = 10,
+  // GNN graph store (reference common_graph_table.cc)
+  kGraphAddEdges = 11, kGraphSample = 12, kGraphSetFeat = 13,
+  kGraphGetFeat = 14, kGraphDegree = 15,
 };
 
 enum OptType : int { kSGD = 0, kAdagrad = 1, kAdam = 2 };
@@ -164,6 +170,17 @@ class SparseTable {
       auto& row = GetOrInit(s, ids[i]);
       apply_opt(cfg_.opt, cfg_.lr, cfg_.dim, row.data(), row.data() + cfg_.dim,
                 grads + i * cfg_.dim);
+    }
+  }
+
+  // geo-SGD: w += delta, no optimizer state touched
+  // (memory_sparse_geo_table.cc _PushSparse semantics)
+  void AddDelta(const uint64_t* ids, int n, const float* deltas) {
+    for (int i = 0; i < n; ++i) {
+      size_t s = ids[i] % shards_.size();
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      auto& row = GetOrInit(s, ids[i]);
+      for (int j = 0; j < cfg_.dim; ++j) row[j] += deltas[i * cfg_.dim + j];
     }
   }
 
@@ -250,6 +267,12 @@ class DenseTable {
     std::memcpy(w_.data(), values, w_.size() * sizeof(float));
   }
 
+  // geo-SGD: w += delta (deltas from several trainers aggregate by addition)
+  void AddDelta(const float* delta) {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (size_t i = 0; i < w_.size(); ++i) w_[i] += delta[i];
+  }
+
   bool Save(FILE* f) {
     std::lock_guard<std::mutex> lk(mu_);
     return fwrite(w_.data(), sizeof(float), w_.size(), f) == w_.size();
@@ -267,6 +290,137 @@ class DenseTable {
   std::vector<float> w_;
   std::vector<float> slots_;
   std::mutex mu_;
+};
+
+// ---------------- graph table (reference common_graph_table.cc) ----------------
+class GraphTable {
+  // TPU-native design delta: the reference's 1.3k-LoC graph table carries
+  // GPU-cache plumbing and protobuf sampling configs; the contract GNN
+  // training actually needs is (add edges, per-node features, uniform
+  // neighbor sampling, degree) over an id-sharded store — which is what
+  // this provides, behind the same PS wire protocol as the other tables.
+ public:
+  GraphTable(int feat_dim, int shard_num)
+      : feat_dim_(feat_dim), shards_(shard_num), locks_(shard_num) {}
+
+  void AddEdges(const uint64_t* src, const uint64_t* dst, int n) {
+    for (int i = 0; i < n; ++i) {
+      size_t s = src[i] % shards_.size();
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      shards_[s][src[i]].nbrs.push_back(dst[i]);
+    }
+  }
+
+  void Degree(const uint64_t* ids, int n, int64_t* out) {
+    for (int i = 0; i < n; ++i) {
+      size_t s = ids[i] % shards_.size();
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      auto it = shards_[s].find(ids[i]);
+      out[i] = it == shards_[s].end()
+                   ? 0 : static_cast<int64_t>(it->second.nbrs.size());
+    }
+  }
+
+  // k uniform samples WITH replacement per id (deterministic in seed);
+  // nodes without neighbors fill UINT64_MAX so callers can mask
+  void Sample(const uint64_t* ids, int n, int k, uint64_t seed,
+              uint64_t* out) {
+    for (int i = 0; i < n; ++i) {
+      size_t s = ids[i] % shards_.size();
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      auto it = shards_[s].find(ids[i]);
+      if (it == shards_[s].end() || it->second.nbrs.empty()) {
+        for (int j = 0; j < k; ++j) out[i * k + j] = UINT64_MAX;
+        continue;
+      }
+      const auto& nb = it->second.nbrs;
+      uint64_t x = seed ^ (ids[i] + 0x9E3779B97F4A7C15ull);
+      for (int j = 0; j < k; ++j) {
+        x ^= x >> 30; x *= 0xBF58476D1CE4E5B9ull;
+        x ^= x >> 27; x *= 0x94D049BB133111EBull;
+        x ^= x >> 31;
+        out[i * k + j] = nb[x % nb.size()];
+      }
+    }
+  }
+
+  void SetFeat(const uint64_t* ids, int n, const float* feats) {
+    for (int i = 0; i < n; ++i) {
+      size_t s = ids[i] % shards_.size();
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      auto& node = shards_[s][ids[i]];
+      node.feat.assign(feats + i * feat_dim_, feats + (i + 1) * feat_dim_);
+    }
+  }
+
+  void GetFeat(const uint64_t* ids, int n, float* out) {
+    for (int i = 0; i < n; ++i) {
+      size_t s = ids[i] % shards_.size();
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      auto it = shards_[s].find(ids[i]);
+      // copy min(stored, feat_dim) and zero-fill the rest: a checkpoint
+      // written under a different feat_dim must not read out of bounds
+      size_t m = it == shards_[s].end()
+                     ? 0 : std::min(it->second.feat.size(),
+                                    static_cast<size_t>(feat_dim_));
+      if (m)
+        std::memcpy(out + i * feat_dim_, it->second.feat.data(),
+                    m * sizeof(float));
+      if (m < static_cast<size_t>(feat_dim_))
+        std::memset(out + i * feat_dim_ + m, 0,
+                    (feat_dim_ - m) * sizeof(float));
+    }
+  }
+
+  bool Save(FILE* f) {
+    for (size_t s = 0; s < shards_.size(); ++s) {
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      for (auto& kv : shards_[s]) {
+        uint64_t nn = kv.second.nbrs.size();
+        uint64_t nf = kv.second.feat.size();
+        if (fwrite(&kv.first, sizeof(uint64_t), 1, f) != 1 ||
+            fwrite(&nn, sizeof(uint64_t), 1, f) != 1 ||
+            fwrite(&nf, sizeof(uint64_t), 1, f) != 1)
+          return false;
+        if (nn && fwrite(kv.second.nbrs.data(), sizeof(uint64_t), nn, f) != nn)
+          return false;
+        if (nf && fwrite(kv.second.feat.data(), sizeof(float), nf, f) != nf)
+          return false;
+      }
+    }
+    return true;
+  }
+
+  bool Load(FILE* f) {
+    uint64_t id, nn, nf;
+    while (fread(&id, sizeof(uint64_t), 1, f) == 1) {
+      if (fread(&nn, sizeof(uint64_t), 1, f) != 1 ||
+          fread(&nf, sizeof(uint64_t), 1, f) != 1)
+        return false;
+      Node node;
+      node.nbrs.resize(nn);
+      node.feat.resize(nf);
+      if (nn && fread(node.nbrs.data(), sizeof(uint64_t), nn, f) != nn)
+        return false;
+      if (nf && fread(node.feat.data(), sizeof(float), nf, f) != nf)
+        return false;
+      size_t s = id % shards_.size();
+      std::lock_guard<std::mutex> lk(locks_[s]);
+      shards_[s][id] = std::move(node);
+    }
+    return true;
+  }
+
+  int feat_dim() const { return feat_dim_; }
+
+ private:
+  struct Node {
+    std::vector<uint64_t> nbrs;
+    std::vector<float> feat;
+  };
+  int feat_dim_;
+  std::vector<std::unordered_map<uint64_t, Node>> shards_;
+  std::vector<std::mutex> locks_;
 };
 
 // ---------------- server ----------------
@@ -313,6 +467,18 @@ class PsServer {
     std::lock_guard<std::mutex> lk(tables_mu_);
     auto it = dense_.find(id);
     return it == dense_.end() ? nullptr : it->second.get();
+  }
+
+  void AddGraphTable(uint32_t id, int feat_dim, int shard_num) {
+    std::lock_guard<std::mutex> lk(tables_mu_);
+    graph_[id] = std::make_unique<GraphTable>(feat_dim,
+                                              shard_num > 0 ? shard_num : 8);
+  }
+
+  GraphTable* graph(uint32_t id) {
+    std::lock_guard<std::mutex> lk(tables_mu_);
+    auto it = graph_.find(id);
+    return it == graph_.end() ? nullptr : it->second.get();
   }
 
   bool stop_requested() const { return stop_requested_.load(); }
@@ -411,7 +577,7 @@ class PsServer {
                send_all(fd, buf.data(), buf.size() * sizeof(float));
           break;
         }
-        case kPushDense: case kPushDenseParam: {
+        case kPushDense: case kPushDenseParam: case kPushDenseDelta: {
           auto* t = dense(table_id);
           uint32_t nfloats;
           if (!(ok = recv_u32(fd, &nfloats))) break;
@@ -424,10 +590,95 @@ class PsServer {
           } else {
             if (cmd == kPushDense)
               t->Push(buf.data());
-            else
+            else if (cmd == kPushDenseParam)
               t->SetParam(buf.data());
+            else
+              t->AddDelta(buf.data());
             ok = send_i64(fd, 0);
           }
+          break;
+        }
+        case kPushSparseDelta: {
+          auto* t = sparse(table_id);
+          ids.resize(n);
+          if (!(ok = recv_all(fd, ids.data(), n * sizeof(uint64_t)))) break;
+          uint32_t nfloats;
+          if (!(ok = recv_u32(fd, &nfloats))) break;
+          buf.resize(nfloats);
+          if (!(ok = recv_all(fd, buf.data(), nfloats * sizeof(float)))) break;
+          if (!t) {
+            ok = send_i64(fd, -2);
+          } else if (nfloats != static_cast<size_t>(n) * t->config().dim) {
+            ok = send_i64(fd, -3);
+          } else {
+            t->AddDelta(ids.data(), n, buf.data());
+            ok = send_i64(fd, 0);
+          }
+          break;
+        }
+        case kGraphAddEdges: {
+          auto* t = graph(table_id);
+          ids.resize(static_cast<size_t>(n) * 2);  // src then dst
+          if (!(ok = recv_all(fd, ids.data(), n * 2 * sizeof(uint64_t))))
+            break;
+          if (!t) { ok = send_i64(fd, -2); break; }
+          t->AddEdges(ids.data(), ids.data() + n, n);
+          ok = send_i64(fd, 0);
+          break;
+        }
+        case kGraphDegree: {
+          auto* t = graph(table_id);
+          ids.resize(n);
+          if (!(ok = recv_all(fd, ids.data(), n * sizeof(uint64_t)))) break;
+          if (!t) { ok = send_i64(fd, -2); break; }
+          std::vector<int64_t> deg(n);
+          t->Degree(ids.data(), n, deg.data());
+          ok = send_i64(fd, 0) &&
+               send_all(fd, deg.data(), n * sizeof(int64_t));
+          break;
+        }
+        case kGraphSample: {
+          auto* t = graph(table_id);
+          ids.resize(n);
+          uint32_t k, seed;
+          if (!(ok = recv_all(fd, ids.data(), n * sizeof(uint64_t)) &&
+                     recv_u32(fd, &k) && recv_u32(fd, &seed)))
+            break;
+          if (!t) { ok = send_i64(fd, -2); break; }
+          std::vector<uint64_t> samples(static_cast<size_t>(n) * k);
+          t->Sample(ids.data(), n, static_cast<int>(k), seed, samples.data());
+          ok = send_i64(fd, 0) &&
+               send_all(fd, samples.data(),
+                        samples.size() * sizeof(uint64_t));
+          break;
+        }
+        case kGraphSetFeat: {
+          auto* t = graph(table_id);
+          ids.resize(n);
+          if (!(ok = recv_all(fd, ids.data(), n * sizeof(uint64_t)))) break;
+          uint32_t nfloats;
+          if (!(ok = recv_u32(fd, &nfloats))) break;
+          buf.resize(nfloats);
+          if (!(ok = recv_all(fd, buf.data(), nfloats * sizeof(float)))) break;
+          if (!t) {
+            ok = send_i64(fd, -2);
+          } else if (nfloats != static_cast<size_t>(n) * t->feat_dim()) {
+            ok = send_i64(fd, -3);
+          } else {
+            t->SetFeat(ids.data(), n, buf.data());
+            ok = send_i64(fd, 0);
+          }
+          break;
+        }
+        case kGraphGetFeat: {
+          auto* t = graph(table_id);
+          ids.resize(n);
+          if (!(ok = recv_all(fd, ids.data(), n * sizeof(uint64_t)))) break;
+          if (!t) { ok = send_i64(fd, -2); break; }
+          buf.resize(static_cast<size_t>(n) * t->feat_dim());
+          t->GetFeat(ids.data(), n, buf.data());
+          ok = send_i64(fd, 0) &&
+               send_all(fd, buf.data(), buf.size() * sizeof(float));
           break;
         }
         case kSave: case kLoad: {
@@ -436,24 +687,23 @@ class PsServer {
           int64_t status = 0;
           {
             std::lock_guard<std::mutex> lk(tables_mu_);
-            for (auto& kv : sparse_) {
-              std::string p = path + ".sparse." + std::to_string(kv.first);
-              FILE* f = fopen(p.c_str(), cmd == kSave ? "wb" : "rb");
-              if (!f) { if (cmd == kLoad) continue; status = -errno; break; }
-              bool io_ok = cmd == kSave ? kv.second->Save(f) : kv.second->Load(f);
-              fclose(f);
-              if (!io_ok) { status = -5; break; }
-            }
-            if (status == 0) {
-              for (auto& kv : dense_) {
-                std::string p = path + ".dense." + std::to_string(kv.first);
+            // one policy for every table kind: save opens "wb"; load skips
+            // tables with no file (partial checkpoints are legal)
+            auto io_tables = [&](auto& table_map, const char* tag) {
+              for (auto& kv : table_map) {
+                std::string p =
+                    path + "." + tag + "." + std::to_string(kv.first);
                 FILE* f = fopen(p.c_str(), cmd == kSave ? "wb" : "rb");
-                if (!f) { if (cmd == kLoad) continue; status = -errno; break; }
-                bool io_ok = cmd == kSave ? kv.second->Save(f) : kv.second->Load(f);
+                if (!f) { if (cmd == kLoad) continue; status = -errno; return; }
+                bool io_ok =
+                    cmd == kSave ? kv.second->Save(f) : kv.second->Load(f);
                 fclose(f);
-                if (!io_ok) { status = -5; break; }
+                if (!io_ok) { status = -5; return; }
               }
-            }
+            };
+            io_tables(sparse_, "sparse");
+            if (status == 0) io_tables(dense_, "dense");
+            if (status == 0) io_tables(graph_, "graph");
           }
           ok = send_i64(fd, status);
           break;
@@ -509,6 +759,7 @@ class PsServer {
   std::mutex tables_mu_;
   std::map<uint32_t, std::unique_ptr<SparseTable>> sparse_;
   std::map<uint32_t, std::unique_ptr<DenseTable>> dense_;
+  std::map<uint32_t, std::unique_ptr<GraphTable>> graph_;
   std::mutex barrier_mu_;
   std::condition_variable barrier_cv_;
   std::map<uint32_t, int64_t> barrier_counts_;
@@ -590,6 +841,11 @@ void ps_server_add_dense_table(void* server, uint32_t id, int dim, int opt,
   cfg.opt = opt;
   cfg.lr = lr;
   static_cast<PsServer*>(server)->AddDenseTable(id, cfg);
+}
+
+void ps_server_add_graph_table(void* server, uint32_t id, int feat_dim,
+                               int shards) {
+  static_cast<PsServer*>(server)->AddGraphTable(id, feat_dim, shards);
 }
 
 int64_t ps_server_sparse_size(void* server, uint32_t id) {
@@ -676,6 +932,95 @@ int ps_push_dense(void* client, uint32_t table, const float* grads, int dim) {
 int ps_push_dense_param(void* client, uint32_t table, const float* values,
                         int dim) {
   return push_dense_impl(client, kPushDenseParam, table, values, dim);
+}
+
+int ps_push_dense_delta(void* client, uint32_t table, const float* delta,
+                        int dim) {
+  return push_dense_impl(client, kPushDenseDelta, table, delta, dim);
+}
+
+int ps_push_sparse_delta(void* client, uint32_t table, const uint64_t* ids,
+                         int n, const float* deltas, int dim) {
+  auto* c = static_cast<PsClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  uint32_t nfloats = static_cast<uint32_t>(n) * dim;
+  if (!send_header(c->fd_, kPushSparseDelta, table, n) ||
+      !send_all(c->fd_, ids, n * sizeof(uint64_t)) ||
+      !send_u32(c->fd_, nfloats) ||
+      !send_all(c->fd_, deltas, static_cast<size_t>(nfloats) * sizeof(float)))
+    return -EPIPE;
+  int64_t status;
+  return recv_i64(c->fd_, &status) ? static_cast<int>(status) : -EPIPE;
+}
+
+int ps_graph_add_edges(void* client, uint32_t table, const uint64_t* src,
+                       const uint64_t* dst, int n) {
+  auto* c = static_cast<PsClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  if (!send_header(c->fd_, kGraphAddEdges, table, n) ||
+      !send_all(c->fd_, src, n * sizeof(uint64_t)) ||
+      !send_all(c->fd_, dst, n * sizeof(uint64_t)))
+    return -EPIPE;
+  int64_t status;
+  return recv_i64(c->fd_, &status) ? static_cast<int>(status) : -EPIPE;
+}
+
+int ps_graph_degree(void* client, uint32_t table, const uint64_t* ids, int n,
+                    int64_t* out) {
+  auto* c = static_cast<PsClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  if (!send_header(c->fd_, kGraphDegree, table, n) ||
+      !send_all(c->fd_, ids, n * sizeof(uint64_t)))
+    return -EPIPE;
+  int64_t status;
+  if (!recv_i64(c->fd_, &status)) return -EPIPE;
+  if (status != 0) return static_cast<int>(status);
+  return recv_all(c->fd_, out, static_cast<size_t>(n) * sizeof(int64_t))
+             ? 0 : -EPIPE;
+}
+
+int ps_graph_sample(void* client, uint32_t table, const uint64_t* ids, int n,
+                    int k, uint32_t seed, uint64_t* out) {
+  auto* c = static_cast<PsClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  if (!send_header(c->fd_, kGraphSample, table, n) ||
+      !send_all(c->fd_, ids, n * sizeof(uint64_t)) ||
+      !send_u32(c->fd_, static_cast<uint32_t>(k)) ||
+      !send_u32(c->fd_, seed))
+    return -EPIPE;
+  int64_t status;
+  if (!recv_i64(c->fd_, &status)) return -EPIPE;
+  if (status != 0) return static_cast<int>(status);
+  return recv_all(c->fd_, out,
+                  static_cast<size_t>(n) * k * sizeof(uint64_t)) ? 0 : -EPIPE;
+}
+
+int ps_graph_set_feat(void* client, uint32_t table, const uint64_t* ids,
+                      int n, const float* feats, int dim) {
+  auto* c = static_cast<PsClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  uint32_t nfloats = static_cast<uint32_t>(n) * dim;
+  if (!send_header(c->fd_, kGraphSetFeat, table, n) ||
+      !send_all(c->fd_, ids, n * sizeof(uint64_t)) ||
+      !send_u32(c->fd_, nfloats) ||
+      !send_all(c->fd_, feats, static_cast<size_t>(nfloats) * sizeof(float)))
+    return -EPIPE;
+  int64_t status;
+  return recv_i64(c->fd_, &status) ? static_cast<int>(status) : -EPIPE;
+}
+
+int ps_graph_get_feat(void* client, uint32_t table, const uint64_t* ids,
+                      int n, float* out, int dim) {
+  auto* c = static_cast<PsClient*>(client);
+  std::lock_guard<std::mutex> lk(c->mu_);
+  if (!send_header(c->fd_, kGraphGetFeat, table, n) ||
+      !send_all(c->fd_, ids, n * sizeof(uint64_t)))
+    return -EPIPE;
+  int64_t status;
+  if (!recv_i64(c->fd_, &status)) return -EPIPE;
+  if (status != 0) return static_cast<int>(status);
+  return recv_all(c->fd_, out, static_cast<size_t>(n) * dim * sizeof(float))
+             ? 0 : -EPIPE;
 }
 
 static int save_load_impl(void* client, uint8_t cmd, const char* path) {

@@ -6,9 +6,11 @@ core/native/ps_table.cc: sharded sparse/dense tables with server-side optimizers
 behind a TCP service (brpc in the reference). Ids shard across server instances
 by `id % num_servers` exactly like the reference's key-hash table partitioning.
 """
-from .service import PSClient, PSServer, SparseTableConfig, DenseTableConfig
-from .runtime import TheOnePSRuntime
+from .service import (PSClient, PSServer, SparseTableConfig,
+                      DenseTableConfig, GraphTableConfig)
+from .runtime import (TheOnePSRuntime, DenseSync, GeoSync, GraphClient)
 from .layers import DistributedEmbedding, distributed_lookup_table
 
 __all__ = ["PSClient", "PSServer", "SparseTableConfig", "DenseTableConfig",
-           "TheOnePSRuntime", "DistributedEmbedding", "distributed_lookup_table"]
+           "GraphTableConfig", "TheOnePSRuntime", "DenseSync", "GeoSync",
+           "GraphClient", "DistributedEmbedding", "distributed_lookup_table"]

@@ -16,7 +16,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .service import DenseTableConfig, PSClient, PSServer, SparseTableConfig
+from .service import (DenseTableConfig, GraphTableConfig, PSClient, PSServer,
+                      SparseTableConfig)
 
 
 class TheOnePSRuntime:
@@ -92,8 +93,8 @@ class TheOnePSRuntime:
 class DenseSync:
     """Async/sync dense-parameter flow for PS training: trainer pushes dense
     grads to the server-side optimizer and pulls fresh params back (reference
-    Communicator send/recv threads, ps/service/communicator/). `pull_interval`
-    > 1 approximates geo-async: params refresh every k steps."""
+    Communicator send/recv threads, ps/service/communicator/). For geo-SGD
+    (local training + delta aggregation) use GeoSync below."""
 
     def __init__(self, client: PSClient, params: Dict[int, "object"],
                  pull_interval: int = 1):
@@ -122,3 +123,93 @@ class DenseSync:
         for tid, p in self.params.items():
             vals = self.client.pull_dense(tid).reshape(p.shape)
             p._data = Tensor(vals.astype(p.numpy().dtype))._data
+
+
+class GeoSync:
+    """Geo-SGD delta aggregation (reference memory_sparse_geo_table.cc +
+    GeoCommunicator): each trainer optimizes LOCALLY; every `push_interval`
+    steps it pushes `delta = local - base` to the server, which ADDS deltas
+    from all trainers into the global parameter; the trainer then pulls the
+    merged value and rebases. Unlike DenseSync's grad-push, the server runs
+    no optimizer — aggregation is exact addition of locally-optimized
+    movement, which is the geo-SGD algorithm (arXiv:1811.11682).
+    """
+
+    def __init__(self, client: PSClient, params: Dict[int, "object"],
+                 push_interval: int = 4,
+                 init_from_server: Optional[bool] = None):
+        # params: table_id -> Parameter tensor (trainer-side, optimizer-owned)
+        self.client = client
+        self.params = params
+        self.push_interval = push_interval
+        self._step = 0
+        self._base: Dict[int, np.ndarray] = {}
+        if init_from_server is None:
+            # only rank 0 seeds the server; a later-starting trainer that
+            # pushed its init unconditionally would WIPE deltas already
+            # aggregated by earlier trainers
+            init_from_server = int(os.environ.get("PADDLE_TRAINER_ID",
+                                                  "0")) != 0
+        for tid, p in params.items():
+            self.client.register_table_dim(tid, int(np.prod(p.shape)))
+            if init_from_server:
+                self._pull_one(tid, p)
+            else:
+                self.client.push_dense_param(tid, p.numpy().reshape(-1))
+            self._base[tid] = np.asarray(p.numpy(), np.float32).copy()
+
+    def step(self) -> None:
+        """Call AFTER the local optimizer step."""
+        self._step += 1
+        if self._step % self.push_interval == 0:
+            self.sync()
+
+    def sync(self) -> None:
+        for tid, p in self.params.items():
+            local = np.asarray(p.numpy(), np.float32)
+            delta = (local - self._base[tid]).reshape(-1)
+            self.client.push_dense_delta(tid, delta)
+            self._pull_one(tid, p)
+            self._base[tid] = np.asarray(p.numpy(), np.float32).copy()
+
+    def _pull_one(self, tid, p) -> None:
+        from ...core.tensor import Tensor
+
+        vals = self.client.pull_dense(tid).reshape(p.shape)
+        p._data = Tensor(vals.astype(p.numpy().dtype))._data
+
+
+class GraphClient:
+    """High-level GNN graph-store API over the PS graph table (reference
+    common_graph_table.cc service surface: add edges, sample neighbors,
+    node features, degrees)."""
+
+    def __init__(self, client: PSClient, table_id: int, feat_dim: int = 0):
+        self.client = client
+        self.table_id = table_id
+        self.feat_dim = feat_dim
+        if feat_dim:
+            client.register_table_dim(table_id, feat_dim)
+
+    def add_edges(self, src, dst, bidirectional: bool = False) -> None:
+        self.client.graph_add_edges(self.table_id, np.asarray(src),
+                                    np.asarray(dst))
+        if bidirectional:
+            self.client.graph_add_edges(self.table_id, np.asarray(dst),
+                                        np.asarray(src))
+
+    def degree(self, ids) -> np.ndarray:
+        return self.client.graph_degree(self.table_id, np.asarray(ids))
+
+    def sample_neighbors(self, ids, k: int, seed: int = 0) -> np.ndarray:
+        """[*ids.shape, k] uint64; UINT64_MAX marks neighborless nodes."""
+        return self.client.graph_sample_neighbors(self.table_id,
+                                                  np.asarray(ids), k, seed)
+
+    def set_node_feat(self, ids, feats) -> None:
+        self.client.graph_set_feat(self.table_id, np.asarray(ids),
+                                   np.asarray(feats), self.feat_dim or None)
+
+    def get_node_feat(self, ids) -> np.ndarray:
+        return self.client.graph_get_feat(self.table_id, np.asarray(ids),
+                                          self.feat_dim or None)

@@ -31,6 +31,8 @@ def _lib():
     lib.ps_server_add_dense_table.argtypes = [
         ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
         ctypes.c_float]
+    lib.ps_server_add_graph_table.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int, ctypes.c_int]
     lib.ps_server_sparse_size.restype = ctypes.c_int64
     lib.ps_server_sparse_size.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.ps_server_stop.argtypes = [ctypes.c_void_p]
@@ -50,6 +52,24 @@ def _lib():
                            ctypes.c_int]),
         ("ps_push_dense_param", [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
                                  ctypes.c_int]),
+        ("ps_push_dense_delta", [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+                                 ctypes.c_int]),
+        ("ps_push_sparse_delta", [ctypes.c_void_p, ctypes.c_uint32,
+                                  ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.c_void_p, ctypes.c_int]),
+        ("ps_graph_add_edges", [ctypes.c_void_p, ctypes.c_uint32,
+                                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]),
+        ("ps_graph_degree", [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+                             ctypes.c_int, ctypes.c_void_p]),
+        ("ps_graph_sample", [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+                             ctypes.c_int, ctypes.c_int, ctypes.c_uint32,
+                             ctypes.c_void_p]),
+        ("ps_graph_set_feat", [ctypes.c_void_p, ctypes.c_uint32,
+                               ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+                               ctypes.c_int]),
+        ("ps_graph_get_feat", [ctypes.c_void_p, ctypes.c_uint32,
+                               ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+                               ctypes.c_int]),
         ("ps_save", [ctypes.c_void_p, ctypes.c_char_p]),
         ("ps_load", [ctypes.c_void_p, ctypes.c_char_p]),
         ("ps_barrier", [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int]),
@@ -79,12 +99,22 @@ class DenseTableConfig:
     learning_rate: float = 0.01
 
 
+@dataclass
+class GraphTableConfig:
+    """GNN graph store (reference common_graph_table.cc): id-sharded
+    adjacency + per-node features behind the PS wire protocol."""
+    table_id: int
+    feat_dim: int = 0
+    shard_num: int = 8
+
+
 class PSServer:
     """One PS server instance hosting its shard of every configured table."""
 
     def __init__(self, port: int = 0,
                  sparse_tables: Sequence[SparseTableConfig] = (),
-                 dense_tables: Sequence[DenseTableConfig] = ()):
+                 dense_tables: Sequence[DenseTableConfig] = (),
+                 graph_tables: Sequence[GraphTableConfig] = ()):
         self._lib = _lib()
         got = ctypes.c_int(0)
         self._handle = self._lib.ps_server_start(port, ctypes.byref(got))
@@ -95,6 +125,8 @@ class PSServer:
             self.add_sparse_table(t)
         for t in dense_tables:
             self.add_dense_table(t)
+        for t in graph_tables:
+            self.add_graph_table(t)
 
     def add_sparse_table(self, cfg: SparseTableConfig):
         self._lib.ps_server_add_sparse_table(
@@ -105,6 +137,10 @@ class PSServer:
         self._lib.ps_server_add_dense_table(
             self._handle, cfg.table_id, cfg.dim, _OPTS[cfg.optimizer],
             cfg.learning_rate)
+
+    def add_graph_table(self, cfg: GraphTableConfig):
+        self._lib.ps_server_add_graph_table(
+            self._handle, cfg.table_id, cfg.feat_dim, cfg.shard_num)
 
     def sparse_size(self, table_id: int) -> int:
         return int(self._lib.ps_server_sparse_size(self._handle, table_id))
@@ -150,40 +186,39 @@ class PSClient:
         assert d, f"dim unknown for table {table_id}; call register_table_dim"
         return d
 
+    def _shards(self, ids: np.ndarray):
+        """Route ids to their owning server (the ONE partitioning rule:
+        id % n_servers). Yields (server_conn, mask, contiguous_ids)."""
+        flat = np.ascontiguousarray(ids, dtype=np.uint64).reshape(-1)
+        for s in range(self.n_servers):
+            mask = (flat % self.n_servers) == s
+            if mask.any():
+                yield self._conns[s], mask, np.ascontiguousarray(flat[mask])
+
     # ---- sparse (reference ps_client.h PullSparse/PushSparse) ----
     def pull_sparse(self, table_id: int, ids: np.ndarray,
                     dim: Optional[int] = None) -> np.ndarray:
         d = self._dim(table_id, dim)
-        flat = np.ascontiguousarray(ids, dtype=np.uint64).reshape(-1)
-        out = np.empty((flat.size, d), dtype=np.float32)
-        for s in range(self.n_servers):
-            mask = (flat % self.n_servers) == s
-            if not mask.any():
-                continue
-            sub = np.ascontiguousarray(flat[mask])
+        n = int(np.asarray(ids).size)
+        out = np.empty((n, d), dtype=np.float32)
+        for conn, mask, sub in self._shards(ids):
             rows = np.empty((sub.size, d), dtype=np.float32)
-            rc = self._lib.ps_pull_sparse(
-                self._conns[s], table_id, sub.ctypes.data, sub.size,
-                rows.ctypes.data, d)
+            rc = self._lib.ps_pull_sparse(conn, table_id, sub.ctypes.data,
+                                          sub.size, rows.ctypes.data, d)
             if rc != 0:
                 raise RuntimeError(f"pull_sparse(table={table_id}) rc={rc}")
             out[mask] = rows
-        return out.reshape(*ids.shape, d)
+        return out.reshape(*np.asarray(ids).shape, d)
 
     def push_sparse(self, table_id: int, ids: np.ndarray, grads: np.ndarray,
                     dim: Optional[int] = None) -> None:
         d = self._dim(table_id, dim)
-        flat = np.ascontiguousarray(ids, dtype=np.uint64).reshape(-1)
-        g = np.ascontiguousarray(grads, dtype=np.float32).reshape(flat.size, d)
-        for s in range(self.n_servers):
-            mask = (flat % self.n_servers) == s
-            if not mask.any():
-                continue
-            sub = np.ascontiguousarray(flat[mask])
+        n = int(np.asarray(ids).size)
+        g = np.ascontiguousarray(grads, dtype=np.float32).reshape(n, d)
+        for conn, mask, sub in self._shards(ids):
             gsub = np.ascontiguousarray(g[mask])
-            rc = self._lib.ps_push_sparse(
-                self._conns[s], table_id, sub.ctypes.data, sub.size,
-                gsub.ctypes.data, d)
+            rc = self._lib.ps_push_sparse(conn, table_id, sub.ctypes.data,
+                                          sub.size, gsub.ctypes.data, d)
             if rc != 0:
                 raise RuntimeError(f"push_sparse(table={table_id}) rc={rc}")
 
@@ -213,6 +248,94 @@ class PSClient:
                                            v.ctypes.data, v.size)
         if rc != 0:
             raise RuntimeError(f"push_dense_param(table={table_id}) rc={rc}")
+
+    # ---- geo-SGD deltas (reference memory_sparse_geo_table.cc): the server
+    # ADDS trainer deltas; aggregation across trainers is the sum ----
+    def push_dense_delta(self, table_id: int, delta: np.ndarray) -> None:
+        v = np.ascontiguousarray(delta, dtype=np.float32).reshape(-1)
+        rc = self._lib.ps_push_dense_delta(self._dense_conn(table_id), table_id,
+                                           v.ctypes.data, v.size)
+        if rc != 0:
+            raise RuntimeError(f"push_dense_delta(table={table_id}) rc={rc}")
+
+    def push_sparse_delta(self, table_id: int, ids: np.ndarray,
+                          deltas: np.ndarray,
+                          dim: Optional[int] = None) -> None:
+        d = self._dim(table_id, dim)
+        n = int(np.asarray(ids).size)
+        g = np.ascontiguousarray(deltas, dtype=np.float32).reshape(n, d)
+        for conn, mask, sub in self._shards(ids):
+            gsub = np.ascontiguousarray(g[mask])
+            rc = self._lib.ps_push_sparse_delta(conn, table_id,
+                                                sub.ctypes.data, sub.size,
+                                                gsub.ctypes.data, d)
+            if rc != 0:
+                raise RuntimeError(
+                    f"push_sparse_delta(table={table_id}) rc={rc}")
+
+    # ---- graph (reference common_graph_table.cc): nodes shard by id ----
+    def graph_add_edges(self, table_id: int, src: np.ndarray,
+                        dst: np.ndarray) -> None:
+        d_flat = np.ascontiguousarray(dst, dtype=np.uint64).reshape(-1)
+        assert np.asarray(src).size == d_flat.size
+        for conn, mask, ss in self._shards(src):  # edges live with their src
+            dd = np.ascontiguousarray(d_flat[mask])
+            rc = self._lib.ps_graph_add_edges(conn, table_id, ss.ctypes.data,
+                                              dd.ctypes.data, ss.size)
+            if rc != 0:
+                raise RuntimeError(f"graph_add_edges rc={rc}")
+
+    def graph_degree(self, table_id: int, ids: np.ndarray) -> np.ndarray:
+        out = np.zeros(int(np.asarray(ids).size), dtype=np.int64)
+        for conn, mask, sub in self._shards(ids):
+            deg = np.empty(sub.size, dtype=np.int64)
+            rc = self._lib.ps_graph_degree(conn, table_id, sub.ctypes.data,
+                                           sub.size, deg.ctypes.data)
+            if rc != 0:
+                raise RuntimeError(f"graph_degree rc={rc}")
+            out[mask] = deg
+        return out.reshape(np.asarray(ids).shape)
+
+    def graph_sample_neighbors(self, table_id: int, ids: np.ndarray, k: int,
+                               seed: int = 0) -> np.ndarray:
+        """k uniform samples (with replacement) per id; UINT64_MAX marks
+        neighborless nodes."""
+        out = np.full((int(np.asarray(ids).size), k),
+                      np.iinfo(np.uint64).max, dtype=np.uint64)
+        for conn, mask, sub in self._shards(ids):
+            smp = np.empty((sub.size, k), dtype=np.uint64)
+            rc = self._lib.ps_graph_sample(conn, table_id, sub.ctypes.data,
+                                           sub.size, k, seed & 0xFFFFFFFF,
+                                           smp.ctypes.data)
+            if rc != 0:
+                raise RuntimeError(f"graph_sample rc={rc}")
+            out[mask] = smp
+        return out.reshape(*np.asarray(ids).shape, k)
+
+    def graph_set_feat(self, table_id: int, ids: np.ndarray,
+                       feats: np.ndarray, dim: Optional[int] = None) -> None:
+        d = self._dim(table_id, dim)
+        f = np.ascontiguousarray(feats, dtype=np.float32).reshape(
+            int(np.asarray(ids).size), d)
+        for conn, mask, sub in self._shards(ids):
+            fsub = np.ascontiguousarray(f[mask])
+            rc = self._lib.ps_graph_set_feat(conn, table_id, sub.ctypes.data,
+                                             sub.size, fsub.ctypes.data, d)
+            if rc != 0:
+                raise RuntimeError(f"graph_set_feat rc={rc}")
+
+    def graph_get_feat(self, table_id: int, ids: np.ndarray,
+                       dim: Optional[int] = None) -> np.ndarray:
+        d = self._dim(table_id, dim)
+        out = np.zeros((int(np.asarray(ids).size), d), dtype=np.float32)
+        for conn, mask, sub in self._shards(ids):
+            rows = np.empty((sub.size, d), dtype=np.float32)
+            rc = self._lib.ps_graph_get_feat(conn, table_id, sub.ctypes.data,
+                                             sub.size, rows.ctypes.data, d)
+            if rc != 0:
+                raise RuntimeError(f"graph_get_feat rc={rc}")
+            out[mask] = rows
+        return out.reshape(*np.asarray(ids).shape, d)
 
     # ---- control ----
     def save(self, path: str) -> None:
