@@ -146,7 +146,7 @@ def fused_linear_cross_entropy(hidden, weight, label, transpose_y=True,
         if _use_pallas(transpose_y):
             from .pallas.lm_loss import lm_head_cross_entropy, supported
 
-            pad = (-n) % 128  # smallest row tile _pick can choose
+            pad = (-n) % 1024  # row tile = XLA's 1024-element 1D layout tile
             npad = n + pad
             if supported(npad, w.shape[0], hdim):
                 ignore = lb1 == ignore_index

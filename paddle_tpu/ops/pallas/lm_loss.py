@@ -44,15 +44,26 @@ def _pick(n: int, preferred: int) -> int:
     return 0
 
 
+def _pick_rows(n: int) -> int:
+    """Row blocks tile the 1D labels/loss/lse operands, whose XLA layout is
+    (8 sublanes x 128 lanes) = 1024-element tiles — a smaller 1D block fails
+    Mosaic layout verification on real TPU ("XLA layout {0:T(1024)} does not
+    match Mosaic layout {0:T(512)}"), so 1024 is the floor, not 128."""
+    return 1024 if n % 1024 == 0 and n >= 1024 else 0
+
+
 def supported(n_rows: int, vocab: int, hidden: int) -> bool:
-    return (_pick(n_rows, 512) > 0 and _pick(vocab, 512) > 0
-            and hidden % 128 == 0)
+    # vocab needs no divisibility: the wrapper pads W to a 512 multiple and the
+    # kernels mask the padded columns to NEG_INF (a 50304 vocab would otherwise
+    # fall to 128-wide blocks -> a 393-step inner grid and minutes of Mosaic
+    # compile at bench shapes)
+    return _pick_rows(n_rows) > 0 and vocab >= 128 and hidden % 128 == 0
 
 
 # ---------------------------------------------------------------- forward ----
 
 def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr,
-                *, block_v, v_blocks):
+                *, block_v, v_blocks, v_true):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -71,6 +82,9 @@ def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr,
     #                                     Mosaic's (8, 128) block-tiling rule)
     col0 = j * block_v
     cols = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    if v_true is not None:              # W padded to a 512 multiple: padded
+        #                                 columns must not enter the logsumexp
+        s = jnp.where(cols < v_true, s, jnp.float32(NEG_INF))
     hit = cols == lab[:, None]          # row's label inside this tile?
     # each label lands in exactly one tile: accumulate its logit via sum
     # zeros_like, not a 0.0 literal: under jax_enable_x64 the weak literal
@@ -92,7 +106,7 @@ def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr,
         lse_ref[...] = lse[:, 0]
 
 
-def _fwd(h2, w, labels, block_n, block_v):
+def _fwd(h2, w, labels, block_n, block_v, v_true=None):
     n, hdim = h2.shape
     v = w.shape[0]
     if w.dtype != h2.dtype:
@@ -101,7 +115,7 @@ def _fwd(h2, w, labels, block_n, block_v):
         w = w.astype(h2.dtype)
     grid = (n // block_n, v // block_v)
     kernel = functools.partial(_fwd_kernel, block_v=block_v,
-                               v_blocks=v // block_v)
+                               v_blocks=v // block_v, v_true=v_true)
     loss, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -128,7 +142,7 @@ def _fwd(h2, w, labels, block_n, block_v):
 # --------------------------------------------------------------- backward ----
 
 def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dh_ref, dh_scr,
-               *, block_v, v_blocks):
+               *, block_v, v_blocks, v_true):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -142,8 +156,10 @@ def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dh_ref, dh_scr,
     lab = lab_ref[...]
     lse = lse_ref[...]
     g = g_ref[...]
-    p = jnp.exp(s - lse[:, None])
     cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    if v_true is not None:  # padded columns: p -> 0, no gradient flow
+        s = jnp.where(cols < v_true, s, jnp.float32(NEG_INF))
+    p = jnp.exp(s - lse[:, None])
     dl = (p - (cols == lab[:, None])) * g[:, None]       # [bn, bv] f32
     dh_scr[...] += jax.lax.dot_general(
         dl.astype(w.dtype), w, (((1,), (0,)), ((), ())),
@@ -155,7 +171,7 @@ def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dh_ref, dh_scr,
 
 
 def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dw_ref, dw_scr,
-               *, block_v, n_blocks):
+               *, block_v, n_blocks, v_true):
     j = pl.program_id(0)
     i = pl.program_id(1)
 
@@ -170,8 +186,10 @@ def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dw_ref, dw_scr,
     lab = lab_ref[...]
     lse = lse_ref[...]
     g = g_ref[...]
-    p = jnp.exp(s - lse[:, None])
     cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    if v_true is not None:  # padded columns contribute zero to dW rows >= v_true
+        s = jnp.where(cols < v_true, s, jnp.float32(NEG_INF))
+    p = jnp.exp(s - lse[:, None])
     dl = (p - (cols == lab[:, None])) * g[:, None]
     dw_scr[...] += jax.lax.dot_general(
         dl.astype(h.dtype), h, (((0,), (0,)), ((), ())),
@@ -182,7 +200,7 @@ def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dw_ref, dw_scr,
         dw_ref[...] = dw_scr[...].astype(dw_ref.dtype)
 
 
-def _bwd(res, g, block_n, block_v):
+def _bwd(res, g, block_n, block_v, v_true=None):
     h2, w, labels, lse = res
     w_dtype = w.dtype
     if w.dtype != h2.dtype:
@@ -193,7 +211,8 @@ def _bwd(res, g, block_n, block_v):
     g32 = g.astype(jnp.float32)
 
     dh = pl.pallas_call(
-        functools.partial(_dh_kernel, block_v=block_v, v_blocks=vb),
+        functools.partial(_dh_kernel, block_v=block_v, v_blocks=vb,
+                          v_true=v_true),
         grid=(nb, vb),
         in_specs=[
             pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
@@ -209,7 +228,8 @@ def _bwd(res, g, block_n, block_v):
     )(h2, w, labels, lse, g32)
 
     dw = pl.pallas_call(
-        functools.partial(_dw_kernel, block_v=block_v, n_blocks=nb),
+        functools.partial(_dw_kernel, block_v=block_v, n_blocks=nb,
+                          v_true=v_true),
         grid=(vb, nb),
         in_specs=[
             pl.BlockSpec((block_n, hdim), lambda j, i: (i, _I0)),
@@ -228,19 +248,19 @@ def _bwd(res, g, block_n, block_v):
 
 # ------------------------------------------------------------- public API ----
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _lm_loss(h2, w, labels, block_n, block_v):
-    loss, _ = _fwd(h2, w, labels, block_n, block_v)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _lm_loss(h2, w, labels, block_n, block_v, v_true):
+    loss, _ = _fwd(h2, w, labels, block_n, block_v, v_true)
     return loss
 
 
-def _fwd_rule(h2, w, labels, block_n, block_v):
-    loss, lse = _fwd(h2, w, labels, block_n, block_v)
+def _fwd_rule(h2, w, labels, block_n, block_v, v_true):
+    loss, lse = _fwd(h2, w, labels, block_n, block_v, v_true)
     return loss, (h2, w, labels, lse)
 
 
-def _bwd_rule(block_n, block_v, res, g):
-    dh, dw = _bwd(res, g, block_n, block_v)
+def _bwd_rule(block_n, block_v, v_true, res, g):
+    dh, dw = _bwd(res, g, block_n, block_v, v_true)
     dlab = np.zeros(res[2].shape, dtype=jax.dtypes.float0)
     return dh, dw, dlab
 
@@ -251,8 +271,16 @@ _lm_loss.defvjp(_fwd_rule, _bwd_rule)
 def lm_head_cross_entropy(h2, w, labels):
     """h2 [N, H], w [V, H], labels [N] int32 (already ignore-masked to a safe
     index by the caller) -> per-row loss [N] f32. Caller guarantees
-    supported(N, V, H)."""
+    supported(N, V, H). W is padded to a 512-multiple vocab internally (padded
+    columns masked to NEG_INF; dW for them is zero and sliced off by autodiff
+    of the pad)."""
     n = h2.shape[0]
-    block_n = _pick(n, 512)
+    v = w.shape[0]
+    block_n = _pick_rows(n)
+    vpad = (-v) % 512
+    if vpad:
+        w = jnp.concatenate(
+            [w, jnp.zeros((vpad, w.shape[1]), w.dtype)], axis=0)
     block_v = _pick(w.shape[0], 512)
-    return _lm_loss(h2, w, labels.astype(jnp.int32), block_n, block_v)
+    return _lm_loss(h2, w, labels.astype(jnp.int32), block_n, block_v,
+                    v if vpad else None)

@@ -19,7 +19,7 @@ def _dense(h, w, lab):
 @pytest.mark.parametrize("dtype,atol", [(jnp.float32, 1e-4), (jnp.bfloat16, 8e-2)])
 def test_kernel_matches_dense(dtype, atol):
     rng = np.random.RandomState(0)
-    N, V, H = 256, 512, 128
+    N, V, H = 1024, 512, 128
     h = jnp.asarray(rng.randn(N, H), dtype)
     w = jnp.asarray(rng.randn(V, H) * 0.05, dtype)
     lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
@@ -32,7 +32,7 @@ def test_kernel_matches_dense(dtype, atol):
 
 def test_kernel_grads_match_dense():
     rng = np.random.RandomState(1)
-    N, V, H = 128, 256, 128
+    N, V, H = 1024, 256, 128
     h = jnp.asarray(rng.randn(N, H).astype(np.float32))
     w = jnp.asarray((rng.randn(V, H) * 0.05).astype(np.float32))
     lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
@@ -45,11 +45,35 @@ def test_kernel_grads_match_dense():
 
 
 def test_supported_predicate():
-    assert supported(512, 50304, 768)     # bench shapes (50304 = 393*128)
-    assert supported(8192, 50304, 768)
+    assert supported(8192, 50304, 768)    # bench shapes (vocab padded to 50688)
+    assert supported(16384, 50304, 768)
+    # rows tile the 1D labels/loss/lse operands whose XLA layout is 1024-wide:
+    # anything below/off the 1024 grid fails Mosaic layout verification on TPU
+    assert not supported(512, 50304, 768)
     assert not supported(100, 512, 128)   # rows not tileable
-    assert not supported(512, 500, 128)   # vocab not tileable
-    assert not supported(512, 512, 100)   # hidden not lane-aligned
+    assert supported(1024, 500, 128)      # unaligned vocab: padded internally
+    assert not supported(1024, 512, 100)  # hidden not lane-aligned
+
+
+def test_unaligned_vocab_padded():
+    """Vocab not divisible by 512: W is padded and masked; results must match
+    the dense reference exactly on the true vocab, grads flow only to W[:V]."""
+    rng = np.random.RandomState(5)
+    N, V, H = 1024, 500, 128
+    h = jnp.asarray(rng.randn(N, H).astype(np.float32))
+    w = jnp.asarray((rng.randn(V, H) * 0.05).astype(np.float32))
+    lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
+
+    loss = lm_head_cross_entropy(h, w, lab)
+    ref = _dense(h, w, lab)
+    np.testing.assert_allclose(loss, ref, atol=1e-4, rtol=1e-4)
+
+    gp = jax.grad(lambda a, b: lm_head_cross_entropy(a, b, lab).mean(),
+                  argnums=(0, 1))(h, w)
+    gr = jax.grad(lambda a, b: _dense(a, b, lab).mean(), argnums=(0, 1))(h, w)
+    assert gp[1].shape == (V, H)  # pad sliced off by autodiff of the concat
+    np.testing.assert_allclose(gp[0], gr[0], atol=1e-5)
+    np.testing.assert_allclose(gp[1], gr[1], atol=1e-5)
 
 
 class TestRoutedThroughFused:
@@ -63,7 +87,7 @@ class TestRoutedThroughFused:
         from paddle_tpu.ops.fused import fused_linear_cross_entropy
 
         rng = np.random.RandomState(2)
-        b, s, v, hdim = 2, 100, 256, 128  # 200 rows: exercises padding to 512
+        b, s, v, hdim = 2, 100, 256, 128  # 200 rows: exercises padding to 1024
         h = paddle.to_tensor(rng.randn(b, s, hdim).astype(np.float32),
                              stop_gradient=False)
         w = paddle.to_tensor((rng.randn(v, hdim) * 0.1).astype(np.float32),
@@ -110,7 +134,7 @@ def test_mixed_dtype_bf16_h_f32_w():
     paddle.set_flags({"use_pallas_lm_loss": True, "pallas_interpret_ok": True})
     try:
         rng = np.random.RandomState(4)
-        N, V, H = 128, 256, 128
+        N, V, H = 1024, 256, 128
         h = jnp.asarray(rng.randn(N, H), jnp.bfloat16)
         w = jnp.asarray(rng.randn(V, H) * 0.05, jnp.float32)
         lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
