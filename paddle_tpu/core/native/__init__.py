@@ -28,27 +28,44 @@ def _cache_dir() -> str:
     return d
 
 
-def build_library(name: str, sources=None, extra_flags=()) -> str:
-    """Compile `<name>.cc` (plus extra sources) into a cached shared library and
-    return its path. Raises RuntimeError if the toolchain is missing/fails."""
-    sources = sources or [os.path.join(_SRC_DIR, f"{name}.cc")]
+def _out_path(name: str, sources, extra_flags) -> str:
     h = hashlib.sha256()
     for s in sources:
         with open(s, "rb") as f:
             h.update(f.read())
     h.update(" ".join(extra_flags).encode())
-    out = os.path.join(_cache_dir(), f"{name}-{h.hexdigest()[:16]}.so")
-    if os.path.exists(out):
-        return out
+    return os.path.join(_cache_dir(), f"{name}-{h.hexdigest()[:16]}.so")
+
+
+def _compile(sources, extra_flags, out: str) -> None:
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           *extra_flags, *sources, "-o", out + ".tmp"]
+           *extra_flags, *sources, "-o", out]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except FileNotFoundError as e:
         raise RuntimeError(f"no C++ toolchain: {e}") from e
     except subprocess.CalledProcessError as e:
+        import contextlib
+
+        with contextlib.suppress(OSError):  # no orphaned temp on failure
+            os.remove(out)
         raise RuntimeError(f"native build failed:\n{e.stderr}") from e
-    os.replace(out + ".tmp", out)
+
+
+def build_library(name: str, sources=None, extra_flags=()) -> str:
+    """Compile `<name>.cc` (plus extra sources) into a cached shared library and
+    return its path. Raises RuntimeError if the toolchain is missing/fails."""
+    sources = sources or [os.path.join(_SRC_DIR, f"{name}.cc")]
+    out = _out_path(name, sources, extra_flags)
+    if os.path.exists(out):
+        return out
+    # per-process temp name: concurrent ranks of a multi-process cluster may
+    # build the same library simultaneously, and a SHARED .tmp target lets one
+    # rank rename the other's half-written object (os.replace is atomic, so
+    # with unique temps the last complete build simply wins)
+    tmp = f"{out}.tmp.{os.getpid()}"
+    _compile(sources, extra_flags, tmp)
+    os.replace(tmp, out)
     return out
 
 
@@ -61,7 +78,28 @@ def load_library(name: str):
         if name in _libs:
             return _libs[name]
         try:
-            lib = ctypes.CDLL(build_library(name))
+            try:
+                lib = ctypes.CDLL(build_library(name))
+            except OSError:
+                # the cached .so can be unloadable if it was corrupted by a
+                # pre-fix concurrent build: recompile to a fresh temp, load
+                # THAT, and only then swap it into the cache. Never delete the
+                # cache entry — other processes may hold it open, and an
+                # environment-level load failure (missing runtime dep) would
+                # otherwise turn the one-time build into per-process churn.
+                sources = [os.path.join(_SRC_DIR, f"{name}.cc")]
+                out = _out_path(name, sources, ())
+                tmp = f"{out}.retry.{os.getpid()}"
+                _compile(sources, (), tmp)
+                try:
+                    lib = ctypes.CDLL(tmp)  # raises OSError -> fallback below
+                except OSError:
+                    import contextlib
+
+                    with contextlib.suppress(OSError):
+                        os.remove(tmp)
+                    raise
+                os.replace(tmp, out)  # dlopen keeps the mapping across rename
         except (RuntimeError, OSError) as e:
             print(f"paddle_tpu: native {name} unavailable ({e}); using Python "
                   f"fallback", file=sys.stderr)

@@ -90,3 +90,52 @@ def test_reshape_inplace_on_nonleaf():
     assert y.shape == [6]
     y.sum().backward()
     assert x.grad.shape == [2, 3]
+
+
+def test_native_build_race_two_processes(tmp_path):
+    """Two processes building the same native library concurrently must both
+    end with a loadable .so (a shared .tmp target used to let one rank rename
+    the other's half-written object — the corrupted cache then broke every
+    later multi-process fleet-executor run)."""
+    import subprocess
+    import sys
+    import textwrap
+
+    script = tmp_path / "build_one.py"
+    script.write_text(textwrap.dedent("""
+        import ctypes
+        from paddle_tpu.core.native import build_library
+        ctypes.CDLL(build_library("tcp_store"))
+        print("LOADED")
+    """))
+    import os
+
+    env = {**os.environ, "PADDLE_TPU_NATIVE_CACHE": str(tmp_path / "cache")}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen([sys.executable, str(script)], env=env,
+                              stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                              text=True) for _ in range(2)]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    assert all("LOADED" in o for o in outs), outs
+
+
+def test_native_corrupted_cache_recovers(tmp_path, monkeypatch):
+    """A corrupted cached .so (e.g. from a pre-fix concurrent build) must heal:
+    load_library recompiles to a temp, loads it, and swaps it into the cache
+    without ever deleting an entry another process might hold open."""
+    import os
+
+    monkeypatch.setenv("PADDLE_TPU_NATIVE_CACHE", str(tmp_path))
+    import importlib
+
+    import paddle_tpu.core.native as native
+    native = importlib.reload(native)
+    src = [os.path.join(native._SRC_DIR, "tcp_store.cc")]
+    out = native._out_path("tcp_store", src, ())
+    with open(out, "wb") as f:
+        f.write(b"garbage not an elf")
+    lib = native.load_library("tcp_store")
+    assert lib is not None
+    assert os.path.getsize(out) > 1000  # cache healed in place
