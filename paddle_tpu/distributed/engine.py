@@ -148,6 +148,8 @@ class TrainStepEngine:
                 jax.device_put(s, self._opt_sharding(spec)) for s in st)
 
         self._step_fn = None
+        self._scan_fns = {True: None, False: None}  # fixed_batch -> jitted scan
+        self._scan_batch_shardings = {}
         self._step_count = optimizer._step_count
         self._key = jax.random.key(random_mod.default_generator().initial_seed() or 0)
         self.last_loss = None
@@ -162,7 +164,7 @@ class TrainStepEngine:
         return NamedSharding(self.mesh, spec)
 
     # ---- step function construction ----
-    def _build(self, batch_avals):
+    def _raw_step(self):
         update = opt_funct.make_tree_update(
             self.optimizer, {n: self._state_refs[n] for n in self._param_names})
         clip = self.optimizer._grad_clip
@@ -220,6 +222,10 @@ class TrainStepEngine:
             new_params, new_opt = update(params, grads, opt_state, lr, step_i)
             return loss, new_params, new_opt
 
+        return step
+
+    def _build(self, batch_avals):
+        step = self._raw_step()
         param_shardings = {n: NamedSharding(self.mesh, s) for n, s in self.param_specs.items()}
         # the jitted step is all-device; offload transfers happen at the
         # python boundary in step() (jax 0.9 dropped in-jit memory transfers)
@@ -244,19 +250,159 @@ class TrainStepEngine:
             donate_argnums=(0, 1) if self._donate else (),
         )
 
-    # ---- public API ----
-    def step(self, *batch) -> Tensor:
-        arrays = []
-        for b in batch:
-            a = b._data if isinstance(b, Tensor) else jnp.asarray(b)
-            arrays.append(a)
+    def _build_scan(self, batch_avals, fixed_batch):
+        """K train steps fused into ONE compiled program via lax.scan.
+
+        The analogue of the reference's fleet_executor running a whole section
+        of iterations per dispatch (fleet_executor/compute_interceptor.cc's
+        LoopCounter / max_run_times) instead of one step per Executor.run —
+        on TPU it also collapses K PJRT execute round-trips into one, which
+        matters through remote/tunneled backends where each execute pays
+        network latency. With fixed_batch=False, batch arrays carry a leading
+        [K] axis and the scan consumes one slice per step; with
+        fixed_batch=True the same single batch feeds every step (scan
+        xs=None — one device copy, not K). Per-step learning rates arrive as
+        a [K] f32 array (schedules stay host-side).
+        """
+        step = self._raw_step()
+
+        def multi(params, opt_state, lrs, step0, keys, *batch):
+            # keys: [K] array of per-step subkeys, split HOST-side with the
+            # exact split sequence step() uses — so dropout streams (and thus
+            # losses) match a loop of K step() calls bit-for-bit
+            def body(carry, xs):
+                p, o, i = carry
+                sub = xs[0]
+                loss, p, o = step(p, o, lrs[i], step0 + i, sub,
+                                  *(batch if fixed_batch else xs[1:]))
+                return (p, o, i + jnp.int32(1)), loss
+
+            (params, opt_state, _), losses = jax.lax.scan(
+                body, (params, opt_state, jnp.int32(0)),
+                (keys,) if fixed_batch else (keys,) + tuple(batch))
+            return losses, params, opt_state
+
+        param_shardings = {n: NamedSharding(self.mesh, s)
+                           for n, s in self.param_specs.items()}
+        opt_shardings = {
+            n: tuple(NamedSharding(self.mesh, self.opt_specs[n])
+                     for _ in self.opt_state[n])
+            for n in self._param_names}
+        if self.input_specs is not None:
+            per_step = self.input_specs
+        else:
+            lead = 0 if fixed_batch else 1
+            per_step = [_default_input_spec(a.shape[lead:], self.hcg)
+                        for a in batch_avals]
+        batch_shardings = tuple(
+            NamedSharding(self.mesh, s if fixed_batch else P(None, *s))
+            for s in per_step)
+        scalar = NamedSharding(self.mesh, P())
+
+        self._scan_batch_shardings[fixed_batch] = batch_shardings
+        return jax.jit(
+            multi,
+            in_shardings=(param_shardings, opt_shardings, scalar, scalar,
+                          scalar) + batch_shardings,
+            out_shardings=(scalar, param_shardings, opt_shardings),
+            donate_argnums=(0, 1) if self._donate else (),
+        )
+
+    # ---- shared step plumbing ----
+    def _check_batch(self, arrays, lead_axes=0):
+        """The dp*sharding divisibility guard, shared by step()/run_steps()."""
         batch_axes = self.hcg.degrees["dp"] * self.hcg.degrees["sharding"]
         for a in arrays:
-            if a.ndim >= 1 and a.shape[0] % batch_axes != 0:
+            if a.ndim > lead_axes and a.shape[lead_axes] % batch_axes != 0:
                 raise ValueError(
-                    f"batch dim {a.shape[0]} is not divisible by "
+                    f"batch dim {a.shape[lead_axes]} is not divisible by "
                     f"dp*sharding = {batch_axes}; pad or resize the batch "
                     f"(topology: {self.hcg.topology()})")
+
+    @staticmethod
+    def _to_arrays(batch):
+        return [b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                for b in batch]
+
+    def _opt_to_hbm(self, opt_state):
+        """Offload mode: stream host-resident optimizer state to HBM for the
+        update (async device_put pipelines with dispatch). No-op otherwise."""
+        if not self._opt_memory_kind:
+            return opt_state
+        return {
+            n: tuple(jax.device_put(s, NamedSharding(self.mesh,
+                                                     self.opt_specs[n]))
+                     for s in st) for n, st in opt_state.items()}
+
+    def _opt_to_home(self, opt_state):
+        """Offload mode: move the fresh optimizer state back to host memory."""
+        if not self._opt_memory_kind:
+            return opt_state
+        return {
+            n: tuple(jax.device_put(s, self._opt_sharding(self.opt_specs[n]))
+                     for s in st) for n, st in opt_state.items()}
+
+    # ---- public API ----
+    def run_steps(self, *batch, steps: Optional[int] = None):
+        """Run K fused train steps in one dispatch; returns losses [K].
+
+        Either pass batch arrays with a leading [K] step axis, or single-step
+        arrays plus steps=K to reuse the same batch every step (benchmark /
+        overfit loops; the batch is uploaded ONCE, not K times). Loss history
+        comes back as one f32 array.
+        """
+        arrays = self._to_arrays(batch)
+        fixed = steps is not None
+        self._check_batch(arrays, lead_axes=0 if fixed else 1)
+        k = steps if fixed else arrays[0].shape[0]
+        if k < 1:
+            raise ValueError(f"run_steps needs at least one step, got K={k}")
+        from ..core import autotune
+        autotune.set_step(self._step_count + k)
+        if self._scan_fns[fixed] is None:
+            self._scan_fns[fixed] = self._build_scan(arrays, fixed)
+        arrays = [jax.device_put(a, s)
+                  for a, s in zip(arrays, self._scan_batch_shardings[fixed])]
+        # host-side schedule bookkeeping, mirroring step(): one lr per step
+        step0 = self._step_count + 1
+        lrs = []
+        for _ in range(k):
+            self._step_count += 1
+            self.optimizer._step_count = self._step_count
+            lrs.append(self.optimizer.get_lr())
+        lrs = jnp.asarray(lrs, jnp.float32)
+        # one subkey per step, advancing self._key exactly as K step() calls
+        subs = []
+        for _ in range(k):
+            self._key, sub = jax.random.split(self._key)
+            subs.append(sub)
+        losses, self.params, new_opt = self._scan_fns[fixed](
+            self.params, self._opt_to_hbm(self.opt_state), lrs,
+            jnp.int32(step0), jnp.stack(subs), *arrays)
+        self.opt_state = self._opt_to_home(new_opt)
+        self.last_loss = Tensor(losses[-1])
+        return Tensor(losses)
+
+    def warm_scan(self, *batch, steps: int):
+        """Compile + device-warm the K-step scan program WITHOUT advancing
+        training state: run_steps executes on copies (its donation consumes
+        the originals; the copies made here survive and are restored). Use
+        before timing a run_steps region so compile cost stays outside it."""
+        saved = (jax.tree_util.tree_map(jnp.copy, self.params),
+                 jax.tree_util.tree_map(jnp.copy, self.opt_state),
+                 self._step_count, self._key, self.last_loss)
+        try:
+            losses = self.run_steps(*batch, steps=steps)
+            float(losses[-1].item())  # drain: the warm execution must not
+            #                           queue into a caller's timed region
+        finally:
+            (self.params, self.opt_state, self._step_count, self._key,
+             self.last_loss) = saved
+            self.optimizer._step_count = self._step_count
+
+    def step(self, *batch) -> Tensor:
+        arrays = self._to_arrays(batch)
+        self._check_batch(arrays)
         from ..core import autotune
         autotune.set_step(self._step_count + 1)
         if self._step_fn is None:
@@ -270,21 +416,10 @@ class TrainStepEngine:
             self._lr_cache = (lr_val, jnp.float32(lr_val))
         lr = self._lr_cache[1]
         self._key, sub = jax.random.split(self._key)
-        opt_state = self.opt_state
-        if self._opt_memory_kind:
-            # offload: state lives in host memory between steps; stream it to
-            # HBM for the update (async device_put pipelines with dispatch)
-            opt_state = {
-                n: tuple(jax.device_put(s, NamedSharding(self.mesh,
-                                                         self.opt_specs[n]))
-                         for s in st) for n, st in opt_state.items()}
         loss, self.params, new_opt = self._step_fn(
-            self.params, opt_state, lr, jnp.int32(self._step_count), sub, *arrays)
-        if self._opt_memory_kind:
-            new_opt = {
-                n: tuple(jax.device_put(s, self._opt_sharding(self.opt_specs[n]))
-                         for s in st) for n, st in new_opt.items()}
-        self.opt_state = new_opt
+            self.params, self._opt_to_hbm(self.opt_state), lr,
+            jnp.int32(self._step_count), sub, *arrays)
+        self.opt_state = self._opt_to_home(new_opt)
         self.last_loss = Tensor(loss)
         return self.last_loss
 

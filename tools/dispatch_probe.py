@@ -1,0 +1,52 @@
+"""Measure per-execute dispatch latency through the PJRT backend.
+
+Through a tunneled/remote PJRT (the axon backend used in this sandbox),
+each jitted execute may pay a network round-trip that local PJRT does not.
+If that fixed cost is significant relative to the ~170ms bench train step,
+the right TPU-native fix is fewer, larger executions: the scanned
+multi-step trainer (TrainStepEngine.run_steps), the analogue of the
+reference's fleet_executor running a whole section of iterations per
+dispatch (paddle/fluid/distributed/fleet_executor/compute_interceptor.cc
+LoopCounter) rather than one op at a time.
+
+Prints JSON lines:
+  {"probe": "noop_dispatch", "mean_us": ..}   tiny jitted fn, 100 executes
+  {"probe": "chained_dispatch", "mean_us": ..} same but arg=prev result
+  {"probe": "small_matmul", "mean_us": ..}    256x256 matmul, 100 executes
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(name, fn, x, n=100, chain=False):
+    y = fn(x)
+    jax.block_until_ready(y)
+    t0 = time.perf_counter()
+    if chain:
+        for _ in range(n):
+            x = fn(x)
+        jax.block_until_ready(x)
+    else:
+        for _ in range(n):
+            y = fn(y)
+        jax.block_until_ready(y)
+    dt = time.perf_counter() - t0
+    print(json.dumps({"probe": name, "mean_us": round(dt / n * 1e6, 1)}),
+          flush=True)
+
+
+def main():
+    x = jnp.ones((8, 128), jnp.float32)
+    f = jax.jit(lambda a: a + 1.0)
+    timeit("noop_dispatch", f, x)
+    timeit("chained_dispatch", f, x, chain=True)
+    m = jnp.ones((256, 256), jnp.bfloat16)
+    g = jax.jit(lambda a: a @ a)
+    timeit("small_matmul", g, m)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,172 @@
+"""Isolate the Mosaic compile-time pathology in the Pallas LM-loss kernel.
+
+Round-3 on-chip finding: at bench shapes (rows 16k, vocab 50304->50688,
+hidden 768) the lm_loss FORWARD alone did not finish compiling in 9.5 min
+through the remote-compile tunnel — and both round-3 tunnel wedges happened
+immediately after attempting that compile. The flash kernel at comparable
+block areas compiles in tens of seconds, so something in the lm_loss body
+scales superlinearly. This probe times jit-compile of stripped kernel
+variants at SMALL shapes (each compile must stay <~60s) so the pathological
+term can be identified without risking the tunnel:
+
+  variants (cumulative from `bare`):
+    bare      s = h @ w^T, running max/sum, no extras
+    sliced    + the production kernel's `[:, :1]` lane-slices on scratch
+    picked    + label one-hot pick accumulation (iota/compare/where/sum)
+    masked    + padded-vocab NEG_INF masking
+    full      the production kernel itself (ops/pallas/lm_loss.py)
+
+  scaling axes: block_n in {256, 512, 1024} x the variant set, vocab 8192.
+
+Usage (on a live TPU):  python tools/lmloss_compile_probe.py [--quick]
+Prints one JSON line per (variant, block_n): {"variant", "block_n",
+"compile_s", "run_ms"}. Kill-safe: each compile runs in THIS process; run
+the probe under `timeout` and read partial stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr, *,
+            block_v, v_blocks, v_true, variant):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        p_scr[...] = jnp.zeros_like(p_scr)
+
+    h = h_ref[...]
+    w = w_ref[...]
+    s = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+
+    if variant in ("masked", "full"):
+        cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols < v_true, s, jnp.float32(NEG_INF))
+    if variant in ("picked", "masked", "full"):
+        lab = lab_ref[...]
+        cols2 = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        hit = cols2 == lab[:, None]
+        p_scr[...] += jnp.sum(jnp.where(hit, s, jnp.zeros_like(s)), axis=1,
+                              keepdims=True)
+
+    if variant == "bare":
+        # full-width scratch ops, no lane slicing anywhere
+        m_prev = m_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)          # (bn,128) via broadcast
+        l_scr[...] = (l_scr[...] * jnp.exp(m_prev - m_new)
+                      + jnp.sum(jnp.exp(s - m_new[:, :1]), axis=1,
+                                keepdims=True))
+        m_scr[...] = m_new
+    else:
+        # production style: [:, :1] lane-slices
+        m_prev = m_scr[...][:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        l_scr[...] = (l_scr[...] * jnp.exp(m_prev - m_new)
+                      + jnp.sum(jnp.exp(s - m_new), axis=1, keepdims=True))
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(j == v_blocks - 1)
+    def _fin():
+        lse = m_scr[...][:, :1] + jnp.log(l_scr[...][:, :1])
+        loss_ref[...] = (lse - p_scr[...][:, :1])[:, 0]
+        lse_ref[...] = lse[:, 0]
+
+
+def build(n, v, hdim, block_n, block_v, variant):
+    grid = (n // block_n, v // block_v)
+    kern = functools.partial(_kernel, block_v=block_v, v_blocks=v // block_v,
+                             v_true=v - 64, variant=variant)
+
+    def f(h, w, lab):
+        return pl.pallas_call(
+            kern,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_n, hdim), lambda i, j: (i, 0)),
+                pl.BlockSpec((block_v, hdim), lambda i, j: (j, 0)),
+                pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            ],
+            out_specs=[
+                pl.BlockSpec((block_n,), lambda i, j: (i,)),
+                pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            ],
+            out_shape=[jax.ShapeDtypeStruct((n,), jnp.float32),
+                       jax.ShapeDtypeStruct((n,), jnp.float32)],
+            scratch_shapes=[  # three f32 accumulators, production layout
+                pltpu.VMEM((block_n, 128), jnp.float32) for _ in range(3)
+            ],
+            interpret=jax.default_backend() == "cpu",  # CPU = sanity mode
+        )(h, w, lab)
+
+    return f
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="one variant (full) x one block_n (1024)")
+    ap.add_argument("--vocab", type=int, default=8192)
+    ap.add_argument("--hidden", type=int, default=768)
+    ap.add_argument("--rows", type=int, default=4096)
+    args = ap.parse_args()
+
+    n, v, hdim = args.rows, args.vocab, args.hidden
+    h = jnp.ones((n, hdim), jnp.bfloat16)
+    w = jnp.ones((v, hdim), jnp.bfloat16)
+    lab = jnp.zeros((n,), jnp.int32)
+
+    combos = ([("full", 1024)] if args.quick else
+              [(vr, bn) for bn in (1024, 512, 256)
+               for vr in ("bare", "sliced", "picked", "masked", "full")])
+    for variant, block_n in combos:
+        if variant == "full":
+            # the real production kernel (block_n fixed at 1024 by _pick_rows;
+            # only run it for block_n==1024)
+            if block_n != 1024 or n % 1024:
+                continue
+            from paddle_tpu.ops.pallas.lm_loss import lm_head_cross_entropy
+            fn = jax.jit(lambda a, b, c: lm_head_cross_entropy(a, b, c))
+        else:
+            if n % block_n:
+                continue
+            fn = jax.jit(build(n, v, hdim, block_n, 512, variant))
+        t0 = time.time()
+        try:
+            out = fn(h, w, lab)
+            jax.tree_util.tree_map(
+                lambda x: x.block_until_ready(), out)
+            dt = time.time() - t0
+            t1 = time.time()
+            for _ in range(3):
+                out = fn(h, w, lab)
+            jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
+            run_ms = (time.time() - t1) / 3 * 1e3
+            print(json.dumps({"variant": variant, "block_n": block_n,
+                              "compile_s": round(dt, 2),
+                              "run_ms": round(run_ms, 3)}), flush=True)
+        except Exception as e:  # noqa: BLE001 - report and continue
+            print(json.dumps({"variant": variant, "block_n": block_n,
+                              "error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
+            break  # a wedged tunnel makes further combos meaningless
+
+
+if __name__ == "__main__":
+    sys.exit(main())
