@@ -84,11 +84,20 @@ def load_library(name: str):
                 # the cached .so can be unloadable if it was corrupted by a
                 # pre-fix concurrent build: recompile to a fresh temp, load
                 # THAT, and only then swap it into the cache. Never delete the
-                # cache entry — other processes may hold it open, and an
-                # environment-level load failure (missing runtime dep) would
-                # otherwise turn the one-time build into per-process churn.
+                # cache entry — other processes may hold it open. Only retry
+                # when the file is actually damaged (truncated / not ELF): an
+                # environment-level load failure (missing runtime dep,
+                # incompatible libstdc++) would reproduce after a rebuild and
+                # turn the one-time build into per-process churn.
                 sources = [os.path.join(_SRC_DIR, f"{name}.cc")]
                 out = _out_path(name, sources, ())
+                try:
+                    with open(out, "rb") as f:
+                        intact = f.read(4) == b"\x7fELF"
+                except OSError:
+                    intact = False
+                if intact:
+                    raise
                 tmp = f"{out}.retry.{os.getpid()}"
                 _compile(sources, (), tmp)
                 try:

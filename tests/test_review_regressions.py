@@ -139,3 +139,32 @@ def test_native_corrupted_cache_recovers(tmp_path, monkeypatch):
     lib = native.load_library("tcp_store")
     assert lib is not None
     assert os.path.getsize(out) > 1000  # cache healed in place
+
+
+def test_native_env_load_failure_does_not_rebuild(tmp_path, monkeypatch):
+    """A cache entry that IS a real ELF but still fails to dlopen signals an
+    environment problem (missing runtime dep), not corruption — rebuilding
+    would reproduce the failure at multi-second cost per process, so the
+    loader must fall back to Python without recompiling."""
+    import os
+
+    monkeypatch.setenv("PADDLE_TPU_NATIVE_CACHE", str(tmp_path))
+    import importlib
+
+    import paddle_tpu.core.native as native
+    native = importlib.reload(native)
+    src = [os.path.join(native._SRC_DIR, "tcp_store.cc")]
+    out = native._out_path("tcp_store", src, ())
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    payload = b"\x7fELF" + b"\0" * 200  # valid magic, undlopenable body
+    with open(out, "wb") as f:
+        f.write(payload)
+    calls = []
+    real_compile = native._compile
+    monkeypatch.setattr(native, "_compile",
+                        lambda *a, **k: calls.append(a) or real_compile(*a, **k))
+    lib = native.load_library("tcp_store")
+    assert lib is None          # python fallback
+    assert calls == []          # and NO rebuild churn
+    with open(out, "rb") as f:
+        assert f.read() == payload  # cache entry untouched
