@@ -236,18 +236,26 @@ def _orchestrate():
     configs = [("default", {"PADDLE_TPU_BENCH_DECODE": "1"})]
     user_tuned = any(k in os.environ for k in (
         "PADDLE_TPU_BENCH_BATCH", "PADDLE_TPU_BENCH_PALLAS_LOSS",
-        "PADDLE_TPU_BENCH_AUTOTUNE", "PADDLE_TPU_BENCH_RECOMPUTE"))
+        "PADDLE_TPU_BENCH_AUTOTUNE", "PADDLE_TPU_BENCH_RECOMPUTE",
+        "PADDLE_TPU_BENCH_SCAN"))
     # explicit env: honor it verbatim, don't sweep
     if os.environ.get("PADDLE_TPU_BENCH_SWEEP", "1") != "0" and not user_tuned:
         configs += [
             ("batch16", {"PADDLE_TPU_BENCH_BATCH": "16"}),
-            ("batch16_pallas_loss", {"PADDLE_TPU_BENCH_BATCH": "16",
-                                     "PADDLE_TPU_BENCH_PALLAS_LOSS": "1"}),
-            # riskiest LAST (an OOM here wedged the tunnel in round 1; with
+            # K steps fused into one dispatch: removes per-step PJRT
+            # round-trips (significant through the tunneled backend)
+            ("batch16_scan", {"PADDLE_TPU_BENCH_BATCH": "16",
+                              "PADDLE_TPU_BENCH_SCAN": "1"}),
+            # riskiest last (an OOM here wedged the tunnel in round 1; with
             # the fused CE + recompute it should fit — and a wedge at this
             # point can no longer cost an earlier result)
             ("batch32_recompute", {"PADDLE_TPU_BENCH_BATCH": "32",
                                    "PADDLE_TPU_BENCH_RECOMPUTE": "1"}),
+            # VERY last: the lm_loss Mosaic compile at bench vocab exceeded
+            # 9.5 min and wedged the tunnel twice in round 3 — anything after
+            # it would be lost (tools/lmloss_compile_probe.py tracks the fix)
+            ("batch16_pallas_loss", {"PADDLE_TPU_BENCH_BATCH": "16",
+                                     "PADDLE_TPU_BENCH_PALLAS_LOSS": "1"}),
         ]
     per_attempt = float(os.environ.get("PADDLE_TPU_BENCH_WALL_TIMEOUT", "420"))
     budget = float(os.environ.get("PADDLE_TPU_BENCH_SWEEP_BUDGET", "600"))

@@ -37,6 +37,35 @@ def _out_path(name: str, sources, extra_flags) -> str:
     return os.path.join(_cache_dir(), f"{name}-{h.hexdigest()[:16]}.so")
 
 
+def _elf_intact(path: str) -> bool:
+    """Structural sanity for a cached .so: magic AND the section-header table
+    the ELF header promises actually fits inside the file. A half-written
+    object from an interrupted build keeps the magic (the header is written
+    first) but its e_shoff points past the truncation, so this distinguishes
+    'file is damaged — rebuild' from 'file is fine but undlopenable —
+    environment problem, rebuilding would reproduce it'."""
+    import struct
+
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            hdr = f.read(64)
+    except OSError:
+        return False
+    if len(hdr) < 64 or hdr[:4] != b"\x7fELF":
+        return False
+    is64 = hdr[4] == 2
+    little = hdr[5] == 1
+    end = "<" if little else ">"
+    if is64:
+        (e_shoff,) = struct.unpack_from(end + "Q", hdr, 0x28)
+        e_shentsize, e_shnum = struct.unpack_from(end + "HH", hdr, 0x3A)
+    else:
+        (e_shoff,) = struct.unpack_from(end + "I", hdr, 0x20)
+        e_shentsize, e_shnum = struct.unpack_from(end + "HH", hdr, 0x2E)
+    return size >= e_shoff + e_shentsize * e_shnum
+
+
 def _compile(sources, extra_flags, out: str) -> None:
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
            *extra_flags, *sources, "-o", out]
@@ -78,26 +107,21 @@ def load_library(name: str):
         if name in _libs:
             return _libs[name]
         try:
-            try:
-                lib = ctypes.CDLL(build_library(name))
-            except OSError:
-                # the cached .so can be unloadable if it was corrupted by a
-                # pre-fix concurrent build: recompile to a fresh temp, load
-                # THAT, and only then swap it into the cache. Never delete the
-                # cache entry — other processes may hold it open. Only retry
-                # when the file is actually damaged (truncated / not ELF): an
-                # environment-level load failure (missing runtime dep,
-                # incompatible libstdc++) would reproduce after a rebuild and
-                # turn the one-time build into per-process churn.
+            out = build_library(name)
+            if _elf_intact(out):
+                # structurally sound: a dlopen failure now is an environment
+                # problem (missing runtime dep, incompatible libstdc++) that a
+                # rebuild would only reproduce at multi-second cost — let the
+                # OSError fall through to the Python fallback.
+                lib = ctypes.CDLL(out)
+            else:
+                # the cached .so is damaged (e.g. truncated by an interrupted
+                # pre-fix concurrent build). The check MUST run before dlopen:
+                # mapping a truncated object can die with SIGBUS, not OSError.
+                # Recompile to a fresh temp, load THAT, then swap it into the
+                # cache — never delete the entry, other processes may hold it
+                # open (dlopen keeps the mapping across the rename).
                 sources = [os.path.join(_SRC_DIR, f"{name}.cc")]
-                out = _out_path(name, sources, ())
-                try:
-                    with open(out, "rb") as f:
-                        intact = f.read(4) == b"\x7fELF"
-                except OSError:
-                    intact = False
-                if intact:
-                    raise
                 tmp = f"{out}.retry.{os.getpid()}"
                 _compile(sources, (), tmp)
                 try:
@@ -108,7 +132,7 @@ def load_library(name: str):
                     with contextlib.suppress(OSError):
                         os.remove(tmp)
                     raise
-                os.replace(tmp, out)  # dlopen keeps the mapping across rename
+                os.replace(tmp, out)
         except (RuntimeError, OSError) as e:
             print(f"paddle_tpu: native {name} unavailable ({e}); using Python "
                   f"fallback", file=sys.stderr)

@@ -156,7 +156,16 @@ def test_native_env_load_failure_does_not_rebuild(tmp_path, monkeypatch):
     src = [os.path.join(native._SRC_DIR, "tcp_store.cc")]
     out = native._out_path("tcp_store", src, ())
     os.makedirs(os.path.dirname(out), exist_ok=True)
-    payload = b"\x7fELF" + b"\0" * 200  # valid magic, undlopenable body
+    # structurally-valid ELF header (magic, 64-bit LE, section table fits in
+    # the file) whose body is garbage — dlopen fails, but the structure says
+    # "not truncated", i.e. rebuild would reproduce the failure
+    import struct
+    hdr = bytearray(64)
+    hdr[0:4] = b"\x7fELF"
+    hdr[4], hdr[5] = 2, 1  # ELFCLASS64, little-endian
+    struct.pack_into("<Q", hdr, 0x28, 64)   # e_shoff = end of header
+    struct.pack_into("<HH", hdr, 0x3A, 0, 0)  # e_shentsize, e_shnum
+    payload = bytes(hdr) + b"\0" * 64
     with open(out, "wb") as f:
         f.write(payload)
     calls = []
@@ -168,3 +177,39 @@ def test_native_env_load_failure_does_not_rebuild(tmp_path, monkeypatch):
     assert calls == []          # and NO rebuild churn
     with open(out, "rb") as f:
         assert f.read() == payload  # cache entry untouched
+
+
+def test_native_truncated_cache_recovers(tmp_path, monkeypatch):
+    """A HALF-written .so keeps the ELF magic (the header lands first) but
+    its section table points past the truncation — that must still classify
+    as corruption and heal, not as an environment failure."""
+    import os
+
+    monkeypatch.setenv("PADDLE_TPU_NATIVE_CACHE", str(tmp_path))
+    import importlib
+
+    import paddle_tpu.core.native as native
+    native = importlib.reload(native)
+    lib = native.load_library("tcp_store")   # real build into the fresh cache
+    assert lib is not None
+    src = [os.path.join(native._SRC_DIR, "tcp_store.cc")]
+    out = native._out_path("tcp_store", src, ())
+    with open(out, "rb") as f:
+        real = f.read()
+    with open(out, "wb") as f:
+        f.write(real[:1024])  # truncate early (magic survives, segments don't)
+    assert not native._elf_intact(out)
+    # dlopen caches by path within a process (the intact pre-truncation
+    # mapping would mask the damage) — a FRESH process must hit the heal path
+    import subprocess
+    import sys as _sys
+    env = dict(os.environ, PADDLE_TPU_NATIVE_CACHE=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [_sys.executable, "-c",
+         "import paddle_tpu.core.native as n; "
+         "print('LOADED' if n.load_library('tcp_store') else 'NONE')"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert "LOADED" in r.stdout, (r.stdout, r.stderr[-500:])
+    assert os.path.getsize(out) > len(real) // 2  # cache healed in place

@@ -1,0 +1,9 @@
+"""Make `import paddle_tpu` work when a tools/ script runs straight from a
+checkout with no pip install: the script's own directory (tools/) is on
+sys.path, so `import _bootstrap` is all a tool needs."""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)

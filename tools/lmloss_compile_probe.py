@@ -25,6 +25,8 @@ the probe under `timeout` and read partial stdout.
 """
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path, tools/_bootstrap.py)
+
 import argparse
 import functools
 import json

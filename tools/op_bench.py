@@ -18,8 +18,11 @@ One JSON line per config: {"op", "shape", "dtype", "mean_us", "p50_us", ...}
 """
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path, tools/_bootstrap.py)
+
 import argparse
 import json
+import sys
 import time
 
 import numpy as np

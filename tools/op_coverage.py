@@ -18,6 +18,8 @@ Test: tests/test_op_coverage.py asserts missing == [].
 """
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path, tools/_bootstrap.py)
+
 import argparse
 import json
 import os

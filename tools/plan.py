@@ -10,6 +10,8 @@ prints a ranked JSON table of the planner's cost-model readout
 """
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path, tools/_bootstrap.py)
+
 import argparse
 import json
 import os
