@@ -352,3 +352,29 @@ def test_zero_sharding_gathers_params_and_keeps_fused_grad_reduce():
     assert n_ag >= 5, f"{n_ag} all-gathers: ZeRO param re-materialization gone"
     assert 1 <= n_ar <= 8, (
         f"{n_ar} all-reduce ops — gradient reduction no longer combined")
+
+
+def test_run_steps_scan_is_one_program_one_loop():
+    """The fused K-step trainer must compile to ONE program whose steps run
+    inside a single while-loop (lax.scan), with the same fused gradient
+    all-reduce as the single step — not K unrolled bodies and not K
+    dispatches. Donation must still alias the carried params+opt state."""
+    eng, arrays = _dp8_engine(n_linear=12)
+    k = 5
+    jf = eng._build_scan(arrays, True)
+    keys = jnp.stack([jax.random.key(i) for i in range(k)])
+    comp = jf.lower(eng.params, eng.opt_state, jnp.full((k,), 1e-3, jnp.float32),
+                    jnp.int32(1), keys, *arrays).compile()
+    txt = comp.as_text()
+    # the while op line is `%while.N = (...) while(%arg), condition=...`
+    n_while = len(re.findall(r"\) while\(", txt))
+    assert n_while == 1, f"expected one scan while-loop, found {n_while}"
+    n_ar = len(_ALL_REDUCE_OP.findall(txt))
+    assert 1 <= n_ar <= 4, (
+        f"{n_ar} all-reduce ops inside the scanned step — the fused gradient "
+        f"reduction regressed in the run_steps path")
+    ma = comp.memory_analysis()
+    state_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                      for a in eng.params.values())
+    assert ma.alias_size_in_bytes >= 0.9 * state_bytes, (
+        "scan carry donation regressed: params would double-buffer per step")
