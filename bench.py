@@ -43,6 +43,9 @@ def main():
         batch, seq, steps, warmup = 8, 128, 5, 1
     batch = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", batch))
     steps = int(os.environ.get("PADDLE_TPU_BENCH_STEPS", steps))
+    seq = int(os.environ.get("PADDLE_TPU_BENCH_SEQ", seq))
+    if seq != cfg.max_seq_len:  # long-context single-chip config (flash tiles
+        cfg.max_seq_len = seq   # over seq; BASELINE.md 4k-16k sweep)
     if os.environ.get("PADDLE_TPU_BENCH_RECOMPUTE"):  # trade FLOPs for HBM
         cfg.use_recompute = True
     if os.environ.get("PADDLE_TPU_BENCH_AUTOTUNE"):  # flash block-size search
@@ -237,7 +240,7 @@ def _orchestrate():
     user_tuned = any(k in os.environ for k in (
         "PADDLE_TPU_BENCH_BATCH", "PADDLE_TPU_BENCH_PALLAS_LOSS",
         "PADDLE_TPU_BENCH_AUTOTUNE", "PADDLE_TPU_BENCH_RECOMPUTE",
-        "PADDLE_TPU_BENCH_SCAN"))
+        "PADDLE_TPU_BENCH_SCAN", "PADDLE_TPU_BENCH_SEQ"))
     # explicit env: honor it verbatim, don't sweep
     if os.environ.get("PADDLE_TPU_BENCH_SWEEP", "1") != "0" and not user_tuned:
         configs += [
