@@ -508,9 +508,19 @@ class GPTForPretraining(nn.Layer):
 
         try:
             # one compiled decode program per sampling configuration — a fresh
-            # jax.jit wrapper each call would recompile every generate()
+            # jax.jit wrapper each call would recompile every generate().
+            # The active amp scope is part of the key: tracing under
+            # paddle.amp.auto_cast() bakes bf16 matmuls into the executable
+            # (halves decode weight traffic — the decode loop is HBM-bound)
+            from ..core.dispatch import amp_ctx
+            amp = amp_ctx()
+            # the FULL behavioral tuple: dtype/level AND the op lists that
+            # _autocast_dtype_for consults — scopes differing only in
+            # white/black lists must not share an executable
+            amp_key = ((str(amp.dtype), amp.level, frozenset(amp.white),
+                        frozenset(amp.black)) if amp is not None else None)
             cache_key = (b, prompt, max_new_tokens, float(temperature),
-                         int(top_k), float(top_p), eos_token_id)
+                         int(top_k), float(top_p), eos_token_id, amp_key)
             jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
             fn = jit_cache.get(cache_key)
             if fn is None:

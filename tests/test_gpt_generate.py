@@ -93,3 +93,27 @@ def test_length_guard(model):
     with pytest.raises(ValueError, match="max_seq_len"):
         model.generate(paddle.to_tensor(ids),
                        max_new_tokens=model.config.max_seq_len)
+
+
+def test_generate_under_amp_caches_separately():
+    """Tracing generate under paddle.amp.auto_cast bakes bf16 matmuls into
+    the decode executable; the amp scope must be part of the jit cache key
+    so f32 and bf16 programs never collide."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+
+    paddle.seed(0)
+    m = GPTForPretraining(gpt_tiny())
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 100, (2, 8)).astype(np.int64))
+    out_f32 = m.generate(ids, max_new_tokens=4, temperature=0)
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        out_bf16 = m.generate(ids, max_new_tokens=4, temperature=0)
+    assert out_bf16.shape == out_f32.shape == [2, 12]
+    # two distinct cached executables (amp state in the key)
+    assert len(m._generate_jit_cache) == 2
+    # prompts are echoed verbatim either way
+    np.testing.assert_array_equal(out_bf16.numpy()[:, :8], ids.numpy())

@@ -1,0 +1,73 @@
+"""Decompose KV-cache decode time: prefill+dispatch vs per-token scan cost.
+
+Round-3 on-chip datum: generate(batch 16, prompt 128, 64 new, greedy) ran at
+179.8 total tokens/s — ~89 ms per decode step for a 124M-param model whose
+weights fit one HBM pass in <1 ms. This probe times max_new_tokens in
+{1, 8, 64, 128} at the bench config; the slope of time vs K is the true
+per-token cost, the intercept is prefill + dispatch + D2H. A big intercept
+says tunnel/dispatch; a big slope says the scan step itself is slow (e.g.
+cache update not in-place, or the per-step LM head dominating).
+
+Usage (live TPU): python tools/decode_probe.py [--batch 16] [--prompt 128]
+One JSON line per K: {"k", "total_s", "tokens_per_s"}; then a summary line
+{"per_token_ms", "intercept_s"} from a least-squares fit.
+"""
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path, tools/_bootstrap.py)
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--prompt", type=int, default=128)
+    ap.add_argument("--ks", default="1,8,64,128")
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    import jax
+
+    on_tpu = jax.default_backend() != "cpu"
+    cfg = (GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                     num_heads=12, max_seq_len=1024) if on_tpu else
+           __import__("paddle_tpu.models", fromlist=["gpt_tiny"]).gpt_tiny())
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompt = min(args.prompt, cfg.max_seq_len // 2)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (args.batch, prompt)).astype(np.int64))
+
+    ks, xs, ys = [int(k) for k in args.ks.split(",")], [], []
+    with paddle.amp.auto_cast(enable=on_tpu, dtype="bfloat16"):  # match bench
+        for k in ks:
+            if prompt + k > cfg.max_seq_len:
+                continue
+            model.generate(ids, max_new_tokens=k, temperature=0)  # compile
+            t0 = time.perf_counter()
+            out = model.generate(ids, max_new_tokens=k, temperature=0)
+            int(out.numpy()[0, -1])                               # D2H sync
+            dt = time.perf_counter() - t0
+            xs.append(k)
+            ys.append(dt)
+            print(json.dumps({"k": k, "total_s": round(dt, 4),
+                              "tokens_per_s": round(args.batch * k / dt, 1)}),
+                  flush=True)
+    if len(xs) >= 2:
+        slope, intercept = np.polyfit(xs, ys, 1)
+        print(json.dumps({"per_token_ms": round(slope * 1e3, 3),
+                          "intercept_s": round(float(intercept), 4),
+                          "batch": args.batch, "prompt": prompt}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
