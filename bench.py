@@ -35,9 +35,20 @@ def main():
     n_dev = jax.device_count()
 
     if on_tpu:
-        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
-                        max_seq_len=1024)
-        batch, seq, steps, warmup = 8, 1024, 20, 3
+        # base = GPT-2 124M (the round-1..3 headline config); medium = 350M
+        # (hidden 1024 tiles the 128x128 MXU better — higher MFU ceiling)
+        model_name = os.environ.get("PADDLE_TPU_BENCH_MODEL", "base")
+        if model_name not in ("base", "medium"):
+            raise SystemExit(f"PADDLE_TPU_BENCH_MODEL must be 'base' or "
+                             f"'medium', got {model_name!r}")
+        if model_name == "medium":
+            cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                            num_heads=16, max_seq_len=1024)
+            batch, seq, steps, warmup = 8, 1024, 10, 2
+        else:
+            cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                            num_heads=12, max_seq_len=1024)
+            batch, seq, steps, warmup = 8, 1024, 20, 3
     else:
         cfg = gpt_tiny()
         batch, seq, steps, warmup = 8, 128, 5, 1
@@ -243,7 +254,8 @@ def _orchestrate():
     user_tuned = any(k in os.environ for k in (
         "PADDLE_TPU_BENCH_BATCH", "PADDLE_TPU_BENCH_PALLAS_LOSS",
         "PADDLE_TPU_BENCH_AUTOTUNE", "PADDLE_TPU_BENCH_RECOMPUTE",
-        "PADDLE_TPU_BENCH_SCAN", "PADDLE_TPU_BENCH_SEQ"))
+        "PADDLE_TPU_BENCH_SCAN", "PADDLE_TPU_BENCH_SEQ",
+        "PADDLE_TPU_BENCH_MODEL"))
     # explicit env: honor it verbatim, don't sweep
     if os.environ.get("PADDLE_TPU_BENCH_SWEEP", "1") != "0" and not user_tuned:
         configs += [

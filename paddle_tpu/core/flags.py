@@ -80,5 +80,8 @@ define_flag("apply_ir_passes", True, "run CSE/DCE/fuse passes before lowering st
 define_flag("use_autotune", False, "enable kernel autotune (pallas block-size search + cache)")
 define_flag("enable_unused_var_check", False, "warn when an op kernel never reads a declared input")
 define_flag("use_pallas_lm_loss", False, "route fused LM loss to the online Pallas kernel")
+define_flag("pallas_lm_loss_block_n", 1024,
+            "row-block size of the Pallas LM-loss COMPUTE tiles (256/512/1024;"
+            " 1D operands stay on 1024-element blocks via revisit sub-slices)")
 define_flag("use_pallas_layernorm", False, "route layer_norm to the fused Pallas kernel")
 define_flag("pallas_interpret_ok", False, "allow pallas kernels in interpret mode on CPU (tests)")

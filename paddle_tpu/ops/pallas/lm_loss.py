@@ -52,6 +52,25 @@ def _pick_rows(n: int) -> int:
     return 1024 if n % 1024 == 0 and n >= 1024 else 0
 
 
+def _block_n() -> int:
+    """COMPUTE row-block size (the 2D h/s tiles). The 1D operands always use
+    1024-element blocks (_pick_rows); when block_n < 1024 each 1D block is
+    revisited 1024//block_n consecutive row-steps via an i//pack index map
+    and pl.ds sub-slices. Mosaic compile time grows superlinearly in the
+    vector-op count of the kernel body (~block_n x block_v tiles): the
+    round-3 on-chip probe is what this knob exists for — at 1024x512 the
+    forward alone exceeded 9.5 min of Mosaic compile."""
+    from ...core.flags import flag
+
+    v = int(flag("pallas_lm_loss_block_n") or 1024)
+    if v not in (256, 512, 1024):
+        raise ValueError(
+            f"FLAGS_pallas_lm_loss_block_n must be 256, 512 or 1024 (the 1D "
+            f"operands tile at 1024 and the compute block must divide it); "
+            f"got {v}")
+    return v
+
+
 def supported(n_rows: int, vocab: int, hidden: int) -> bool:
     # vocab needs no divisibility: the wrapper pads W to a 512 multiple and the
     # kernels mask the padded columns to NEG_INF (a 50304 vocab would otherwise
@@ -63,8 +82,13 @@ def supported(n_rows: int, vocab: int, hidden: int) -> bool:
 # ---------------------------------------------------------------- forward ----
 
 def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr,
-                *, block_v, v_blocks, v_true):
+                *, block_n, block_v, v_blocks, v_true, pack):
+    i = pl.program_id(0)
     j = pl.program_id(1)
+    # 1D operands ride 1024-element blocks (their XLA tile); when the compute
+    # block is smaller, each 1D block is revisited `pack` consecutive row
+    # steps and this step touches only its ds sub-slice
+    off = (i % pack) * block_n if pack > 1 else 0
 
     @pl.when(j == 0)
     def _init():
@@ -77,7 +101,7 @@ def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr,
     s = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # [bn, bv]
 
-    lab = lab_ref[...]                  # [bn] int32 (1D block: a [nb, bn]
+    lab = lab_ref[pl.ds(off, block_n)]  # [bn] int32 (1D block: a [nb, bn]
     #                                     2D layout with [1, bn] blocks breaks
     #                                     Mosaic's (8, 128) block-tiling rule)
     col0 = j * block_v
@@ -101,9 +125,11 @@ def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr,
 
     @pl.when(j == v_blocks - 1)
     def _finalize():
+        # the output block flushes when i crosses a pack boundary; each of the
+        # pack visits fills its own sub-slice at its last vocab step
         lse = m_scr[...][:, :1] + jnp.log(l_scr[...][:, :1])
-        loss_ref[...] = (lse - p_scr[...][:, :1])[:, 0]
-        lse_ref[...] = lse[:, 0]
+        loss_ref[pl.ds(off, block_n)] = (lse - p_scr[...][:, :1])[:, 0]
+        lse_ref[pl.ds(off, block_n)] = lse[:, 0]
 
 
 def _fwd(h2, w, labels, block_n, block_v, v_true=None):
@@ -113,20 +139,21 @@ def _fwd(h2, w, labels, block_n, block_v, v_true=None):
         # one materialized cast (f32 master -> bf16 under amp): tiles then read
         # at half bandwidth; dW still accumulates f32 in scratch
         w = w.astype(h2.dtype)
+    pack = 1024 // block_n
     grid = (n // block_n, v // block_v)
-    kernel = functools.partial(_fwd_kernel, block_v=block_v,
-                               v_blocks=v // block_v, v_true=v_true)
+    kernel = functools.partial(_fwd_kernel, block_n=block_n, block_v=block_v,
+                               v_blocks=v // block_v, v_true=v_true, pack=pack)
     loss, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
             pl.BlockSpec((block_v, hdim), lambda i, j: (j, _I0)),
-            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((1024,), lambda i, j: (i // pack,)),
         ],
         out_specs=[
-            pl.BlockSpec((block_n,), lambda i, j: (i,)),
-            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((1024,), lambda i, j: (i // pack,)),
+            pl.BlockSpec((1024,), lambda i, j: (i // pack,)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n,), jnp.float32),
@@ -142,8 +169,10 @@ def _fwd(h2, w, labels, block_n, block_v, v_true=None):
 # --------------------------------------------------------------- backward ----
 
 def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dh_ref, dh_scr,
-               *, block_v, v_blocks, v_true):
+               *, block_n, block_v, v_blocks, v_true, pack):
+    i = pl.program_id(0)
     j = pl.program_id(1)
+    off = (i % pack) * block_n if pack > 1 else 0
 
     @pl.when(j == 0)
     def _init():
@@ -153,9 +182,9 @@ def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dh_ref, dh_scr,
     w = w_ref[...]
     s = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-    lab = lab_ref[...]
-    lse = lse_ref[...]
-    g = g_ref[...]
+    lab = lab_ref[pl.ds(off, block_n)]
+    lse = lse_ref[pl.ds(off, block_n)]
+    g = g_ref[pl.ds(off, block_n)]
     cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     if v_true is not None:  # padded columns: p -> 0, no gradient flow
         s = jnp.where(cols < v_true, s, jnp.float32(NEG_INF))
@@ -171,9 +200,10 @@ def _dh_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dh_ref, dh_scr,
 
 
 def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dw_ref, dw_scr,
-               *, block_v, n_blocks, v_true):
+               *, block_n, block_v, n_blocks, v_true, pack):
     j = pl.program_id(0)
     i = pl.program_id(1)
+    off = (i % pack) * block_n if pack > 1 else 0
 
     @pl.when(i == 0)
     def _init():
@@ -183,9 +213,9 @@ def _dw_kernel(h_ref, w_ref, lab_ref, lse_ref, g_ref, dw_ref, dw_scr,
     w = w_ref[...]
     s = jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-    lab = lab_ref[...]
-    lse = lse_ref[...]
-    g = g_ref[...]
+    lab = lab_ref[pl.ds(off, block_n)]
+    lse = lse_ref[pl.ds(off, block_n)]
+    g = g_ref[pl.ds(off, block_n)]
     cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     if v_true is not None:  # padded columns contribute zero to dW rows >= v_true
         s = jnp.where(cols < v_true, s, jnp.float32(NEG_INF))
@@ -207,19 +237,20 @@ def _bwd(res, g, block_n, block_v, v_true=None):
         w = w.astype(h2.dtype)
     n, hdim = h2.shape
     v = w.shape[0]
+    pack = 1024 // block_n
     nb, vb = n // block_n, v // block_v
     g32 = g.astype(jnp.float32)
 
     dh = pl.pallas_call(
-        functools.partial(_dh_kernel, block_v=block_v, v_blocks=vb,
-                          v_true=v_true),
+        functools.partial(_dh_kernel, block_n=block_n, block_v=block_v,
+                          v_blocks=vb, v_true=v_true, pack=pack),
         grid=(nb, vb),
         in_specs=[
             pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
             pl.BlockSpec((block_v, hdim), lambda i, j: (j, _I0)),
-            pl.BlockSpec((block_n,), lambda i, j: (i,)),
-            pl.BlockSpec((block_n,), lambda i, j: (i,)),
-            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((1024,), lambda i, j: (i // pack,)),
+            pl.BlockSpec((1024,), lambda i, j: (i // pack,)),
+            pl.BlockSpec((1024,), lambda i, j: (i // pack,)),
         ],
         out_specs=pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
         out_shape=jax.ShapeDtypeStruct((n, hdim), h2.dtype),
@@ -228,15 +259,15 @@ def _bwd(res, g, block_n, block_v, v_true=None):
     )(h2, w, labels, lse, g32)
 
     dw = pl.pallas_call(
-        functools.partial(_dw_kernel, block_v=block_v, n_blocks=nb,
-                          v_true=v_true),
+        functools.partial(_dw_kernel, block_n=block_n, block_v=block_v,
+                          n_blocks=nb, v_true=v_true, pack=pack),
         grid=(vb, nb),
         in_specs=[
             pl.BlockSpec((block_n, hdim), lambda j, i: (i, _I0)),
             pl.BlockSpec((block_v, hdim), lambda j, i: (j, _I0)),
-            pl.BlockSpec((block_n,), lambda j, i: (i,)),
-            pl.BlockSpec((block_n,), lambda j, i: (i,)),
-            pl.BlockSpec((block_n,), lambda j, i: (i,)),
+            pl.BlockSpec((1024,), lambda j, i: (i // pack,)),
+            pl.BlockSpec((1024,), lambda j, i: (i // pack,)),
+            pl.BlockSpec((1024,), lambda j, i: (i // pack,)),
         ],
         out_specs=pl.BlockSpec((block_v, hdim), lambda j, i: (j, _I0)),
         out_shape=jax.ShapeDtypeStruct((v, hdim), jnp.float32),
@@ -276,7 +307,8 @@ def lm_head_cross_entropy(h2, w, labels):
     of the pad)."""
     n = h2.shape[0]
     v = w.shape[0]
-    block_n = _pick_rows(n)
+    assert _pick_rows(n) == 1024  # wrapper in ops/fused.py pads rows to 1024
+    block_n = _block_n()          # compute tile; FLAGS_pallas_lm_loss_block_n
     vpad = (-v) % 512
     if vpad:
         w = jnp.concatenate(

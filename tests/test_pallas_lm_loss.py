@@ -151,3 +151,30 @@ def test_mixed_dtype_bf16_h_f32_w():
         np.testing.assert_allclose(gw, gr[1], atol=5e-3, rtol=5e-2)
     finally:
         paddle.set_flags({"use_pallas_lm_loss": False, "pallas_interpret_ok": False})
+
+
+@pytest.mark.parametrize("block_n", [256, 512])
+def test_small_compute_blocks_match_dense(block_n):
+    """FLAGS_pallas_lm_loss_block_n shrinks the 2D compute tiles while the
+    1D operands stay on their 1024-element XLA-tile blocks (revisit
+    sub-slices) — value and both grads must match the dense reference at
+    every supported block size. (The knob exists because Mosaic compile time
+    grows superlinearly in per-block vector ops — BASELINE.md round 3.)"""
+    paddle.set_flags({"pallas_lm_loss_block_n": block_n})
+    try:
+        rng = np.random.RandomState(7)
+        N, V, H = 2048, 640, 128  # N spans 2 revisit groups at block 256
+        h = jnp.asarray(rng.randn(N, H).astype(np.float32))
+        w = jnp.asarray((rng.randn(V, H) * 0.05).astype(np.float32))
+        lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
+        loss = lm_head_cross_entropy(h, w, lab)
+        ref = _dense(h, w, lab)
+        np.testing.assert_allclose(loss, ref, atol=1e-4, rtol=1e-4)
+        gp = jax.grad(lambda a, b: lm_head_cross_entropy(a, b, lab).mean(),
+                      argnums=(0, 1))(h, w)
+        gr = jax.grad(lambda a, b: _dense(a, b, lab).mean(),
+                      argnums=(0, 1))(h, w)
+        np.testing.assert_allclose(gp[0], gr[0], atol=1e-5)
+        np.testing.assert_allclose(gp[1], gr[1], atol=1e-5)
+    finally:
+        paddle.set_flags({"pallas_lm_loss_block_n": 1024})
