@@ -62,7 +62,12 @@ def main():
     if os.environ.get("PADDLE_TPU_BENCH_AUTOTUNE"):  # flash block-size search
         paddle.incubate.autotune.set_config({"kernel": {"enable": True}})
     if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LOSS"):  # online LM-loss kernel
-        paddle.set_flags({"use_pallas_lm_loss": True})
+        # compute block 256 by default: the 1024-block variant's Mosaic
+        # compile exceeded 9.5 min on chip (BASELINE.md round 3)
+        paddle.set_flags({
+            "use_pallas_lm_loss": True,
+            "pallas_lm_loss_block_n": int(os.environ.get(
+                "PADDLE_TPU_BENCH_PALLAS_LOSS_BLOCK", "256"))})
     if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LN"):  # fused LayerNorm kernel
         paddle.set_flags({"use_pallas_layernorm": True})
     if batch % n_dev:  # batch dim shards over dp_degree = n_dev

@@ -1,0 +1,100 @@
+"""Ring vs Ulysses vs dense sequence parallelism — XLA cost-model comparison.
+
+The BASELINE.md on-chip ring-vs-Ulysses sweep needs multiple real chips
+(sp>1 on one chip is degenerate), which this sandbox does not have. This is
+the chip-independent half: compile the FULL GPT train step at each (impl,
+sp_degree, seq) on the virtual 8-device CPU mesh and report what the XLA
+cost model and the compiled HLO say —
+
+  flops            cost_analysis() total flops (per device program)
+  bytes            cost_analysis() bytes accessed (HBM traffic proxy)
+  peak_mb          memory_analysis() temp+output peak per device
+  collective ops   collective-permute (ring) / all-to-all (Ulysses) counts
+
+Ring should show collective-permutes with per-shard peak memory ~1/sp of
+dense attention's; Ulysses shows all-to-alls with head-sharded compute.
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     JAX_PLATFORMS=cpu python tools/sp_cost_compare.py
+One JSON line per config; paste the table into BASELINE.md.
+"""
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path, tools/_bootstrap.py)
+
+import argparse
+import json
+import re
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="1024,4096")
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+
+    import os
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8"
+                                   ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+    from paddle_tpu.models import GPTConfig, GPTForPretraining
+
+    for seq in [int(s) for s in args.seqs.split(",")]:
+        for impl, sp in [("dense", 1), ("ring", 2), ("ulysses", 2),
+                         ("ring", 4), ("ulysses", 4)]:
+            set_hybrid_communicate_group(None)
+            fleet.fleet.__init__()
+            paddle.seed(0)
+            strategy = dist.DistributedStrategy()
+            strategy.hybrid_configs = {"dp_degree": 8 // sp,
+                                       "sep_degree": sp}
+            if impl != "dense":
+                strategy.sep_impl = impl
+            fleet.init(is_collective=True, strategy=strategy)
+            cfg = GPTConfig(vocab_size=1024, hidden_size=args.hidden,
+                            num_layers=args.layers, num_heads=args.heads,
+                            max_seq_len=seq)
+            model = GPTForPretraining(cfg)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                         parameters=model.parameters())
+            eng = fleet.distributed_engine(model, opt)
+            rng = np.random.RandomState(0)
+            ids = jnp.asarray(rng.randint(0, 1024, (args.batch, seq)),
+                              jnp.int64)
+            labels = jnp.roll(ids, -1, 1)
+            jf = eng._build([ids, labels])
+            comp = jf.lower(eng.params, eng.opt_state, jnp.float32(1e-4),
+                            jnp.int32(1), jax.random.key(0), ids,
+                            labels).compile()
+            ca = comp.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            ma = comp.memory_analysis()
+            txt = comp.as_text()
+            row = {
+                "impl": impl, "sp": sp, "seq": seq,
+                "gflops": round(float(ca.get("flops", 0)) / 1e9, 2),
+                "gbytes": round(float(ca.get("bytes accessed", 0)) / 1e9, 3),
+                "peak_mb": round((ma.temp_size_in_bytes +
+                                  ma.output_size_in_bytes) / 1e6, 1),
+                "collective_permutes": len(
+                    re.findall(r"collective-permute\(", txt)),
+                "all_to_alls": len(re.findall(r"all-to-all\(", txt)),
+            }
+            print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
