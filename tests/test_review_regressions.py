@@ -190,14 +190,16 @@ def test_native_truncated_cache_recovers(tmp_path, monkeypatch):
 
     import paddle_tpu.core.native as native
     native = importlib.reload(native)
-    lib = native.load_library("tcp_store")   # real build into the fresh cache
-    assert lib is not None
-    src = [os.path.join(native._SRC_DIR, "tcp_store.cc")]
-    out = native._out_path("tcp_store", src, ())
+    # build WITHOUT dlopen-ing here: truncating a file this process has
+    # mapped poisons the live mapping (later symbol access SIGBUSes the
+    # whole pytest process — exactly the hazard the loader guards against)
+    out = native.build_library("tcp_store")
     with open(out, "rb") as f:
         real = f.read()
-    with open(out, "wb") as f:
+    tmp_trunc = out + ".trunc"
+    with open(tmp_trunc, "wb") as f:
         f.write(real[:1024])  # truncate early (magic survives, segments don't)
+    os.replace(tmp_trunc, out)  # swap, never write the cache file in place
     assert not native._elf_intact(out)
     # dlopen caches by path within a process (the intact pre-truncation
     # mapping would mask the damage) — a FRESH process must hit the heal path
