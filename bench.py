@@ -172,9 +172,17 @@ def main():
 
     tokens_per_sec = steps * batch * seq / dt
     tokens_per_sec_chip = tokens_per_sec / n_dev
-    # MFU on v5e (197 TFLOPs bf16): 6 * params * tokens/sec
-    flops_per_tok = 6 * n_params
+    # MFU on v5e (197 TFLOPs bf16) with the standard model-FLOPs accounting
+    # (PaLM appendix B): 6*N parameter FLOPs + 12*L*h*s attention-matmul
+    # FLOPs per token. This deliberately follows the PaLM convention, which
+    # counts FULL attention matmuls (the causal flash kernel actually skips
+    # ~half those blocks). Rounds 1-2 reported the 6*N-only figure; both are
+    # recorded so the cross-round series stays comparable.
+    flops_per_tok_param = 6 * n_params
+    flops_per_tok = flops_per_tok_param + 12 * cfg.num_layers * cfg.hidden_size * seq
     mfu = (flops_per_tok * tokens_per_sec_chip) / 197e12 if on_tpu else None
+    mfu_param = (flops_per_tok_param * tokens_per_sec_chip) / 197e12 \
+        if on_tpu else None
 
     print(json.dumps({
         "metric": "gpt_pretrain_tokens_per_sec_per_chip",
@@ -188,6 +196,7 @@ def main():
             "final_loss": round(final_loss, 4),
             "platform": jax.default_backend(), "devices": n_dev,
             "mfu_vs_v5e_bf16_peak": round(mfu, 4) if mfu else None,
+            "mfu_param_flops_only": round(mfu_param, 4) if mfu_param else None,
             "decode_tokens_per_sec": decode_tps,
             "degraded": degraded,
         },

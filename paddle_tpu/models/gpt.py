@@ -433,7 +433,20 @@ class GPTForPretraining(nn.Layer):
         nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
         state = self.state_dict(include_non_persistable_buffer=True)
         params = {k: v._data for k, v in state.items()}
-        cache_dtype = self.gpt.wte.weight._data.dtype
+        # KV cache dtype follows the autocast COMPUTE dtype of the attention
+        # matmul (the op that reads the cache), not the param dtype: an f32
+        # cache under bf16 amp would be converted to bf16 inside the decode
+        # loop every step — 2 cache-sized casts per layer per token (~0.7
+        # GB/step of pure HBM waste at the bench config; found by
+        # tools/decode_hlo_probe.py). Routing through _autocast_dtype_for
+        # keeps the white/black-list semantics: a user black-listing the
+        # attention op to hold it in f32 keeps the f32 cache.
+        from ..core.dispatch import _autocast_dtype_for, amp_ctx as _amp_ctx
+
+        _amp = _amp_ctx()
+        _mm_dtype = _autocast_dtype_for("attention", ())
+        cache_dtype = (_mm_dtype if _mm_dtype is not None
+                       else self.gpt.wte.weight._data.dtype)
         was_training = self.training
         self.eval()
 
@@ -512,15 +525,18 @@ class GPTForPretraining(nn.Layer):
             # The active amp scope is part of the key: tracing under
             # paddle.amp.auto_cast() bakes bf16 matmuls into the executable
             # (halves decode weight traffic — the decode loop is HBM-bound)
-            from ..core.dispatch import amp_ctx
-            amp = amp_ctx()
+            amp = _amp  # the scope captured above (cache_dtype reads it too)
             # the FULL behavioral tuple: dtype/level AND the op lists that
             # _autocast_dtype_for consults — scopes differing only in
             # white/black lists must not share an executable
             amp_key = ((str(amp.dtype), amp.level, frozenset(amp.white),
                         frozenset(amp.black)) if amp is not None else None)
+            # cache_dtype is baked into run()'s closure: key it, or a later
+            # call on the no-amp fallback path (param dtype changed, amp_key
+            # identical) would retrace the stale closure
             cache_key = (b, prompt, max_new_tokens, float(temperature),
-                         int(top_k), float(top_p), eos_token_id, amp_key)
+                         int(top_k), float(top_p), eos_token_id, amp_key,
+                         str(cache_dtype))
             jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
             fn = jit_cache.get(cache_key)
             if fn is None:

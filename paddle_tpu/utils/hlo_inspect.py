@@ -1,0 +1,64 @@
+"""Shared helpers for classifying compiled-HLO text in perf gates and probes.
+
+Used by tests/test_hlo_perf_gates.py and tools/decode_hlo_probe.py so the
+fragile text heuristics (XLA metadata tags, shape regexes) live in ONE place.
+The reference's analogue is the IR-pass test utilities that grep ProgramDesc
+text (test/ir mem_opt pass tests); here the inspected artifact is XLA's
+optimized HLO.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+_SHAPE_RE = re.compile(r"=\s*\S*\s*(bf16|f32|f16|s32|s64)\[([\d,]*)\]")
+_BF16_CONVERT_RE = re.compile(r"=\s*bf16\[([\d,]+)\]\S*\s+convert\(")
+
+
+def while_body_lines(hlo_text: str) -> List[str]:
+    """Ops belonging to a jitted loop body, identified by the `while/body`
+    op_name metadata (robust across XLA computation-naming schemes; fusion
+    roots inherit the metadata of the op they fuse)."""
+    return [ln for ln in hlo_text.splitlines() if "while/body" in ln]
+
+
+def shape_elems(line: str) -> Tuple[Optional[str], int]:
+    """(dtype, element-count) of the op result on `line`, or (None, 0)."""
+    m = _SHAPE_RE.search(line)
+    if not m:
+        return None, 0
+    n = 1
+    for d in m.group(2).split(","):
+        if d:
+            n *= int(d)
+    return m.group(1), n
+
+
+def copies_of_shape(lines: List[str], shape_csv: str) -> List[str]:
+    """copy/copy-start ops whose text mentions the given `d0,d1,...` shape."""
+    return [ln.strip() for ln in lines
+            if shape_csv in ln and ("copy(" in ln or "copy-start" in ln)]
+
+
+def count_dynamic_update_slices(lines: List[str]) -> int:
+    return sum("dynamic-update-slice" in ln for ln in lines)
+
+
+def bf16_converts_of_min_size(lines: List[str], min_elems: int,
+                              exclude_shape_csv: Optional[str] = None
+                              ) -> List[str]:
+    """f32->bf16 convert ops at/above `min_elems`, optionally excluding a
+    shape (e.g. the KV cache, whose bf16 converts on CPU are f32-legalization
+    noise — CPU dots have no native bf16)."""
+    out = []
+    for ln in lines:
+        m = _BF16_CONVERT_RE.search(ln)
+        if not m:
+            continue
+        n = 1
+        for d in m.group(1).split(","):
+            n *= int(d)
+        if n >= min_elems and (exclude_shape_csv is None
+                               or exclude_shape_csv not in ln):
+            out.append(ln.strip())
+    return out

@@ -378,3 +378,55 @@ def test_run_steps_scan_is_one_program_one_loop():
                       for a in eng.params.values())
     assert ma.alias_size_in_bytes >= 0.9 * state_bytes, (
         "scan carry donation regressed: params would double-buffer per step")
+
+
+def test_decode_loop_cache_in_place_no_weight_casts():
+    """The KV-cache decode loop (GPTForPretraining.generate) must compile to a
+    while loop whose body (a) updates the cache via dynamic-update-slice with
+    NO cache-sized copy ops (in-place carry), and (b) contains no
+    weight-sized f32->bf16 converts — under bf16 amp the weights are cast
+    ONCE outside the loop and the cache is STORED in the compute dtype
+    (round-3 fix: an f32 cache cost 2 cache-sized casts per layer per token,
+    ~0.7 GB/step of HBM traffic at the bench config; tools/decode_hlo_probe.py).
+    """
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+
+    cfg = gpt_tiny()
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    b, prompt, new = 2, 16, 48
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (b, prompt)).astype(np.int64)
+    with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=new,
+                       temperature=0)
+        jf = next(iter(model._generate_jit_cache.values()))
+        params = {k: v._data for k, v in model.state_dict(
+            include_non_persistable_buffer=True).items()}
+        txt = jf.lower(params, ids, jax.random.key(0)).compile().as_text()
+
+    from paddle_tpu.utils import hlo_inspect as hi
+
+    assert re.search(r"\) while\(", txt), \
+        "decode scan unrolled or missing — expected one while loop"
+    body = hi.while_body_lines(txt)
+    assert body, "no while/body-tagged ops in compiled decode program"
+
+    nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    cache_shape = f"{b},{prompt + new},{nh},{hd}"
+    copies = hi.copies_of_shape(body, cache_shape)
+    assert not copies, (
+        f"cache-sized copies inside the decode loop (in-place DUS regressed): "
+        f"{copies[:2]}")
+    dus = hi.count_dynamic_update_slices(body)
+    assert dus >= 2 * cfg.num_layers, (
+        f"{dus} dynamic-update-slices in decode body for "
+        f"{cfg.num_layers} layers — KV append path changed shape")
+    # cache-shaped bf16 converts on CPU are f32-legalization noise (CPU dots
+    # have no native bf16); weight-sized ones are real
+    wcasts = hi.bf16_converts_of_min_size(
+        body, cfg.hidden_size * cfg.hidden_size, exclude_shape_csv=cache_shape)
+    assert not wcasts, (
+        f"weight-sized f32->bf16 converts INSIDE the decode loop — amp cast "
+        f"hoisting regressed: {wcasts[:2]}")
