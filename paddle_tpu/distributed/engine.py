@@ -179,7 +179,10 @@ class TrainStepEngine:
         from .meta_parallel.sequence_parallel import sequence_parallel_scope
 
         sp_deg = self.hcg.degrees["sp"]
-        sp_impl = getattr(self.strategy, "sep_impl", "ring") if self.strategy else "ring"
+        # default matches DistributedStrategy.sep_impl: Ulysses wins on the
+        # XLA cost model at moderate seq (BASELINE.md); ring for seq >> 100k
+        sp_impl = getattr(self.strategy, "sep_impl", "ulysses") \
+            if self.strategy else "ulysses"
         mesh = self.mesh
 
         # strategy.amp: autocast the whole traced forward (the analogue of the

@@ -39,8 +39,13 @@ def active() -> bool:
 
 
 @contextlib.contextmanager
-def sequence_parallel_scope(mesh, axis: str = "sp", impl: str = "ring"):
-    """Route scaled_dot_product_attention to ring/Ulysses attention over `axis`."""
+def sequence_parallel_scope(mesh, axis: str = "sp", impl: str = "ulysses"):
+    """Route scaled_dot_product_attention to ring/Ulysses attention over
+    `axis`. Default matches DistributedStrategy.sep_impl ("ulysses")."""
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(
+            f"sequence-parallel impl must be 'ring' or 'ulysses', got "
+            f"{impl!r}")
     prev = getattr(_state, "ctx", None)
     _state.ctx = (mesh, axis, impl)
     try:

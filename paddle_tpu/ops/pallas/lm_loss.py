@@ -79,6 +79,24 @@ def supported(n_rows: int, vocab: int, hidden: int) -> bool:
     return _pick_rows(n_rows) > 0 and vocab >= 128 and hidden % 128 == 0
 
 
+def _row1d_index_map(pack: int):
+    """Index map for the 1024-element 1D blocks revisited `pack` row-steps.
+    pack == 1 avoids the traced floor_divide entirely: each index_map traces
+    through several jnp layers, and at the default block the extra frames
+    pushed the deeply nested export->grad->pallas stack over CPython's
+    recursion limit under pytest."""
+    if pack == 1:
+        return lambda i, j: (i,)
+    return lambda i, j: (i // pack,)
+
+
+def _row1d_index_map_ji(pack: int):
+    """Same but for (j, i)-ordered grids (the dW kernel)."""
+    if pack == 1:
+        return lambda j, i: (i,)
+    return lambda j, i: (i // pack,)
+
+
 # ---------------------------------------------------------------- forward ----
 
 def _fwd_kernel(h_ref, w_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr, p_scr,
@@ -143,17 +161,18 @@ def _fwd(h2, w, labels, block_n, block_v, v_true=None):
     grid = (n // block_n, v // block_v)
     kernel = functools.partial(_fwd_kernel, block_n=block_n, block_v=block_v,
                                v_blocks=v // block_v, v_true=v_true, pack=pack)
+    row1d = _row1d_index_map(pack)
     loss, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
             pl.BlockSpec((block_v, hdim), lambda i, j: (j, _I0)),
-            pl.BlockSpec((1024,), lambda i, j: (i // pack,)),
+            pl.BlockSpec((1024,), row1d),
         ],
         out_specs=[
-            pl.BlockSpec((1024,), lambda i, j: (i // pack,)),
-            pl.BlockSpec((1024,), lambda i, j: (i // pack,)),
+            pl.BlockSpec((1024,), row1d),
+            pl.BlockSpec((1024,), row1d),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n,), jnp.float32),
@@ -241,6 +260,7 @@ def _bwd(res, g, block_n, block_v, v_true=None):
     nb, vb = n // block_n, v // block_v
     g32 = g.astype(jnp.float32)
 
+    row1d = _row1d_index_map(pack)
     dh = pl.pallas_call(
         functools.partial(_dh_kernel, block_n=block_n, block_v=block_v,
                           v_blocks=vb, v_true=v_true, pack=pack),
@@ -248,9 +268,9 @@ def _bwd(res, g, block_n, block_v, v_true=None):
         in_specs=[
             pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
             pl.BlockSpec((block_v, hdim), lambda i, j: (j, _I0)),
-            pl.BlockSpec((1024,), lambda i, j: (i // pack,)),
-            pl.BlockSpec((1024,), lambda i, j: (i // pack,)),
-            pl.BlockSpec((1024,), lambda i, j: (i // pack,)),
+            pl.BlockSpec((1024,), row1d),
+            pl.BlockSpec((1024,), row1d),
+            pl.BlockSpec((1024,), row1d),
         ],
         out_specs=pl.BlockSpec((block_n, hdim), lambda i, j: (i, _I0)),
         out_shape=jax.ShapeDtypeStruct((n, hdim), h2.dtype),
@@ -258,6 +278,7 @@ def _bwd(res, g, block_n, block_v, v_true=None):
         interpret=_interpret(),
     )(h2, w, labels, lse, g32)
 
+    row1d_ji = _row1d_index_map_ji(pack)
     dw = pl.pallas_call(
         functools.partial(_dw_kernel, block_n=block_n, block_v=block_v,
                           n_blocks=nb, v_true=v_true, pack=pack),
@@ -265,9 +286,9 @@ def _bwd(res, g, block_n, block_v, v_true=None):
         in_specs=[
             pl.BlockSpec((block_n, hdim), lambda j, i: (i, _I0)),
             pl.BlockSpec((block_v, hdim), lambda j, i: (j, _I0)),
-            pl.BlockSpec((1024,), lambda j, i: (i // pack,)),
-            pl.BlockSpec((1024,), lambda j, i: (i // pack,)),
-            pl.BlockSpec((1024,), lambda j, i: (i // pack,)),
+            pl.BlockSpec((1024,), row1d_ji),
+            pl.BlockSpec((1024,), row1d_ji),
+            pl.BlockSpec((1024,), row1d_ji),
         ],
         out_specs=pl.BlockSpec((block_v, hdim), lambda j, i: (j, _I0)),
         out_shape=jax.ShapeDtypeStruct((v, hdim), jnp.float32),

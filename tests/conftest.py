@@ -16,7 +16,18 @@ if os.environ.get("PADDLE_TPU_TEST_DEVICE", "cpu") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
 
+import sys
+
 import pytest
+
+# jax tracing is deeply recursive (export -> grad of custom_vjp -> pallas
+# index-map traces nest hundreds of frames) and pytest adds its own stack on
+# top; the lm_loss Mosaic-export gate sat within ~100 frames of CPython's
+# default 1000 and tipped over. Match the reference's posture of configuring
+# interpreter limits for the test run (its dy2static tests raise the limit
+# for AST recursion the same way).
+if sys.getrecursionlimit() < 3000:
+    sys.setrecursionlimit(3000)
 
 
 @pytest.fixture(autouse=True)

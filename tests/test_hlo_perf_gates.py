@@ -302,7 +302,7 @@ def test_fused_lm_loss_avoids_logits_materialization():
 
 # ------------------------------------------------------ ICI-level gates ----
 
-def _gpt_engine_compiled(conf, sharding=False):
+def _gpt_engine_compiled(conf, sharding=False, sep_impl=None):
     import paddle_tpu.distributed as dist
     from paddle_tpu.distributed import fleet
     from paddle_tpu.models import GPTForPretraining, gpt_tiny
@@ -311,6 +311,8 @@ def _gpt_engine_compiled(conf, sharding=False):
     strategy = dist.DistributedStrategy()
     strategy.sharding = sharding
     strategy.hybrid_configs = conf
+    if sep_impl is not None:
+        strategy.sep_impl = sep_impl
     fleet.init(is_collective=True, strategy=strategy)
     hcg = fleet.get_hybrid_communicate_group()
     model = GPTForPretraining(gpt_tiny())
@@ -327,15 +329,29 @@ def _gpt_engine_compiled(conf, sharding=False):
 
 
 def test_ring_sequence_parallel_emits_collective_permute():
-    """sp=2 must route attention through the ring (ppermute over 'sp') —
-    the KV blocks rotate on ICI instead of an all-gather of the sequence."""
+    """sp=2 with sep_impl='ring' (the default is ulysses) must route
+    attention through the ring (ppermute over 'sp') — the KV blocks rotate
+    on ICI instead of an all-gather of the sequence."""
     eng, tr = _gpt_engine_compiled({"dp_degree": 2, "mp_degree": 2,
-                                    "sep_degree": 2})
+                                    "sep_degree": 2}, sep_impl="ring")
     assert "ppermute" in str(tr.jaxpr), "ring attention not engaged under sp=2"
     txt = tr.lower().compile().as_text()
     assert txt.count("collective-permute") >= 2, (
         "no collective-permute in the compiled sp step — the ring rotation "
         "was optimized out or replaced by sequence all-gather")
+
+
+def test_default_sequence_parallel_is_ulysses_all_to_all():
+    """The DEFAULT sp flavor is Ulysses (cost-model-backed, BASELINE.md):
+    sp=2 with no explicit sep_impl must emit all-to-alls, not ppermutes."""
+    eng, tr = _gpt_engine_compiled({"dp_degree": 2, "mp_degree": 2,
+                                    "sep_degree": 2})
+    txt = tr.lower().compile().as_text()
+    assert "all-to-all" in txt, (
+        "no all-to-all in the default sp step — the ulysses default regressed")
+    assert "collective-permute" not in txt, (
+        "ppermute in the default sp step — ring engaged despite the ulysses "
+        "default")
 
 
 def test_zero_sharding_gathers_params_and_keeps_fused_grad_reduce():

@@ -79,8 +79,11 @@ _WORKER = textwrap.dedent("""
     from paddle_tpu.distributed.store import TCPStore
 
     rank, world, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    # generous timeout: 4 interpreters cold-start SERIALLY on a loaded 1-core
+    # box (each pays the jax import), so the non-master clients can sit tens
+    # of seconds ahead of rank 0's bind — 30 s flaked in full-suite runs
     store = TCPStore("127.0.0.1", port, is_master=(rank == 0), world_size=world,
-                     timeout=30.0)
+                     timeout=150.0)
     store.set(f"rank/{rank}", str(rank))
     # everyone reads everyone (get blocks until the key appears)
     total = sum(int(store.get(f"rank/{r}")) for r in range(world))
