@@ -237,6 +237,18 @@ def _ulysses_shard(q, k, v, *, axis, causal, sm_scale):
 def ulysses_attention(q, k, v, mesh, axis: str = "sp", causal: bool = False,
                       sm_scale: float | None = None):
     """DeepSpeed-Ulysses-style attention; requires num_heads % axis_size == 0."""
+    sp_size = mesh.shape[axis]
+    n_heads = q.shape[2]
+    if n_heads % sp_size:
+        # validate here, where the head count is known: the all_to_all's own
+        # failure is an opaque shape error deep inside shard_map tracing
+        # that never names the knob (matters since ulysses became the
+        # sep_impl default)
+        raise ValueError(
+            f"ulysses sequence parallelism scatters heads over the '{axis}' "
+            f"axis and needs num_heads ({n_heads}) divisible by its size "
+            f"({sp_size}); use strategy.sep_impl = 'ring' (no divisibility "
+            f"requirement) or change the head count / sep_degree")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     spec = P(None, axis, None, None)
