@@ -78,6 +78,7 @@ from . import sysconfig  # noqa: E402
 from . import reader  # noqa: E402
 from . import onnx  # noqa: E402
 from . import compat  # noqa: E402
+from . import cost_model  # noqa: E402
 from . import hapi  # noqa: E402
 from .hapi import Model  # noqa: E402
 from .framework import io as _fw_io  # noqa: E402
