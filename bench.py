@@ -57,8 +57,11 @@ def main():
     seq = int(os.environ.get("PADDLE_TPU_BENCH_SEQ", seq))
     if seq != cfg.max_seq_len:  # long-context single-chip config (flash tiles
         cfg.max_seq_len = seq   # over seq; BASELINE.md 4k-16k sweep)
-    if os.environ.get("PADDLE_TPU_BENCH_RECOMPUTE"):  # trade FLOPs for HBM
-        cfg.use_recompute = True
+    recompute_env = os.environ.get("PADDLE_TPU_BENCH_RECOMPUTE")
+    if recompute_env:  # trade FLOPs for HBM; "selective" saves matmul
+        cfg.use_recompute = True       # outputs and recomputes elementwise
+        if recompute_env == "selective":
+            cfg.recompute_granularity = "selective"
     if os.environ.get("PADDLE_TPU_BENCH_AUTOTUNE"):  # flash block-size search
         paddle.incubate.autotune.set_config({"kernel": {"enable": True}})
     if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LOSS"):  # online LM-loss kernel
@@ -347,6 +350,11 @@ def _orchestrate():
             # point can no longer cost an earlier result)
             ("batch32_recompute", {"PADDLE_TPU_BENCH_BATCH": "32",
                                    "PADDLE_TPU_BENCH_RECOMPUTE": "1"}),
+            # selective remat: saves matmul outputs, replays only the
+            # elementwise tail — should recover most of full-remat's ~21%
+            # throughput cost while still fitting batch 32
+            ("batch32_selective", {"PADDLE_TPU_BENCH_BATCH": "32",
+                                   "PADDLE_TPU_BENCH_RECOMPUTE": "selective"}),
             # VERY last: the lm_loss Mosaic compile at bench vocab exceeded
             # 9.5 min and wedged the tunnel twice in round 3 — anything after
             # it would be lost (tools/lmloss_compile_probe.py tracks the fix)

@@ -31,6 +31,10 @@ class RecomputeConfig:
     checkpoints: List[str] = field(default_factory=list)
     enable_offload: bool = False
     checkpoint_shape: List[int] = field(default_factory=list)
+    # "full" recomputes whole segments; "selective" saves matmul outputs and
+    # recomputes only the elementwise tail (jax.checkpoint policy) — the
+    # reference's recompute_granularity knob
+    granularity: str = "full"
 
 
 @dataclass

@@ -136,10 +136,13 @@ class RecomputeOptimizer(MetaOptimizerBase):
         return bool(strategy.recompute)
 
     def enable_on(self, model):
+        gran = getattr(self._strategy.recompute_configs, "granularity", "full")
         n = 0
         for layer in model.sublayers(include_self=True):
             if hasattr(layer, "use_recompute"):
                 layer.use_recompute = True
+                if hasattr(layer, "recompute_granularity"):
+                    layer.recompute_granularity = gran
                 n += 1
         return n
 

@@ -19,9 +19,44 @@ from ...core.tensor import Tensor
 from ...jit import in_jit_trace
 
 
+_REMAT_POLICIES = {
+    # reference recompute_granularity analogues (recompute_configs; the
+    # static sharding optimizer's fp16_helper/offload split the same knob):
+    #   "full"      — save nothing, recompute the whole segment (max HBM win,
+    #                 ~+33% forward FLOPs)
+    #   "selective" — save matmul/dot outputs, recompute only the cheap
+    #                 elementwise tail (most of the memory win at a fraction
+    #                 of the recompute FLOPs — the TPU-native middle ground,
+    #                 since elementwise recompute is HBM-cheap and the MXU
+    #                 matmuls are what recompute would otherwise repeat)
+    "full": None,  # jax.checkpoint default: nothing saveable
+    "selective": "dots_with_no_batch_dims_saveable",
+}
+
+
+def _resolve_policy(policy):
+    if policy is None or policy == "full":
+        return None
+    if callable(policy):
+        return policy
+    fn = getattr(jax.checkpoint_policies,
+                 _REMAT_POLICIES.get(policy, policy), None) \
+        if isinstance(policy, str) else None
+    if fn is None:
+        raise ValueError(
+            f"unknown recompute policy {policy!r}; use 'full', 'selective', "
+            f"a jax.checkpoint_policies name, or a callable")
+    return fn
+
+
 def recompute(function, *args, **kwargs):
     preserve_rng_state = kwargs.pop("preserve_rng_state", True)
     use_reentrant = kwargs.pop("use_reentrant", True)
+    # policy applies on the traced (jax.checkpoint) path; the eager tape
+    # replay below always recomputes the full segment ("full" semantics).
+    # Resolve unconditionally so a typo'd granularity fails fast in BOTH
+    # modes instead of silently training full-remat eagerly.
+    policy = _resolve_policy(kwargs.pop("policy", None))
 
     tensor_args = [a for a in args if isinstance(a, Tensor)]
 
@@ -38,8 +73,8 @@ def recompute(function, *args, **kwargs):
                 return tuple(o._data if isinstance(o, Tensor) else o for o in out)
             return out._data if isinstance(out, Tensor) else out
 
-        ck = jax.checkpoint(f)
-        out = ck(*[t._data for t in tensor_args])
+        out = jax.checkpoint(f, policy=policy)(
+            *[t._data for t in tensor_args])
         if isinstance(out, tuple):
             return tuple(Tensor(o) for o in out)
         return Tensor(out)

@@ -28,7 +28,8 @@ from ..nn import functional as F
 class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
                  ffn_hidden_size=None, max_seq_len=1024, dropout=0.0,
-                 attention_dropout=0.0, use_recompute=False, dtype="float32",
+                 attention_dropout=0.0, use_recompute=False,
+                 recompute_granularity="full", dtype="float32",
                  tie_word_embeddings=True):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -39,6 +40,9 @@ class GPTConfig:
         self.dropout = dropout
         self.attention_dropout = attention_dropout
         self.use_recompute = use_recompute
+        # "full" | "selective" (reference recompute_configs granularity):
+        # selective saves matmul outputs and recomputes only elementwise ops
+        self.recompute_granularity = recompute_granularity
         self.dtype = dtype
         self.tie_word_embeddings = tie_word_embeddings
 
@@ -133,6 +137,8 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(config)
         self.dropout = config.dropout
         self.use_recompute = config.use_recompute
+        self.recompute_granularity = getattr(config, "recompute_granularity",
+                                             "full")
 
     def _forward(self, x):
         h = x + F.dropout(self.attn(self.ln1(x)), self.dropout, training=self.training)
@@ -144,7 +150,8 @@ class GPTBlock(nn.Layer):
             h = x + a
             return h + self.mlp(self.ln2(h)), new_cache
         if self.use_recompute and self.training:
-            return recompute(self._forward, x)
+            return recompute(self._forward, x,
+                             policy=self.recompute_granularity)
         return self._forward(x)
 
 
@@ -274,6 +281,11 @@ class GPTForPretrainingPipe(nn.Layer):
         n_micro = self.num_microbatches
 
         use_recompute = cfg.use_recompute
+        if use_recompute:
+            from ..distributed.fleet.utils import _resolve_policy
+
+            remat_policy = _resolve_policy(
+                getattr(cfg, "recompute_granularity", "full"))
 
         def kernel(xa, *flat):
             params = dict(zip(self._STACKED, flat))
@@ -281,7 +293,7 @@ class GPTForPretrainingPipe(nn.Layer):
                 def one(h, layer):
                     return _pipe_block_fwd(h, layer, nh, hd), None
                 if use_recompute:  # recompute_interval analogue: checkpoint each block
-                    one = jax.checkpoint(one)
+                    one = jax.checkpoint(one, policy=remat_policy)
                 h, _ = jax.lax.scan(one, h, lp)
                 return h
             if mesh is not None:

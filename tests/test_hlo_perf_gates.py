@@ -229,13 +229,14 @@ def test_layer_norm_mosaic_compiles_for_tpu(monkeypatch):
 
 # -------------------------------------------------------- memory behavior ----
 
-def _gpt_loss_fn(use_recompute):
+def _gpt_loss_fn(use_recompute, granularity="full"):
     from paddle_tpu.jit import functional_call
     from paddle_tpu.models import GPTConfig, GPTForPretraining
 
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=4, num_heads=4,
-                    max_seq_len=256, use_recompute=use_recompute)
+                    max_seq_len=256, use_recompute=use_recompute,
+                    recompute_granularity=granularity)
     model = GPTForPretraining(cfg)
     model.train()
     state = model.state_dict(include_non_persistable_buffer=True)
@@ -269,6 +270,28 @@ def test_recompute_shrinks_saved_residuals():
     assert b_yes < 0.25 * b_no, (
         f"remat saved-residuals {b_yes}B vs {b_no}B without — recompute no "
         f"longer reduces activation memory")
+
+
+def test_selective_recompute_sits_between_full_and_none():
+    """recompute_granularity='selective' (save matmul outputs, recompute
+    elementwise — jax dots_with_no_batch_dims_saveable) must save less than
+    no-remat but more than full remat, and must recompute FEWER flops than
+    full remat (the matmuls are not replayed)."""
+    f_none, a = _gpt_loss_fn(False)
+    f_full, _ = _gpt_loss_fn(True)
+    f_sel, _ = _gpt_loss_fn(True, granularity="selective")
+    b_none = _saved_residual_bytes(f_none, a)
+    b_full = _saved_residual_bytes(f_full, a)
+    b_sel = _saved_residual_bytes(f_sel, a)
+    assert b_full < b_sel < b_none, (b_full, b_sel, b_none)
+
+    def grad_flops(f):
+        g = jax.jit(jax.grad(lambda p: f(p).sum()))
+        c = g.lower(a).compile().cost_analysis() or {}
+        return float(c.get("flops", 0.0))
+
+    fl_none, fl_full, fl_sel = map(grad_flops, (f_none, f_full, f_sel))
+    assert fl_none < fl_sel < fl_full, (fl_none, fl_sel, fl_full)
 
 
 def test_fused_lm_loss_avoids_logits_materialization():
