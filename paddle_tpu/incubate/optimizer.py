@@ -98,3 +98,15 @@ class _RestoreCtx:
     def __exit__(self, *exc):
         self._ma.restore()
         return False
+
+
+# `paddle.incubate.optimizer.functional` submodule surface (reference
+# python/paddle/incubate/optimizer/__init__.py:18): minimize_bfgs /
+# minimize_lbfgs live in optimizer_functional.py; alias it so both
+# attribute access and `import paddle_tpu.incubate.optimizer.functional`
+# resolve even though `optimizer` is a module, not a package.
+from . import optimizer_functional as functional  # noqa: E402,F401
+import sys as _sys
+
+_sys.modules[__name__ + ".functional"] = functional
+del _sys

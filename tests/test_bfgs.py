@@ -1,0 +1,112 @@
+"""minimize_bfgs / minimize_lbfgs (reference python/paddle/incubate/optimizer/
+functional/{bfgs,lbfgs}.py + unittests test_bfgs.py / test_lbfgs.py):
+quasi-Newton with strong-Wolfe line search, compiled as one lax.while_loop."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.optimizer.functional import (minimize_bfgs,
+                                                      minimize_lbfgs)
+
+
+def quad(x):
+    return paddle.dot(x, x)
+
+
+def rosen(x):
+    a = x[1] - x[0] * x[0]
+    b = 1.0 - x[0]
+    return 100.0 * a * a + b * b
+
+
+@pytest.mark.parametrize("minimize", [minimize_bfgs, minimize_lbfgs],
+                         ids=["bfgs", "lbfgs"])
+def test_quadratic_converges(minimize):
+    x0 = paddle.to_tensor(np.array([1.3, 2.7], "float32"))
+    r = minimize(quad, x0)
+    assert bool(r[0].numpy())                       # is_converge
+    assert int(r[1].numpy()) >= 1                   # num_func_calls
+    np.testing.assert_allclose(r[2].numpy(), [0.0, 0.0], atol=1e-5)
+    assert float(r[3].numpy()) < 1e-8               # objective value
+    np.testing.assert_allclose(r[4].numpy(), [0.0, 0.0], atol=1e-5)  # grad
+
+
+@pytest.mark.parametrize("minimize,kw", [
+    (minimize_bfgs, {"max_iters": 100}),
+    (minimize_lbfgs, {"max_iters": 120, "history_size": 6}),
+], ids=["bfgs", "lbfgs"])
+def test_rosenbrock_converges(minimize, kw):
+    x0 = paddle.to_tensor(np.array([-1.2, 1.0], "float32"))
+    r = minimize(rosen, x0, **kw)
+    assert bool(r[0].numpy())
+    np.testing.assert_allclose(r[2].numpy(), [1.0, 1.0], atol=1e-3)
+
+
+def test_bfgs_returns_inverse_hessian():
+    """6th return slot (reference bfgs.py return signature) is the inverse
+    Hessian estimate: symmetric positive definite by the BFGS update
+    invariant. (It need not equal the true I/2 — the solve converges in a
+    couple of steps, before the estimate matures.)"""
+    x0 = paddle.to_tensor(np.array([1.0, -2.0, 3.0], "float32"))
+    r = minimize_bfgs(quad, x0, max_iters=60)
+    assert len(r) == 6
+    H = r[5].numpy()
+    np.testing.assert_allclose(H, H.T, atol=1e-6)
+    assert np.linalg.eigvalsh(H).min() > 0
+
+
+def test_lbfgs_high_dim_and_history_wrap():
+    """history_size smaller than iteration count exercises the circular
+    buffer + two-loop recursion wrap-around."""
+    rng = np.random.RandomState(0)
+    diag = paddle.to_tensor(np.linspace(1.0, 10.0, 20).astype("float32"))
+
+    def f(x):
+        return paddle.dot(x * diag, x)
+
+    x0 = paddle.to_tensor(rng.randn(20).astype("float32"))
+    r = minimize_lbfgs(f, x0, history_size=4, max_iters=80)
+    assert bool(r[0].numpy())
+    assert np.abs(r[2].numpy()).max() < 1e-4
+
+
+def test_float64_dtype():
+    x0 = paddle.to_tensor(np.array([0.7, -0.3], "float64"))
+    r = minimize_bfgs(quad, x0, dtype="float64")
+    assert r[2].numpy().dtype == np.float64
+    np.testing.assert_allclose(r[2].numpy(), [0.0, 0.0], atol=1e-10)
+
+
+def test_validation_errors():
+    x0 = paddle.to_tensor(np.array([1.0], "float32"))
+    with pytest.raises(ValueError):
+        minimize_bfgs(quad, x0, dtype="float16")
+    with pytest.raises(NotImplementedError):
+        minimize_lbfgs(quad, x0, line_search_fn="hager_zhang")
+
+
+def test_initial_inverse_hessian_validation():
+    x0 = paddle.to_tensor(np.array([1.0, 1.0], "float32"))
+    with pytest.raises(ValueError):  # not symmetric
+        minimize_bfgs(quad, x0, initial_inverse_hessian_estimate=np.array(
+            [[1.0, 0.5], [0.0, 1.0]], "float32"))
+    with pytest.raises(ValueError):  # not positive definite
+        minimize_lbfgs(quad, x0, initial_inverse_hessian_estimate=np.array(
+            [[1.0, 0.0], [0.0, -1.0]], "float32"))
+
+
+def test_lbfgs_applies_anisotropic_h0():
+    """A preconditioner matching the problem's curvature must not collapse
+    to a scalar: with H0 = inv(Hessian) the first step is (nearly) exact."""
+    diag = np.array([100.0, 1.0], "float32")
+
+    def f(x):
+        return paddle.dot(x * paddle.to_tensor(diag), x)
+
+    h0 = np.diag(0.5 / diag).astype("float32")  # true inverse Hessian
+    x0 = paddle.to_tensor(np.array([1.0, -1.0], "float32"))
+    r = minimize_lbfgs(f, x0, initial_inverse_hessian_estimate=h0,
+                       max_iters=10)
+    assert bool(r[0].numpy())
+    assert int(r[1].numpy()) <= 5  # near-Newton: converges in ~1 step
+    assert np.abs(r[2].numpy()).max() < 1e-5
