@@ -46,23 +46,26 @@ class FusedMultiHeadAttention(Layer):
         self.ln = base_nn.LayerNorm(embed_dim, epsilon=epsilon)
 
     def forward(self, x, attn_mask=None, cache=None):
-        b, s = x.shape[0], x.shape[1]
-        residual = x
-        if self.normalize_before:
-            x = self.ln(x)
-        qkv = x @ self.qkv_weight.t() + self.qkv_bias
-        qkv = P.reshape(qkv, (b, s, 3, self.num_heads, self.head_dim))
-        q, k, v = P.unbind(qkv, axis=2)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate,
-            is_causal=False, training=self.training)
-        out = P.reshape(out, (b, s, self.embed_dim))
-        out = out @ self.linear_weight + self.linear_bias
-        out = residual + F.dropout(out, self.dropout_rate,
-                                   training=self.training)
-        if not self.normalize_before:
-            out = self.ln(out)
-        return out
+        # delegate to the functional form (incubate.nn.functional) — one
+        # implementation of the block; this layer stores qkv as [3e, e] and
+        # the functional form takes the reference's [3, nh, hd, e] layout
+        from .nn_functional import fused_multi_head_attention
+
+        qkv_w = P.reshape(self.qkv_weight,
+                          (3, self.num_heads, self.head_dim, self.embed_dim))
+        qkv_b = P.reshape(self.qkv_bias,
+                          (3, self.num_heads, self.head_dim))
+        return fused_multi_head_attention(
+            x, qkv_w, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.ln.weight, pre_ln_bias=self.ln.bias,
+            ln_scale=self.ln.weight, ln_bias=self.ln.bias,
+            pre_ln_epsilon=self.ln._epsilon, ln_epsilon=self.ln._epsilon,
+            qkv_bias=qkv_b, linear_bias=self.linear_bias,
+            cache_kv=cache, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            training=self.training)
 
 
 class FusedFeedForward(Layer):
@@ -87,17 +90,19 @@ class FusedFeedForward(Layer):
         self.ln = base_nn.LayerNorm(d_model, epsilon=epsilon)
 
     def forward(self, x):
-        residual = x
-        if self.normalize_before:
-            x = self.ln(x)
-        act = getattr(F, self.activation)
-        x = F.dropout(act(self.linear1(x)), self.act_dropout_rate,
-                      training=self.training)
-        x = residual + F.dropout(self.linear2(x), self.dropout_rate,
-                                 training=self.training)
-        if not self.normalize_before:
-            x = self.ln(x)
-        return x
+        # delegate to the functional form — one implementation of the block
+        from .nn_functional import fused_feedforward
+
+        return fused_feedforward(
+            x, self.linear1.weight, self.linear2.weight,
+            linear1_bias=self.linear1.bias, linear2_bias=self.linear2.bias,
+            ln1_scale=self.ln.weight, ln1_bias=self.ln.bias,
+            ln2_scale=self.ln.weight, ln2_bias=self.ln.bias,
+            ln1_epsilon=self.ln._epsilon, ln2_epsilon=self.ln._epsilon,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate,
+            activation=self.activation, pre_layer_norm=self.normalize_before,
+            training=self.training)
 
 
 class FusedTransformerEncoderLayer(Layer):
@@ -122,3 +127,14 @@ class FusedTransformerEncoderLayer(Layer):
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
            "FusedTransformerEncoderLayer"]
+
+
+# `paddle.incubate.nn.functional` (reference incubate/nn/functional/
+# fused_transformer.py): functional forms of the fused blocks above. Alias
+# so both attribute access and `import paddle_tpu.incubate.nn.functional`
+# resolve even though `nn` here is a module, not a package.
+from . import nn_functional as functional  # noqa: E402,F401
+import sys as _sys
+
+_sys.modules[__name__ + ".functional"] = functional
+del _sys

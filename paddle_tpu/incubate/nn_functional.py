@@ -1,0 +1,101 @@
+"""Functional fused-transformer ops — `paddle.incubate.nn.functional`.
+
+Reference: python/paddle/incubate/nn/functional/fused_transformer.py
+(fused_feedforward:31, fused_multi_head_attention:215). The reference fuses
+these into single CUDA ops (fused_feedforward_op, fused_attention_op); on
+TPU the same fusion is XLA's job, so these are the mathematically identical
+compositions the reference documents as pseudo code — under jit they fuse
+into the same few kernels the CUDA ops hand-fuse. The Layer classes in
+incubate.nn delegate to the same primitive ops.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..ops import manipulation as P
+
+__all__ = ["fused_feedforward", "fused_multi_head_attention"]
+
+
+def _layer_norm(x, scale, bias, epsilon):
+    dim = x.shape[-1]
+    return F.layer_norm(x, normalized_shape=[dim], weight=scale, bias=bias,
+                        epsilon=epsilon)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", name=None):
+    """residual + dropout2(linear2(dropout1(act(linear1(ln(x)))))), with the
+    layer_norm before (pre_layer_norm) or after the residual add
+    (fused_transformer.py:31 pseudo code)."""
+    residual = x
+    if pre_layer_norm:
+        x = _layer_norm(x, ln1_scale, ln1_bias, ln1_epsilon)
+    act = getattr(F, activation)
+    h = act(x @ linear1_weight if linear1_bias is None
+            else x @ linear1_weight + linear1_bias)
+    h = F.dropout(h, dropout1_rate, training=training, mode=mode)
+    h = h @ linear2_weight if linear2_bias is None \
+        else h @ linear2_weight + linear2_bias
+    out = residual + F.dropout(h, dropout2_rate, training=training, mode=mode)
+    if not pre_layer_norm:
+        out = _layer_norm(out, ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, name=None):
+    """Self-attention block (fused_transformer.py:215 pseudo code).
+    qkv_weight: [3, num_heads, head_dim, embed_dim] (the reference's fused
+    layout); qkv_bias: [3, num_heads, head_dim]. ring_id != -1 (tensor-
+    parallel allreduce inside the CUDA op) is out of scope here — under
+    this framework mp runs through the mp_layers + GSPMD path."""
+    if ring_id != -1:
+        raise NotImplementedError(
+            "ring_id is the reference CUDA op's in-kernel tensor-parallel "
+            "allreduce; use distributed.meta_parallel mp_layers instead")
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "cache_kv decode belongs to the model-level KV-cache path "
+            "(models.gpt.generate)")
+    if mode != "upscale_in_train" and attn_dropout_rate:
+        # scaled_dot_product_attention's internal weight-dropout has no mode
+        # knob; silently diverging from the reference op's semantics would
+        # be worse than refusing
+        raise NotImplementedError(
+            "attention-weight dropout only supports mode='upscale_in_train'")
+    three, num_heads, head_dim, embed_dim = qkv_weight.shape
+    assert three == 3
+    b, s = x.shape[0], x.shape[1]
+    residual = x
+    if pre_layer_norm:
+        x = _layer_norm(x, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    w = P.reshape(qkv_weight, (3 * num_heads * head_dim, embed_dim))
+    qkv = x @ w.t()
+    if qkv_bias is not None:
+        qkv = qkv + P.reshape(qkv_bias, (3 * num_heads * head_dim,))
+    qkv = P.reshape(qkv, (b, s, 3, num_heads, head_dim))
+    q, k, v = P.unbind(qkv, axis=2)
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        is_causal=False, training=training)
+    out = P.reshape(out, (b, s, num_heads * head_dim))
+    out = out @ linear_weight
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = residual + F.dropout(out, dropout_rate, training=training,
+                               mode=mode)
+    if not pre_layer_norm:
+        out = _layer_norm(out, ln_scale, ln_bias, ln_epsilon)
+    return out

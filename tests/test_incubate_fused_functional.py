@@ -1,0 +1,95 @@
+"""incubate.nn.functional fused transformer ops vs independent numpy
+references (reference incubate/nn/functional/fused_transformer.py pseudo
+code; unittests test_fused_attention_op.py / test_fused_feedforward_op.py
+use the same compose-then-compare strategy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn.functional import (fused_feedforward,
+                                               fused_multi_head_attention)
+
+
+def np_layer_norm(x, scale, bias, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * scale + bias
+
+
+def test_fused_feedforward_matches_numpy():
+    rng = np.random.RandomState(0)
+    b, s, d, dff = 2, 6, 16, 64
+    x = rng.randn(b, s, d).astype("float32")
+    w1 = (rng.randn(d, dff) * 0.1).astype("float32")
+    b1 = (rng.randn(dff) * 0.1).astype("float32")
+    w2 = (rng.randn(dff, d) * 0.1).astype("float32")
+    b2 = (rng.randn(d) * 0.1).astype("float32")
+    scale = rng.rand(d).astype("float32") + 0.5
+    bias = rng.randn(d).astype("float32")
+
+    # pre_layer_norm: residual + linear2(relu(linear1(ln(x))))
+    ref = x + (np.maximum(np_layer_norm(x, scale, bias) @ w1 + b1, 0)
+               @ w2 + b2)
+    out = fused_feedforward(
+        paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+        linear1_bias=paddle.to_tensor(b1), linear2_bias=paddle.to_tensor(b2),
+        ln1_scale=paddle.to_tensor(scale), ln1_bias=paddle.to_tensor(bias),
+        dropout1_rate=0.0, dropout2_rate=0.0, pre_layer_norm=True,
+        training=False)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-5, atol=2e-5)
+
+    # post-layer_norm variant: ln(residual + ffn(x))
+    ref2 = np_layer_norm(x + (np.maximum(x @ w1 + b1, 0) @ w2 + b2),
+                         scale, bias)
+    out2 = fused_feedforward(
+        paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+        linear1_bias=paddle.to_tensor(b1), linear2_bias=paddle.to_tensor(b2),
+        ln2_scale=paddle.to_tensor(scale), ln2_bias=paddle.to_tensor(bias),
+        dropout1_rate=0.0, dropout2_rate=0.0, pre_layer_norm=False,
+        training=False)
+    np.testing.assert_allclose(out2.numpy(), ref2, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_multi_head_attention_matches_numpy():
+    rng = np.random.RandomState(1)
+    b, s, nh, hd = 2, 5, 4, 8
+    d = nh * hd
+    x = rng.randn(b, s, d).astype("float32")
+    qkv_w = (rng.randn(3, nh, hd, d) * 0.1).astype("float32")
+    qkv_b = (rng.randn(3, nh, hd) * 0.1).astype("float32")
+    lin_w = (rng.randn(d, d) * 0.1).astype("float32")
+    lin_b = (rng.randn(d) * 0.1).astype("float32")
+    scale = np.ones(d, "float32")
+    bias = np.zeros(d, "float32")
+
+    # numpy reference: qkv proj -> per-head softmax attention -> out proj
+    w2 = qkv_w.reshape(3 * d, d)
+    qkv = x @ w2.T + qkv_b.reshape(-1)
+    qkv = qkv.reshape(b, s, 3, nh, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b, s, nh, hd]
+    q = q.transpose(0, 2, 1, 3)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    logits = q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    attn = (p @ v).transpose(0, 2, 1, 3).reshape(b, s, d)
+    ref = np_layer_norm(x + (attn @ lin_w + lin_b), scale, bias)
+
+    out = fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkv_w), paddle.to_tensor(lin_w),
+        qkv_bias=paddle.to_tensor(qkv_b), linear_bias=paddle.to_tensor(lin_b),
+        ln_scale=paddle.to_tensor(scale), ln_bias=paddle.to_tensor(bias),
+        pre_layer_norm=False, dropout_rate=0.0, attn_dropout_rate=0.0,
+        training=False)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_unsupported_modes_raise():
+    x = paddle.to_tensor(np.zeros((1, 2, 8), "float32"))
+    qkv_w = paddle.to_tensor(np.zeros((3, 2, 4, 8), "float32"))
+    lin_w = paddle.to_tensor(np.zeros((8, 8), "float32"))
+    with pytest.raises(NotImplementedError):
+        fused_multi_head_attention(x, qkv_w, lin_w, ring_id=2)
+    with pytest.raises(NotImplementedError):
+        fused_multi_head_attention(x, qkv_w, lin_w, cache_kv=object())
