@@ -134,3 +134,80 @@ def test_loss_and_grad_parity(models):
                                rtol=3e-4, atol=3e-5)
     np.testing.assert_allclose(g_fc1_p, tm.fc1[0].weight.grad.numpy().T,
                                rtol=3e-4, atol=3e-5)
+
+
+class TorchCNN(torch.nn.Module):
+    """Independent torch twin of the paddle_tpu CNN below (OIHW conv weights
+    in both frameworks; BN in train mode uses batch statistics)."""
+
+    def __init__(self):
+        super().__init__()
+        self.c1 = torch.nn.Conv2d(3, 8, 3, stride=2, padding=1)
+        self.bn = torch.nn.BatchNorm2d(8)
+        self.c2 = torch.nn.Conv2d(8, 16, 3, groups=2)
+        self.fc = torch.nn.Linear(16, 5)
+
+    def forward(self, x):
+        h = torch.relu(self.bn(self.c1(x)))
+        h = torch.nn.functional.max_pool2d(h, 2)
+        h = torch.relu(self.c2(h))
+        h = h.mean(dim=(2, 3))
+        return self.fc(h)
+
+
+def test_vision_stack_parity():
+    """Conv (strided, padded, grouped) + BatchNorm + pooling + Linear:
+    forward and gradient parity against torch pins the NCHW layout and
+    padding conventions of the whole vision stack."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as PF
+
+    paddle.seed(0)
+
+    class OursCNN(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.c1 = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+            self.bn = nn.BatchNorm2D(8)
+            self.c2 = nn.Conv2D(8, 16, 3, groups=2)
+            self.fc = nn.Linear(16, 5)
+
+        def forward(self, x):
+            h = PF.relu(self.bn(self.c1(x)))
+            h = PF.max_pool2d(h, 2)
+            h = PF.relu(self.c2(h))
+            h = h.mean(axis=[2, 3])
+            return self.fc(h)
+
+    pm = OursCNN()
+    tm = TorchCNN()
+    sd = {k: np.array(v.numpy()) for k, v in pm.state_dict().items()}
+    with torch.no_grad():
+        tm.c1.weight.copy_(torch.from_numpy(sd["c1.weight"]))
+        tm.c1.bias.copy_(torch.from_numpy(sd["c1.bias"]))
+        tm.bn.weight.copy_(torch.from_numpy(sd["bn.weight"]))
+        tm.bn.bias.copy_(torch.from_numpy(sd["bn.bias"]))
+        tm.c2.weight.copy_(torch.from_numpy(sd["c2.weight"]))
+        tm.c2.bias.copy_(torch.from_numpy(sd["c2.bias"]))
+        tm.fc.weight.copy_(torch.from_numpy(sd["fc.weight"].T))
+        tm.fc.bias.copy_(torch.from_numpy(sd["fc.bias"]))
+
+    x = np.random.RandomState(0).randn(4, 3, 16, 16).astype("float32")
+    pm.train()
+    tm.train()
+    out_p = pm(paddle.to_tensor(x))
+    out_t = tm(torch.from_numpy(x))
+    np.testing.assert_allclose(out_p.numpy(), out_t.detach().numpy(),
+                               rtol=2e-4, atol=2e-5)
+
+    out_p.sum().backward()
+    out_t.sum().backward()
+    np.testing.assert_allclose(pm.c1.weight.grad.numpy(),
+                               tm.c1.weight.grad.numpy(),
+                               rtol=3e-4, atol=3e-5)
+    np.testing.assert_allclose(pm.c2.weight.grad.numpy(),
+                               tm.c2.weight.grad.numpy(),
+                               rtol=3e-4, atol=3e-5)
+    np.testing.assert_allclose(pm.bn.weight.grad.numpy(),
+                               tm.bn.weight.grad.numpy(),
+                               rtol=3e-4, atol=3e-5)
