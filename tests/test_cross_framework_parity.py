@@ -58,10 +58,14 @@ class TorchGPT(torch.nn.Module):
         return h @ self.wte.weight.t()  # tied head
 
 
+def _numpy_state_dict(pm):
+    return {k: np.array(v.numpy()) for k, v in pm.state_dict().items()}
+
+
 def _copy_weights(pm, tm):
     """paddle_tpu state_dict -> torch parameters (same layouts: our Linear
     stores [in, out], torch stores [out, in])."""
-    sd = {k: np.array(v.numpy()) for k, v in pm.state_dict().items()}
+    sd = _numpy_state_dict(pm)
     with torch.no_grad():
         tm.wte.weight.copy_(torch.from_numpy(sd["gpt.wte.weight"]))
         tm.wpe.weight.copy_(torch.from_numpy(sd["gpt.wpe.weight"]))
@@ -181,7 +185,7 @@ def test_vision_stack_parity():
 
     pm = OursCNN()
     tm = TorchCNN()
-    sd = {k: np.array(v.numpy()) for k, v in pm.state_dict().items()}
+    sd = _numpy_state_dict(pm)
     with torch.no_grad():
         tm.c1.weight.copy_(torch.from_numpy(sd["c1.weight"]))
         tm.c1.bias.copy_(torch.from_numpy(sd["c1.bias"]))
@@ -210,4 +214,141 @@ def test_vision_stack_parity():
                                rtol=3e-4, atol=3e-5)
     np.testing.assert_allclose(pm.bn.weight.grad.numpy(),
                                tm.bn.weight.grad.numpy(),
+                               rtol=3e-4, atol=3e-5)
+
+
+def test_ernie_encoder_parity():
+    """Post-LN bidirectional encoder (ERNIE/BERT convention) with
+    word+position+type embeddings, additive attention mask, and tanh pooler
+    matches an independent torch twin on sequence output and pooled output."""
+    from paddle_tpu.models import ErnieConfig, ErnieModel
+
+    EV, EH, EL, ENH, ES = 64, 32, 2, 4, 12
+    paddle.seed(0)
+    cfg = ErnieConfig(vocab_size=EV, hidden_size=EH, num_layers=EL,
+                      num_heads=ENH, max_seq_len=ES, dropout=0.0,
+                      attention_dropout=0.0)
+    pm = ErnieModel(cfg)
+    pm.eval()
+
+    class TorchErnie(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.word = torch.nn.Embedding(EV, EH)
+            self.pos = torch.nn.Embedding(ES, EH)
+            self.typ = torch.nn.Embedding(cfg.type_vocab_size, EH)
+            self.emb_ln = torch.nn.LayerNorm(EH)
+            mk = lambda: torch.nn.ModuleDict({
+                "qkv": torch.nn.Linear(EH, 3 * EH),
+                "proj": torch.nn.Linear(EH, EH),
+                "ln1": torch.nn.LayerNorm(EH),
+                "fc1": torch.nn.Linear(EH, cfg.ffn_hidden_size),
+                "fc2": torch.nn.Linear(cfg.ffn_hidden_size, EH),
+                "ln2": torch.nn.LayerNorm(EH)})
+            self.blocks = torch.nn.ModuleList([mk() for _ in range(EL)])
+            self.pooler = torch.nn.Linear(EH, EH)
+
+        def forward(self, ids, type_ids, mask):
+            b, s = ids.shape
+            x = self.word(ids) + self.pos(torch.arange(s)) + self.typ(type_ids)
+            x = self.emb_ln(x)
+            amask = (1.0 - mask.float()) * -1e4  # additive [b,1,1,s]
+            amask = amask.view(b, 1, 1, s)
+            for blk in self.blocks:
+                qkv = blk["qkv"](x).view(b, s, 3, ENH, EH // ENH)
+                q, k, v = qkv.unbind(2)
+                o = torch.nn.functional.scaled_dot_product_attention(
+                    q.transpose(1, 2), k.transpose(1, 2), v.transpose(1, 2),
+                    attn_mask=amask)
+                h = blk["ln1"](x + blk["proj"](
+                    o.transpose(1, 2).reshape(b, s, EH)))
+                ffn = blk["fc2"](torch.nn.functional.gelu(
+                    blk["fc1"](h), approximate="tanh"))
+                x = blk["ln2"](h + ffn)
+            return x, torch.tanh(self.pooler(x[:, 0]))
+
+    tm = TorchErnie()
+    tm.eval()
+    sd = _numpy_state_dict(pm)
+    with torch.no_grad():
+        tm.word.weight.copy_(torch.from_numpy(sd["word_emb.weight"]))
+        tm.pos.weight.copy_(torch.from_numpy(sd["pos_emb.weight"]))
+        tm.typ.weight.copy_(torch.from_numpy(sd["type_emb.weight"]))
+        tm.emb_ln.weight.copy_(torch.from_numpy(sd["emb_ln.weight"]))
+        tm.emb_ln.bias.copy_(torch.from_numpy(sd["emb_ln.bias"]))
+        tm.pooler.weight.copy_(torch.from_numpy(sd["pooler.weight"].T))
+        tm.pooler.bias.copy_(torch.from_numpy(sd["pooler.bias"]))
+        for i in range(EL):
+            p = f"blocks.{i}."
+            b = tm.blocks[i]
+            b["qkv"].weight.copy_(
+                torch.from_numpy(sd[p + "attn.qkv_proj.weight"].T))
+            b["qkv"].bias.copy_(
+                torch.from_numpy(sd[p + "attn.qkv_proj.bias"]))
+            b["proj"].weight.copy_(
+                torch.from_numpy(sd[p + "attn.out_proj.weight"].T))
+            b["proj"].bias.copy_(
+                torch.from_numpy(sd[p + "attn.out_proj.bias"]))
+            for nm in ("ln1", "ln2"):
+                b[nm].weight.copy_(torch.from_numpy(sd[p + nm + ".weight"]))
+                b[nm].bias.copy_(torch.from_numpy(sd[p + nm + ".bias"]))
+            b["fc1"].weight.copy_(torch.from_numpy(sd[p + "fc1.weight"].T))
+            b["fc1"].bias.copy_(torch.from_numpy(sd[p + "fc1.bias"]))
+            b["fc2"].weight.copy_(torch.from_numpy(sd[p + "fc2.weight"].T))
+            b["fc2"].bias.copy_(torch.from_numpy(sd[p + "fc2.bias"]))
+
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, EV, (2, ES)).astype(np.int64)
+    type_ids = rng.randint(0, 2, (2, ES)).astype(np.int64)
+    mask = np.ones((2, ES), np.int64)
+    mask[:, -3:] = 0  # padded tail
+
+    seq_p, pool_p = pm(paddle.to_tensor(ids), paddle.to_tensor(type_ids),
+                       paddle.to_tensor(mask))
+    seq_t, pool_t = tm(torch.from_numpy(ids), torch.from_numpy(type_ids),
+                       torch.from_numpy(mask))
+    np.testing.assert_allclose(seq_p.numpy(), seq_t.detach().numpy(),
+                               rtol=3e-4, atol=3e-5)
+    np.testing.assert_allclose(pool_p.numpy(), pool_t.detach().numpy(),
+                               rtol=3e-4, atol=3e-5)
+
+
+def test_lstm_parity():
+    """The fused-scan LSTM (nn/layers/rnn.py) matches torch.nn.LSTM on
+    outputs and final (h, c) with copied gate weights (both use the
+    i,f,g,o gate order and [4h, in] weight layout)."""
+    import paddle_tpu.nn as nn
+
+    IN, HID, T, BT = 6, 8, 5, 3
+    paddle.seed(0)
+    pm = nn.LSTM(IN, HID, num_layers=1)
+    tm = torch.nn.LSTM(IN, HID, num_layers=1, batch_first=True)
+    sd = _numpy_state_dict(pm)
+    pre = "_all_layers.0.cell."
+    with torch.no_grad():
+        tm.weight_ih_l0.copy_(torch.from_numpy(sd[pre + "weight_ih"]))
+        tm.weight_hh_l0.copy_(torch.from_numpy(sd[pre + "weight_hh"]))
+        tm.bias_ih_l0.copy_(torch.from_numpy(sd[pre + "bias_ih"]))
+        tm.bias_hh_l0.copy_(torch.from_numpy(sd[pre + "bias_hh"]))
+
+    x = np.random.RandomState(3).randn(BT, T, IN).astype("float32")
+    out_p, (h_p, c_p) = pm(paddle.to_tensor(x))
+    out_t, (h_t, c_t) = tm(torch.from_numpy(x))
+    np.testing.assert_allclose(out_p.numpy(), out_t.detach().numpy(),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(h_p.numpy(), h_t.detach().numpy(),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(c_p.numpy(), c_t.detach().numpy(),
+                               rtol=2e-4, atol=2e-5)
+
+    # gradient parity through the fused lax.scan vjp (rnn.py's TPU-first
+    # backward) vs torch's autograd through its unrolled loop
+    out_p.sum().backward()
+    out_t.sum().backward()
+    cell = pm._all_layers[0].cell
+    np.testing.assert_allclose(cell.weight_ih.grad.numpy(),
+                               tm.weight_ih_l0.grad.numpy(),
+                               rtol=3e-4, atol=3e-5)
+    np.testing.assert_allclose(cell.weight_hh.grad.numpy(),
+                               tm.weight_hh_l0.grad.numpy(),
                                rtol=3e-4, atol=3e-5)
