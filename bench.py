@@ -73,6 +73,9 @@ def main():
                 "PADDLE_TPU_BENCH_PALLAS_LOSS_BLOCK", "256"))})
     if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LN"):  # fused LayerNorm kernel
         paddle.set_flags({"use_pallas_layernorm": True})
+    if os.environ.get("PADDLE_TPU_BENCH_CE_CHUNK"):  # rows per fused-CE step
+        paddle.set_flags({"fused_ce_chunk":
+                          int(os.environ["PADDLE_TPU_BENCH_CE_CHUNK"])})
     if batch % n_dev:  # batch dim shards over dp_degree = n_dev
         batch = max(n_dev, batch - batch % n_dev)
 

@@ -84,4 +84,9 @@ define_flag("pallas_lm_loss_block_n", 1024,
             "row-block size of the Pallas LM-loss COMPUTE tiles (256/512/1024;"
             " 1D operands stay on 1024-element blocks via revisit sub-slices)")
 define_flag("use_pallas_layernorm", False, "route layer_norm to the fused Pallas kernel")
+define_flag("fused_ce_chunk", 2048,
+            "rows per scan step of the chunked fused LM-head cross-entropy "
+            "(ops/fused.py). Each chunk re-reads the [V, H] head weight from "
+            "HBM, so larger chunks trade transient logits memory "
+            "(chunk x vocab f32) for fewer weight reads")
 define_flag("pallas_interpret_ok", False, "allow pallas kernels in interpret mode on CPU (tests)")

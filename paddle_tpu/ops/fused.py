@@ -160,7 +160,13 @@ def fused_linear_cross_entropy(hidden, weight, label, transpose_y=True,
                 loss = jnp.where(ignore, 0.0, loss)
                 return loss.reshape(lead_shape)
 
-        chunk = min(_CHUNK, n)
+        from ..core.flags import flag as _flag
+
+        cfg_chunk = int(_flag("fused_ce_chunk") or _CHUNK)
+        if cfg_chunk < 1:
+            raise ValueError(
+                f"FLAGS_fused_ce_chunk must be >= 1, got {cfg_chunk}")
+        chunk = min(cfg_chunk, n)
         pad = (-n) % chunk
         if pad:
             h2 = jnp.concatenate([h2, jnp.zeros((pad, hdim), h2.dtype)], axis=0)
