@@ -93,8 +93,7 @@ def _copy_weights(pm, tm):
             tm.fc2[i].bias.copy_(torch.from_numpy(sd[p + "mlp.fc2.bias"]))
 
 
-@pytest.fixture(scope="module")
-def models():
+def _fresh_pair():
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=V, hidden_size=H, num_layers=L, num_heads=NH,
                     max_seq_len=S)
@@ -105,6 +104,11 @@ def models():
     _copy_weights(pm, tm)
     ids = np.random.RandomState(0).randint(0, V, (B, S)).astype(np.int64)
     return pm, tm, ids
+
+
+@pytest.fixture(scope="module")
+def models():
+    return _fresh_pair()
 
 
 def test_logits_parity(models):
@@ -352,3 +356,53 @@ def test_lstm_parity():
     np.testing.assert_allclose(cell.weight_hh.grad.numpy(),
                                tm.weight_hh_l0.grad.numpy(),
                                rtol=3e-4, atol=3e-5)
+
+
+@pytest.mark.parametrize("opt_name", ["adamw", "sgd_momentum"])
+def test_optimizer_trajectory_parity(opt_name):
+    """Five full training steps must track torch step-for-step: same loss at
+    every step and same parameters at the end. Pins the optimizer update
+    rules (decoupled AdamW weight decay, classical momentum) composed with
+    the full model's gradients, not just per-op math. Builds a FRESH model
+    pair: this test mutates weights, and the shared fixture must stay
+    pristine under shuffled test order."""
+    pm, tm, ids = _fresh_pair()
+    labels = np.roll(ids, -1, 1)
+
+    if opt_name == "adamw":
+        opt_p = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                       parameters=pm.parameters(),
+                                       weight_decay=0.01)
+        opt_t = torch.optim.AdamW(tm.parameters(), lr=1e-3, weight_decay=0.01)
+    else:
+        opt_p = paddle.optimizer.Momentum(learning_rate=1e-2,
+                                          momentum=0.9,
+                                          parameters=pm.parameters())
+        opt_t = torch.optim.SGD(tm.parameters(), lr=1e-2, momentum=0.9)
+
+    pm.train()
+    tm.train()
+    losses_p, losses_t = [], []
+    for _ in range(5):
+        loss_p = pm(paddle.to_tensor(ids), paddle.to_tensor(labels))
+        loss_p.backward()
+        opt_p.step()
+        opt_p.clear_grad()
+        losses_p.append(float(loss_p.item()))
+
+        opt_t.zero_grad()
+        logits = tm(torch.from_numpy(ids))
+        loss_t = torch.nn.functional.cross_entropy(
+            logits.reshape(-1, V), torch.from_numpy(labels).reshape(-1))
+        loss_t.backward()
+        opt_t.step()
+        losses_t.append(float(loss_t.item()))
+    pm.eval()
+
+    np.testing.assert_allclose(losses_p, losses_t, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        pm.gpt.blocks[0].mlp.fc1.weight.numpy(),
+        tm.fc1[0].weight.detach().numpy().T, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        pm.gpt.wte.weight.numpy(), tm.wte.weight.detach().numpy(),
+        rtol=2e-4, atol=2e-5)
