@@ -167,16 +167,24 @@ class Beta(Distribution):
         shape = tuple(shape) + self.batch_shape
         return Tensor(jax.random.beta(_key(), self.alpha, self.beta, shape))
 
+    def _log_beta(self):
+        return (jax.scipy.special.gammaln(self.alpha)
+                + jax.scipy.special.gammaln(self.beta)
+                - jax.scipy.special.gammaln(self.alpha + self.beta))
+
     def log_prob(self, value):
         v = _t(value)
-        lbeta = (jax.scipy.special.gammaln(self.alpha)
-                 + jax.scipy.special.gammaln(self.beta)
-                 - jax.scipy.special.gammaln(self.alpha + self.beta))
         return Tensor((self.alpha - 1) * jnp.log(v)
-                      + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+                      + (self.beta - 1) * jnp.log1p(-v) - self._log_beta())
 
     def mean(self):
         return Tensor(self.alpha / (self.alpha + self.beta))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        return Tensor(self._log_beta() - (a - 1) * dg(a) - (b - 1) * dg(b)
+                      + (a + b - 2) * dg(a + b))
 
 
 class Dirichlet(Distribution):
@@ -189,12 +197,22 @@ class Dirichlet(Distribution):
         shape = tuple(shape) + self.batch_shape
         return Tensor(jax.random.dirichlet(_key(), self.concentration, shape))
 
+    def _log_norm(self):
+        c = self.concentration
+        return (jax.scipy.special.gammaln(c).sum(-1)
+                - jax.scipy.special.gammaln(c.sum(-1)))
+
     def log_prob(self, value):
         v = _t(value)
         c = self.concentration
-        lnorm = (jax.scipy.special.gammaln(c).sum(-1)
-                 - jax.scipy.special.gammaln(c.sum(-1)))
-        return Tensor(((c - 1) * jnp.log(v)).sum(-1) - lnorm)
+        return Tensor(((c - 1) * jnp.log(v)).sum(-1) - self._log_norm())
+
+    def entropy(self):
+        c = self.concentration
+        dg = jax.scipy.special.digamma
+        c0 = c.sum(-1)
+        return Tensor(self._log_norm() + (c0 - c.shape[-1]) * dg(c0)
+                      - ((c - 1) * dg(c)).sum(-1))
 
 
 class Multinomial(Distribution):

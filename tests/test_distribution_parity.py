@@ -1,0 +1,98 @@
+"""paddle.distribution vs torch.distributions: log_prob, entropy, and KL
+parity (reference python/paddle/distribution.py + unittests
+test_distribution.py use hand-numpy references; torch.distributions is a
+stronger independent implementation of the same math)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Bernoulli, Beta, Categorical, Dirichlet,
+                                     Normal, Uniform, kl_divergence)
+
+RTOL, ATOL = 2e-5, 2e-6
+
+
+def _np(x):
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
+def test_normal_parity():
+    loc, scale = np.float32(0.7), np.float32(1.3)
+    p = Normal(loc, scale)
+    t = torch.distributions.Normal(torch.tensor(loc), torch.tensor(scale))
+    v = np.linspace(-3, 3, 7).astype("float32")
+    np.testing.assert_allclose(_np(p.log_prob(paddle.to_tensor(v))),
+                               t.log_prob(torch.from_numpy(v)).numpy(),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(_np(p.entropy()), t.entropy().numpy(),
+                               rtol=RTOL, atol=ATOL)
+    q = Normal(np.float32(-0.5), np.float32(0.8))
+    tq = torch.distributions.Normal(torch.tensor(-0.5), torch.tensor(0.8))
+    np.testing.assert_allclose(
+        _np(kl_divergence(p, q)),
+        torch.distributions.kl_divergence(t, tq).numpy(),
+        rtol=RTOL, atol=ATOL)
+
+
+def test_uniform_parity():
+    p = Uniform(np.float32(-1.0), np.float32(2.0))
+    t = torch.distributions.Uniform(torch.tensor(-1.0), torch.tensor(2.0))
+    v = np.array([-0.5, 0.0, 1.5], "float32")
+    np.testing.assert_allclose(_np(p.log_prob(paddle.to_tensor(v))),
+                               t.log_prob(torch.from_numpy(v)).numpy(),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(_np(p.entropy()), t.entropy().numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_categorical_and_bernoulli_parity():
+    logits = np.array([[0.2, -1.0, 0.7], [1.5, 0.1, -0.4]], "float32")
+    p = Categorical(paddle.to_tensor(logits))
+    t = torch.distributions.Categorical(logits=torch.from_numpy(logits))
+    v = np.array([2, 0], "int64")
+    np.testing.assert_allclose(_np(p.log_prob(paddle.to_tensor(v))),
+                               t.log_prob(torch.from_numpy(v)).numpy(),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(_np(p.entropy()), t.entropy().numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+    pb = Bernoulli(np.float32(0.3))
+    tb = torch.distributions.Bernoulli(torch.tensor(0.3))
+    vb = np.array([0.0, 1.0], "float32")
+    np.testing.assert_allclose(_np(pb.log_prob(paddle.to_tensor(vb))),
+                               tb.log_prob(torch.from_numpy(vb)).numpy(),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(_np(pb.entropy()), tb.entropy().numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_beta_dirichlet_parity():
+    p = Beta(np.float32(2.0), np.float32(3.0))
+    t = torch.distributions.Beta(torch.tensor(2.0), torch.tensor(3.0))
+    v = np.array([0.2, 0.5, 0.8], "float32")
+    np.testing.assert_allclose(_np(p.log_prob(paddle.to_tensor(v))),
+                               t.log_prob(torch.from_numpy(v)).numpy(),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(_np(p.entropy()), t.entropy().numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+    conc = np.array([1.5, 2.5, 3.0], "float32")
+    pd_ = Dirichlet(paddle.to_tensor(conc))
+    td = torch.distributions.Dirichlet(torch.from_numpy(conc))
+    x = np.array([0.2, 0.3, 0.5], "float32")
+    np.testing.assert_allclose(_np(pd_.log_prob(paddle.to_tensor(x))),
+                               td.log_prob(torch.from_numpy(x)).numpy(),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(_np(pd_.entropy()), td.entropy().numpy(),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_sampling_moments():
+    """Samples are RNG-specific across frameworks; check moments instead."""
+    paddle.seed(0)
+    s = Normal(np.float32(2.0), np.float32(0.5)).sample([20000])
+    arr = _np(s)
+    assert abs(arr.mean() - 2.0) < 0.02
+    assert abs(arr.std() - 0.5) < 0.02
