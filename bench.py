@@ -160,6 +160,11 @@ def main():
             paddle.seed(0)
             dm = GPTForPretraining(cfg)
             dm.eval()
+            if os.environ.get("PADDLE_TPU_BENCH_DECODE_INT8") == "1":
+                # weight-only int8 projections: halves decode weight traffic
+                from paddle_tpu.incubate.quantization import quantize_model
+
+                quantize_model(dm)
             n_new = 64
             p_len = max(1, min(128, cfg.max_seq_len - n_new))
             d_prompt = rng.randint(0, cfg.vocab_size,

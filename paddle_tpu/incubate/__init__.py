@@ -8,6 +8,7 @@ from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from . import nn  # noqa: F401
 from . import autograd  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import quantization  # noqa: F401
 from .operators import (  # noqa: F401
     graph_khop_sampler, graph_reindex, graph_sample_neighbors, graph_send_recv,
     softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
