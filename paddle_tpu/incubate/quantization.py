@@ -142,13 +142,11 @@ class QuantizedLinear(Layer):
                   bias=None if self._bias_none else self._bias)
 
 
-def quantize_model(layer, mode="weight_only_int8"):
-    """Swap every Linear-shaped sublayer for a QuantizedLinear in place and
-    return the layer (post-training, weight-only by default — the
-    reference's PostTrainingQuantization applied the TPU way). The TP
-    layers (Column/RowParallelLinear — what the model zoo's transformer
-    blocks use) are included only in the single-replica case: under mp > 1
-    their forward carries sharding constraints/collectives that the plain
+def _linear_kinds():
+    """Layer classes eligible for quantization swaps. The TP layers
+    (Column/RowParallelLinear — what the model zoo's transformer blocks
+    use) are included only in the single-replica case: under mp > 1 their
+    forward carries sharding constraints/collectives that the plain
     quantized matmul would drop."""
     from ..distributed.mesh import get_hybrid_communicate_group
     from ..distributed.meta_parallel.mp_layers import (ColumnParallelLinear,
@@ -156,16 +154,137 @@ def quantize_model(layer, mode="weight_only_int8"):
     from ..nn import Linear
 
     hcg = get_hybrid_communicate_group()
-    single_replica = hcg is None or hcg.degrees["mp"] <= 1
-    kinds = (Linear, ColumnParallelLinear, RowParallelLinear) \
-        if single_replica else (Linear,)
-    if isinstance(layer, kinds):  # the root itself is a linear
-        return QuantizedLinear.from_linear(layer, mode)
+    if hcg is None or hcg.degrees["mp"] <= 1:
+        return (Linear, ColumnParallelLinear, RowParallelLinear)
+    return (Linear,)
+
+
+def _swap_sublayers(layer, match, make):
+    """One walker for every quantization swap: replace each sublayer
+    matching `match` with `make(sublayer)`, without descending into already
+    wrapped layers (QATLinear holds an inner Linear that must never be
+    re-swapped out from under it). Returns the (possibly replaced) root."""
+    if match(layer):
+        return make(layer)
     for name, sub in list(layer.named_sublayers()):
-        parent = layer
         parts = name.split(".")
-        for p in parts[:-1]:
-            parent = getattr(parent, p)
-        if isinstance(sub, kinds):
-            setattr(parent, parts[-1], QuantizedLinear.from_linear(sub, mode))
+        parent = layer
+        skip = False
+        for pth in parts[:-1]:
+            parent = getattr(parent, pth)
+            if isinstance(parent, (QATLinear, QuantizedLinear)):
+                skip = True
+                break
+        if skip or not match(sub):
+            continue
+        setattr(parent, parts[-1], make(sub))
     return layer
+
+
+def quantize_model(layer, mode="weight_only_int8"):
+    """Swap every Linear-shaped sublayer for a QuantizedLinear in place and
+    return the layer (post-training, weight-only by default — the
+    reference's PostTrainingQuantization applied the TPU way). QAT-wrapped
+    layers (QATLinear) convert via their trained inner Linear."""
+    kinds = _linear_kinds()
+
+    def match(sub):
+        return isinstance(sub, kinds + (QATLinear,))
+
+    def make(sub):
+        inner = sub.inner if isinstance(sub, QATLinear) else sub
+        return QuantizedLinear.from_linear(inner, mode)
+
+    return _swap_sublayers(layer, match, make)
+
+
+# --------------------------------------------------------------------- QAT ---
+
+def fake_quant(x, bits=8, scale=None):
+    """Quantize-dequantize with a straight-through gradient (the reference's
+    fake_quantize_dequantize_abs_max op, quantization_pass.py): forward
+    rounds onto the int grid, backward passes gradients through unchanged.
+    scale=None (or a scale holding 0 — the never-calibrated sentinel) falls
+    back to dynamic abs-max INSIDE the kernel, so the choice is trace-safe
+    and survives checkpoint restore."""
+    from ..core.dispatch import apply
+
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def kernel(a, *s):
+        dyn = jnp.max(jnp.abs(a)) / qmax
+        sc = jnp.where(s[0] > 0, s[0], dyn) if s else dyn
+        sc = jnp.where(sc == 0, 1.0, sc).astype(a.dtype)
+        q = jnp.clip(jnp.round(a / sc), -qmax, qmax) * sc
+        # straight-through: forward quantized value, identity gradient
+        return a + jax.lax.stop_gradient(q - a)
+
+    args = [_as_t(x)] + ([_as_t(scale)] if scale is not None else [])
+    return apply("fake_quant", kernel, args)
+
+
+class QATLinear(Layer):
+    """Linear with fake-quantized weight and activation — trains in float
+    with quantization noise so post-training int8 conversion loses nothing
+    (reference imperative/qat.py QuantizedLinear). Activation scale follows
+    a moving average of abs-max (moving_average_abs_max); "never
+    calibrated" is encoded as scale == 0 IN the persisted buffer, so
+    restored checkpoints keep their calibration."""
+
+    def __init__(self, linear, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self.inner = linear
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.register_buffer("_act_scale", Tensor(jnp.zeros((), jnp.float32)),
+                             persistable=True)
+
+    def forward(self, x):
+        from ..jit import in_jit_trace
+        from ..nn import functional as F
+
+        qmax = float(2 ** (self.activation_bits - 1) - 1)
+        if self.training and not in_jit_trace():
+            # moving-average abs-max tracked host-side, OUTSIDE the traced
+            # graph (reference moving_average_abs_max state vars). Inside a
+            # trace (engine/jit) the frozen scale from eager steps is used.
+            cur = float(jnp.max(jnp.abs(_arr(x)))) / qmax
+            prev = float(self._act_scale._data)
+            new = cur if prev == 0 else \
+                self.moving_rate * prev + (1 - self.moving_rate) * cur
+            self._act_scale._data = jnp.asarray(new, jnp.float32)
+        # scale == 0 -> in-kernel dynamic fallback (never-calibrated case)
+        xq = fake_quant(x, self.activation_bits, scale=self._act_scale)
+        wq = fake_quant(self.inner.weight, self.weight_bits)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class ImperativeQuantAware:
+    """QAT driver (reference imperative/qat.py:42): quantize(model) swaps
+    Linear-shaped layers (incl. single-replica TP layers) for QATLinear in
+    place; after training, convert(model, mode=...) produces true-int8
+    QuantizedLinear layers. mode="dynamic_int8" re-derives activation
+    scales per row at runtime (the trained moving average regularized
+    training; deployment stays calibration-free, like the reference's
+    dynamic strategy); the default keeps activations in float."""
+
+    def __init__(self, weight_bits=8, activation_bits=8, moving_rate=0.9):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+
+    def quantize(self, model):
+        kinds = _linear_kinds()
+        return _swap_sublayers(
+            model, lambda sub: isinstance(sub, kinds),
+            lambda lin: QATLinear(lin, self.weight_bits,
+                                  self.activation_bits, self.moving_rate))
+
+    def convert(self, model, mode="weight_only_int8"):
+        """QATLinear -> real int8 QuantizedLinear (weights re-quantized
+        from the trained floats)."""
+        return _swap_sublayers(
+            model, lambda sub: isinstance(sub, QATLinear),
+            lambda q: QuantizedLinear.from_linear(q.inner, mode))

@@ -143,3 +143,108 @@ def test_quantize_model_handles_root_linear():
     lin = nn.Linear(4, 4)
     out = quantize_model(lin)
     assert isinstance(out, QuantizedLinear)
+
+
+def test_fake_quant_straight_through():
+    """Forward lands on the int grid; backward is identity (STE)."""
+    import jax
+
+    from paddle_tpu.incubate.quantization import fake_quant
+
+    x = paddle.to_tensor(_rand((8,), 20))
+    x.stop_gradient = False
+    y = fake_quant(x, bits=8)
+    err = np.abs(y.numpy() - x.numpy())
+    scale = np.abs(x.numpy()).max() / 127.0
+    assert (err <= scale / 2 + 1e-7).all()       # on-grid forward
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(8), rtol=1e-6)  # STE
+
+
+def test_qat_train_then_convert():
+    """ImperativeQuantAware: fake-quant training converges, convert()
+    produces true int8 layers whose outputs track the QAT model."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate.quantization import (ImperativeQuantAware,
+                                                  QATLinear)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    qat = ImperativeQuantAware()
+    qat.quantize(net)
+    assert isinstance(net[0], QATLinear)
+    assert len(list(net.parameters())) == 4  # still trainable floats
+
+    x = paddle.to_tensor(_rand((32, 8), 21))
+    target = paddle.to_tensor(_rand((32, 1), 22))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    net.train()
+    losses = []
+    for _ in range(30):
+        loss = ((net(x) - target) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < 0.5 * losses[0], losses[::10]  # QAT trains
+
+    net.eval()
+    ref = net(x).numpy()
+    qat.convert(net)
+    assert isinstance(net[0], QuantizedLinear)
+    out = net(x).numpy()
+    # converted int8 stays close to the fake-quant-trained model
+    assert np.abs(out - ref).mean() / (np.abs(ref).mean() + 1e-9) < 0.1
+
+
+def test_qat_wraps_tp_layers_and_ptq_converts_qat():
+    """QAT must reach the model zoo's transformer projections (TP linear
+    layers, single replica), and quantize_model on a QAT-wrapped model must
+    convert via the trained inner Linear instead of corrupting the wrapper."""
+    from paddle_tpu.incubate.quantization import (ImperativeQuantAware,
+                                                  QATLinear)
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+
+    paddle.seed(0)
+    m = GPTForPretraining(gpt_tiny())
+    ImperativeQuantAware().quantize(m)
+    assert isinstance(m.gpt.blocks[0].attn.qkv_proj, QATLinear)
+    assert isinstance(m.gpt.blocks[0].mlp.fc2, QATLinear)
+
+    quantize_model(m)  # PTQ over a QAT model: unwrap, don't corrupt
+    assert isinstance(m.gpt.blocks[0].attn.qkv_proj, QuantizedLinear)
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 1024, (2, 8)).astype(np.int64))
+    m.eval()
+    out = m.generate(ids, max_new_tokens=2, temperature=0)
+    assert out.shape == [2, 10]
+
+
+def test_qat_calibration_survives_checkpoint(tmp_path):
+    """The moving-average activation scale lives in a persisted buffer and
+    the calibrated/uncalibrated choice is derived from it (scale > 0), so a
+    restored QAT model keeps its calibration."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate.quantization import (ImperativeQuantAware,
+                                                  QATLinear)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 4))
+    ImperativeQuantAware().quantize(net)
+    x = paddle.to_tensor(_rand((4, 8), 30))
+    net.train()
+    net(x)  # calibrates the moving average
+    scale = float(net[0]._act_scale.numpy())
+    assert scale > 0
+    path = str(tmp_path / "qat.pdparams")
+    paddle.save(net.state_dict(), path)
+
+    paddle.seed(0)
+    net2 = nn.Sequential(nn.Linear(8, 4))
+    ImperativeQuantAware().quantize(net2)
+    net2.set_state_dict(paddle.load(path))
+    assert float(net2[0]._act_scale.numpy()) == pytest.approx(scale)
+    net2.eval()
+    # restored model quantizes with the trained scale, matching the source
+    np.testing.assert_allclose(net2(x).numpy(), net(x).numpy(), rtol=1e-6)
