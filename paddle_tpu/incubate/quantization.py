@@ -33,7 +33,9 @@ from ..core.tensor import Tensor
 from ..nn import Layer
 
 __all__ = ["quantize_weight", "weight_only_int8_matmul",
-           "dynamic_int8_matmul", "QuantizedLinear", "quantize_model"]
+           "dynamic_int8_matmul", "static_int8_matmul", "QuantizedLinear",
+           "quantize_model", "fake_quant", "QATLinear",
+           "ImperativeQuantAware", "PostTrainingQuantization"]
 
 
 def _arr(x):
@@ -106,15 +108,50 @@ def dynamic_int8_matmul(x, w_int8, scale, bias=None):
                  nondiff_mask=[False, True, False, False][:len(args)])
 
 
+def static_int8_matmul(x, w_int8, scale, act_scale, bias=None):
+    """Calibrated static activation quantization: x quantized with the FIXED
+    per-layer scale recorded during PTQ calibration (the reference's
+    out_threshold), then s8 x s8 -> s32 on the MXU. Unlike dynamic_int8
+    there is no runtime abs-max reduction over the activation."""
+    from ..core.dispatch import apply
+
+    def kernel(a, wq, s, act_s, *rest):
+        lead = a.shape[:-1]
+        x2 = a.reshape((-1, a.shape[-1]))
+        sc = jnp.where(act_s == 0, 1.0, act_s).astype(jnp.float32)
+        x_q = jnp.clip(jnp.round(x2 / sc.astype(x2.dtype)),
+                       -127, 127).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            x_q, wq, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = (acc.astype(jnp.float32) * sc
+               * s.astype(jnp.float32)[None, :]).astype(a.dtype)
+        out = out.reshape(lead + (out.shape[-1],))
+        if rest:
+            out = out + rest[0].astype(out.dtype)
+        return out
+
+    args = [_as_t(x), _as_t(w_int8), _as_t(scale), _as_t(act_scale)]
+    if bias is not None:
+        args.append(_as_t(bias))
+    return apply("linear", kernel, args,
+                 nondiff_mask=[False, True, False, False, False][:len(args)])
+
+
 class QuantizedLinear(Layer):
     """Drop-in for nn.Linear built from a trained layer's weights."""
 
-    def __init__(self, w_int8, scale, bias=None, mode="weight_only_int8"):
+    MODES = ("weight_only_int8", "dynamic_int8", "static_int8")
+
+    def __init__(self, w_int8, scale, bias=None, mode="weight_only_int8",
+                 act_scale=None):
         super().__init__()
-        if mode not in ("weight_only_int8", "dynamic_int8"):
+        if mode not in self.MODES:
+            raise ValueError(f"mode must be one of {self.MODES}, got {mode!r}")
+        if mode == "static_int8" and act_scale is None:
             raise ValueError(
-                f"mode must be 'weight_only_int8' or 'dynamic_int8', "
-                f"got {mode!r}")
+                "static_int8 needs the calibrated act_scale "
+                "(PostTrainingQuantization.collect records it)")
         self.mode = mode
         # persistable BUFFERS, not Parameters: not trainable (absent from
         # parameters()) but they must flow through state_dict — paddle.save
@@ -127,19 +164,25 @@ class QuantizedLinear(Layer):
         self._bias_none = bias is None
         if bias is not None:
             self.register_buffer("_bias", Tensor(_arr(bias)))
+        if act_scale is not None:
+            self.register_buffer(
+                "_act_scale", Tensor(jnp.asarray(act_scale, jnp.float32)))
 
     @classmethod
-    def from_linear(cls, linear, mode="weight_only_int8"):
+    def from_linear(cls, linear, mode="weight_only_int8", act_scale=None):
         q, scale = quantize_weight(linear.weight)
         bias = getattr(linear, "bias", None)
         return cls(q, scale, bias=None if bias is None else bias._data,
-                   mode=mode)
+                   mode=mode, act_scale=act_scale)
 
     def forward(self, x):
+        bias = None if self._bias_none else self._bias
+        if self.mode == "static_int8":
+            return static_int8_matmul(x, self._w_int8, self._scale,
+                                      self._act_scale, bias=bias)
         fn = (weight_only_int8_matmul if self.mode == "weight_only_int8"
               else dynamic_int8_matmul)
-        return fn(x, self._w_int8, self._scale,
-                  bias=None if self._bias_none else self._bias)
+        return fn(x, self._w_int8, self._scale, bias=bias)
 
 
 def _linear_kinds():
@@ -161,11 +204,12 @@ def _linear_kinds():
 
 def _swap_sublayers(layer, match, make):
     """One walker for every quantization swap: replace each sublayer
-    matching `match` with `make(sublayer)`, without descending into already
-    wrapped layers (QATLinear holds an inner Linear that must never be
-    re-swapped out from under it). Returns the (possibly replaced) root."""
+    matching `match` with `make(sublayer, name)`, without descending into
+    already wrapped layers (QATLinear holds an inner Linear that must never
+    be re-swapped out from under it). Returns the (possibly replaced)
+    root; the root itself is addressed by name ""."""
     if match(layer):
-        return make(layer)
+        return make(layer, "")
     for name, sub in list(layer.named_sublayers()):
         parts = name.split(".")
         parent = layer
@@ -177,42 +221,56 @@ def _swap_sublayers(layer, match, make):
                 break
         if skip or not match(sub):
             continue
-        setattr(parent, parts[-1], make(sub))
+        setattr(parent, parts[-1], make(sub, name))
     return layer
 
 
-def quantize_model(layer, mode="weight_only_int8"):
+def quantize_model(layer, mode="weight_only_int8", act_scales=None):
     """Swap every Linear-shaped sublayer for a QuantizedLinear in place and
     return the layer (post-training, weight-only by default — the
     reference's PostTrainingQuantization applied the TPU way). QAT-wrapped
-    layers (QATLinear) convert via their trained inner Linear."""
+    layers (QATLinear) convert via their trained inner Linear. act_scales
+    (name -> f32, from PostTrainingQuantization.collect) feeds the
+    static_int8 mode."""
+    if mode == "static_int8" and not act_scales:
+        raise ValueError(
+            "static_int8 needs act_scales from a calibration pass "
+            "(use PostTrainingQuantization)")
     kinds = _linear_kinds()
 
     def match(sub):
         return isinstance(sub, kinds + (QATLinear,))
 
-    def make(sub):
+    def make(sub, name):
         inner = sub.inner if isinstance(sub, QATLinear) else sub
-        return QuantizedLinear.from_linear(inner, mode)
+        act = None if act_scales is None else act_scales.get(name)
+        return QuantizedLinear.from_linear(inner, mode, act_scale=act)
 
     return _swap_sublayers(layer, match, make)
 
 
 # --------------------------------------------------------------------- QAT ---
 
-def fake_quant(x, bits=8, scale=None):
+def fake_quant(x, bits=8, scale=None, channel_axis=None):
     """Quantize-dequantize with a straight-through gradient (the reference's
     fake_quantize_dequantize_abs_max op, quantization_pass.py): forward
     rounds onto the int grid, backward passes gradients through unchanged.
     scale=None (or a scale holding 0 — the never-calibrated sentinel) falls
     back to dynamic abs-max INSIDE the kernel, so the choice is trace-safe
-    and survives checkpoint restore."""
+    and survives checkpoint restore. channel_axis selects per-channel
+    abs-max (the grid deployment uses — quantize_weight is per output
+    channel, and QAT must train against the same noise)."""
     from ..core.dispatch import apply
 
     qmax = float(2 ** (bits - 1) - 1)
 
     def kernel(a, *s):
-        dyn = jnp.max(jnp.abs(a)) / qmax
+        if channel_axis is None:
+            dyn = jnp.max(jnp.abs(a)) / qmax
+        else:
+            axes = tuple(i for i in range(a.ndim)
+                         if i != channel_axis % a.ndim)
+            dyn = jnp.max(jnp.abs(a), axis=axes, keepdims=True) / qmax
         sc = jnp.where(s[0] > 0, s[0], dyn) if s else dyn
         sc = jnp.where(sc == 0, 1.0, sc).astype(a.dtype)
         q = jnp.clip(jnp.round(a / sc), -qmax, qmax) * sc
@@ -257,7 +315,9 @@ class QATLinear(Layer):
             self._act_scale._data = jnp.asarray(new, jnp.float32)
         # scale == 0 -> in-kernel dynamic fallback (never-calibrated case)
         xq = fake_quant(x, self.activation_bits, scale=self._act_scale)
-        wq = fake_quant(self.inner.weight, self.weight_bits)
+        # per-OUTPUT-channel weight grid, matching quantize_weight's
+        # deployment grid (weight layout [in, out] -> channel_axis -1)
+        wq = fake_quant(self.inner.weight, self.weight_bits, channel_axis=-1)
         return F.linear(xq, wq, self.inner.bias)
 
 
@@ -279,12 +339,77 @@ class ImperativeQuantAware:
         kinds = _linear_kinds()
         return _swap_sublayers(
             model, lambda sub: isinstance(sub, kinds),
-            lambda lin: QATLinear(lin, self.weight_bits,
-                                  self.activation_bits, self.moving_rate))
+            lambda lin, name: QATLinear(lin, self.weight_bits,
+                                        self.activation_bits,
+                                        self.moving_rate))
 
     def convert(self, model, mode="weight_only_int8"):
         """QATLinear -> real int8 QuantizedLinear (weights re-quantized
-        from the trained floats)."""
+        from the trained floats; static_int8 consumes each layer's trained
+        moving-average activation scale)."""
+        def make(q, name):
+            act = float(q._act_scale._data) if mode == "static_int8" else None
+            return QuantizedLinear.from_linear(q.inner, mode, act_scale=act)
+
         return _swap_sublayers(
-            model, lambda sub: isinstance(sub, QATLinear),
-            lambda q: QuantizedLinear.from_linear(q.inner, mode))
+            model, lambda sub: isinstance(sub, QATLinear), make)
+
+
+class PostTrainingQuantization:
+    """Calibration-based PTQ (reference post_training_quantization.py): run
+    representative batches through the model, record per-layer activation
+    abs-max, then deploy int8 weights. Usage:
+
+        ptq = PostTrainingQuantization(model)
+        for batch in calib_loader: ptq.collect(batch)   # forward passes
+        qmodel = ptq.convert(mode="dynamic_int8")
+
+    Collection wraps each Linear-shaped layer with a recording hook; the
+    calibrated scales are exposed in `ptq.scales` (layer name -> f32
+    abs-max/127) for inspection, matching the reference's saved
+    out_threshold attributes. Conversion reuses quantize_model's swap."""
+
+    def __init__(self, model):
+        self.model = model
+        self.scales = {}
+        self._hooks = []
+        kinds = _linear_kinds()
+        for name, sub in model.named_sublayers(include_self=True):
+            if isinstance(sub, kinds):
+                self._hooks.append(sub.register_forward_pre_hook(
+                    self._recorder(name)))
+
+    def _recorder(self, name):
+        def hook(layer, inputs):
+            x = inputs[0]
+            cur = float(jnp.max(jnp.abs(_arr(x)))) / 127.0
+            prev = self.scales.get(name, 0.0)
+            self.scales[name] = max(prev, cur)
+            return None
+
+        return hook
+
+    def collect(self, *batch):
+        """One calibration forward pass (eval mode, no grad). Per-sublayer
+        training flags are snapshotted and restored — a blanket .train()
+        would clobber deliberately frozen (eval) submodules."""
+        from ..core.autograd import no_grad
+
+        modes = [(sub, sub.training)
+                 for _, sub in self.model.named_sublayers(include_self=True)]
+        self.model.eval()
+        try:
+            with no_grad():
+                self.model(*batch)
+        finally:
+            for sub, training in modes:
+                sub.training = training
+
+    def convert(self, mode="weight_only_int8"):
+        """Remove the recording hooks and swap to int8 layers. For
+        static_int8 the calibrated per-layer scales feed each
+        QuantizedLinear's fixed activation grid."""
+        for h in self._hooks:
+            h.remove()
+        self._hooks = []
+        return quantize_model(self.model, mode, act_scales=self.scales)

@@ -248,3 +248,53 @@ def test_qat_calibration_survives_checkpoint(tmp_path):
     net2.eval()
     # restored model quantizes with the trained scale, matching the source
     np.testing.assert_allclose(net2(x).numpy(), net(x).numpy(), rtol=1e-6)
+
+
+def test_post_training_quantization_calibration():
+    """PostTrainingQuantization: calibration hooks record per-layer
+    activation abs-max scales, convert() removes hooks and swaps layers."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate.quantization import PostTrainingQuantization
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    ptq = PostTrainingQuantization(net)
+    big = _rand((4, 8), 40, scale=3.0)
+    ptq.collect(paddle.to_tensor(_rand((4, 8), 41)))
+    ptq.collect(paddle.to_tensor(big))
+    assert len(ptq.scales) == 2
+    # the recorded scale is the max over calibration batches
+    assert ptq.scales["0"] == pytest.approx(np.abs(big).max() / 127.0)
+
+    q = ptq.convert(mode="dynamic_int8")
+    assert isinstance(q[0], QuantizedLinear)
+    out = q(paddle.to_tensor(big))
+    assert out.shape == [4, 4]
+    # hooks removed: further forwards must not grow the scale record
+    before = dict(ptq.scales)
+    q(paddle.to_tensor(_rand((4, 8), 42, scale=10.0)))
+    assert ptq.scales == before
+
+
+def test_static_int8_uses_calibrated_scales():
+    """static_int8: activations quantize with the FIXED calibrated scale
+    (no runtime abs-max); numerics stay within int8 tolerance of f32 when
+    the calibration data covers the activation range."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate.quantization import PostTrainingQuantization
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = paddle.to_tensor(_rand((8, 8), 50))
+    ref = net(x).numpy()
+    ptq = PostTrainingQuantization(net)
+    ptq.collect(x)
+    q = ptq.convert(mode="static_int8")
+    assert q[0].mode == "static_int8"
+    assert float(q[0]._act_scale.numpy()) > 0
+    out = q(x).numpy()
+    assert np.abs(out - ref).mean() / (np.abs(ref).mean() + 1e-9) < 0.06
+
+    # static mode without calibration must refuse
+    with pytest.raises(ValueError):
+        quantize_model(nn.Sequential(nn.Linear(4, 4)), mode="static_int8")
