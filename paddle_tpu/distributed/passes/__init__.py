@@ -205,3 +205,76 @@ class FuseGemmEpiloguePass(PassBase):
             i += 1
         block.ops = kept
         return fused
+
+
+@register_pass("int8_fake_quantize")
+class FakeQuantizePass(PassBase):
+    """Static-graph quantization pass (reference slim
+    quantization_pass.py QuantizationTransformPass): inserts
+    fake_quantize_dequantize ops in front of the activation/weight inputs
+    of the quantizable ops, so a Program trains/evaluates with int8 grid
+    noise. Biases stay unquantized (real int8 deployments keep them
+    f32/s32, and the reference pass does the same).
+    attrs: quantizable_op_types (default {"linear", "matmul", "mul"}),
+    bits (default 8). The inserted op is a real OpDesc — it shows in the
+    program text and lowers through the one-XLA-computation executor like
+    any other op. Idempotent: already-quantized inputs are skipped, and
+    two quantization-_type passes conflict in one PassManager.
+    """
+
+    _type = "quantization"
+    _FQ = "fake_quantize_dequantize"
+
+    def _check_conflict(self, other):
+        return getattr(other, "_type", None) != self._type
+
+    def _apply_impl(self, program, context):
+        import jax.numpy as jnp
+
+        from ...incubate.quantization import fake_quant_array
+
+        targets = set(self.attrs.get("quantizable_op_types",
+                                     ("linear", "matmul", "mul")))
+        bits = int(self.attrs.get("bits", 8))
+
+        def fq_kernel(a):
+            if not hasattr(a, "dtype") or not jnp.issubdtype(
+                    jnp.asarray(a).dtype, jnp.floating):
+                return a
+            return fake_quant_array(a, bits)
+
+        from ...static.framework import OpDesc as op_cls
+
+        block = program.global_block()
+        new_ops = []
+        n_inserted = 0
+        quantized = {}  # var name -> its fake-quant output name
+        for op in block.ops:
+            if op.type in targets and op.type != self._FQ:
+                new_inputs = []
+                for i, name in enumerate(op.input_names):
+                    # skip the bias operand of linear (x, w, bias), and
+                    # anything already on the int8 grid (idempotency)
+                    is_bias = op.type == "linear" and \
+                        len(op.input_names) == 3 and i == 2
+                    if is_bias or name.endswith("@fake_quant"):
+                        new_inputs.append(name)
+                        continue
+                    if name not in quantized:
+                        qname = f"{name}@fake_quant"
+                        block.create_var(qname)
+                        new_ops.append(op_cls(
+                            self._FQ, fq_kernel, [name], [qname],
+                            {"bits": bits}))
+                        quantized[name] = qname
+                        n_inserted += 1
+                    new_inputs.append(quantized[name])
+                # a NEW OpDesc, never in-place mutation: Program.clone()
+                # copies share op objects, and a clone must keep seeing its
+                # own (un-quantized) wiring
+                op = op_cls(op.type, op.kernel, new_inputs,
+                            op.output_names, op.attrs)
+            new_ops.append(op)
+        block.ops[:] = new_ops
+        program._version += 1
+        return {"inserted": n_inserted}

@@ -34,7 +34,7 @@ from ..nn import Layer
 
 __all__ = ["quantize_weight", "weight_only_int8_matmul",
            "dynamic_int8_matmul", "static_int8_matmul", "QuantizedLinear",
-           "quantize_model", "fake_quant", "QATLinear",
+           "quantize_model", "fake_quant", "fake_quant_array", "QATLinear",
            "ImperativeQuantAware", "PostTrainingQuantization"]
 
 
@@ -251,6 +251,22 @@ def quantize_model(layer, mode="weight_only_int8", act_scales=None):
 
 # --------------------------------------------------------------------- QAT ---
 
+def fake_quant_array(a, bits=8, scale=None, channel_axis=None):
+    """Raw-array STE quantize-dequantize (shared by the eager fake_quant op
+    below and the static-graph int8_fake_quantize pass)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    if channel_axis is None:
+        dyn = jnp.max(jnp.abs(a)) / qmax
+    else:
+        axes = tuple(i for i in range(a.ndim) if i != channel_axis % a.ndim)
+        dyn = jnp.max(jnp.abs(a), axis=axes, keepdims=True) / qmax
+    sc = jnp.where(scale > 0, scale, dyn) if scale is not None else dyn
+    sc = jnp.where(sc == 0, 1.0, sc).astype(a.dtype)
+    q = jnp.clip(jnp.round(a / sc), -qmax, qmax) * sc
+    # straight-through: forward quantized value, identity gradient
+    return a + jax.lax.stop_gradient(q - a)
+
+
 def fake_quant(x, bits=8, scale=None, channel_axis=None):
     """Quantize-dequantize with a straight-through gradient (the reference's
     fake_quantize_dequantize_abs_max op, quantization_pass.py): forward
@@ -262,20 +278,9 @@ def fake_quant(x, bits=8, scale=None, channel_axis=None):
     channel, and QAT must train against the same noise)."""
     from ..core.dispatch import apply
 
-    qmax = float(2 ** (bits - 1) - 1)
-
     def kernel(a, *s):
-        if channel_axis is None:
-            dyn = jnp.max(jnp.abs(a)) / qmax
-        else:
-            axes = tuple(i for i in range(a.ndim)
-                         if i != channel_axis % a.ndim)
-            dyn = jnp.max(jnp.abs(a), axis=axes, keepdims=True) / qmax
-        sc = jnp.where(s[0] > 0, s[0], dyn) if s else dyn
-        sc = jnp.where(sc == 0, 1.0, sc).astype(a.dtype)
-        q = jnp.clip(jnp.round(a / sc), -qmax, qmax) * sc
-        # straight-through: forward quantized value, identity gradient
-        return a + jax.lax.stop_gradient(q - a)
+        return fake_quant_array(a, bits, scale=s[0] if s else None,
+                                channel_axis=channel_axis)
 
     args = [_as_t(x)] + ([_as_t(scale)] if scale is not None else [])
     return apply("fake_quant", kernel, args)

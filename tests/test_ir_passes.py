@@ -104,3 +104,88 @@ class TestDebugInterpreter:
         with pytest.raises(FloatingPointError, match="log"):
             exe.run_debug(main, feed={"x": np.array([-1.0, 1.0], np.float32)},
                           fetch_list=[z], check_nan_inf=True)
+
+
+def test_int8_fake_quantize_pass():
+    """The static-graph quant pass (reference QuantizationTransformPass)
+    inserts fake_quantize_dequantize ops ahead of quantizable ops' inputs;
+    the rewritten program still executes and stays close to the f32 result."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu.distributed.passes import PassManager, new_pass
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main_program=main,
+                                  startup_program=startup):
+            x = static.data(name="X", shape=[4, 8], dtype="float32")
+            h = static.nn.fc(x, 16)
+            y = paddle.mean(h)
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"X": np.random.RandomState(0).randn(4, 8).astype("float32")}
+        ref = exe.run(main, feed=feed, fetch_list=[y])[0]
+
+        p = new_pass("int8_fake_quantize")
+        pm = PassManager([p])
+        pm.apply(main)
+        n = pm.context.results["int8_fake_quantize"]["inserted"]
+        assert n >= 2  # at least activation + weight of the fc matmul
+        types = [op.type for op in main.global_block().ops]
+        assert "fake_quantize_dequantize" in types
+        out = exe.run(main, feed=feed, fetch_list=[y])[0]
+        assert abs(float(out) - float(ref)) / (abs(float(ref)) + 1e-9) < 0.05
+    finally:
+        paddle.disable_static()
+
+
+def test_int8_fake_quantize_pass_idempotent_and_clone_safe():
+    """Double application must not stack fake-quant ops; a clone taken
+    BEFORE the pass keeps its own un-quantized wiring (ops are never
+    mutated in place); two quantization-type passes conflict."""
+    import numpy as np
+    import pytest as _pytest
+
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu.distributed.passes import PassManager, new_pass
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main_program=main,
+                                  startup_program=startup):
+            x = static.data(name="X", shape=[2, 4], dtype="float32")
+            y = paddle.mean(static.nn.fc(x, 8))
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"X": np.ones((2, 4), "float32")}
+        clone = main.clone(for_test=True)
+
+        p = new_pass("int8_fake_quantize")
+        p.apply(main)
+        n1 = sum(op.type == "fake_quantize_dequantize"
+                 for op in main.global_block().ops)
+        p.apply(main)  # second application: no stacking
+        n2 = sum(op.type == "fake_quantize_dequantize"
+                 for op in main.global_block().ops)
+        assert n1 == n2 and n1 >= 2
+        assert not any("@fake_quant@fake_quant" in v
+                       for op in main.global_block().ops
+                       for v in op.input_names + op.output_names)
+
+        # the pre-pass clone still executes with its original wiring
+        out = exe.run(clone, feed=feed, fetch_list=[clone.global_block()
+                                                    .vars[y.name]])
+        assert np.isfinite(float(out[0]))
+
+        with _pytest.raises(ValueError):
+            PassManager([new_pass("int8_fake_quantize"),
+                         new_pass("int8_fake_quantize")])
+    finally:
+        paddle.disable_static()
