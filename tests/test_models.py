@@ -160,8 +160,6 @@ def test_gpt_memorizes_fixed_batch():
     subtle optimizer/gradient/loss-scaling bugs that per-op numerics and
     short loss-decrease checks miss — a wrong but plausible gradient still
     reduces loss for 3 steps; it does not memorize."""
-    import paddle_tpu.distributed as dist
-    from paddle_tpu.distributed import fleet
     from paddle_tpu.models import GPTForPretraining, gpt_tiny
 
     paddle.seed(0)
