@@ -1,5 +1,6 @@
 """paddle.nn equivalent."""
 from . import functional  # noqa: F401
+from . import utils  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer import Layer, ParamAttr, Parameter  # noqa: F401
 from .layers.activation import (  # noqa: F401

@@ -1,0 +1,203 @@
+"""paddle.nn.utils — weight/spectral norm reparametrization and
+parameter<->vector transforms.
+
+Reference: python/paddle/nn/utils/{weight_norm_hook.py:155,
+spectral_norm_hook.py, transform_parameters.py:73,121}. TPU-native design
+delta: instead of a forward pre-hook that caches a recomputed weight (which
+would be a CONSTANT to any trace taken later — silently stopping gradients
+under jit/to_static), the weight becomes an instance-class PROPERTY computed
+from the g/v (or orig) Parameters at every access. Whoever reads
+`layer.weight` — the eager tape, functional_call inside pjit, or a
+to_static trace — sees an expression of the live Parameters, so gradients
+always flow and no trace-time Tensor is ever stored on the layer.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops import manipulation as P
+from .layer import Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+_EPS = 1e-12
+
+
+def _check_dim(w, dim, what):
+    ndim = len(w.shape)
+    if not (-1 <= dim < ndim):
+        raise ValueError(
+            f"{what}: dim must be -1 (whole-tensor) or in [0, {ndim}) for a "
+            f"{ndim}-D weight, got {dim}")
+
+
+def _norm_except_dim(v, dim):
+    """L2 norm over all axes except `dim` (reference norm_except_dim:45);
+    dim == -1 -> one global norm."""
+    a = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+    if dim == -1:
+        return Tensor(jnp.sqrt(jnp.sum(a * a) + _EPS))
+    axes = tuple(i for i in range(a.ndim) if i != dim)
+    return Tensor(jnp.sqrt(jnp.sum(a * a, axis=axes) + _EPS))
+
+
+def _weight_from_gv(g, v, dim):
+    """w = g * v / ||v||, broadcasting g over every axis but `dim`
+    (reference _weight_norm:64). Built from Tensor ops so autograd records
+    the reparametrization and gradients reach g AND v."""
+    ndim = len(v.shape)
+    if dim == -1:
+        norm = ((v * v).sum() + _EPS).sqrt()
+        return g * v / norm
+    axes = [i for i in range(ndim) if i != dim]
+    norm = ((v * v).sum(axis=axes, keepdim=True) + _EPS).sqrt()
+    shape = [1] * ndim
+    shape[dim] = v.shape[dim]
+    return P.reshape(g, shape) * v / norm
+
+
+def _install_property(layer, name, fget):
+    """Swap the instance onto a per-instance subclass carrying `name` as a
+    property. The previous class is recorded so removal can restore it."""
+    prev_cls = layer.__class__
+    new_cls = type(f"{prev_cls.__name__}", (prev_cls,), {name: property(fget)})
+    layer.__class__ = new_cls
+    return prev_cls
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparametrize `layer.<name>` as magnitude g and direction v
+    (arXiv:1602.07868; reference weight_norm_hook.py:155): the original
+    Parameter is replaced by `<name>_g` / `<name>_v`, and `<name>` becomes
+    a property recomputing g * v/||v|| from the live Parameters at every
+    access (gradients flow on the eager tape AND inside traces)."""
+    w = getattr(layer, name)
+    if not isinstance(w, Parameter):
+        raise ValueError(f"layer has no Parameter {name!r}")
+    if hasattr(layer, f"_{name}_weight_norm"):
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    _check_dim(w, dim, "weight_norm")
+
+    g = Parameter(_norm_except_dim(w, dim)._data)
+    v = Parameter(w._data)
+    del layer._parameters[name]
+    setattr(layer, f"{name}_g", g)
+    setattr(layer, f"{name}_v", v)
+
+    def fget(self):
+        return _weight_from_gv(getattr(self, f"{name}_g"),
+                               getattr(self, f"{name}_v"), dim)
+
+    prev_cls = _install_property(layer, name, fget)
+    layer.__dict__[f"_{name}_weight_norm"] = (prev_cls, dim)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Bake the current g/v back into a single `<name>` Parameter and drop
+    the property (reference weight_norm_hook.py:202)."""
+    key = f"_{name}_weight_norm"
+    if key not in layer.__dict__:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    prev_cls, dim = layer.__dict__.pop(key)
+    w = _weight_from_gv(getattr(layer, f"{name}_g"),
+                        getattr(layer, f"{name}_v"), dim)
+    del layer._parameters[f"{name}_g"]
+    del layer._parameters[f"{name}_v"]
+    layer.__class__ = prev_cls
+    setattr(layer, name, Parameter(w._data))
+    return layer
+
+
+def _default_sn_dim(layer):
+    """Reference spectral_norm_hook default: dim=None auto-selects 1 for
+    layers whose weight stores the output on axis 1 (Linear [in, out] and
+    transposed convs), else 0."""
+    cls = type(layer).__name__
+    return 1 if ("Linear" in cls or "Transpose" in cls) else 0
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide `layer.<name>` by its largest singular value sigma, with u
+    estimated by power iteration (reference spectral_norm_hook.py). The
+    original Parameter moves to `<name>_orig`; `<name>` becomes a property
+    computing W / sigma where sigma = u^T W v is a live expression of W
+    (u, v detached, the standard SN-GAN treatment) — so gradients flow in
+    eager and traced contexts alike. The u buffer advances one power
+    iteration per EAGER access; inside a trace it stays frozen."""
+    if n_power_iterations < 1:
+        raise ValueError("n_power_iterations must be >= 1")
+    w = getattr(layer, name)
+    if not isinstance(w, Parameter):
+        raise ValueError(f"layer has no Parameter {name!r}")
+    if dim is None:
+        dim = _default_sn_dim(layer)
+    _check_dim(w, dim, "spectral_norm")
+    wa = w._data
+    ndim = wa.ndim
+    h = wa.shape[dim]
+
+    import jax
+
+    from ..core import random as random_mod
+
+    u0 = jax.random.normal(random_mod.next_key(), (h,), jnp.float32)
+    layer.register_buffer(f"{name}_u", Tensor(u0 / (jnp.linalg.norm(u0)
+                                                    + eps)))
+
+    orig_name = f"{name}_orig"
+    del layer._parameters[name]
+    setattr(layer, orig_name, w)
+    perm = [dim] + [i for i in range(ndim) if i != dim]
+
+    def fget(self):
+        import jax
+
+        from ..jit import in_jit_trace
+
+        w_t = getattr(self, orig_name)
+        m_t = P.reshape(P.transpose(w_t, perm), (h, -1))
+        u = getattr(self, f"{name}_u")._data
+        m = jax.lax.stop_gradient(m_t._data)
+        for _ in range(n_power_iterations):
+            vvec = m.T @ u
+            vvec = vvec / (jnp.linalg.norm(vvec) + eps)
+            u = m @ vvec
+            u = u / (jnp.linalg.norm(u) + eps)
+        if not in_jit_trace():
+            getattr(self, f"{name}_u")._data = u  # persist eager PI progress
+        # sigma = u^T W v via Tensor ops: a live function of W
+        sigma = (Tensor(u) * (m_t @ Tensor(vvec))).sum()
+        return w_t / sigma
+
+    _install_property(layer, name, fget)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten + concat parameters into ONE 1-D Tensor (reference
+    transform_parameters.py:73)."""
+    parts = [P.reshape(p, (-1,)) for p in parameters]
+    return P.concat(parts, axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Slice a flat vector back into the parameters, in place (reference
+    transform_parameters.py:121). Accepts any iterable."""
+    parameters = list(parameters)
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    total = sum(int(np.prod(p.shape)) if p.shape else 1 for p in parameters)
+    if total != data.shape[0]:
+        raise ValueError(
+            f"vector length {data.shape[0]} does not match total parameter "
+            f"size {total}")
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p.set_value(data[off:off + n].reshape(p.shape))
+        off += n
+    return parameters
