@@ -469,3 +469,27 @@ def test_decode_loop_cache_in_place_no_weight_casts():
     assert not wcasts, (
         f"weight-sized f32->bf16 converts INSIDE the decode loop — amp cast "
         f"hoisting regressed: {wcasts[:2]}")
+
+
+def test_flash_attention_memory_scales_linearly_with_seq():
+    """Long-context gate: flash attention's compiled fwd+bwd temp memory
+    must scale ~O(seq), not O(seq^2) — the property that makes seq 16k+
+    single-chip configs (PADDLE_TPU_BENCH_SEQ) feasible at all. Measured
+    ratio for 4x seq is ~3.9; a dense [.., s, s] materialization would be
+    16x. Gate at 6x for headroom."""
+    paddle.set_flags({"use_flash_attention": True, "pallas_interpret_ok": True})
+    from paddle_tpu.ops import nn_functional as F
+
+    def temp_bytes(seq):
+        def att(qd):
+            t = Tensor(qd)
+            return F.scaled_dot_product_attention(t, t, t, is_causal=True)._data
+
+        q = jnp.zeros((1, seq, 4, 64), jnp.float32)
+        g = jax.jit(lambda x: jax.grad(lambda y: att(y).sum())(x))
+        return g.lower(q).compile().memory_analysis().temp_size_in_bytes
+
+    b1, b4 = temp_bytes(1024), temp_bytes(4096)
+    assert b4 < 6 * b1, (
+        f"flash temp memory grew {b4 / max(b1, 1):.1f}x for 4x seq — "
+        f"attention is materializing O(s^2) state again")
