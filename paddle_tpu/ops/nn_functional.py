@@ -619,6 +619,33 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
         label = label_smooth(label, epsilon=label_smoothing)
         soft_label = True
 
+    if weight is not None and soft_label:
+        # per-class weighting inside the soft sum (reference cross_entropy
+        # weighted soft-label semantics):
+        #   loss_i = -sum_c w_c * label_{i,c} * log p_{i,c}
+        # mean reduction divides by the summed effective weights
+        # sum_i sum_c w_c * label_{i,c}. One kernel, BEFORE the unweighted
+        # computation (no discarded forward); input AND label stay
+        # differentiable, matching the unweighted soft-label convention.
+        def wsoft_kernel(x, lb, w):
+            logp = (jax.nn.log_softmax(x, axis=axis) if use_softmax
+                    else jnp.log(jnp.clip(x, 1e-10, 1.0)))
+            shape = [1] * logp.ndim
+            shape[axis] = logp.shape[axis]
+            wb = w.reshape(shape)
+            loss = -jnp.sum(wb * lb * logp, axis=axis, keepdims=True)
+            wsamp = jnp.sum(wb * lb, axis=axis, keepdims=True)
+            return loss, wsamp
+
+        loss, wsamp = apply("weighted_soft_cross_entropy", wsoft_kernel,
+                            [input, label, t_(weight)],
+                            nondiff_mask=[False, False, True])
+        if reduction == "mean":
+            from . import reduction as R
+
+            return R.sum(loss) / R.sum(wsamp)
+        return _reduce_loss(loss, reduction)
+
     if not use_softmax:
         def kernel(p, lb, *w):
             logp = jnp.log(jnp.clip(p, 1e-10, 1.0))
@@ -639,8 +666,6 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
 
     if weight is not None:
         weight = t_(weight)
-        if soft_label:
-            raise NotImplementedError("class weight with soft labels deferred")
         lbl = label._data if label.ndim < input.ndim else jnp.squeeze(label._data, axis)
         w = Tensor(jnp.take(weight._data, jnp.where(lbl == ignore_index, 0, lbl))[..., None])
         loss = loss * w

@@ -304,3 +304,59 @@ class TestMiscLayers:
 
         with _pytest.raises(ValueError):
             vision.set_image_backend("nope")
+
+
+def test_cross_entropy_weighted_soft_labels():
+    """Class weights + soft labels (previously an explicit deferral):
+    loss_i = -sum_c w_c * label_c * log p_c; mean divides by the summed
+    effective weights. Checked against a numpy reference, grads flow."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    logits = rng.randn(5, 4).astype("float32")
+    soft = rng.rand(5, 4).astype("float32")
+    soft /= soft.sum(1, keepdims=True)
+    w = np.array([0.5, 1.0, 2.0, 1.5], "float32")
+
+    lp = logits - logits.max(1, keepdims=True)
+    lp = lp - np.log(np.exp(lp).sum(1, keepdims=True))
+    per = -(w[None, :] * soft * lp).sum(1)
+    ref_mean = per.sum() / (w[None, :] * soft).sum()
+
+    out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft),
+                          weight=paddle.to_tensor(w), soft_label=True)
+    assert float(out.item()) == pytest.approx(float(ref_mean), rel=1e-5)
+    out_none = F.cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(soft),
+        weight=paddle.to_tensor(w), soft_label=True, reduction="none")
+    np.testing.assert_allclose(np.asarray(out_none.numpy()).squeeze(), per,
+                               rtol=1e-5)
+
+    x = paddle.to_tensor(logits)
+    x.stop_gradient = False
+    F.cross_entropy(x, paddle.to_tensor(soft), weight=paddle.to_tensor(w),
+                    soft_label=True).backward()
+    assert float(x.grad.abs().sum().item()) > 0
+
+
+def test_cross_entropy_weighted_soft_labels_grad_paths():
+    """Weighted soft labels keep BOTH input and label differentiable (the
+    unweighted soft-label convention), including use_softmax=False."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(1)
+    probs = rng.rand(3, 4).astype("float32")
+    probs /= probs.sum(1, keepdims=True)
+    soft = rng.rand(3, 4).astype("float32")
+    soft /= soft.sum(1, keepdims=True)
+    w = np.array([1.0, 2.0, 0.5, 1.5], "float32")
+
+    x = paddle.to_tensor(probs)
+    x.stop_gradient = False
+    lb = paddle.to_tensor(soft)
+    lb.stop_gradient = False
+    out = F.cross_entropy(x, lb, weight=paddle.to_tensor(w), soft_label=True,
+                          use_softmax=False)
+    out.backward()
+    assert float(x.grad.abs().sum().item()) > 0   # probability-input grads
+    assert float(lb.grad.abs().sum().item()) > 0  # label grads
