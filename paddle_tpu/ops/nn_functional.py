@@ -613,38 +613,19 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
     input, label = t_(input), t_(label)
+    smoothed_ignore_mask = None
     if label_smoothing > 0.0 and not soft_label:
         num_classes = input.shape[axis]
+        if weight is not None:
+            # remember which rows were padding BEFORE smoothing turns their
+            # all-zero one-hot into a uniform eps/K distribution — the
+            # weighted-soft scale below must zero them like the hard-label
+            # weighted path does
+            smoothed_ignore_mask = Tensor(
+                (label._data == ignore_index).astype(jnp.float32))
         label = one_hot(label, num_classes)
         label = label_smooth(label, epsilon=label_smoothing)
         soft_label = True
-
-    if weight is not None and soft_label:
-        # per-class weighting inside the soft sum (reference cross_entropy
-        # weighted soft-label semantics):
-        #   loss_i = -sum_c w_c * label_{i,c} * log p_{i,c}
-        # mean reduction divides by the summed effective weights
-        # sum_i sum_c w_c * label_{i,c}. One kernel, BEFORE the unweighted
-        # computation (no discarded forward); input AND label stay
-        # differentiable, matching the unweighted soft-label convention.
-        def wsoft_kernel(x, lb, w):
-            logp = (jax.nn.log_softmax(x, axis=axis) if use_softmax
-                    else jnp.log(jnp.clip(x, 1e-10, 1.0)))
-            shape = [1] * logp.ndim
-            shape[axis] = logp.shape[axis]
-            wb = w.reshape(shape)
-            loss = -jnp.sum(wb * lb * logp, axis=axis, keepdims=True)
-            wsamp = jnp.sum(wb * lb, axis=axis, keepdims=True)
-            return loss, wsamp
-
-        loss, wsamp = apply("weighted_soft_cross_entropy", wsoft_kernel,
-                            [input, label, t_(weight)],
-                            nondiff_mask=[False, False, True])
-        if reduction == "mean":
-            from . import reduction as R
-
-            return R.sum(loss) / R.sum(wsamp)
-        return _reduce_loss(loss, reduction)
 
     if not use_softmax:
         def kernel(p, lb, *w):
@@ -663,6 +644,28 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     else:
         loss = softmax_with_cross_entropy(input, label, soft_label=soft_label,
                                           ignore_index=ignore_index, axis=axis)
+
+    if weight is not None and soft_label:
+        # reference semantics (nn/functional/loss.py:1769): the UNWEIGHTED
+        # per-sample soft loss scales by weight_gather = sum_c w_c*label_c,
+        # and mean reduction divides by sum(weight_gather). Built from
+        # Tensor ops so input AND label gradients keep flowing through the
+        # already-computed loss (which used the f32-upcast kernels).
+        from . import manipulation as _P
+
+        weight = t_(weight)
+        shape = [1] * len(label.shape)
+        shape[axis % len(label.shape)] = label.shape[axis % len(label.shape)]
+        wg = (label * _P.reshape(weight, shape)).sum(axis=axis, keepdim=True)
+        if smoothed_ignore_mask is not None:
+            keep = 1.0 - smoothed_ignore_mask
+            wg = wg * _P.reshape(keep, wg.shape)
+        loss = loss * wg
+        if reduction == "mean":
+            from . import reduction as R
+
+            return R.sum(loss) / R.sum(wg)
+        return _reduce_loss(loss, reduction)
 
     if weight is not None:
         weight = t_(weight)

@@ -1,0 +1,65 @@
+"""paddle.fft vs numpy.fft: values, norm conventions, and inverse
+round-trips (reference python/paddle/fft.py wraps the same FFT semantics;
+numpy is the independent ground truth)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft
+
+RTOL, ATOL = 2e-5, 2e-5
+
+
+def _x(shape, complex_=False, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(*shape).astype("float32")
+    if complex_:
+        return (a + 1j * rng.randn(*shape).astype("float32")).astype(
+            "complex64")
+    return a
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_fft_ifft_norms(norm):
+    x = _x((4, 16), complex_=True)
+    ours = fft.fft(paddle.to_tensor(x), norm=norm).numpy()
+    ref = np.fft.fft(x, norm=norm)
+    np.testing.assert_allclose(ours, ref, rtol=RTOL, atol=ATOL)
+    back = fft.ifft(paddle.to_tensor(ours), norm=norm).numpy()
+    np.testing.assert_allclose(back, x, rtol=RTOL, atol=ATOL)
+
+
+def test_rfft_irfft_roundtrip():
+    x = _x((3, 32))
+    ours = fft.rfft(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(ours, np.fft.rfft(x), rtol=RTOL, atol=ATOL)
+    back = fft.irfft(paddle.to_tensor(ours), n=32).numpy()
+    np.testing.assert_allclose(back, x, rtol=RTOL, atol=ATOL)
+
+
+def test_fft2_and_fftn():
+    x = _x((2, 8, 8), complex_=True)
+    np.testing.assert_allclose(fft.fft2(paddle.to_tensor(x)).numpy(),
+                               np.fft.fft2(x), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(fft.fftn(paddle.to_tensor(x)).numpy(),
+                               np.fft.fftn(x), rtol=RTOL, atol=ATOL)
+
+
+def test_fftshift_fftfreq():
+    np.testing.assert_allclose(fft.fftfreq(10, d=0.5).numpy(),
+                               np.fft.fftfreq(10, d=0.5), rtol=RTOL)
+    x = _x((9,))
+    np.testing.assert_allclose(fft.fftshift(paddle.to_tensor(x)).numpy(),
+                               np.fft.fftshift(x), rtol=RTOL)
+    np.testing.assert_allclose(fft.ifftshift(paddle.to_tensor(x)).numpy(),
+                               np.fft.ifftshift(x), rtol=RTOL)
+
+
+def test_stft_istft_roundtrip():
+    from paddle_tpu import signal
+
+    x = _x((2, 512), seed=3)
+    n_fft = 64
+    spec = signal.stft(paddle.to_tensor(x), n_fft=n_fft, hop_length=16)
+    back = signal.istft(spec, n_fft=n_fft, hop_length=16, length=512).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)

@@ -307,9 +307,10 @@ class TestMiscLayers:
 
 
 def test_cross_entropy_weighted_soft_labels():
-    """Class weights + soft labels (previously an explicit deferral):
-    loss_i = -sum_c w_c * label_c * log p_c; mean divides by the summed
-    effective weights. Checked against a numpy reference, grads flow."""
+    """Class weights + soft labels (previously an explicit deferral),
+    REFERENCE semantics (loss.py:1769): the unweighted per-sample soft loss
+    scales by weight_gather = sum_c w_c*label_c; mean divides by
+    sum(weight_gather). Checked against a numpy reference, grads flow."""
     import paddle_tpu.nn.functional as F
 
     rng = np.random.RandomState(0)
@@ -320,8 +321,10 @@ def test_cross_entropy_weighted_soft_labels():
 
     lp = logits - logits.max(1, keepdims=True)
     lp = lp - np.log(np.exp(lp).sum(1, keepdims=True))
-    per = -(w[None, :] * soft * lp).sum(1)
-    ref_mean = per.sum() / (w[None, :] * soft).sum()
+    unweighted = -(soft * lp).sum(1)
+    wg = (w[None, :] * soft).sum(1)
+    per = wg * unweighted
+    ref_mean = per.sum() / wg.sum()
 
     out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft),
                           weight=paddle.to_tensor(w), soft_label=True)
@@ -360,3 +363,25 @@ def test_cross_entropy_weighted_soft_labels_grad_paths():
     out.backward()
     assert float(x.grad.abs().sum().item()) > 0   # probability-input grads
     assert float(lb.grad.abs().sum().item()) > 0  # label grads
+
+
+def test_cross_entropy_weight_smoothing_ignores_padding():
+    """label_smoothing flips hard labels to soft; with a class weight the
+    padding rows (ignore_index) must contribute zero loss AND zero weight
+    mass — not an eps/K-uniform contribution."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(2)
+    logits = rng.randn(6, 4).astype("float32")
+    labels = np.array([0, 1, -100, 2, -100, 3], "int64")
+    w = np.array([1.0, 2.0, 0.5, 1.5], "float32")
+
+    full = F.cross_entropy(paddle.to_tensor(logits),
+                           paddle.to_tensor(labels),
+                           weight=paddle.to_tensor(w), label_smoothing=0.1)
+    # the same batch with padding rows REMOVED must give the same mean
+    keep = labels != -100
+    sub = F.cross_entropy(paddle.to_tensor(logits[keep]),
+                          paddle.to_tensor(labels[keep]),
+                          weight=paddle.to_tensor(w), label_smoothing=0.1)
+    assert float(full.item()) == pytest.approx(float(sub.item()), rel=1e-5)
