@@ -616,15 +616,25 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     smoothed_ignore_mask = None
     if label_smoothing > 0.0 and not soft_label:
         num_classes = input.shape[axis]
-        if weight is not None:
-            # remember which rows were padding BEFORE smoothing turns their
-            # all-zero one-hot into a uniform eps/K distribution — the
-            # weighted-soft scale below must zero them like the hard-label
-            # weighted path does
-            smoothed_ignore_mask = Tensor(
-                (label._data == ignore_index).astype(jnp.float32))
+        # normalize paddle's hard-label conventions BEFORE one_hot: a
+        # trailing singleton class slot ((N, 1) labels, or (..., 1) at
+        # `axis`) must squeeze away, or one_hot would broadcast a bogus
+        # cross-pairing through the soft kernel
+        if len(label.shape) == len(input.shape) and \
+                label.shape[axis % len(input.shape)] == 1:
+            label = Tensor(jnp.squeeze(label._data, axis % len(input.shape)))
+        # remember which rows were padding BEFORE smoothing turns their
+        # all-zero one-hot into a uniform eps/K distribution — ALL reductions
+        # below must keep excluding them, weighted or not
+        smoothed_ignore_mask = Tensor(
+            (label._data == ignore_index).astype(jnp.float32))
         label = one_hot(label, num_classes)
         label = label_smooth(label, epsilon=label_smoothing)
+        if axis % len(input.shape) != len(input.shape) - 1:
+            # one_hot/label_smooth work with classes on the LAST axis; the
+            # soft kernels reduce over `axis` — line the two up
+            label = Tensor(jnp.moveaxis(label._data, -1,
+                                        axis % len(input.shape)))
         soft_label = True
 
     if not use_softmax:
@@ -664,7 +674,26 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
         if reduction == "mean":
             from . import reduction as R
 
-            return R.sum(loss) / R.sum(wg)
+            denom = R.sum(wg)
+            # reference guard (loss.py:1839): a fully-padded batch gives
+            # weight mass 0 — return 0, never 0/0 = NaN
+            denom = denom + (denom == 0).astype(denom.dtype)
+            return R.sum(loss) / denom
+        return _reduce_loss(loss, reduction)
+
+    if smoothed_ignore_mask is not None:
+        # unweighted label_smoothing over hard labels: padding rows must
+        # keep contributing ZERO loss and not enter the mean denominator
+        # (exactly like the un-smoothed hard-label path below)
+        from . import manipulation as _P
+        from . import reduction as R
+
+        keep = 1.0 - smoothed_ignore_mask
+        loss = loss * _P.reshape(keep, loss.shape)
+        if reduction == "mean":
+            denom = R.sum(keep)
+            denom = denom + (denom == 0).astype(denom.dtype)
+            return R.sum(loss) / denom
         return _reduce_loss(loss, reduction)
 
     if weight is not None:

@@ -406,3 +406,42 @@ def test_optimizer_trajectory_parity(opt_name):
     np.testing.assert_allclose(
         pm.gpt.wte.weight.numpy(), tm.wte.weight.detach().numpy(),
         rtol=2e-4, atol=2e-5)
+
+
+def test_conv_variants_parity():
+    """Conv1D, Conv3D, and Conv2DTranspose (incl. output_padding and
+    stride) vs torch: layouts and transposed-conv conventions pinned."""
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    x1 = np.random.RandomState(0).randn(2, 3, 20).astype("float32")
+    c1 = nn.Conv1D(3, 5, 4, stride=2, padding=1)
+    t1 = torch.nn.Conv1d(3, 5, 4, stride=2, padding=1)
+    with torch.no_grad():
+        t1.weight.copy_(torch.from_numpy(np.array(c1.weight.numpy())))
+        t1.bias.copy_(torch.from_numpy(np.array(c1.bias.numpy())))
+    np.testing.assert_allclose(c1(paddle.to_tensor(x1)).numpy(),
+                               t1(torch.from_numpy(x1)).detach().numpy(),
+                               rtol=2e-4, atol=2e-5)
+
+    x3 = np.random.RandomState(1).randn(1, 2, 6, 6, 6).astype("float32")
+    c3 = nn.Conv3D(2, 4, 3, padding=1)
+    t3 = torch.nn.Conv3d(2, 4, 3, padding=1)
+    with torch.no_grad():
+        t3.weight.copy_(torch.from_numpy(np.array(c3.weight.numpy())))
+        t3.bias.copy_(torch.from_numpy(np.array(c3.bias.numpy())))
+    np.testing.assert_allclose(c3(paddle.to_tensor(x3)).numpy(),
+                               t3(torch.from_numpy(x3)).detach().numpy(),
+                               rtol=2e-4, atol=2e-5)
+
+    xt = np.random.RandomState(2).randn(2, 4, 5, 5).astype("float32")
+    ct = nn.Conv2DTranspose(4, 3, 3, stride=2, padding=1, output_padding=1)
+    tt = torch.nn.ConvTranspose2d(4, 3, 3, stride=2, padding=1,
+                                  output_padding=1)
+    with torch.no_grad():
+        tt.weight.copy_(torch.from_numpy(np.array(ct.weight.numpy())))
+        tt.bias.copy_(torch.from_numpy(np.array(ct.bias.numpy())))
+    ours = ct(paddle.to_tensor(xt)).numpy()
+    ref = tt(torch.from_numpy(xt)).detach().numpy()
+    assert ours.shape == ref.shape == (2, 3, 10, 10)
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-5)

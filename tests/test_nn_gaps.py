@@ -385,3 +385,32 @@ def test_cross_entropy_weight_smoothing_ignores_padding():
                           paddle.to_tensor(labels[keep]),
                           weight=paddle.to_tensor(w), label_smoothing=0.1)
     assert float(full.item()) == pytest.approx(float(sub.item()), rel=1e-5)
+
+
+def test_cross_entropy_smoothing_padding_unweighted_and_edge_shapes():
+    """label_smoothing must exclude padding rows from the mean with or
+    without a class weight; (N, 1) hard labels squeeze before one_hot; a
+    fully-padded batch returns 0, never 0/0 NaN."""
+    import paddle_tpu.nn.functional as F
+
+    logits = np.random.RandomState(0).randn(5, 4).astype("float32")
+    labels = np.array([0, -100, 2, -100, 3], "int64")
+    full = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                           label_smoothing=0.1)
+    keep = labels != -100
+    sub = F.cross_entropy(paddle.to_tensor(logits[keep]),
+                          paddle.to_tensor(labels[keep]),
+                          label_smoothing=0.1)
+    assert float(full.item()) == pytest.approx(float(sub.item()), rel=1e-6)
+
+    n1 = F.cross_entropy(paddle.to_tensor(logits),
+                         paddle.to_tensor(labels.reshape(-1, 1)),
+                         label_smoothing=0.1)
+    assert float(n1.item()) == pytest.approx(float(full.item()), rel=1e-6)
+
+    allpad = F.cross_entropy(
+        paddle.to_tensor(logits),
+        paddle.to_tensor(np.full(5, -100, "int64")),
+        weight=paddle.to_tensor(np.ones(4, "float32")), label_smoothing=0.1)
+    assert np.isfinite(float(allpad.item()))
+    assert float(allpad.item()) == 0.0
