@@ -459,6 +459,15 @@ class GPTForPretraining(nn.Layer):
         _mm_dtype = _autocast_dtype_for("attention", ())
         cache_dtype = (_mm_dtype if _mm_dtype is not None
                        else self.gpt.wte.weight._data.dtype)
+        # Matmul-family weights are pre-cast to the autocast compute dtype
+        # ONCE, outside the decode loop (weights-in-compute-dtype, the
+        # standard inference layout). Relying on per-dispatch casts instead
+        # leaves f32 masters in the loop: whether XLA hoists the casts is
+        # backend-dependent, and un-hoisted they re-read ~2x the weight
+        # bytes every token (the decode loop is weight-bandwidth-bound).
+        # 1-D params (biases, norm scales) stay f32: the black-listed norm
+        # ops want f32, and per-step casts of [h]-sized biases are noise.
+        _w_dtype = _autocast_dtype_for("matmul", ())
         was_training = self.training
         self.eval()
 
@@ -488,6 +497,12 @@ class GPTForPretraining(nn.Layer):
                 return self._head_logits(Tensor(h_arr))._data
 
         def run(params, ids, key):
+            if _w_dtype is not None:
+                params = {k: (v.astype(_w_dtype)
+                              if v.ndim >= 2 and jnp.issubdtype(
+                                  v.dtype, jnp.floating)
+                              else v)
+                          for k, v in params.items()}
             # derive the submodule view from the TRACED params argument — a
             # closure over the concrete arrays would bake every weight into
             # the executable as a constant

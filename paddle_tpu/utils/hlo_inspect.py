@@ -44,6 +44,57 @@ def count_dynamic_update_slices(lines: List[str]) -> int:
     return sum("dynamic-update-slice" in ln for ln in lines)
 
 
+def jaxpr_loop_report(closed_jaxpr, min_elems: int):
+    """Backend-independent loop audit: find scan/while eqns (recursively) and
+    report (big_loop_inputs, weight_sized_converts_in_bodies).
+
+    big_loop_inputs: list of "dtype[shape]" strings for loop invars whose
+    element count >= min_elems. converts: count of convert_element_type eqns
+    inside loop bodies whose INPUT is that large. Compiled-HLO carry checks
+    are backend-contaminated (XLA CPU upcasts bf16 dots to f32 and LICM
+    hoists the upcasts into the carry); the jaxpr is the traced truth."""
+    import numpy as _np
+
+    big_inputs: List[str] = []
+    n_converts = 0
+
+    def _sub_jaxprs(eqn):
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr"):
+                yield v.jaxpr
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if hasattr(x, "jaxpr"):
+                        yield x.jaxpr
+
+    def _count_converts(jxp):
+        nonlocal n_converts
+        for eqn in jxp.eqns:
+            if eqn.primitive.name == "convert_element_type":
+                a = eqn.invars[0].aval
+                if a.shape and int(_np.prod(a.shape)) >= min_elems:
+                    n_converts += 1
+            for sub in _sub_jaxprs(eqn):
+                _count_converts(sub)
+
+    def _walk(jxp):
+        for eqn in jxp.eqns:
+            if eqn.primitive.name in ("scan", "while"):
+                for v in eqn.invars:
+                    a = getattr(v, "aval", None)
+                    if (a is not None and a.shape
+                            and int(_np.prod(a.shape)) >= min_elems):
+                        big_inputs.append(f"{a.dtype}{list(a.shape)}")
+                for sub in _sub_jaxprs(eqn):
+                    _count_converts(sub)
+            else:
+                for sub in _sub_jaxprs(eqn):
+                    _walk(sub)
+
+    _walk(closed_jaxpr.jaxpr)
+    return big_inputs, n_converts
+
+
 def bf16_converts_of_min_size(lines: List[str], min_elems: int,
                               exclude_shape_csv: Optional[str] = None
                               ) -> List[str]:

@@ -471,6 +471,44 @@ def test_decode_loop_cache_in_place_no_weight_casts():
         f"hoisting regressed: {wcasts[:2]}")
 
 
+def test_decode_loop_weights_precast_to_bf16():
+    """Backend-independent decode-loop gate at the JAXPR level: under bf16
+    amp, every weight-sized input to the decode scan must already be bf16
+    (generate() pre-casts matmul weights ONCE outside the loop —
+    weights-in-compute-dtype), and the scan body must contain ZERO
+    weight-sized convert_element_type ops. Compiled-HLO carry checks can't
+    pin this: XLA CPU upcasts bf16 dots to f32 and hoists the upcasts into
+    the while carry, which on TPU would instead read f32 masters every token
+    (~2x the weight traffic of the HBM-bound loop)."""
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+    from paddle_tpu.utils import hlo_inspect as hi
+
+    cfg = gpt_tiny()
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 16)).astype(np.int64)
+    with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=48,
+                       temperature=0)
+        jf = next(iter(model._generate_jit_cache.values()))
+        params = {k: v._data for k, v in model.state_dict(
+            include_non_persistable_buffer=True).items()}
+        jaxpr = jax.make_jaxpr(jf)(params, ids, jax.random.key(0))
+
+    wmin = cfg.hidden_size * cfg.hidden_size
+    big_inputs, n_converts = hi.jaxpr_loop_report(jaxpr, wmin)
+    assert big_inputs, "decode scan not found in jaxpr"
+    non_bf16 = [s for s in big_inputs if not s.startswith("bfloat16")]
+    assert not non_bf16, (
+        f"weight/cache-sized decode-loop inputs not pre-cast to bf16: "
+        f"{non_bf16[:4]}")
+    assert n_converts == 0, (
+        f"{n_converts} weight-sized converts inside the decode scan body — "
+        f"per-token weight casts regressed")
+
+
 def test_flash_attention_memory_scales_linearly_with_seq():
     """Long-context gate: flash attention's compiled fwd+bwd temp memory
     must scale ~O(seq), not O(seq^2) — the property that makes seq 16k+

@@ -28,7 +28,15 @@ def main():
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--prompt", type=int, default=128)
     ap.add_argument("--ks", default="1,8,64,128")
+    ap.add_argument("--device", default="auto", choices=("auto", "cpu"),
+                    help="cpu forces the host platform BEFORE jax backend "
+                         "init (a wedged tunnel hangs default_backend())")
     args = ap.parse_args()
+
+    if args.device == "cpu":
+        from paddle_tpu.device.probe import force_cpu_platform
+
+        force_cpu_platform()
 
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTConfig, GPTForPretraining
@@ -52,8 +60,9 @@ def main():
         for k in ks:
             if prompt + k > cfg.max_seq_len:
                 continue
-            model.generate(ids, max_new_tokens=k, temperature=0)  # compile
-            t0 = time.perf_counter()
+            warm = model.generate(ids, max_new_tokens=k, temperature=0)
+            int(warm.numpy()[0, -1])  # sync: jit dispatch is async — without
+            t0 = time.perf_counter()  # this the warmup exec lands in the fit
             out = model.generate(ids, max_new_tokens=k, temperature=0)
             int(out.numpy()[0, -1])                               # D2H sync
             dt = time.perf_counter() - t0
