@@ -178,6 +178,12 @@ class TrainStepEngine:
 
         from .meta_parallel.sequence_parallel import sequence_parallel_scope
 
+        # grads are pinned to the opt-state specs when ZeRO is active (plain
+        # partition specs — the offload memory kind must NOT ride along:
+        # grads live in HBM, only the persistent state is host-resident)
+        zero_specs = (self.opt_specs
+                      if self.hcg.degrees["sharding"] > 1 else None)
+        param_specs_c = self.param_specs
         sp_deg = self.hcg.degrees["sp"]
         # default matches DistributedStrategy.sep_impl: Ulysses wins on the
         # XLA cost model at moderate seq (BASELINE.md); ring for seq >> 100k
@@ -221,6 +227,28 @@ class TrainStepEngine:
                 return loss._data if isinstance(loss, Tensor) else loss
 
             loss, grads = jax.value_and_grad(compute_loss)(params)
+            if zero_specs is not None:
+                # ZeRO stage-1/2 boundary (reference group_sharded_optimizer_
+                # stage2.py:48 semantics), in TWO chained constraints:
+                # 1. grad at the PARAM spec — stops the optimizer-state
+                #    sharding from propagating backward INTO the grad
+                #    computation. Un-pinned, GSPMD pushes e.g. the embedding
+                #    m/v spec ("mp","sharding") onto the wte grad
+                #    scatter-add, which then demands its [b,s,h] update
+                #    operand hidden-sharded — a batch->hidden reshard the
+                #    partitioner can only do by full rematerialization
+                #    (VERDICT r3 #4). At the param spec the scatter keeps
+                #    batch-sharded updates and emits partial grads + psum.
+                # 2. grad at the OPT spec — the explicit ZeRO transition,
+                #    a composable subdivide that lowers to
+                #    reduce-scatter/dynamic-slice, after which the update
+                #    runs on the shard and only new params all-gather.
+                grads = {n: jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, param_specs_c[n]))
+                    for n, g in grads.items()}
+                grads = {n: jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, zero_specs[n]))
+                    for n, g in grads.items()}
             grads = opt_funct.clip_grads(grads, clip)
             new_params, new_opt = update(params, grads, opt_state, lr, step_i)
             return loss, new_params, new_opt

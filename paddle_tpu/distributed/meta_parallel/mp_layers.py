@@ -72,8 +72,14 @@ class ColumnParallelLinear(nn.Layer):
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
         if not self.gather_output:
-            # keep the hidden dim sharded: the paired RowParallelLinear consumes it
-            out = _constraint(out, P(*([None] * (len(out.shape) - 1) + ["mp"])))
+            # keep the hidden dim sharded: the paired RowParallelLinear
+            # consumes it. Leading (batch/seq) dims stay UNCONSTRAINED — a
+            # None would pin them REPLICATED, fighting the engine's
+            # dp x sharding batch sharding; GSPMD then resolves the forward/
+            # backward conflict with an involuntary full rematerialization
+            # of the activation (VERDICT r3 #4).
+            out = _constraint(out, P(*([P.UNCONSTRAINED]
+                                       * (len(out.shape) - 1) + ["mp"])))
         return out
 
 
@@ -96,7 +102,12 @@ class RowParallelLinear(nn.Layer):
 
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
-        return _constraint(out, P())
+        # only the feature dim is pinned dense (GSPMD inserts the psum over
+        # 'mp' from the contracted-dim sharding); batch/seq dims stay
+        # UNCONSTRAINED so dp/sharding/sp batch specs propagate through the
+        # residual stream instead of being forced replicated here
+        return _constraint(out, P(*([P.UNCONSTRAINED]
+                                    * (len(out.shape) - 1) + [None])))
 
 
 class ParallelCrossEntropy(nn.Layer):

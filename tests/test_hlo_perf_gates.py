@@ -16,6 +16,7 @@ Each test pins a compiler-level property that the on-chip numbers depend on:
 
 Thresholds are pinned from measured values; regressions fail loudly.
 """
+import os
 import re
 import sys
 
@@ -469,6 +470,52 @@ def test_decode_loop_cache_in_place_no_weight_casts():
     assert not wcasts, (
         f"weight-sized f32->bf16 converts INSIDE the decode loop — amp cast "
         f"hoisting regressed: {wcasts[:2]}")
+
+
+def test_zero_step_compiles_without_involuntary_rematerialization(capfd):
+    """VERDICT r3 #4: the dp x mp x sharding (ZeRO) step must compile WITHOUT
+    XLA's '[SPMD] Involuntary full rematerialization' warning. The round-3
+    artifact carried two: the embedding optimizer-state spec ("mp","sharding")
+    propagated backward onto the wte-grad scatter-add, demanding the [b,s,h]
+    residual grad hidden-sharded — a batch->hidden reshard GSPMD can only do
+    by replicate-and-repartition. The engine now pins grads to the param spec
+    then the opt spec (distributed/engine.py); this gate captures the C++
+    stderr via capfd during a fresh compile. (reduce-scatter counting is not
+    assertable here: XLA CPU never forms reduce-scatter from all-reduce +
+    dynamic-slice — that rewrite is TPU/GPU-only.)"""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+
+    if os.environ.get("TF_CPP_MIN_LOG_LEVEL", "0") not in ("0", "1"):
+        # XLA emits the remat diagnostic at WARNING; with C++ logging forced
+        # quieter this gate would pass vacuously
+        pytest.skip("TF_CPP_MIN_LOG_LEVEL suppresses XLA warnings")
+
+    strategy = dist.DistributedStrategy()
+    strategy.sharding = True
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "sharding_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    model = GPTForPretraining(gpt_tiny())
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    eng = fleet.distributed_engine(model, opt)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 1024, (4, 64)).astype(np.int64))
+    labels = jnp.asarray(np.roll(np.asarray(ids), -1, 1))
+    capfd.readouterr()  # drain anything queued before the compile
+    compiled = _compile_step(eng, [ids, labels])
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, (
+        "ZeRO step reintroduced a replicate-and-repartition reshard:\n"
+        + "\n".join(ln for ln in err.splitlines()
+                    if "rematerialization" in ln)[:500])
+    # the partitioned step must still carry real collectives (the psums /
+    # gathers of dp+mp+zero), or the topology silently degenerated
+    txt = compiled.as_text()
+    assert re.search(r"all-reduce", txt) and re.search(r"all-gather", txt)
 
 
 def test_decode_loop_weights_precast_to_bf16():
