@@ -87,7 +87,30 @@ def _unwrap(out):
 
 
 def _wrap_inputs(args):
-    return [a if isinstance(a, Tensor) else Tensor(jnp.asarray(a)) for a in args]
+    """Split positional args into traced Tensors and STATIC values.
+
+    Strings/objects that jnp.asarray rejects are closed over instead of
+    traced (paddle's to_static passes non-tensor args through unchanged);
+    since every call re-traces over concrete values (see the kernel NOTE
+    below), each distinct static value simply steers its own trace.
+    Returns (tensors, template) where template holds the static value per
+    position, or _TENSOR_SLOT where a tensor goes.
+    """
+    tensors, template = [], []
+    for a in args:
+        if isinstance(a, Tensor):
+            tensors.append(a)
+            template.append(_TENSOR_SLOT)
+        else:
+            try:
+                tensors.append(Tensor(jnp.asarray(a)))
+                template.append(_TENSOR_SLOT)
+            except (TypeError, ValueError):
+                template.append(a)
+    return tensors, template
+
+
+_TENSOR_SLOT = object()
 
 
 class StaticFunction:
@@ -100,7 +123,7 @@ class StaticFunction:
         self._jitted = None
         self._param_names = []
 
-    def _build_kernel(self, n_inputs, kwargs):
+    def _build_kernel(self, template, kwargs):
         from . import dy2static
 
         layer = self._layer
@@ -111,10 +134,27 @@ class StaticFunction:
         raw = function or (layer.forward if layer is not None else None)
         converted = dy2static.convert_to_static(raw) if raw is not None else None
 
+        # NOTE: this kernel intentionally closes over the raw kwargs DICT,
+        # which core/dispatch._freeze cannot hash — so to_static programs are
+        # NEVER rule-cached and re-trace per call over concrete values. That
+        # is the semantic contract, not an accident: a cached (abstract)
+        # trace would turn python control flow on input VALUES (`if flag:`,
+        # `float(x)`) into abstract-tracer errors or silently different
+        # programs. The reference ProgramTranslator re-traces per CacheKey
+        # for the same reason.
+        n_pos = len(template)
+        statics = tuple((i, v) for i, v in enumerate(template)
+                        if v is not _TENSOR_SLOT)
+
         def kernel(*arrays):
             param_arrays = arrays[:len(param_names)]
-            input_arrays = arrays[len(param_names):]
-            inputs = [Tensor(a, stop_gradient=True) for a in input_arrays]
+            input_arrays = iter(arrays[len(param_names):])
+            # interleave traced tensors and static (closed-over) values back
+            # into their original positions
+            slots = dict(statics)
+            inputs = [slots[i] if i in slots
+                      else Tensor(next(input_arrays), stop_gradient=True)
+                      for i in range(n_pos)]
             if layer is not None:
                 state = dict(zip(param_names, param_arrays))
                 with _swapped_state(layer, state), _tracing(), no_grad():
@@ -134,14 +174,14 @@ class StaticFunction:
         return dy2static.get_code(raw)
 
     def __call__(self, *args, **kwargs):
-        inputs = _wrap_inputs(args)
+        inputs, template = _wrap_inputs(args)
         if self._layer is not None:
             state = self._layer.state_dict(include_non_persistable_buffer=True)
             self._param_names = list(state.keys())
             tensor_args = [state[n] for n in self._param_names] + inputs
         else:
             tensor_args = inputs
-        kernel = self._build_kernel(len(inputs), kwargs)
+        kernel = self._build_kernel(template, kwargs)
         return apply("to_static_program", kernel, tensor_args)
 
 
