@@ -109,10 +109,21 @@ def _emit_eqn(g: _Graph, eqn):
         to = P.DTYPE[_JAX2ONNX_DTYPE[str(params["new_dtype"])]]
         out(g.emit("Cast", [ins[0]], to=to))
     elif prim == "select_n":
-        if len(eqn.invars) != 3:
-            raise NotImplementedError("onnx export: select_n with >2 cases")
-        # select_n(pred, on_false, on_true) -> Where(pred, on_true, on_false)
-        out(g.emit("Where", [ins[0], ins[2], ins[1]]))
+        if len(eqn.invars) == 3:
+            # select_n(pred, on_false, on_true) -> Where(pred, on_true, on_false)
+            out(g.emit("Where", [ins[0], ins[2], ins[1]]))
+        else:
+            # integer selector with N cases: cascade Where(pred == k, case_k)
+            # over k = 1..N-1 starting from case_0 (out-of-range selectors are
+            # clamped by lax; the cascade's fall-through to case_0 differs
+            # only on inputs lax already deems undefined)
+            pdt = str(eqn.invars[0].aval.dtype)
+            acc = ins[1]
+            for k in range(2, len(eqn.invars)):
+                kk = g.const(np.asarray(k - 1, pdt), "case_idx")
+                acc = g.emit("Where", [g.emit("Equal", [ins[0], kk]),
+                                       ins[k], acc])
+            out(acc)
     elif prim == "reshape":
         shape = g.const(np.asarray(params["new_sizes"], np.int64), "shape")
         out(g.emit("Reshape", [ins[0], shape]))
@@ -167,11 +178,27 @@ def _emit_eqn(g: _Graph, eqn):
         op = "ReduceMax" if prim == "reduce_max" else "ReduceMin"
         out(g.emit(op, [ins[0]], axes=[int(a) for a in params["axes"]],
                    keepdims=0))
-    elif prim == "argmax":
-        axes = params["axes"]
-        if len(axes) != 1:
-            raise NotImplementedError("onnx export: multi-axis argmax")
-        am = g.emit("ArgMax", [ins[0]], axis=int(axes[0]), keepdims=0)
+    elif prim in ("argmax", "argmin"):
+        onnx_op = "ArgMax" if prim == "argmax" else "ArgMin"
+        axes = [int(a) for a in params["axes"]]
+        src = ins[0]
+        if len(axes) == 1:
+            am = g.emit(onnx_op, [src], axis=axes[0], keepdims=0)
+        else:
+            # multi-axis: transpose the reduced axes (in order) to the back,
+            # flatten them into one, then a single trailing ArgMax — the
+            # index is into the row-major flattening of those axes, matching
+            # lax's multi-axis semantics
+            shape = [int(s) for s in eqn.invars[0].aval.shape]
+            keep = [d for d in range(len(shape)) if d not in axes]
+            perm = keep + axes
+            if perm != list(range(len(shape))):
+                src = g.emit("Transpose", [src], perm=perm)
+            flat = [shape[d] for d in keep] + \
+                [int(np.prod([shape[d] for d in axes]))]
+            src = g.emit("Reshape", [src, g.const(
+                np.asarray(flat, np.int64), "shape")])
+            am = g.emit(onnx_op, [src], axis=len(flat) - 1, keepdims=0)
         to = P.DTYPE[_JAX2ONNX_DTYPE[str(eqn.outvars[0].aval.dtype)]]
         out(g.emit("Cast", [am], to=to))
     elif prim == "dot_general":
@@ -238,16 +265,18 @@ def _emit_dot_general(g, eqn, ins):
                                           "shape")])
 
 
-def _zero_interleave(g, name, shape, axis, d, dtype):
-    """Insert d-1 zeros between elements along `axis` (static shapes):
-    [.., H, ..] -> [.., (H-1)*d+1, ..]. This is lax's lhs_dilation (the
-    fractional stride of a transposed conv) expressed in plain ONNX ops."""
+def _zero_interleave(g, name, shape, axis, d, dtype, fill=0):
+    """Insert d-1 `fill` elements between elements along `axis` (static
+    shapes): [.., H, ..] -> [.., (H-1)*d+1, ..]. This is lax's lhs_dilation
+    (transposed-conv fractional stride) / base_dilation (pooling) expressed
+    in plain ONNX ops; `fill` is the reduction's identity (0 for conv/sum,
+    -inf for max pooling)."""
     H = shape[axis]
     un_shape = list(shape[:axis + 1]) + [1] + list(shape[axis + 1:])
     x = g.emit("Reshape", [name, g.const(np.asarray(un_shape, np.int64),
                                          "shape")])
     z_shape = list(shape[:axis + 1]) + [d - 1] + list(shape[axis + 1:])
-    zeros = g.const(np.zeros(z_shape, dtype), "zeros")
+    zeros = g.const(np.full(z_shape, fill, dtype), "fill")
     x = g.emit("Concat", [x, zeros], axis=axis + 1)
     full = list(shape)
     full[axis] = H * d
@@ -268,10 +297,19 @@ def _emit_conv(g, eqn, ins):
         else dn
     nd = len(p["window_strides"])
     iota = tuple(range(2 + nd))
-    if tuple(spec[0]) != iota or tuple(spec[1]) != iota or tuple(spec[2]) != iota:
-        raise NotImplementedError("onnx export: conv layout != NCHW/OIHW")
-    lname = ins[0]
+    lname, rname = ins[0], ins[1]
     shape = [int(s) for s in eqn.invars[0].aval.shape]
+    # non-NCHW/OIHW layouts (NHWC inputs, HWIO kernels, ...): the spec
+    # tuples ARE the permutations onto canonical order — transpose in, run
+    # the canonical Conv, transpose the output back per out_spec. Strides/
+    # padding/dilations are already spatial-ordered and layout-independent.
+    if tuple(spec[0]) != iota:
+        perm = [int(d) for d in spec[0]]
+        lname = g.emit("Transpose", [lname], perm=perm)
+        shape = [shape[d] for d in perm]
+    if tuple(spec[1]) != iota:
+        rname = g.emit("Transpose", [rname], perm=[int(d) for d in spec[1]])
+    ins = [lname, rname] + list(ins[2:])
     if any(d != 1 for d in p["lhs_dilation"]):
         # transposed conv: lax lowers it as a fractionally-strided conv
         # (lhs_dilation = stride). Decompose generically — zero-interleave
@@ -301,12 +339,20 @@ def _emit_conv(g, eqn, ins):
         lname = g.emit("Slice", args)
         padding = [(max(0, lo), max(0, hi)) for lo, hi in padding]
     pads = [lo for lo, _ in padding] + [hi for _, hi in padding]
-    return g.emit(
+    conv = g.emit(
         "Conv", [lname] + ins[1:],
         strides=[int(s) for s in p["window_strides"]],
         dilations=[int(d) for d in p["rhs_dilation"]],
         pads=pads,
         group=int(p["feature_group_count"]))
+    if tuple(spec[2]) != iota:
+        # Conv emits canonical NCHW; out_spec[k] says where canonical dim k
+        # lives in the jax output — the inverse permutation
+        inv = [0] * (2 + nd)
+        for k, d in enumerate(spec[2]):
+            inv[int(d)] = k
+        conv = g.emit("Transpose", [conv], perm=inv)
+    return conv
 
 
 def _emit_pool(g, eqn, ins, kind):
@@ -316,14 +362,26 @@ def _emit_pool(g, eqn, ins, kind):
     padding = p["padding"]
     if len(window) < 3 or window[0] != 1 or window[1] != 1:
         raise NotImplementedError("onnx export: pool window not NCHW-spatial")
-    if any(d != 1 for d in p.get("base_dilation", [1])):
-        raise NotImplementedError("onnx export: base-dilated pooling")
+    dtype = str(eqn.invars[0].aval.dtype)
+    src = ins[0]
+    shape = [int(s) for s in eqn.invars[0].aval.shape]
+    base_dil = [int(d) for d in
+                p.get("base_dilation", [1] * len(window))]
+    if any(d != 1 for d in base_dil):
+        # base dilation interleaves the INPUT with the reduction identity
+        # (-inf for max, 0 for sum) before windowing — same decomposition
+        # as a transposed conv's fractional stride
+        # the reduce identity: -inf (NOT finfo.min) for float max — with
+        # base dilation > window size some windows see only fill, and lax
+        # yields -inf there
+        fill = (-np.inf if kind == "MaxPool" else 0) \
+            if np.issubdtype(np.dtype(dtype), np.floating) \
+            else (np.iinfo(dtype).min if kind == "MaxPool" else 0)
+        for i, d in enumerate(base_dil):
+            if d != 1:
+                src, shape = _zero_interleave(g, src, shape, i, d, dtype,
+                                              fill=fill)
     dil = [int(d) for d in p.get("window_dilation", [1] * len(window))][2:]
-    if any(d != 1 for d in dil) and kind != "MaxPool":
-        # ONNX AveragePool only grows dilations at opset 19; MaxPool has
-        # them since 10 (our opset is 13)
-        raise NotImplementedError("onnx export: dilated sum/avg pooling")
-    spatial = len(window) - 2
     kernel = [int(w) for w in window[2:]]
     pads = [int(pad[0]) for pad in padding[2:]] + \
            [int(pad[1]) for pad in padding[2:]]
@@ -331,12 +389,20 @@ def _emit_pool(g, eqn, ins, kind):
                  pads=pads)
     if kind == "MaxPool":
         if any(d != 1 for d in dil):
-            attrs["dilations"] = dil
-        return g.emit("MaxPool", ins, **attrs)
+            attrs["dilations"] = dil  # MaxPool grew dilations at opset 10
+        return g.emit("MaxPool", [src], **attrs)
+    if any(d != 1 for d in dil):
+        # ONNX AveragePool only grows dilations at opset 19 (ours is 13):
+        # a dilated window SUM is exactly a depthwise Conv with a ones
+        # kernel [C,1,*k], group=C — Conv has dilations since opset 1
+        C = shape[1]
+        ones = g.const(np.ones([C, 1] + kernel, dtype), "ones_kernel")
+        return g.emit("Conv", [src, ones], group=C, dilations=dil,
+                      pads=pads, strides=attrs["strides"],
+                      kernel_shape=kernel)
     # reduce_window_sum -> AveragePool(count_include_pad=1) * window_size
-    avg = g.emit("AveragePool", ins, count_include_pad=1, **attrs)
-    n = g.const(np.asarray(float(np.prod(kernel)),
-                           str(eqn.invars[0].aval.dtype)), "window_elems")
+    avg = g.emit("AveragePool", [src], count_include_pad=1, **attrs)
+    n = g.const(np.asarray(float(np.prod(kernel)), dtype), "window_elems")
     return g.emit("Mul", [avg, n])
 
 

@@ -266,6 +266,8 @@ def run_model(path, feeds):
                            keepdims=bool(a.get("keepdims", 1)))
         elif op == "ArgMax":
             r = np.argmax(ins[0], axis=a["axis"])
+        elif op == "ArgMin":
+            r = np.argmin(ins[0], axis=a["axis"])
         elif op == "Conv":
             r = _conv(ins[0], ins[1], a["strides"], a["pads"],
                       a["dilations"], a.get("group", 1))
