@@ -156,6 +156,55 @@ def diagflat(x, offset=0, name=None):
     return apply("diagflat", lambda a, offset: jnp.diagflat(a, offset), [t_(x)], {"offset": int(offset)})
 
 
+def _diag_rc(n, offset):
+    """(row, col) index arrays of an n-element diagonal at `offset` (shared
+    by diag_embed / fill_diagonal_tensor so offset handling cannot drift)."""
+    idx = jnp.arange(n)
+    r = idx if offset >= 0 else idx - offset
+    c = idx + offset if offset >= 0 else idx
+    return r, c
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (reference diag_embed_op.cc semantics):
+    the last dim of `input` becomes the (offset) diagonal of a new matrix
+    spanned by dims (dim1, dim2) of the output."""
+    x = t_(input)
+
+    def k(a, offset, dim1, dim2):
+        n = a.shape[-1] + abs(offset)
+        out_ndim = a.ndim + 1
+        d1, d2 = dim1 % out_ndim, dim2 % out_ndim
+        r, c = _diag_rc(a.shape[-1], offset)
+        # build with (row, col) as the LAST two axes, then move them home
+        mat = jnp.zeros(a.shape[:-1] + (n, n), a.dtype).at[..., r, c].set(a)
+        return jnp.moveaxis(mat, (-2, -1), (d1, d2))
+
+    return apply("diag_embed", k, [x],
+                 {"offset": int(offset), "dim1": int(dim1),
+                  "dim2": int(dim2)})
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write `y` onto the (offset) diagonal spanned by (dim1, dim2) of a
+    COPY of x (reference fill_diagonal_tensor_op.cc)."""
+    x, y = t_(x), t_(y)
+
+    def k(a, b, offset, dim1, dim2):
+        d1 = dim1 % a.ndim
+        d2 = dim2 % a.ndim
+        m = jnp.moveaxis(a, (d1, d2), (-2, -1))
+        nr, nc = m.shape[-2], m.shape[-1]
+        dlen = min(nr, nc - offset) if offset >= 0 else min(nr + offset, nc)
+        r, c = _diag_rc(dlen, offset)
+        m = m.at[..., r, c].set(b.astype(a.dtype))
+        return jnp.moveaxis(m, (-2, -1), (d1, d2))
+
+    return apply("fill_diagonal_tensor", k, [x, y],
+                 {"offset": int(offset), "dim1": int(dim1),
+                  "dim2": int(dim2)})
+
+
 def meshgrid(*args, **kwargs):
     tensors = [t_(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
     outs = jnp.meshgrid(*[t._data for t in tensors], indexing="ij")

@@ -80,3 +80,21 @@ def test_numeric_coverage_partition_is_total():
         assert any(re.search(r"\b" + re.escape(c) + r"\b", txt)
                    for c in {name, leaf}), (
             f"{name}: neither '{name}' nor '{leaf}' appears in {fn}")
+
+
+def test_legacy_op_surface_fully_scoped():
+    """VERDICT r3 missing #3: the NON-api.yaml operator surface must be
+    explicitly delimited — every root-dir fluid operator is api-surface /
+    equivalent (evidence verified) / waived (reasoned), and every family
+    directory has a disposition. The 235/235 headline is about api.yaml;
+    this keeps it from being mistaken for full-fluid parity."""
+    import op_coverage as oc
+
+    rep = oc.legacy_audit()
+    assert rep["root"]["unscoped"] == [], rep["root"]["unscoped"]
+    assert rep["root"]["broken_evidence"] == [], rep["root"]["broken_evidence"]
+    c = rep["counts"]
+    assert c["api_surface"] + c["equivalent"] + c["waived"] == c["root_ops"]
+    # the audit is hermetic: the bundled snapshot must exist and parse
+    ops, _ = oc.extract_legacy_root_ops("/nonexistent")
+    assert len(ops) >= 400
