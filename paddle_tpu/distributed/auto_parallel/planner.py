@@ -105,8 +105,14 @@ def enumerate_topologies(n_devices: int,
         c = dict(zip(axes, shape))
         if max_mp and c.get("mp", 1) > max_mp:
             continue
-        cands.append({f"{k}_degree": v for k, v in c.items() if v > 1} or
-                     {"dp_degree": 1})
+        # hybrid_configs spells the sp axis "sep_degree" (reference naming).
+        # dp_degree is ALWAYS explicit, even at 1: omitted, the HCG's
+        # dp_degree=-1 auto-fill would grow dp to consume every host device,
+        # silently scoring the candidate on a different topology than its
+        # label (e.g. {'sep_degree': 4} becoming dp2 x sp4 on an 8-device
+        # host when n_devices=4 was asked for)
+        cands.append({("sep_degree" if k == "sp" else f"{k}_degree"): v
+                      for k, v in c.items() if v > 1 or k == "dp"})
     # dedupe (dict order-insensitive)
     seen, uniq = set(), []
     for c in cands:

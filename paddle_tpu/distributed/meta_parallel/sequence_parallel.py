@@ -96,18 +96,21 @@ def _chunk_attn(q, k, v, sm_scale, mask):
 
 def _ring_use_flash(s_loc: int, d: int) -> bool:
     """Per-shard block compute runs the Pallas flash kernel when the shapes
-    qualify (SURVEY §5.7's Pallas-ring requirement). On CPU the kernel only
-    exists in slow interpret mode, so it is opt-in there (tests set
-    PADDLE_TPU_RING_FLASH=1)."""
+    qualify (SURVEY §5.7's Pallas-ring requirement). The flag policy is the
+    SHARED one (ops.nn_functional.flash_flag_allows — so a user disabling
+    use_flash_attention disables ring's kernel too, on any backend), with
+    the test env knob PADDLE_TPU_RING_FLASH=1 as a CPU-only extra opt-in."""
     import os
 
+    from ...ops.nn_functional import flash_flag_allows
     from ...ops.pallas.flash_attention import supported
 
     if not supported(s_loc, s_loc, d):
         return False
-    if jax.default_backend() == "cpu":
-        return os.environ.get("PADDLE_TPU_RING_FLASH") == "1"
-    return True
+    if (jax.default_backend() == "cpu"
+            and os.environ.get("PADDLE_TPU_RING_FLASH") == "1"):
+        return True
+    return flash_flag_allows()
 
 
 def _block_attn_normalized(q, kc, vc, sm_scale, *, diag, use_flash):
@@ -218,9 +221,9 @@ def _ulysses_shard(q, k, v, *, axis, causal, sm_scale):
 
     s_loc = q.shape[1]
     qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    from ...ops.pallas.flash_attention import supported as flash_ok
+    from ...ops.nn_functional import _use_flash
 
-    if jax.default_backend() != "cpu" and flash_ok(qg.shape[1], kg.shape[1], qg.shape[-1]):
+    if _use_flash(qg, kg):
         from ...ops.pallas.flash_attention import flash_attention
 
         out = flash_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale)

@@ -919,23 +919,27 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                  nondiff_mask=[False, False, False] + ([True] * (len(args) - 3)))
 
 
-def _use_flash(q, k) -> bool:
-    """Route to the Pallas flash kernel: TPU only (interpret mode is test-only),
-    long-enough sequences, supported tiling."""
+def flash_flag_allows() -> bool:
+    """The flag half of the flash-routing decision, shared by the dense
+    route, ring SP, and Ulysses SP so the policies cannot drift: flag ON,
+    and off-TPU additionally a DELIBERATE opt-in (use_flash_attention
+    explicitly set + pallas_interpret_ok) — or enabling interpret mode for
+    another kernel would silently reroute all attention through the
+    orders-of-magnitude-slower interpreted kernel."""
     import jax as _jax
 
     from ..core import flags as _flags
     if not _flags.flag("use_flash_attention"):
         return False
-    # Mosaic kernels on TPU; interpret mode only when explicitly allowed
-    # (tests + HLO perf gates). Unlike layer_norm/lm_loss, this route's flag
-    # defaults ON — so the CPU interpret path additionally requires that
-    # use_flash_attention was DELIBERATELY set, or enabling interpret_ok for
-    # another kernel would silently reroute all attention through the
-    # (orders-of-magnitude slower) interpreted kernel.
-    if _jax.default_backend() != "tpu" and not (
-            _flags.flag("pallas_interpret_ok")
-            and _flags.was_set("use_flash_attention")):
+    return _jax.default_backend() == "tpu" or (
+        _flags.flag("pallas_interpret_ok")
+        and _flags.was_set("use_flash_attention"))
+
+
+def _use_flash(q, k) -> bool:
+    """Route to the Pallas flash kernel: TPU only (interpret mode is test-only),
+    long-enough sequences, supported tiling."""
+    if not flash_flag_allows():
         return False
     from .pallas.flash_attention import supported
 

@@ -33,6 +33,15 @@ def main():
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--flash", action="store_true",
+                    help="compile with the Pallas flash kernel (interpret "
+                         "mode on CPU): the linear-memory attention that "
+                         "long-context configs actually run with")
+    ap.add_argument("--sp-degrees", default="1,2,4",
+                    help="sp degrees to sweep (1 = dense baseline)")
+    ap.add_argument("--hbm-gb", type=float, default=16.0,
+                    help="per-chip HBM budget used for the feasible column "
+                         "(v5e: 16 GB)")
     args = ap.parse_args()
 
     import os
@@ -52,9 +61,29 @@ def main():
     from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
     from paddle_tpu.models import GPTConfig, GPTForPretraining
 
+    if args.flash:
+        paddle.set_flags({"use_flash_attention": True,
+                          "pallas_interpret_ok": True})
+
+    degrees = [int(d) for d in args.sp_degrees.split(",")]
+    bad = [d for d in degrees if d < 1 or 8 % d]
+    if bad:
+        ap.error(f"--sp-degrees must divide the 8-device mesh, got {bad}")
+    combos = []
+    for sp in degrees:
+        if sp == 1:
+            combos.append(("dense", 1))
+        else:
+            combos.append(("ring", sp))
+            if args.heads % sp == 0:
+                combos.append(("ulysses", sp))
+            else:
+                print(json.dumps({"impl": "ulysses", "sp": sp,
+                                  "skipped": f"heads {args.heads} not "
+                                             f"divisible by sp {sp}"}),
+                      flush=True)
     for seq in [int(s) for s in args.seqs.split(",")]:
-        for impl, sp in [("dense", 1), ("ring", 2), ("ulysses", 2),
-                         ("ring", 4), ("ulysses", 4)]:
+        for impl, sp in combos:
             set_hybrid_communicate_group(None)
             fleet.fleet.__init__()
             paddle.seed(0)
@@ -83,12 +112,17 @@ def main():
             ca = ca[0] if isinstance(ca, (list, tuple)) else ca
             ma = comp.memory_analysis()
             txt = comp.as_text()
+            peak_mb = round((ma.temp_size_in_bytes +
+                             ma.output_size_in_bytes) / 1e6, 1)
             row = {
                 "impl": impl, "sp": sp, "seq": seq,
                 "gflops": round(float(ca.get("flops", 0)) / 1e9, 2),
                 "gbytes": round(float(ca.get("bytes accessed", 0)) / 1e9, 3),
-                "peak_mb": round((ma.temp_size_in_bytes +
-                                  ma.output_size_in_bytes) / 1e6, 1),
+                "peak_mb": peak_mb,
+                # params+opt state live in HBM too, but temp+output dwarfs
+                # them in the regime this tool exists for; the column is a
+                # per-device go/no-go against the HBM budget
+                "feasible": bool(peak_mb < args.hbm_gb * 1e3),
                 "collective_permutes": len(
                     re.findall(r"collective-permute\(", txt)),
                 "all_to_alls": len(re.findall(r"all-to-all\(", txt)),
