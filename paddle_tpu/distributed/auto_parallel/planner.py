@@ -111,8 +111,10 @@ def enumerate_topologies(n_devices: int,
         # silently scoring the candidate on a different topology than its
         # label (e.g. {'sep_degree': 4} becoming dp2 x sp4 on an 8-device
         # host when n_devices=4 was asked for)
-        cands.append({("sep_degree" if k == "sp" else f"{k}_degree"): v
-                      for k, v in c.items() if v > 1 or k == "dp"})
+        cand = {("sep_degree" if k == "sp" else f"{k}_degree"): v
+                for k, v in c.items() if v > 1}
+        cand["dp_degree"] = c.get("dp", 1)  # even when dp is not an axis
+        cands.append(cand)
     # dedupe (dict order-insensitive)
     seen, uniq = set(), []
     for c in cands:

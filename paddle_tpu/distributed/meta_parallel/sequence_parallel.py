@@ -105,8 +105,12 @@ def _ring_use_flash(s_loc: int, d: int) -> bool:
     from ...ops.nn_functional import flash_flag_allows
     from ...ops.pallas.flash_attention import supported
 
+    from ...core import flags as _flags
+
     if not supported(s_loc, s_loc, d):
         return False
+    if not _flags.flag("use_flash_attention"):
+        return False  # an explicit disable beats every opt-in, env included
     if (jax.default_backend() == "cpu"
             and os.environ.get("PADDLE_TPU_RING_FLASH") == "1"):
         return True

@@ -1,0 +1,208 @@
+"""Single-chip north-star benchmarks beyond the GPT headline (VERDICT r3 #6).
+
+BASELINE.json configs measured here:
+  1 mnist_dygraph  LeNet EAGER train step latency — the per-op dispatch path
+                   bench.py never times (SURVEY §7 hard-part #1)
+  2 resnet50      ResNet50 imgs/sec/chip through the fused engine step
+                   (the DataParallel config minus the 8-chip allreduce)
+  5 widedeep      Wide&Deep examples/sec with BOTH sparse tables on the
+                   live C++ parameter server (core/native/ps_table.cc)
+                   feeding a jitted dense step — the PS topology where
+                   host-RAM tables sit next to the TPU dense compute
+
+One JSON line per config: {"config", "metric", "value", "unit", ...extras}.
+Chip-ready; --device cpu + --smoke shrink everything for a CPU sanity run
+(tests/test_northstar_bench.py). The watcher queue runs this on revival.
+
+Usage: python tools/northstar_bench.py [--config all|mnist_dygraph|resnet50|
+       widedeep] [--device cpu] [--smoke]
+"""
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path)
+
+import argparse
+import json
+import time
+
+
+def _sync(t):
+    return float(t.numpy().reshape(-1)[0])
+
+
+def bench_mnist_dygraph(smoke: bool) -> dict:
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    batch = 64
+    steps = 5 if smoke else 50
+    img = paddle.to_tensor(rs.rand(batch, 1, 28, 28).astype(np.float32))
+    lab = paddle.to_tensor(rs.randint(0, 10, (batch,)).astype(np.int64))
+
+    def step():
+        loss = loss_fn(model(img), lab)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(3):  # per-op compile warmup
+        _sync(step())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step()
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    return {"config": "mnist_dygraph",
+            "metric": "eager_step_latency", "value": round(dt / steps * 1e3, 2),
+            "unit": "ms/step", "batch": batch, "steps": steps,
+            "imgs_per_sec": round(steps * batch / dt, 1),
+            "final_loss": round(float(loss.numpy()), 4)}
+
+
+def bench_resnet50(smoke: bool) -> dict:
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+    from paddle_tpu.vision.models import resnet50
+
+    import paddle_tpu.distributed as dist
+
+    set_hybrid_communicate_group(None)
+    # per-CHIP number: pin dp=1 or the HCG auto-fill consumes every host
+    # device (8 on the virtual test mesh) and rejects the batch
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters(),
+                                    weight_decay=1e-4)
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    eng = fleet.distributed_engine(model, opt, loss_fn=loss_fn)
+    rs = np.random.RandomState(0)
+    batch, hw = (4, 32) if smoke else (64, 224)
+    steps = 2 if smoke else 20
+    img = paddle.to_tensor(rs.rand(batch, 3, hw, hw).astype(np.float32))
+    lab = paddle.to_tensor(rs.randint(0, 1000, (batch,)).astype(np.int64))
+
+    on_tpu = jax.default_backend() == "tpu"
+    import contextlib
+    amp = paddle.amp.auto_cast(enable=on_tpu, dtype="bfloat16") \
+        if on_tpu else contextlib.nullcontext()
+    with amp:
+        _sync(eng.step(img, lab))  # compile
+        _sync(eng.step(img, lab))  # warm
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = eng.step(img, lab)
+        _sync(loss)
+    dt = time.perf_counter() - t0
+    return {"config": "resnet50",
+            "metric": "resnet50_imgs_per_sec_per_chip",
+            "value": round(steps * batch / dt, 1), "unit": "imgs/s/chip",
+            "batch": batch, "image": hw, "steps": steps,
+            "step_ms": round(dt / steps * 1e3, 1),
+            "final_loss": round(float(loss.numpy()), 4)}
+
+
+def bench_widedeep(smoke: bool) -> dict:
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.ps import (PSClient, PSServer,
+                                           SparseTableConfig)
+    from paddle_tpu.models.rec import WideDeep, ctr_loss
+
+    vocab = 10_000 if smoke else 1_000_000
+    fields, dense_dim = 26, 13
+    sparse = [SparseTableConfig(table_id=0, dim=1, learning_rate=0.05),
+              SparseTableConfig(table_id=1, dim=8, learning_rate=0.05)]
+    server = PSServer(0, sparse, [])
+    try:
+        client = PSClient([f"127.0.0.1:{server.port}"])
+        for t in sparse:
+            client.register_table_dim(t.table_id, t.dim)
+        paddle.seed(0)
+        net = WideDeep(sparse_feature_dim=vocab, embedding_dim=8,
+                       num_fields=fields, dense_dim=dense_dim, use_ps=True,
+                       wide_table_id=0, deep_table_id=1, client=client)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        rs = np.random.RandomState(0)
+        batch = 64 if smoke else 512
+        steps = 3 if smoke else 30
+
+        def one_step():
+            sids = paddle.to_tensor(
+                rs.randint(0, vocab, (batch, fields)).astype(np.int64))
+            dense = paddle.to_tensor(
+                rs.rand(batch, dense_dim).astype(np.float32))
+            lab = paddle.to_tensor(
+                rs.randint(0, 2, (batch, 1)).astype(np.int64))
+            loss = ctr_loss(net(sids, dense), lab)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        for _ in range(2):
+            _sync(one_step())
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = one_step()
+        _sync(loss)
+        dt = time.perf_counter() - t0
+        return {"config": "widedeep",
+                "metric": "widedeep_examples_per_sec",
+                "value": round(steps * batch / dt, 1), "unit": "examples/s",
+                "batch": batch, "steps": steps, "vocab": vocab,
+                "ps": "cpp_ps_table",
+                "final_loss": round(float(loss.numpy()), 4)}
+    finally:
+        server.stop()  # the live C++ PS must not leak into later benches
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="all",
+                    choices=("all", "mnist_dygraph", "resnet50", "widedeep"))
+    ap.add_argument("--device", default="auto", choices=("auto", "cpu"))
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes / few steps (CPU sanity)")
+    args = ap.parse_args()
+
+    if args.device == "cpu":
+        from paddle_tpu.device.probe import force_cpu_platform
+
+        force_cpu_platform()
+    import jax
+
+    benches = {"mnist_dygraph": bench_mnist_dygraph,
+               "resnet50": bench_resnet50,
+               "widedeep": bench_widedeep}
+    names = list(benches) if args.config == "all" else [args.config]
+    for name in names:
+        try:
+            row = benches[name](args.smoke)
+            row["platform"] = jax.default_backend()
+        except Exception as e:  # one failed config must not kill the rest
+            row = {"config": name, "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
