@@ -121,5 +121,10 @@ class PipelineParallel(nn.Layer):
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """Interleaved (virtual-stage) schedule; identical numerics on the eager facade —
-    the SPMD path models virtual stages by stacking more body layers per rank."""
+    """Interleaved (virtual-stage) schedule; identical numerics on the eager
+    facade. The REAL interleaved scheduler is the SPMD path:
+    GPTForPretrainingPipe(num_virtual_stages=V) runs
+    pipeline_schedule.spmd_pipeline_interleaved — a static circular schedule
+    where each rank holds V stage chunks and the bubble shrinks to ~(P-1)
+    ticks total instead of V*(P-1) (reference SectionWorker interleaving,
+    device_worker.h:615)."""

@@ -205,7 +205,8 @@ class GPTForPretrainingPipe(nn.Layer):
     GPTForPretraining; with pp degree 1 it degrades to a plain scan over all layers.
     """
 
-    def __init__(self, config: GPTConfig, num_stages=None, num_microbatches=None):
+    def __init__(self, config: GPTConfig, num_stages=None, num_microbatches=None,
+                 num_virtual_stages=1):
         super().__init__()
         from jax.sharding import PartitionSpec as PS
 
@@ -219,20 +220,34 @@ class GPTForPretrainingPipe(nn.Layer):
                 "GPTForPretrainingPipe does not support dropout yet (needs per-stage "
                 "RNG plumbing through the SPMD schedule); set dropout=0")
         self.num_stages = int(num_stages or (hcg.degrees["pp"] if hcg else 1))
-        if config.num_layers % self.num_stages != 0:
+        # interleaved (virtual-stage) 1F1B: each pp rank holds V chunks of
+        # layers (logical stage v*P + r), cutting the pipeline bubble ~V-fold
+        # (reference SectionWorker interleaving, device_worker.h:615)
+        self.num_virtual_stages = int(num_virtual_stages)
+        total_stages = self.num_stages * self.num_virtual_stages
+        if config.num_layers % total_stages != 0:
             raise ValueError(
-                f"num_layers {config.num_layers} not divisible by pp {self.num_stages}")
-        self.layers_per_stage = config.num_layers // self.num_stages
+                f"num_layers {config.num_layers} not divisible by pp x virtual "
+                f"= {self.num_stages} x {self.num_virtual_stages}")
+        self.layers_per_stage = config.num_layers // total_stages
         self.num_microbatches = int(num_microbatches or max(1, self.num_stages))
 
         H, FF = config.hidden_size, config.ffn_hidden_size
-        S, Lp = self.num_stages, self.layers_per_stage
+        S, Lp, V = self.num_stages, self.layers_per_stage, self.num_virtual_stages
         self.wte = VocabParallelEmbedding(config.vocab_size, H)
         self.wpe = nn.Embedding(config.max_seq_len, H)
         self.ln_f = nn.LayerNorm(H)
         self.loss_fn = ParallelCrossEntropy()
 
         def mk(name, shape, spec, init):
+            if V > 1 and len(spec) > 0 and spec[0] == "pp":
+                # stage-stacked params only: leading dims [V, S], leaf
+                # [v, r] = logical stage v*S + r, so P(None, "pp") places
+                # each rank's V chunks where the interleaved schedule
+                # executes them. Non-stage params (lm_head_w) keep their
+                # shape.
+                shape = (V,) + shape
+                spec = PS(None, *spec)
             p = self.create_parameter(shape, default_initializer=init)
             p.dist_attr = spec
             self.add_parameter(name, p)
@@ -287,6 +302,8 @@ class GPTForPretrainingPipe(nn.Layer):
             remat_policy = _resolve_policy(
                 getattr(cfg, "recompute_granularity", "full"))
 
+        V = self.num_virtual_stages
+
         def kernel(xa, *flat):
             params = dict(zip(self._STACKED, flat))
             def body(lp, h):
@@ -297,11 +314,21 @@ class GPTForPretrainingPipe(nn.Layer):
                 h, _ = jax.lax.scan(one, h, lp)
                 return h
             if mesh is not None:
+                from ..distributed.pipeline_schedule import \
+                    spmd_pipeline_interleaved
+
                 mb = microbatch_split(xa, n_micro)
+                if V > 1:
+                    return microbatch_merge(spmd_pipeline_interleaved(
+                        body, params, mb, mesh, "pp", num_chunks=V))
                 return microbatch_merge(spmd_pipeline(body, params, mb, mesh, "pp"))
-            # single-program fallback: same math, all stages scanned in sequence
+            # single-program fallback: same math, all stages scanned in
+            # sequence (leading [V, S] or [S] dims flatten in logical-stage
+            # order either way — chunk-major matches execution order)
+            n_lead = 3 if V > 1 else 2
             merged = jax.tree.map(
-                lambda l: l.reshape((l.shape[0] * l.shape[1],) + l.shape[2:]), params)
+                lambda l: l.reshape((math.prod(l.shape[:n_lead]),)
+                                    + l.shape[n_lead:]), params)
             return body(merged, xa)
 
         h = apply("gpt_pipe_body", kernel, [x] + [getattr(self, n) for n in self._STACKED])

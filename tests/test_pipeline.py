@@ -149,3 +149,141 @@ def test_pipeline_parallel_facade_grad_accum():
                                rtol=1e-5, atol=1e-6)
     for p1, p2 in zip(m1.parameters(), m2.parameters()):
         np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-5, atol=1e-6)
+
+
+# ---- round 4: interleaved (virtual-stage) scheduler (VERDICT r3 missing #4)
+def test_interleaved_schedule_beats_stacking():
+    """The static circular schedule must realize the bubble win: total
+    ticks < the sequential-stacking baseline V*(M+P-1), and every
+    microbatch emitted exactly once. At even V it hits the streaming
+    optimum M*V + P - 1."""
+    from paddle_tpu.distributed.pipeline_schedule import _interleaved_schedule
+
+    for P_, V, M in [(2, 2, 4), (4, 2, 8), (2, 4, 4), (4, 4, 8)]:
+        sched, T, slots = _interleaved_schedule(P_, V, M)
+        assert T == M * V + P_ - 1, (P_, V, M, T)
+        assert T < V * (M + P_ - 1)
+        emitted = sorted(x for x in sched["out_write"].flatten() if x >= 0)
+        assert emitted == list(range(M))
+        assert slots <= P_  # bounded activation buffering
+
+
+def test_interleaved_pipeline_matches_logical_stage_composition():
+    """spmd_pipeline_interleaved == running the V*P logical stages in
+    sequence — forward AND gradients (AD replays the mirrored schedule)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from paddle_tpu.distributed.pipeline_schedule import \
+        spmd_pipeline_interleaved
+
+    P_, V, M, D = 4, 2, 8, 16
+    mesh = Mesh(np.array(jax.devices()[:P_]), ("pp",))
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(V, P_, D, D).astype("float32")) * 0.3,
+              "b": jnp.asarray(rng.randn(V, P_, D).astype("float32")) * 0.1}
+    x = jnp.asarray(rng.randn(M, 4, D).astype("float32"))
+
+    def body(p, xb):
+        return jnp.tanh(xb @ p["w"] + p["b"])
+
+    def ref_fwd(params, x):
+        h = x
+        for s in range(V * P_):
+            v, r = s // P_, s % P_
+            h = jax.vmap(lambda xb, v=v, r=r: body(
+                {"w": params["w"][v, r], "b": params["b"][v, r]}, xb))(h)
+        return h
+
+    got = spmd_pipeline_interleaved(body, params, x, mesh, "pp", V)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref_fwd(params, x)),
+                               rtol=2e-6, atol=1e-6)
+
+    g1 = jax.grad(lambda p: (spmd_pipeline_interleaved(
+        body, p, x, mesh, "pp", V) ** 2).sum())(params)
+    g2 = jax.grad(lambda p: (ref_fwd(p, x) ** 2).sum())(params)
+    np.testing.assert_allclose(np.asarray(g1["w"]), np.asarray(g2["w"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1["b"]), np.asarray(g2["b"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gpt_pipe_interleaved_trains_identically():
+    """GPTForPretrainingPipe(num_virtual_stages=2) under dp x pp x mp must
+    produce the same losses as the single-chunk pipeline (identical init
+    and math, only the schedule differs)."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+    from paddle_tpu.models import GPTConfig, GPTForPretrainingPipe
+
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                    num_heads=4, max_seq_len=64, dropout=0.0,
+                    attention_dropout=0.0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, (8, 64)).astype(np.int64)
+    lab = np.roll(ids, -1, 1)
+
+    def train(virtual):
+        set_hybrid_communicate_group(None)
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "pp_degree": 2,
+                                   "mp_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = GPTForPretrainingPipe(cfg, num_microbatches=4,
+                                  num_virtual_stages=virtual)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        eng = fleet.distributed_engine(m, opt)
+        return [float(eng.step(paddle.to_tensor(ids),
+                               paddle.to_tensor(lab)).item())
+                for _ in range(3)]
+
+    plain, inter = train(1), train(2)
+    np.testing.assert_allclose(inter, plain, rtol=1e-5)
+    assert inter[-1] < inter[0]
+
+
+def test_interleaved_pipe_untied_head_and_pp1_degenerate():
+    """round-4 review regressions: (a) the V-prepend must not malform the
+    non-stage lm_head_w under tie_word_embeddings=False; (b) pp degree 1
+    with virtual stages degrades to a sequential chunk scan, not a squeeze
+    crash."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+    from paddle_tpu.models import GPTConfig, GPTForPretrainingPipe
+
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                    num_heads=4, max_seq_len=64, dropout=0.0,
+                    attention_dropout=0.0, tie_word_embeddings=False)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, (4, 64)).astype(np.int64)
+    lab = np.roll(ids, -1, 1)
+
+    # (a) untied head under pp2 x interleave
+    set_hybrid_communicate_group(None)
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "pp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    m = GPTForPretrainingPipe(cfg, num_microbatches=4, num_virtual_stages=2)
+    assert tuple(m.lm_head_w.shape) == (64, 256), m.lm_head_w.shape
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    eng = fleet.distributed_engine(m, opt)
+    v = float(eng.step(paddle.to_tensor(ids), paddle.to_tensor(lab)).item())
+    assert np.isfinite(v)
+
+    # (b) pp degree 1 + virtual stages: sequential chunk scan
+    set_hybrid_communicate_group(None)
+    strategy2 = dist.DistributedStrategy()
+    strategy2.hybrid_configs = {"dp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy2)
+    paddle.seed(0)
+    m2 = GPTForPretrainingPipe(cfg, num_stages=1, num_microbatches=2,
+                               num_virtual_stages=2)
+    out = m2(paddle.to_tensor(ids), paddle.to_tensor(lab))
+    assert np.isfinite(float(out.item()))
