@@ -211,6 +211,15 @@ def main():
             "mfu_param_flops_only": round(mfu_param, 4) if mfu_param else None,
             "decode_tokens_per_sec": decode_tps,
             "degraded": degraded,
+            # ALL variant knobs, so tools/plan_validate.py can join history
+            # rows against its predicted ranking without kernel-variant runs
+            # (pallas_ln/autotune/...) masquerading as the plain batch row
+            "recompute": os.environ.get("PADDLE_TPU_BENCH_RECOMPUTE"),
+            "scan": os.environ.get("PADDLE_TPU_BENCH_SCAN"),
+            "ce_chunk": os.environ.get("PADDLE_TPU_BENCH_CE_CHUNK"),
+            "pallas_ln": os.environ.get("PADDLE_TPU_BENCH_PALLAS_LN"),
+            "pallas_loss": os.environ.get("PADDLE_TPU_BENCH_PALLAS_LOSS"),
+            "autotune": os.environ.get("PADDLE_TPU_BENCH_AUTOTUNE"),
         },
     }
     if on_tpu and degraded is None:

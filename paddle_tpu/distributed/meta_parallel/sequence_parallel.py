@@ -97,12 +97,12 @@ def _chunk_attn(q, k, v, sm_scale, mask):
 def _ring_use_flash(s_loc: int, d: int) -> bool:
     """Per-shard block compute runs the Pallas flash kernel when the shapes
     qualify (SURVEY §5.7's Pallas-ring requirement). The flag policy is the
-    SHARED one (ops.nn_functional.flash_flag_allows — so a user disabling
+    SHARED one (ops.nn_functional._flash_flag_allows — so a user disabling
     use_flash_attention disables ring's kernel too, on any backend), with
     the test env knob PADDLE_TPU_RING_FLASH=1 as a CPU-only extra opt-in."""
     import os
 
-    from ...ops.nn_functional import flash_flag_allows
+    from ...ops.nn_functional import _flash_flag_allows
     from ...ops.pallas.flash_attention import supported
 
     from ...core import flags as _flags
@@ -114,7 +114,7 @@ def _ring_use_flash(s_loc: int, d: int) -> bool:
     if (jax.default_backend() == "cpu"
             and os.environ.get("PADDLE_TPU_RING_FLASH") == "1"):
         return True
-    return flash_flag_allows()
+    return _flash_flag_allows()
 
 
 def _block_attn_normalized(q, kc, vc, sm_scale, *, diag, use_flash):

@@ -919,13 +919,18 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                  nondiff_mask=[False, False, False] + ([True] * (len(args) - 3)))
 
 
-def flash_flag_allows() -> bool:
+def _flash_flag_allows() -> bool:
     """The flag half of the flash-routing decision, shared by the dense
     route, ring SP, and Ulysses SP so the policies cannot drift: flag ON,
     and off-TPU additionally a DELIBERATE opt-in (use_flash_attention
     explicitly set + pallas_interpret_ok) — or enabling interpret mode for
     another kernel would silently reroute all attention through the
-    orders-of-magnitude-slower interpreted kernel."""
+    orders-of-magnitude-slower interpreted kernel.
+
+    Underscore-private to stay OFF the public API surface (API.spec), but
+    intentionally imported by distributed/meta_parallel/sequence_parallel —
+    renaming/inlining it breaks the ring/Ulysses routing policy; the SP
+    parity tests pin that contract."""
     import jax as _jax
 
     from ..core import flags as _flags
@@ -939,7 +944,7 @@ def flash_flag_allows() -> bool:
 def _use_flash(q, k) -> bool:
     """Route to the Pallas flash kernel: TPU only (interpret mode is test-only),
     long-enough sequences, supported tiling."""
-    if not flash_flag_allows():
+    if not _flash_flag_allows():
         return False
     from .pallas.flash_attention import supported
 

@@ -1,0 +1,40 @@
+"""tools/plan_validate.py join logic: only CLEAN rows may match a predicted
+variant — kernel-variant and full-recompute runs must not masquerade as the
+plain measurement (round-4 review: the b32 history row is recompute=true)."""
+import json
+import os
+import sys
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(TESTS_DIR), "tools"))
+
+
+def _write(tmp_path, rows):
+    p = tmp_path / "hist.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+    return str(p)
+
+
+def _row(value, **extra):
+    base = {"seq": 1024, "devices": 1, "batch": 16}
+    base.update(extra)
+    return {"metric": "m", "value": value, "extra": base}
+
+
+def test_measured_tokens_clean_join(tmp_path):
+    import plan_validate as pv
+
+    path = _write(tmp_path, [
+        _row(100.0),                                   # clean b16
+        _row(250.0, batch=32, recompute=True),         # full recompute: skip
+        _row(130.0, recompute="selective"),            # b16_selective
+        _row(999.0, pallas_ln="1"),                    # kernel variant: skip
+        _row(888.0, scan="1"),                         # scan trainer: skip
+        _row(777.0, seq=4096),                         # wrong seq: skip
+        _row(666.0, devices=8),                        # multi-device: skip
+        _row(120.0, ce_chunk="4096"),                  # ce4096_b16
+        _row(110.0),                                   # best-per-tag max
+    ])
+    got = pv.measured_tokens(path, 1024)
+    assert got == {"b16": 110.0, "b16_selective": 130.0,
+                   "ce4096_b16": 120.0}, got
