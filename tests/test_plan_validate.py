@@ -38,3 +38,17 @@ def test_measured_tokens_clean_join(tmp_path):
     got = pv.measured_tokens(path, 1024)
     assert got == {"b16": 110.0, "b16_selective": 130.0,
                    "ce4096_b16": 120.0}, got
+
+
+def test_measured_tokens_rejects_model_and_knob_mismatches(tmp_path):
+    import plan_validate as pv
+
+    path = _write(tmp_path, [
+        _row(100.0, hidden=768, layers=12),            # clean base row
+        _row(500.0, hidden=1024, layers=24),           # medium model: skip
+        _row(400.0, pallas_ln="0"),                    # "0" is knob-ON: skip
+        _row(300.0, ce_chunk="4096",
+             recompute="selective"),                   # combined knobs: skip
+    ])
+    got = pv.measured_tokens(path, 1024)
+    assert got == {"b16": 100.0}, got
