@@ -445,16 +445,42 @@ class GPTForPretraining(nn.Layer):
         return self.lm_head(h)
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=0, top_p=1.0, eos_token_id=None, seed=0):
+                 top_k=0, top_p=1.0, eos_token_id=None, seed=0,
+                 decode_strategy=None, num_beams=1, length_penalty=1.0):
         """Autoregressive decode with KV cache — ONE jitted program: prefill
         fills fixed [b, total, nh, hd] cache buffers, then a lax.scan emits a
         token per step (static shapes end to end, the TPU-native decode loop).
         Greedy when temperature == 0; top-k/top-p nucleus sampling otherwise.
         After eos_token_id every subsequent position repeats eos.
 
+        decode_strategy follows the reference generate() API: None picks
+        greedy/sampling from temperature; "beam_search" (or num_beams > 1)
+        routes to generate_beam.
+
         Single-replica inference path (mp decode would shard the head and
         psum logits; see PARITY row 49). Returns [b, prompt + max_new_tokens].
         """
+        if decode_strategy not in (None, "greedy_search", "sampling",
+                                   "beam_search"):
+            raise ValueError(
+                f"decode_strategy must be 'greedy_search', 'sampling' or "
+                f"'beam_search', got {decode_strategy!r}")
+        if decode_strategy == "beam_search" or (decode_strategy is None
+                                                and num_beams > 1):
+            if num_beams < 2:
+                raise ValueError(
+                    "beam_search needs num_beams >= 2 (reference generate() "
+                    f"semantics), got {num_beams}")
+            return self.generate_beam(
+                input_ids, max_new_tokens=max_new_tokens,
+                num_beams=int(num_beams),
+                length_penalty=length_penalty, eos_token_id=eos_token_id)
+        if num_beams > 1:
+            raise ValueError(
+                f"num_beams={num_beams} conflicts with "
+                f"decode_strategy={decode_strategy!r}; use 'beam_search'")
+        if decode_strategy == "greedy_search":
+            temperature = 0.0
         import jax
         import jax.numpy as jnp
 
@@ -596,6 +622,162 @@ class GPTForPretraining(nn.Layer):
             if fn is None:
                 fn = jit_cache[cache_key] = jax.jit(run)
             out = fn(params, ids, jax.random.key(seed))
+        finally:
+            if was_training:
+                self.train()
+        return Tensor(out)
+
+    def generate_beam(self, input_ids, max_new_tokens=32, num_beams=4,
+                      length_penalty=1.0, eos_token_id=None):
+        """Beam-search decode as ONE jitted program (the reference's
+        BeamSearchDecoder / beam_search_op machinery, python/paddle's
+        generate(decode_strategy="beam_search"), re-designed TPU-native):
+        the KV cache carries a beam dim [b*K, total, nh, hd], each scan step
+        log-softmaxes all beams' logits, takes top-K over the flattened
+        [K*V] continuations, and REORDERS the cache by gathering beam rows —
+        static shapes end to end, no host round-trips. Finished beams emit a
+        forced eos with log-prob 0 so their score freezes. Returns the best
+        beam per batch row, [b, prompt + max_new_tokens], ranked by
+        score / length**length_penalty (GNMT-style).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.autograd import no_grad
+        from ..core.dispatch import _autocast_dtype_for, amp_ctx as _amp_ctx
+        from ..core.tensor import Tensor
+        from ..jit import _swapped_state, _tracing, functional_call
+
+        cfg = self.config
+        K = int(num_beams)
+        ids = input_ids._data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        b, prompt = ids.shape
+        total = prompt + max_new_tokens
+        if total > cfg.max_seq_len:
+            raise ValueError(f"prompt {prompt} + max_new_tokens "
+                             f"{max_new_tokens} exceeds max_seq_len "
+                             f"{cfg.max_seq_len}")
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        state = self.state_dict(include_non_persistable_buffer=True)
+        params = {k: v._data for k, v in state.items()}
+        _amp = _amp_ctx()
+        _mm_dtype = _autocast_dtype_for("attention", ())
+        cache_dtype = (_mm_dtype if _mm_dtype is not None
+                       else self.gpt.wte.weight._data.dtype)
+        _w_dtype = _autocast_dtype_for("matmul", ())
+        was_training = self.training
+        self.eval()
+        NEG = jnp.float32(-1e30)
+
+        def head(params, h_arr):
+            with _swapped_state(self, params), _tracing(), no_grad():
+                return self._head_logits(Tensor(h_arr))._data
+
+        def run(params, ids):
+            if _w_dtype is not None:
+                params = {k: (v.astype(_w_dtype)
+                              if v.ndim >= 2 and jnp.issubdtype(
+                                  v.dtype, jnp.floating) else v)
+                          for k, v in params.items()}
+            gpt_params = {k[len("gpt."):]: v for k, v in params.items()
+                          if k.startswith("gpt.")}
+            # ---- prefill on the raw batch, then tile everything to beams
+            caches = [(Tensor(jnp.zeros((b, total, nh, hd), cache_dtype)),
+                       Tensor(jnp.zeros((b, total, nh, hd), cache_dtype)),
+                       Tensor(jnp.int32(0))) for _ in range(cfg.num_layers)]
+            h, caches = functional_call(self.gpt, gpt_params, Tensor(ids),
+                                        caches=caches)
+            logp0 = jax.nn.log_softmax(
+                head(params, h._data[:, -1]).astype(jnp.float32), axis=-1)
+            vocab = logp0.shape[-1]
+            scores, tok0 = jax.lax.top_k(logp0, K)        # [b, K] each
+            toks = jnp.zeros((b, K, max_new_tokens), jnp.int32)
+            toks = toks.at[:, :, 0].set(tok0)
+            finished = (jnp.zeros((b, K), bool) if eos_token_id is None
+                        else tok0 == eos_token_id)
+            lengths = jnp.ones((b, K), jnp.float32)  # emitted per beam
+
+            def tile(t):
+                a = t._data if isinstance(t, Tensor) else t
+                if a.ndim == 0:
+                    return a
+                return jnp.repeat(a, K, axis=0)  # row i -> beams i*K..i*K+K-1
+
+            flat = [tuple(tile(c) for c in layer) for layer in caches]
+
+            def step(carry, t):
+                flat, toks, scores, finished, lengths = carry
+                # each beam continues from its last emitted token
+                prev = jnp.reshape(
+                    jax.lax.dynamic_index_in_dim(
+                        jnp.moveaxis(toks, 2, 0), t - 1, 0, keepdims=False),
+                    (b * K,))
+                caches = [tuple(Tensor(c) for c in layer) for layer in flat]
+                h, caches = functional_call(self.gpt, gpt_params,
+                                            Tensor(prev[:, None]),
+                                            caches=caches)
+                logp = jax.nn.log_softmax(
+                    head(params, h._data[:, 0]).astype(jnp.float32), axis=-1)
+                logp = jnp.reshape(logp, (b, K, vocab))
+                if eos_token_id is not None:
+                    # finished beams: only "emit eos again, score unchanged"
+                    onehot = jnp.where(
+                        jnp.arange(vocab)[None, None, :] == eos_token_id,
+                        jnp.float32(0), NEG)
+                    logp = jnp.where(finished[..., None], onehot, logp)
+                cand = scores[..., None] + logp            # [b, K, V]
+                flat_cand = jnp.reshape(cand, (b, K * vocab))
+                scores, idx = jax.lax.top_k(flat_cand, K)  # [b, K]
+                beam_idx = idx // vocab                    # [b, K]
+                token = (idx % vocab).astype(jnp.int32)
+                # reorder beam state by gathered parent index
+                toks = jnp.take_along_axis(toks, beam_idx[..., None], axis=1)
+                toks = toks.at[:, :, t].set(token)
+                fin_g = jnp.take_along_axis(finished, beam_idx, axis=1)
+                len_g = jnp.take_along_axis(lengths, beam_idx, axis=1)
+                lengths = jnp.where(fin_g, len_g, len_g + 1.0)
+                finished = fin_g if eos_token_id is None else \
+                    fin_g | (token == eos_token_id)
+                # the functional_call appended this step's K/V for the OLD
+                # beam order; gather AFTER the append so each child inherits
+                # its parent's cache including the new row
+                rows = (jnp.arange(b)[:, None] * K + beam_idx).reshape(-1)
+                new_flat = []
+                for layer in caches:
+                    kc, vc, off = (x._data for x in layer)
+                    new_flat.append((kc[rows], vc[rows], off))
+                return (new_flat, toks, scores, finished, lengths), None
+
+            if max_new_tokens > 1:
+                (flat, toks, scores, finished, lengths), _ = jax.lax.scan(
+                    step, (flat, toks, scores, finished, lengths),
+                    jnp.arange(1, max_new_tokens))
+            # GNMT length penalty; pick the best beam per row
+            norm = scores / jnp.power(lengths, jnp.float32(length_penalty))
+            best = jnp.argmax(norm, axis=1)                # [b]
+            best_toks = jnp.take_along_axis(
+                toks, best[:, None, None], axis=1)[:, 0]   # [b, max_new]
+            if eos_token_id is not None:
+                # positions after the eos repeat eos (matches generate())
+                emitted = jnp.cumsum(
+                    (best_toks == eos_token_id).astype(jnp.int32), axis=1)
+                seen = (emitted - (best_toks == eos_token_id)) > 0
+                best_toks = jnp.where(seen, eos_token_id, best_toks)
+            return jnp.concatenate([ids, best_toks.astype(ids.dtype)], axis=1)
+
+        try:
+            amp = _amp
+            amp_key = ((str(amp.dtype), amp.level, frozenset(amp.white),
+                        frozenset(amp.black)) if amp is not None else None)
+            cache_key = ("beam", b, prompt, max_new_tokens, K,
+                         float(length_penalty), eos_token_id, amp_key,
+                         str(cache_dtype))
+            jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
+            fn = jit_cache.get(cache_key)
+            if fn is None:
+                fn = jit_cache[cache_key] = jax.jit(run)
+            out = fn(params, ids)
         finally:
             if was_training:
                 self.train()

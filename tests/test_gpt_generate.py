@@ -117,3 +117,88 @@ def test_generate_under_amp_caches_separately():
     assert len(m._generate_jit_cache) == 2
     # prompts are echoed verbatim either way
     np.testing.assert_array_equal(out_bf16.numpy()[:, :8], ids.numpy())
+
+
+# ---- round 4: beam search (one-scan, beam dim in the KV cache) -------------
+def test_beam_search_beats_or_matches_greedy_logprob():
+    """Beam-K's selected sequence must score >= greedy's under the model's
+    own sequence log-probability (the defining property of beam search),
+    verified with an independent full-forward log-prob oracle."""
+    import scipy.special as sp
+
+    cfg = gpt_tiny()
+    paddle.seed(0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 8)).astype(np.int64)
+    pt = paddle.to_tensor(ids)
+    greedy = m.generate(pt, max_new_tokens=6, temperature=0).numpy()
+    beam = m.generate(pt, max_new_tokens=6, decode_strategy="beam_search",
+                      num_beams=4).numpy()
+    assert beam.shape == greedy.shape == (2, 14)
+    assert (beam[:, :8] == ids).all()
+
+    def seq_logprob(full):
+        logits = m.logits(paddle.to_tensor(full[None, :-1])).numpy()[0]
+        lp = 0.0
+        for t in range(7, full.shape[0] - 1):
+            lp += (logits[t] - sp.logsumexp(logits[t]))[full[t + 1]]
+        return lp
+
+    for r in range(2):
+        assert seq_logprob(beam[r]) >= seq_logprob(greedy[r]) - 1e-4
+
+
+def test_beam_search_eos_freezes_and_pads():
+    cfg = gpt_tiny()
+    paddle.seed(0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    ids = np.random.RandomState(1).randint(
+        0, cfg.vocab_size, (2, 8)).astype(np.int64)
+    pt = paddle.to_tensor(ids)
+    probe = m.generate(pt, max_new_tokens=6, temperature=0).numpy()
+    eos = int(probe[0, 9])
+    out = m.generate(pt, max_new_tokens=6, num_beams=3,
+                     eos_token_id=eos).numpy()
+    for row in out[:, 8:]:
+        lst = row.tolist()
+        if eos in lst:
+            i = lst.index(eos)
+            assert all(x == eos for x in lst[i:]), lst
+
+
+def test_generate_decode_strategy_routing():
+    cfg = gpt_tiny()
+    paddle.seed(0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    ids = np.random.RandomState(2).randint(
+        0, cfg.vocab_size, (1, 8)).astype(np.int64)
+    pt = paddle.to_tensor(ids)
+    g1 = m.generate(pt, max_new_tokens=4, temperature=0).numpy()
+    g2 = m.generate(pt, max_new_tokens=4,
+                    decode_strategy="greedy_search").numpy()
+    np.testing.assert_array_equal(g1, g2)
+    with pytest.raises(ValueError, match="decode_strategy"):
+        m.generate(pt, max_new_tokens=4, decode_strategy="nope")
+
+
+def test_generate_beam_routing_validation():
+    # round-4 review: explicit non-beam strategy must not be silently
+    # overridden by num_beams, and beam_search rejects num_beams < 2
+    cfg = gpt_tiny()
+    paddle.seed(0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    pt = paddle.to_tensor(np.random.RandomState(3).randint(
+        0, cfg.vocab_size, (1, 8)).astype(np.int64))
+    with pytest.raises(ValueError, match="conflicts"):
+        m.generate(pt, max_new_tokens=4, decode_strategy="greedy_search",
+                   num_beams=4)
+    with pytest.raises(ValueError, match="num_beams >= 2"):
+        m.generate(pt, max_new_tokens=4, decode_strategy="beam_search",
+                   num_beams=1)
+    with pytest.raises(ValueError, match="decode_strategy"):
+        m.generate(pt, max_new_tokens=4, decode_strategy="typo", num_beams=2)

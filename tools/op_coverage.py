@@ -363,6 +363,10 @@ LEGACY_EQUIV = {
     "fill_diagonal": "dotted:Tensor.fill_diagonal_",
     "fill_diagonal_tensor": "dotted:Tensor.fill_diagonal_tensor_",
     "fake_dequantize_max_abs": "file:paddle_tpu/incubate/quantization.py",
+    # decode-loop machinery: generate()/generate_beam own the loop as ONE
+    # jitted scan (beam dim in the KV cache, top-k over K*V, cache reorder)
+    "beam_search": "file:paddle_tpu/models/gpt.py",
+    "beam_search_decode": "file:paddle_tpu/models/gpt.py",
     # GNN sampling -> the C++ graph table's sample/degree/feature RPCs
     "graph_khop_sampler": "file:paddle_tpu/core/native/ps_table.cc",
     "graph_reindex": "file:paddle_tpu/core/native/ps_table.cc",
@@ -405,11 +409,6 @@ LEGACY_WAIVED = {
     "split_lod_tensor": "LoD container op",
     "reorder_lod_tensor_by_rank": "LoD container op",
     "tensor_array_to_tensor": "TensorArray stacking; lax.scan stacks carries",
-    # decode-loop machinery: generate() owns the loop (models/gpt.py:420)
-    "beam_search": "decode-loop kernel; generate()'s scan owns decoding "
-                   "(greedy/top-k/top-p); beam kept out until a model needs "
-                   "it",
-    "beam_search_decode": "same decode-loop machinery",
     "ctc_align": "CTC post-processing; host-side numpy is the right tool",
     # fluid-era fused/specialized CPU kernels, composable from primitives
     "attention_lstm": "fused CPU attention-LSTM; compose nn.LSTM + attention",
