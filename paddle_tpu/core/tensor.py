@@ -149,6 +149,16 @@ class Tensor:
         a = np.asarray(self._data)
         return a.astype(dtype) if dtype is not None else a
 
+    # DLPack producer protocol (utils/dlpack.py; reference
+    # python/paddle/utils/dlpack.py:26): jax arrays speak DLPack natively,
+    # so torch.from_dlpack(t) / np.from_dlpack(t) import zero-copy on a
+    # shared device
+    def __dlpack__(self, *args, **kwargs):
+        return self._data.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
     # ---- device movement ----
     def to(self, device=None, dtype=None, blocking=None):
         t = self

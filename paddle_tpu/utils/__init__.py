@@ -10,6 +10,7 @@ import warnings
 
 from . import cpp_extension  # noqa: F401
 from .cpp_extension import custom_op, register_custom_op  # noqa: F401
+from . import dlpack  # noqa: F401
 from . import unique_name  # noqa: F401
 
 

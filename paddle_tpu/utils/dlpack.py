@@ -1,0 +1,122 @@
+"""paddle.utils.dlpack: zero-copy tensor interchange via the DLPack protocol.
+
+Reference: python/paddle/utils/dlpack.py:26,62 (to_dlpack/from_dlpack over
+LoDTensor._to_dlpack / from_dlpack capsules). TPU-native design: jax arrays
+already speak DLPack natively (``__dlpack__`` / ``jax.dlpack``), so the
+exchange object IS the jax array — `to_dlpack` returns a capsule for legacy
+consumers, and `from_dlpack` accepts anything exporting ``__dlpack__``
+(numpy, torch, jax, cupy) or a raw capsule. On CPU the import is zero-copy;
+across devices (e.g. torch-cpu -> TPU HBM) jax falls back to a copy, which
+matches the reference's cross-device semantics.
+"""
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a paddle Tensor as a DLPack capsule.
+
+    The capsule follows the standard lifetime rules: consume it exactly once
+    (``from_dlpack``), after which it is renamed "used_dltensor" and owned by
+    the consumer. Prefer passing the Tensor itself to modern consumers —
+    ``torch.from_dlpack(t)`` / ``np.from_dlpack(t)`` work directly because
+    Tensor forwards ``__dlpack__``.
+    """
+    from ..core.tensor import Tensor
+
+    if not isinstance(x, Tensor):
+        raise TypeError(
+            f"The type of 'x' in to_dlpack must be paddle Tensor, got "
+            f"{type(x)}")
+    return x._data.__dlpack__()
+
+
+def from_dlpack(dlpack):
+    """Import a DLPack-compatible object (numpy/torch/jax array, a paddle
+    Tensor, or a legacy capsule from ``to_dlpack``) as a paddle Tensor.
+
+    Zero-copy when producer and consumer share a device + layout; otherwise
+    jax copies to the default device.
+    """
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if isinstance(dlpack, Tensor):
+        return Tensor(dlpack._data)
+    if hasattr(dlpack, "__dlpack__"):
+        return Tensor(_to_default_backend(jnp.from_dlpack(dlpack)))
+    # legacy path: a raw PyCapsule produced by to_dlpack / torch's
+    # to_dlpack. jax dropped direct capsule ingestion, so wrap the capsule
+    # in a one-shot protocol shim; the DLDevice is read straight from the
+    # DLManagedTensor header (void* data, then {i32 device_type, i32
+    # device_id} — the stable DLPack ABI).
+    type_name = type(dlpack).__name__
+    if type_name != "PyCapsule":
+        raise TypeError(
+            f"from_dlpack needs a DLPack-exporting object or capsule, got "
+            f"{type(dlpack)}")
+    return Tensor(_to_default_backend(jnp.from_dlpack(_CapsuleShim(dlpack))))
+
+
+def _to_default_backend(arr):
+    """Re-home an imported array on the default backend when the producer
+    lives elsewhere (e.g. torch-cpu capsule imported in a TPU process): the
+    import commits the array to the producer's device, and jax refuses mixed
+    -device math. Same-backend imports stay zero-copy."""
+    import jax
+
+    default = jax.devices()[0]
+    src = next(iter(arr.devices()))
+    if src.platform == default.platform:
+        return arr
+    return jax.device_put(arr, default)
+
+
+class _CapsuleShim:
+    """Adapts a legacy DLPack capsule to the modern __dlpack__ protocol.
+
+    The DLDevice (and the versioned-vs-legacy flavor) is parsed eagerly at
+    construction, while the capsule is guaranteed live — so
+    ``__dlpack_device__`` keeps answering after the one-shot ``__dlpack__``
+    hand-off consumed the capsule."""
+
+    def __init__(self, capsule):
+        import ctypes
+
+        api = ctypes.pythonapi
+        api.PyCapsule_GetPointer.restype = ctypes.c_void_p
+        api.PyCapsule_GetPointer.argtypes = [ctypes.py_object, ctypes.c_char_p]
+        ptr, versioned = None, False
+        for name in (b"dltensor", b"dltensor_versioned"):
+            try:
+                ptr = api.PyCapsule_GetPointer(capsule, name)
+                versioned = name.endswith(b"versioned")
+                break
+            except ValueError:
+                ctypes.pythonapi.PyErr_Clear()
+        if not ptr:
+            raise ValueError("not a DLPack capsule")
+        # DLManagedTensorVersioned prepends {DLPackVersion (2*u32), void*
+        # manager_ctx, void* deleter, u64 flags} before the DLTensor
+        base = ptr + (8 + 8 + 8 + 8 if versioned else 0)
+        dev = (ctypes.c_int32 * 2).from_address(base + 8)  # after void* data
+        self._device = (int(dev[0]), int(dev[1]))
+        self._versioned = versioned
+        self._capsule = capsule
+
+    def __dlpack__(self, *args, **kwargs):
+        cap, self._capsule = self._capsule, None
+        if cap is None:
+            raise RuntimeError("DLPack capsule already consumed")
+        if self._versioned and kwargs.get("max_version") is None:
+            # the consumer negotiated for a legacy 'dltensor' capsule; the
+            # one we hold is versioned and cannot be downgraded in place
+            raise BufferError(
+                "producer capsule is DLPack-versioned but the consumer "
+                "requested the legacy format")
+        return cap
+
+    def __dlpack_device__(self):
+        return self._device

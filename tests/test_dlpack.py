@@ -1,0 +1,59 @@
+"""paddle.utils.dlpack interchange (reference python/paddle/utils/dlpack.py:26,62).
+
+Round-trips paddle <-> numpy <-> torch through the DLPack protocol, both the
+modern __dlpack__ object path and the legacy capsule path.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+
+
+class TestDlpack:
+    def test_capsule_round_trip(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        cap = to_dlpack(x)
+        assert type(cap).__name__ == "PyCapsule"
+        y = from_dlpack(cap)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+        assert y.dtype == x.dtype
+
+    def test_from_numpy_zero_copy_protocol(self):
+        a = np.arange(6, dtype=np.int32).reshape(2, 3)
+        t = from_dlpack(a)
+        np.testing.assert_array_equal(t.numpy(), a)
+        assert str(t.dtype).endswith("int32")
+
+    def test_numpy_imports_paddle_tensor(self):
+        x = paddle.to_tensor(np.ones((4,), np.float32) * 3)
+        back = np.from_dlpack(x)  # Tensor.__dlpack__ producer path
+        np.testing.assert_array_equal(back, x.numpy())
+
+    def test_torch_round_trip(self):
+        torch = pytest.importorskip("torch")
+        src = torch.arange(8, dtype=torch.float32).reshape(2, 4)
+        t = from_dlpack(src)
+        np.testing.assert_array_equal(t.numpy(), src.numpy())
+        back = torch.from_dlpack(t)
+        np.testing.assert_array_equal(back.numpy(), t.numpy())
+
+    def test_torch_capsule_legacy_path(self):
+        torch = pytest.importorskip("torch")
+        cap = torch.utils.dlpack.to_dlpack(torch.ones(3, 3))
+        t = from_dlpack(cap)
+        np.testing.assert_array_equal(t.numpy(), np.ones((3, 3), np.float32))
+
+    def test_to_dlpack_type_error(self):
+        with pytest.raises(TypeError):
+            to_dlpack(np.zeros(3))
+
+    def test_from_dlpack_type_error(self):
+        with pytest.raises(TypeError):
+            from_dlpack("not a tensor")
+
+    def test_dtype_preservation(self):
+        for dt in (np.float32, np.float64, np.int64, np.uint8, np.bool_):
+            a = np.zeros((2, 2), dt)
+            t = from_dlpack(a)
+            assert t.numpy().dtype == dt, dt
