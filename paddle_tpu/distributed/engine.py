@@ -164,10 +164,12 @@ class TrainStepEngine:
         return NamedSharding(self.mesh, spec)
 
     # ---- step function construction ----
-    def _raw_step(self):
-        update = opt_funct.make_tree_update(
-            self.optimizer, {n: self._state_refs[n] for n in self._param_names})
-        clip = self.optimizer._grad_clip
+    def _build_compute_loss(self):
+        """(params, key, *batch) -> scalar loss: the EXACT forward trace the
+        fused step differentiates (sp scope, amp autocast, buffers, loss_fn
+        convention). Shared by _raw_step and analysis_loss so the planner's
+        policy-aware residual accounting can never trace a different program
+        than the one that trains."""
         model = self.model
         loss_fn = self.loss_fn
         num_model_inputs = self.num_model_inputs
@@ -178,12 +180,6 @@ class TrainStepEngine:
 
         from .meta_parallel.sequence_parallel import sequence_parallel_scope
 
-        # grads are pinned to the opt-state specs when ZeRO is active (plain
-        # partition specs — the offload memory kind must NOT ride along:
-        # grads live in HBM, only the persistent state is host-resident)
-        zero_specs = (self.opt_specs
-                      if self.hcg.degrees["sharding"] > 1 else None)
-        param_specs_c = self.param_specs
         sp_deg = self.hcg.degrees["sp"]
         # default matches DistributedStrategy.sep_impl: Ulysses wins on the
         # XLA cost model at moderate seq (BASELINE.md); ring for seq >> 100k
@@ -207,26 +203,53 @@ class TrainStepEngine:
 
             return amp_guard_from_configs(amp_cfg, force_bf16=True)
 
-        def step(params, opt_state, lr, step_i, key, *batch):
-            def compute_loss(ps):
-                state = dict(ps)
-                for bn in buffer_names:
-                    state[bn] = buffers[bn]
-                sp_ctx = (sequence_parallel_scope(mesh, "sp", sp_impl)
-                          if sp_deg > 1 else contextlib.nullcontext())
-                with sp_ctx, _amp_ctx(), random_mod.trace_key_scope(key):
-                    inputs = [Tensor(b, stop_gradient=True) for b in batch]
-                    if loss_fn is None:
-                        out = functional_call(model, state, *inputs)
-                    else:
-                        n_in = model_input_count(len(inputs), num_model_inputs)
-                        out = functional_call(model, state, *inputs[:n_in])
-                        outs = out if isinstance(out, (tuple, list)) else (out,)
-                        out = loss_fn(*outs, *inputs[n_in:])
-                loss = out[0] if isinstance(out, (tuple, list)) else out
-                return loss._data if isinstance(loss, Tensor) else loss
+        def compute_loss(ps, key, *batch):
+            state = dict(ps)
+            for bn in buffer_names:
+                state[bn] = buffers[bn]
+            sp_ctx = (sequence_parallel_scope(mesh, "sp", sp_impl)
+                      if sp_deg > 1 else contextlib.nullcontext())
+            with sp_ctx, _amp_ctx(), random_mod.trace_key_scope(key):
+                inputs = [Tensor(b, stop_gradient=True) for b in batch]
+                if loss_fn is None:
+                    out = functional_call(model, state, *inputs)
+                else:
+                    n_in = model_input_count(len(inputs), num_model_inputs)
+                    out = functional_call(model, state, *inputs[:n_in])
+                    outs = out if isinstance(out, (tuple, list)) else (out,)
+                    out = loss_fn(*outs, *inputs[n_in:])
+            loss = out[0] if isinstance(out, (tuple, list)) else out
+            return loss._data if isinstance(loss, Tensor) else loss
 
-            loss, grads = jax.value_and_grad(compute_loss)(params)
+        return compute_loss
+
+    def analysis_loss(self, *batch):
+        """Pure params -> scalar loss over a fixed batch, tracing the same
+        program step() differentiates. For trace-level analyses only (e.g.
+        the planner's jax saved_residuals remat accounting) — nothing is
+        compiled or executed, training state is untouched."""
+        compute = self._build_compute_loss()
+        arrays = self._to_arrays(batch)
+        key = jax.random.key(0)
+        return lambda params: compute(params, key, *arrays)
+
+    def _raw_step(self):
+        update = opt_funct.make_tree_update(
+            self.optimizer, {n: self._state_refs[n] for n in self._param_names})
+        clip = self.optimizer._grad_clip
+        compute = self._build_compute_loss()
+
+        # grads are pinned to the opt-state specs when ZeRO is active (plain
+        # partition specs — the offload memory kind must NOT ride along:
+        # grads live in HBM, only the persistent state is host-resident)
+        zero_specs = (self.opt_specs
+                      if self.hcg.degrees["sharding"] > 1 else None)
+        param_specs_c = self.param_specs
+        mesh = self.mesh
+
+        def step(params, opt_state, lr, step_i, key, *batch):
+            loss, grads = jax.value_and_grad(
+                lambda ps: compute(ps, key, *batch))(params)
             if zero_specs is not None:
                 # ZeRO stage-1/2 boundary (reference group_sharded_optimizer_
                 # stage2.py:48 semantics), in TWO chained constraints:
