@@ -36,6 +36,11 @@ class AlgorithmsCache:
         self._map: Dict[str, Dict[str, Any]] = {}
         self.hits = 0
         self.misses = 0
+        # found-via-peek counter, SEPARATE from hits/misses: peek must not
+        # skew cache_hit_rate (tuning-health telemetry), but consumers of a
+        # preloaded cache (bench provenance) still need to know whether any
+        # tuned choice was actually consulted
+        self.peek_hits = 0
 
     @staticmethod
     def _k(kernel: str, key: Tuple) -> Tuple[str, str]:
@@ -56,7 +61,10 @@ class AlgorithmsCache:
         k1, k2 = self._k(kernel, key)
         with _lock:
             sub = self._map.get(k1)
-            return sub.get(k2) if sub is not None else None
+            got = sub.get(k2) if sub is not None else None
+            if got is not None:
+                self.peek_hits += 1
+            return got
 
     def put(self, kernel: str, key: Tuple, choice):
         k1, k2 = self._k(kernel, key)
@@ -171,8 +179,25 @@ def set_step(step: int):
     if path and not _saved and step >= hi and _cache.size():
         # save at the window's last step, not one past it: a job that stops
         # exactly at tuning_stop must still persist its choices
+        flush()
+
+
+def flush(path: Optional[str] = None) -> bool:
+    """Persist the cache NOW (e.g. a bench run whose step count never
+    reaches the window end). Read-only checkouts are tolerated the way
+    bench history is: measuring beats recording, and the failed attempt is
+    not retried every subsequent step."""
+    global _saved
+    path = path or _config["cache_path"]
+    if not path or not _cache.size():
+        return False
+    try:
         _cache.save(path)
         _saved = True
+        return True
+    except OSError:
+        _saved = True  # don't re-attempt (and re-raise) on every step
+        return False
 
 
 def _in_window() -> bool:

@@ -132,7 +132,7 @@ def measured_tokens(path, seq):
             # bench.py treats ANY non-empty env value as knob-ON (even "0"),
             # so any recorded value disqualifies the row as a plain variant
             if any(ex.get(k) for k in ("scan", "pallas_ln", "pallas_loss",
-                                       "autotune")):
+                                       "autotune", "autotune_cache_loaded")):
                 continue
             rec = ex.get("recompute")
             if rec not in (None, "", False, "selective"):
