@@ -1,0 +1,35 @@
+"""Shared timing helpers for the on-chip probe tools.
+
+The one subtle part is sync(): ending a timed region needs BOTH
+  - jax.block_until_ready — drains every shard on every device (a D2H fetch
+    of one element only proves the queue of the device that served it), and
+  - a D2H fetch of one scalar — through the remote-PJRT tunnel
+    block_until_ready can return before the device work actually drains
+    (bench.py ends its timed regions with .item() for the same reason; the
+    round-5 first step_breakdown run reported a physically impossible
+    8,957 TFLOP/s before this was added).
+Single-device through the tunnel the fetch does the work; multi-device on
+the virtual CPU mesh block_until_ready does; together they cover both.
+"""
+from __future__ import annotations
+
+import time
+
+
+def sync(out):
+    import jax
+
+    jax.block_until_ready(out)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    jax.device_get(leaf.ravel()[:1])
+
+
+def timeit(fn, args=(), iters=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / iters

@@ -21,22 +21,10 @@ obvious. Usage: python tools/step_breakdown.py [--model base|medium]
 [--batch N]. Writes one JSON line per region.
 """
 import json
-import time
 
 import _bootstrap  # noqa: F401  (repo-root sys.path)
 
-
-def timeit(fn, args, iters=10, warmup=2):
-    import jax
-
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+from _timing import timeit  # tunnel-safe sync; see tools/_timing.py
 
 
 def main():
