@@ -30,13 +30,16 @@ def test_measured_tokens_clean_join(tmp_path):
         _row(130.0, recompute="selective"),            # b16_selective
         _row(999.0, pallas_ln="1"),                    # kernel variant: skip
         _row(888.0, scan="1"),                         # scan trainer: skip
+        _row(115.0, autotune="1"),                     # tuned flash blocks:
+        _row(104.0, autotune_cache_loaded=True),       # ACCEPTED since r5 —
+        # the committed cache makes tuned blocks the default program
         _row(777.0, seq=4096),                         # wrong seq: skip
         _row(666.0, devices=8),                        # multi-device: skip
         _row(120.0, ce_chunk="4096"),                  # ce4096_b16
         _row(110.0),                                   # best-per-tag max
     ])
     got = pv.measured_tokens(path, 1024)
-    assert got == {"b16": 110.0, "b16_selective": 130.0,
+    assert got == {"b16": 115.0, "b16_selective": 130.0,
                    "ce4096_b16": 120.0}, got
 
 
@@ -126,3 +129,43 @@ def test_planner_budget_gate_uses_corrected_peak():
     r3 = P.score_topology(mk, mko, batch, {"dp_degree": 1},
                           memory_budget=safety // 2)
     assert not r3.feasible
+
+
+def test_replay_correction_separates_remat_variants():
+    """Round-5 correction: the raw AOT score under-prices selective remat
+    (~1.5% apart vs ~15% measured on chip); the replay term — 2x the
+    saved-residual delta vs the plain twin — must push the corrected score
+    of the remat variant strictly above its twin's, while non-remat
+    variants keep their raw score."""
+    import plan_validate as pv
+
+    m_plain = pv.score_variant({"tag": "b16", "batch": 16}, 256, quick=True)
+    m_sel = pv.score_variant(
+        {"tag": "b16_selective", "batch": 16, "recompute": "selective"},
+        256, quick=True)
+    rows = [
+        {"tag": "b16", "score": m_plain["score"],
+         "residual_bytes": m_plain["residual_bytes"]},
+        {"tag": "b16_selective", "score": m_sel["score"],
+         "residual_bytes": m_sel["residual_bytes"]},
+    ]
+    pv.apply_replay_correction(rows, 256)
+    plain, sel = rows
+    assert plain["score_corrected"] == plain["score"]
+    expected = m_sel["score"] + 2 * (m_plain["residual_bytes"]
+                                     - m_sel["residual_bytes"])
+    assert sel["score_corrected"] == expected
+    # the whole point: corrected, the remat variant prices its replay
+    assert sel["score_corrected"] > plain["score_corrected"]
+    # per-token prediction follows the corrected score
+    assert sel["pred_tokens_per_s_rel_corrected"] < \
+        plain["pred_tokens_per_s_rel_corrected"]
+
+
+def test_replay_correction_survives_missing_residuals():
+    import plan_validate as pv
+
+    rows = [{"tag": "b32", "score": 100.0, "residual_bytes": None},
+            {"tag": "b32_selective", "score": 101.0, "residual_bytes": None}]
+    pv.apply_replay_correction(rows, 1024)
+    assert [r["score_corrected"] for r in rows] == [100.0, 101.0]

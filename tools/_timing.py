@@ -25,9 +25,11 @@ def sync(out):
 
 
 def timeit(fn, args=(), iters=10, warmup=2):
+    out = None
     for _ in range(warmup):
         out = fn(*args)
-    sync(out)
+    if out is not None:  # warmup=0: caller accepts compile time in the timing
+        sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)

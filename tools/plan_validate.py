@@ -8,9 +8,13 @@ selective recompute, fused-CE chunk). This tool:
   1. AOT-compiles the bench-config GPT train step per variant (virtual CPU
      device; nothing executes) and reads the XLA cost model
      (auto_parallel/planner.score_compiled);
-  2. predicts tokens/s up to a constant: tokens_per_step / time_proxy;
+  2. predicts tokens/s up to a constant: tokens_per_step / time_proxy —
+     twice: from the raw AOT score (the pre-registered model) and from the
+     remat-replay-corrected score (round 5; see the correction comment in
+     main());
   3. with --measured BENCH_HISTORY.jsonl, joins measured tokens/s by tag
-     and reports the pairwise rank agreement.
+     and reports the pairwise rank agreement for both models, plus the
+     corrected model's miss pairs with their measured margins.
 
 The scan-trainer variant is deliberately OUT of scope: its win is dispatch
 overlap across steps, invisible to a per-program cost model — predicting it
@@ -95,12 +99,45 @@ def score_variant(v, seq, quick):
         res_b = saved_residual_bytes(eng.analysis_loss(ids, labels),
                                      eng.params)
         m["peak_policy_bytes"] = policy_peak_bytes(m, res_b)
+        m["residual_bytes"] = res_b
     except Exception as e:
         m["peak_policy_bytes"] = None
+        m["residual_bytes"] = None
         print(f"# residual analysis failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     paddle.set_flags({"fused_ce_chunk": 0})
     return m
+
+
+def apply_replay_correction(rows, seq):
+    """Remat-replay corrected score (round 5, POST-HOC — the pre-registered
+    table in BASELINE.md stands as committed; this corrected model's
+    falsifiable content is for configs measured after it). The round-5
+    on-chip rows showed selective remat costing ~15% measured throughput
+    while the AOT score separated the variants by only ~1.5%: XLA's
+    CPU-target AOT cost_analysis barely surfaces the backward-pass replay.
+    The missing term is HBM traffic: every residual the policy chooses NOT
+    to save is recomputed in backward — written once and read once (2x its
+    bytes). That byte count is exactly the saved-residual delta between the
+    plain twin and the policy variant, which the round-4 policy-peak
+    machinery already traces — so the correction introduces no new fit
+    constants. Mutates each row in place: adds score_corrected and
+    pred_tokens_per_s_rel_corrected (equal to the raw values for non-remat
+    variants or when either residual trace failed)."""
+    by_tag = {r["tag"]: r for r in rows}
+    batches = {v["tag"]: v["batch"] for v in VARIANTS}
+    for r in rows:
+        r["score_corrected"] = r["score"]
+        if r["tag"].endswith("_selective"):
+            twin = by_tag.get(r["tag"][: -len("_selective")])
+            if (twin and r.get("residual_bytes") is not None
+                    and twin.get("residual_bytes") is not None):
+                replay = 2 * max(0, twin["residual_bytes"]
+                                 - r["residual_bytes"])
+                r["score_corrected"] = r["score"] + replay
+        batch = r.get("batch") or batches[r["tag"]]
+        r["pred_tokens_per_s_rel_corrected"] = \
+            batch * seq / r["score_corrected"]
 
 
 def measured_tokens(path, seq):
@@ -108,11 +145,13 @@ def measured_tokens(path, seq):
     tag is DERIVED from the recorded variant knobs so it matches VARIANTS:
     b<batch>[_selective], or ce<chunk>_b<batch>. Rows that are NOT clean
     joins are skipped: scan-trainer runs (dispatch overlap is out of the
-    cost model's scope), Pallas kernel variants (pallas_ln/loss/autotune),
+    cost model's scope), Pallas kernel variants (pallas_ln/loss),
     full/boolean recompute (a different program than the prediction —
     round 3's b32 only ran WITH recompute, which is the point: the
     predicted-fastest config was the one that couldn't run plain), wrong
-    seq, and multi-device rows."""
+    seq, and multi-device rows. Autotuned-flash rows ARE admitted (round
+    5): the committed .autotune_cache.json makes tuned blocks the default
+    program every bench run executes."""
     out = {}
     with open(path) as f:
         for ln in f:
@@ -130,9 +169,14 @@ def measured_tokens(path, seq):
                     or ex.get("layers") not in (12, None):
                 continue  # a medium-model row must not join base predictions
             # bench.py treats ANY non-empty env value as knob-ON (even "0"),
-            # so any recorded value disqualifies the row as a plain variant
-            if any(ex.get(k) for k in ("scan", "pallas_ln", "pallas_loss",
-                                       "autotune", "autotune_cache_loaded")):
+            # so any recorded value disqualifies the row as a plain variant.
+            # autotune rows are NOT excluded (round 5): the tuned flash
+            # blocks are the committed-default program now that
+            # .autotune_cache.json ships with the repo — every future bench
+            # row loads it, and excluding them would freeze the measured
+            # join at the pre-cache rows. Structurally different programs
+            # (scan trainer, pallas kernel variants) stay out.
+            if any(ex.get(k) for k in ("scan", "pallas_ln", "pallas_loss")):
                 continue
             rec = ex.get("recompute")
             if rec not in (None, "", False, "selective"):
@@ -178,7 +222,9 @@ def main():
             continue
         m = score_variant(v, args.seq, args.quick)
         tokens = v["batch"] * args.seq
-        rows.append({"tag": v["tag"], "score": m["score"],
+        rows.append({"tag": v["tag"], "batch": v["batch"],
+                     "score": m["score"],
+                     "residual_bytes": m.get("residual_bytes"),
                      "peak_mb": round(m["peak_bytes"] / 1e6, 1),
                      "peak_policy_mb": (
                          round(m["peak_policy_bytes"] / 1e6, 1)
@@ -186,22 +232,43 @@ def main():
                      "pred_tokens_per_s_rel": tokens / m["score"]})
         print(json.dumps(rows[-1]), flush=True)
 
-    pred = sorted(rows, key=lambda r: -r["pred_tokens_per_s_rel"])
-    summary = {"predicted_rank": [r["tag"] for r in pred]}
+    apply_replay_correction(rows, args.seq)
+
+    def ranked(key):
+        return sorted(rows, key=lambda r: -r[key])
+
+    pred = ranked("pred_tokens_per_s_rel")
+    pred_c = ranked("pred_tokens_per_s_rel_corrected")
+    summary = {"predicted_rank": [r["tag"] for r in pred],
+               "predicted_rank_corrected": [r["tag"] for r in pred_c]}
     if args.measured:
         meas = measured_tokens(args.measured, args.seq)
-        # `both` is in predicted-rank order, so for each (a, b) pair the
-        # model predicts a >= b; agreement = the measurement concurring
-        both = [r["tag"] for r in pred if r["tag"] in meas]
-        agree = total = 0
-        for a, b in itertools.combinations(both, 2):
-            total += 1
-            agree += int(meas[a] >= meas[b])
+
+        def agreement(order):
+            # `order` is in predicted-rank order, so for each (a, b) pair
+            # the model predicts a >= b; agreement = measurement concurring
+            both = [r["tag"] for r in order if r["tag"] in meas]
+            agree = total = 0
+            misses = []
+            for a, b in itertools.combinations(both, 2):
+                total += 1
+                if meas[a] >= meas[b]:
+                    agree += 1
+                else:
+                    misses.append([a, b, round(meas[b] / meas[a] - 1, 4)])
+            return both, (round(agree / total, 3) if total else None), \
+                total, misses
+
+        both, pw, total, misses = agreement(pred)
+        _, pw_c, _, misses_c = agreement(pred_c)
         summary.update({
             "measured_tags": both,
             "measured_rank": sorted(both, key=lambda t: -meas[t]),
-            "pairwise_agreement": round(agree / total, 3) if total else None,
-            "pairs": total})
+            "pairwise_agreement": pw,
+            "pairwise_agreement_corrected": pw_c,
+            "pairs": total,
+            # each miss: [predicted-faster, measured-faster, measured margin]
+            "miss_pairs_corrected": misses_c})
     print(json.dumps(summary), flush=True)
     return 0
 
