@@ -369,8 +369,22 @@ def _tuned_blocks(bh, sq, sk, d, dtype, sm_scale, causal):
         return cached
     if not autotune.should_tune():  # closed window / multi-controller: no timing
         return default
-    candidates = sorted({(q_, k_) for q_ in (512, 256, 128) for k_ in (512, 256, 128)
-                         if sq % q_ == 0 and sk % k_ == 0}) or [default]
+    # 1024 joins the space only where the BACKWARD working set fits: the
+    # tuned choice is shared with the bwd kernels (the tuner times fwd
+    # only), whose bodies hold ~4 score-sized f32 intermediates
+    # (s/p/dp/ds) — so the guard budgets 4 * bq * bk * 4 B <= 8 MB of
+    # v5e's 16 MB VMEM, admitting (512,1024)/(1024,512) but not
+    # (1024,1024), whose ~16 MB bwd set would spill or fail Mosaic. At
+    # the bench shape (seq 1024) the {128,256,512} space degenerated to
+    # the heuristic's own choice — the tuned [512,512] equaled
+    # pick_block's default, so the round-5 "autotune win" was run-to-run
+    # variance; the 1024-rect blocks are the first candidates the
+    # heuristic cannot reach.
+    candidates = sorted({(q_, k_)
+                         for q_ in (1024, 512, 256, 128)
+                         for k_ in (1024, 512, 256, 128)
+                         if sq % q_ == 0 and sk % k_ == 0
+                         and 4 * q_ * k_ * 4 <= (8 << 20)}) or [default]
     if len(candidates) == 1:
         return candidates[0]
 
