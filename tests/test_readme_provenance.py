@@ -1,0 +1,53 @@
+"""Every throughput number README quotes must be a committed BENCH_HISTORY row.
+
+VERDICT r4 #10: "a reader can reproduce every number in README from
+committed tools". This pins the mechanical half of that promise — the
+quoted tok/s figures are exact `value` / `extra.decode_tokens_per_sec`
+fields of BENCH_HISTORY.jsonl rows, so README cannot drift into
+aspirational numbers without this test failing. (The MFU/bandwidth
+readings live in BASELINE.md tables next to the tool that produced them;
+the tok/s figures are the ones a reader will try to reproduce first.)
+"""
+import json
+import os
+import re
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _history_values():
+    vals = set()
+    with open(os.path.join(ROOT, "BENCH_HISTORY.jsonl")) as f:
+        for ln in f:
+            try:
+                row = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            v = row.get("value")
+            if isinstance(v, (int, float)):
+                vals.add(round(float(v), 1))
+            d = (row.get("extra") or {}).get("decode_tokens_per_sec")
+            if isinstance(d, (int, float)):
+                vals.add(round(float(d), 1))
+    return vals
+
+
+def test_readme_round5_numbers_are_committed_history_rows():
+    readme = open(os.path.join(ROOT, "README.md")).read()
+    m = re.search(r"Round-5 on-chip results(.*?)\n## ", readme, re.S)
+    assert m, "README round-5 results section not found"
+    section = m.group(1)
+    # quoted figures: thousands-separated numbers with or without decimals
+    # (94,683.7 AND a rounded 95,000 must both be backed); plain unseparated
+    # integers like '16 GB' / years and bracketed block pairs like
+    # [512,512] are out of scope
+    quoted = {float(x.replace(",", ""))
+              for x in re.findall(
+                  r"(?<!\[)\b(\d{1,3}(?:,\d{3})+(?:\.\d+)?)\b(?!\])",
+                  section)}
+    assert quoted, "no quoted tok/s figures found in the round-5 section"
+    hist = _history_values()
+    missing = {q for q in quoted if round(q, 1) not in hist}
+    assert not missing, (
+        f"README quotes figures with no committed BENCH_HISTORY row: "
+        f"{sorted(missing)}")
