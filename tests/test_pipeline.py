@@ -168,9 +168,12 @@ def test_interleaved_schedule_beats_stacking():
         assert slots <= P_  # bounded activation buffering
 
 
-def test_interleaved_pipeline_matches_logical_stage_composition():
+@pytest.mark.parametrize("P_,V", [(4, 2), (2, 4)])
+def test_interleaved_pipeline_matches_logical_stage_composition(P_, V):
     """spmd_pipeline_interleaved == running the V*P logical stages in
-    sequence — forward AND gradients (AD replays the mirrored schedule)."""
+    sequence — forward AND gradients (AD replays the mirrored schedule).
+    (2, 4) is the deep-interleave shape the driver dryrun certifies at
+    8 devices: backward-through-the-buffered-schedule at V > 2."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -178,7 +181,7 @@ def test_interleaved_pipeline_matches_logical_stage_composition():
     from paddle_tpu.distributed.pipeline_schedule import \
         spmd_pipeline_interleaved
 
-    P_, V, M, D = 4, 2, 8, 16
+    M, D = 8, 16
     mesh = Mesh(np.array(jax.devices()[:P_]), ("pp",))
     rng = np.random.RandomState(0)
     params = {"w": jnp.asarray(rng.randn(V, P_, D, D).astype("float32")) * 0.3,
