@@ -95,15 +95,9 @@ def main():
     elif os.path.exists(autotune_cache):
         paddle.incubate.autotune.set_config({"cache_path": autotune_cache})
         autotune_preloaded = True
-    if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LOSS"):  # online LM-loss kernel
-        # compute block 256 by default: the 1024-block variant's Mosaic
-        # compile exceeded 9.5 min on chip (BASELINE.md round 3)
-        paddle.set_flags({
-            "use_pallas_lm_loss": True,
-            "pallas_lm_loss_block_n": int(os.environ.get(
-                "PADDLE_TPU_BENCH_PALLAS_LOSS_BLOCK", "256"))})
-    if os.environ.get("PADDLE_TPU_BENCH_PALLAS_LN"):  # fused LayerNorm kernel
-        paddle.set_flags({"use_pallas_layernorm": True})
+    # PADDLE_TPU_BENCH_PALLAS_LOSS / _PALLAS_LN knobs removed in round 5:
+    # both kernels are retired from the training path (BASELINE.md round-5
+    # retirement note); the flags they set no longer exist.
     if os.environ.get("PADDLE_TPU_BENCH_CE_CHUNK"):  # rows per fused-CE step
         paddle.set_flags({"fused_ce_chunk":
                           int(os.environ["PADDLE_TPU_BENCH_CE_CHUNK"])})
@@ -189,8 +183,7 @@ def main():
         # both copies in HBM. Infra failures (tunnel, OOM) fail here too and
         # surface as a bench error; the tag names the original exception so a
         # degraded number is never mistaken for the tuned one.
-        paddle.set_flags({"use_flash_attention": False,
-                          "use_pallas_lm_loss": False})
+        paddle.set_flags({"use_flash_attention": False})
         n_params, final_loss, dt = run_once()
         degraded = "+".join(filter(None, [
             degraded, f"pallas_disabled_after_{first_error}"]))
@@ -261,8 +254,9 @@ def main():
             "recompute": os.environ.get("PADDLE_TPU_BENCH_RECOMPUTE"),
             "scan": os.environ.get("PADDLE_TPU_BENCH_SCAN"),
             "ce_chunk": os.environ.get("PADDLE_TPU_BENCH_CE_CHUNK"),
-            "pallas_ln": os.environ.get("PADDLE_TPU_BENCH_PALLAS_LN"),
-            "pallas_loss": os.environ.get("PADDLE_TPU_BENCH_PALLAS_LOSS"),
+            # pallas_ln / pallas_loss knobs retired in round 5: no longer
+            # recorded — a stale env var must not mislabel a default run as
+            # a kernel variant (historical rows keep their fields)
             "autotune": os.environ.get("PADDLE_TPU_BENCH_AUTOTUNE"),
             "autotune_cache_loaded": _autotune_epilogue() or None,
         },
@@ -396,7 +390,7 @@ def _orchestrate():
     # completed attempt). PADDLE_TPU_BENCH_SWEEP=0 reverts to single-attempt.
     configs = [("default", {"PADDLE_TPU_BENCH_DECODE": "1"})]
     user_tuned = any(k in os.environ for k in (
-        "PADDLE_TPU_BENCH_BATCH", "PADDLE_TPU_BENCH_PALLAS_LOSS",
+        "PADDLE_TPU_BENCH_BATCH",
         "PADDLE_TPU_BENCH_AUTOTUNE", "PADDLE_TPU_BENCH_RECOMPUTE",
         "PADDLE_TPU_BENCH_SCAN", "PADDLE_TPU_BENCH_SEQ",
         "PADDLE_TPU_BENCH_MODEL"))

@@ -193,7 +193,7 @@ def _rule_key(name, kernel, arrays, attrs, diff_idx, cast_to):
     # changes instead CLEAR the cache via autotune.on_change (version-in-key
     # would orphan every op's rules on each new tuning)
     trace_flags = (flag("tpu_matmul_precision"), flag("use_flash_attention"),
-                   flag("use_autotune"), flag("use_pallas_lm_loss"),
+                   flag("use_autotune"),
                    flag("pallas_interpret_ok"), flag("fused_ce_chunk"))
     return (name, id(code), closure_vals, defaults, akey, sig,
             tuple(diff_idx), str(cast_to), trace_flags)
@@ -420,7 +420,7 @@ _autotune.on_change(_RULE_CACHE.clear)
 # clears the cache, so a future kernel reading a new flag at trace time can
 # never be served a stale trace (ADVICE r1)
 _TRACE_KEY_FLAGS = frozenset({"tpu_matmul_precision", "use_flash_attention",
-                              "use_autotune", "use_pallas_lm_loss",
+                              "use_autotune",
                               "pallas_interpret_ok", "fused_ce_chunk"})
 
 

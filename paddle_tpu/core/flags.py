@@ -79,11 +79,9 @@ define_flag("seed", 0, "global random seed")
 define_flag("apply_ir_passes", True, "run CSE/DCE/fuse passes before lowering static programs")
 define_flag("use_autotune", False, "enable kernel autotune (pallas block-size search + cache)")
 define_flag("enable_unused_var_check", False, "warn when an op kernel never reads a declared input")
-define_flag("use_pallas_lm_loss", False, "route fused LM loss to the online Pallas kernel")
-define_flag("pallas_lm_loss_block_n", 1024,
-            "row-block size of the Pallas LM-loss COMPUTE tiles (256/512/1024;"
-            " 1D operands stay on 1024-element blocks via revisit sub-slices)")
-define_flag("use_pallas_layernorm", False, "route layer_norm to the fused Pallas kernel")
+# use_pallas_lm_loss / pallas_lm_loss_block_n / use_pallas_layernorm were
+# RETIRED in round 5 (BASELINE.md): the kernels stay as direct-call library
+# ops in ops/pallas/, but nothing routes to them and no flag re-enables that.
 define_flag("fused_ce_chunk", 2048,
             "rows per scan step of the chunked fused LM-head cross-entropy "
             "(ops/fused.py). Each chunk re-reads the [V, H] head weight from "

@@ -32,20 +32,6 @@ from ._helpers import t_
 _CHUNK = 2048  # rows per scan step: chunk x vocab f32 logits = ~400 MB transient @ 50k vocab
 
 
-def _use_pallas(transpose_y) -> bool:
-    """Route to the online Pallas kernel (pallas/lm_loss.py): tied-embedding
-    layout only, gated by FLAGS_use_pallas_lm_loss (off until measured on
-    chip; interpret mode is test-only). Shape support is checked at the call
-    site via lm_loss.supported()."""
-    from ..core.flags import flag
-
-    if not flag("use_pallas_lm_loss") or not transpose_y:
-        return False
-    import jax
-
-    return jax.default_backend() == "tpu" or flag("pallas_interpret_ok")
-
-
 def _logits_chunk(hc, w, transpose_y):
     """[C, H] x W -> [C, V] f32 (W cast to the activation dtype for MXU rate)."""
     wc = w.astype(hc.dtype) if hc.dtype != w.dtype else w
@@ -143,23 +129,13 @@ def fused_linear_cross_entropy(hidden, weight, label, transpose_y=True,
         h2 = h.reshape(n, hdim)
         lb1 = lb.reshape(n).astype(jnp.int32)
 
-        if _use_pallas(transpose_y):
-            from .pallas.lm_loss import lm_head_cross_entropy, supported
-
-            pad = (-n) % 1024  # row tile = XLA's 1024-element 1D layout tile
-            npad = n + pad
-            if supported(npad, w.shape[0], hdim):
-                ignore = lb1 == ignore_index
-                safe = jnp.where(ignore, 0, lb1)
-                h2p = h2 if not pad else jnp.concatenate(
-                    [h2, jnp.zeros((pad, hdim), h2.dtype)], axis=0)
-                lbp = safe if not pad else jnp.concatenate(
-                    [safe, jnp.zeros((pad,), jnp.int32)], axis=0)
-                loss = lm_head_cross_entropy(h2p, w, lbp)[:n]
-                # where() routes zero cotangent into ignored rows' pallas grads
-                loss = jnp.where(ignore, 0.0, loss)
-                return loss.reshape(lead_shape)
-
+        # The online Pallas lm_loss kernel is RETIRED from this path
+        # (BASELINE.md round 5: its bench-vocab Mosaic compile exceeded
+        # 9.5 min and wedged the chip tunnel twice; the chunked scan below
+        # measures 91 TFLOP/s on chip — at the chip's achievable matmul
+        # ceiling, leaving the kernel no headroom to win). It remains a
+        # direct-call library kernel (ops/pallas/lm_loss.py) with its math
+        # pinned by tests/test_pallas_lm_loss.py.
         from ..core.flags import flag as _flag
 
         cfg_chunk = int(_flag("fused_ce_chunk") or _CHUNK)

@@ -52,7 +52,7 @@ def _pick_rows(n: int) -> int:
     return 1024 if n % 1024 == 0 and n >= 1024 else 0
 
 
-def _block_n() -> int:
+def _check_block_n(v: int) -> int:
     """COMPUTE row-block size (the 2D h/s tiles). The 1D operands always use
     1024-element blocks (_pick_rows); when block_n < 1024 each 1D block is
     revisited 1024//block_n consecutive row-steps via an i//pack index map
@@ -60,14 +60,11 @@ def _block_n() -> int:
     vector-op count of the kernel body (~block_n x block_v tiles): the
     round-3 on-chip probe is what this knob exists for — at 1024x512 the
     forward alone exceeded 9.5 min of Mosaic compile."""
-    from ...core.flags import flag
-
-    v = int(flag("pallas_lm_loss_block_n") or 1024)
+    v = int(v)
     if v not in (256, 512, 1024):
         raise ValueError(
-            f"FLAGS_pallas_lm_loss_block_n must be 256, 512 or 1024 (the 1D "
-            f"operands tile at 1024 and the compute block must divide it); "
-            f"got {v}")
+            f"block_n must be 256, 512 or 1024 (the 1D operands tile at "
+            f"1024 and the compute block must divide it); got {v}")
     return v
 
 
@@ -320,16 +317,17 @@ def _bwd_rule(block_n, block_v, v_true, res, g):
 _lm_loss.defvjp(_fwd_rule, _bwd_rule)
 
 
-def lm_head_cross_entropy(h2, w, labels):
+def lm_head_cross_entropy(h2, w, labels, block_n=1024):
     """h2 [N, H], w [V, H], labels [N] int32 (already ignore-masked to a safe
     index by the caller) -> per-row loss [N] f32. Caller guarantees
     supported(N, V, H). W is padded to a 512-multiple vocab internally (padded
     columns masked to NEG_INF; dW for them is zero and sliced off by autodiff
-    of the pad)."""
+    of the pad). RETIRED from the training path (BASELINE.md round 5): not
+    routed by ops/fused.py; available as a direct-call library kernel only."""
     n = h2.shape[0]
     v = w.shape[0]
-    assert _pick_rows(n) == 1024  # wrapper in ops/fused.py pads rows to 1024
-    block_n = _block_n()          # compute tile; FLAGS_pallas_lm_loss_block_n
+    assert _pick_rows(n) == 1024  # callers pad rows to a 1024 multiple
+    block_n = _check_block_n(block_n)
     vpad = (-v) % 512
     if vpad:
         w = jnp.concatenate(

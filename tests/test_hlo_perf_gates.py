@@ -130,46 +130,9 @@ def test_flash_attention_routes_to_pallas_when_flagged():
     assert "pallas_call" not in _flash_jaxpr()
 
 
-def test_layernorm_routes_to_pallas_when_flagged():
-    from paddle_tpu.ops import nn_functional as F
-
-    w = paddle.to_tensor(np.ones(256, "float32"))
-    b = paddle.to_tensor(np.zeros(256, "float32"))
-    x = jnp.zeros((64, 256), jnp.float32)
-
-    def trace():
-        # fresh function object per trace: jax's trace cache keys on the
-        # callable's identity, and the flag is a hidden trace-time input
-        def ln(xd):
-            return F.layer_norm(Tensor(xd), normalized_shape=[256],
-                                weight=w, bias=b)._data
-
-        return str(jax.make_jaxpr(ln)(x))
-
-    paddle.set_flags({"use_pallas_layernorm": True, "pallas_interpret_ok": True})
-    assert "pallas_call" in trace()
-    paddle.set_flags({"use_pallas_layernorm": False})
-    assert "pallas_call" not in trace()
-
-
-def test_lm_loss_routes_to_pallas_when_flagged():
-    from paddle_tpu.ops.fused import fused_linear_cross_entropy
-
-    h = paddle.to_tensor(np.zeros((512, 128), "float32"))
-    w = paddle.to_tensor(np.zeros((1024, 128), "float32"))
-    lab = paddle.to_tensor(np.zeros(512, "int64"))
-
-    def trace():
-        def f(hd):
-            return fused_linear_cross_entropy(
-                Tensor(hd), w, lab, transpose_y=True)._data
-
-        return str(jax.make_jaxpr(f)(h._data))
-
-    paddle.set_flags({"use_pallas_lm_loss": True, "pallas_interpret_ok": True})
-    assert "pallas_call" in trace()
-    paddle.set_flags({"use_pallas_lm_loss": False})
-    assert "pallas_call" not in trace()
+# (the layernorm / lm_loss flag-routing gates were removed in round 5 with
+#  the kernels' retirement from the training path — BASELINE.md; their math
+#  stays pinned by tests/test_pallas_layernorm.py / test_pallas_lm_loss.py)
 
 
 # ------------------------------------------------- Mosaic TPU compilation ----

@@ -1,5 +1,7 @@
-"""Online Pallas LM-head cross-entropy (ops/pallas/lm_loss.py) vs dense math,
-and its routing through fused_linear_cross_entropy (interpret mode on CPU)."""
+"""Online Pallas LM-head cross-entropy (ops/pallas/lm_loss.py) vs dense math
+(interpret mode on CPU). Round 5: RETIRED from the fused_linear_cross_entropy
+route (BASELINE.md retirement note) — called DIRECTLY here, keeping the math
+pinned as a library kernel."""
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -76,105 +78,45 @@ def test_unaligned_vocab_padded():
     np.testing.assert_allclose(gp[1], gr[1], atol=1e-5)
 
 
-class TestRoutedThroughFused:
-    def setup_method(self, _):
-        paddle.set_flags({"use_pallas_lm_loss": True, "pallas_interpret_ok": True})
-
-    def teardown_method(self, _):
-        paddle.set_flags({"use_pallas_lm_loss": False, "pallas_interpret_ok": False})
-
-    def test_matches_scan_version(self):
-        from paddle_tpu.ops.fused import fused_linear_cross_entropy
-
-        rng = np.random.RandomState(2)
-        b, s, v, hdim = 2, 100, 256, 128  # 200 rows: exercises padding to 1024
-        h = paddle.to_tensor(rng.randn(b, s, hdim).astype(np.float32),
-                             stop_gradient=False)
-        w = paddle.to_tensor((rng.randn(v, hdim) * 0.1).astype(np.float32),
-                             stop_gradient=False)
-        ln = rng.randint(0, v, (b, s)).astype(np.int64)
-        ln[0, :7] = -100  # ignore_index rows
-        labels = paddle.to_tensor(ln)
-
-        loss = fused_linear_cross_entropy(h, w, labels)
-        loss.sum().backward()
-        out_p, dh_p, dw_p = loss.numpy(), h.grad.numpy(), w.grad.numpy()
-
-        paddle.set_flags({"use_pallas_lm_loss": False})
-        h2 = paddle.to_tensor(h.numpy(), stop_gradient=False)
-        w2 = paddle.to_tensor(w.numpy(), stop_gradient=False)
-        loss2 = fused_linear_cross_entropy(h2, w2, labels)
-        loss2.sum().backward()
-
-        np.testing.assert_allclose(out_p, loss2.numpy(), atol=1e-5, rtol=1e-5)
-        assert (out_p[0, :7] == 0).all()           # ignored rows: zero loss
-        assert np.abs(dh_p[0, :7]).max() == 0.0    # ...and zero grad
-        np.testing.assert_allclose(dh_p, h2.grad.numpy(), atol=1e-5, rtol=1e-4)
-        np.testing.assert_allclose(dw_p, w2.grad.numpy(), atol=1e-5, rtol=1e-4)
-
-    def test_gpt_forward_with_pallas_loss(self):
-        from paddle_tpu.models import GPTForPretraining, GPTConfig
-
-        paddle.seed(0)
-        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
-                        num_heads=4, max_seq_len=128)
-        model = GPTForPretraining(cfg)
-        rng = np.random.RandomState(3)
-        ids = paddle.to_tensor(rng.randint(0, 512, (2, 64)).astype(np.int64))
-        labels = paddle.to_tensor(np.roll(ids.numpy(), -1, 1))
-        loss_p = float(model(ids, labels).numpy())
-        paddle.set_flags({"use_pallas_lm_loss": False})
-        loss_s = float(model(ids, labels).numpy())
-        np.testing.assert_allclose(loss_p, loss_s, rtol=1e-5)
-
-
 def test_mixed_dtype_bf16_h_f32_w():
     """The on-chip amp config: bf16 activations against the f32 master
     embedding weight — the kernel must unify dtypes, dW back in f32."""
-    paddle.set_flags({"use_pallas_lm_loss": True, "pallas_interpret_ok": True})
-    try:
-        rng = np.random.RandomState(4)
-        N, V, H = 1024, 256, 128
-        h = jnp.asarray(rng.randn(N, H), jnp.bfloat16)
-        w = jnp.asarray(rng.randn(V, H) * 0.05, jnp.float32)
-        lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
+    rng = np.random.RandomState(4)
+    N, V, H = 1024, 256, 128
+    h = jnp.asarray(rng.randn(N, H), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(V, H) * 0.05, jnp.float32)
+    lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
 
-        loss = lm_head_cross_entropy(h, w, lab)
-        ref = _dense(h.astype(jnp.float32), w, lab)
-        np.testing.assert_allclose(loss, ref, atol=8e-2, rtol=1e-2)
+    loss = lm_head_cross_entropy(h, w, lab)
+    ref = _dense(h.astype(jnp.float32), w, lab)
+    np.testing.assert_allclose(loss, ref, atol=8e-2, rtol=1e-2)
 
-        gh, gw = jax.grad(lambda a, b: lm_head_cross_entropy(a, b, lab).mean(),
-                          argnums=(0, 1))(h, w)
-        assert gh.dtype == jnp.bfloat16 and gw.dtype == jnp.float32
-        gr = jax.grad(lambda a, b: _dense(a.astype(jnp.float32), b, lab).mean(),
+    gh, gw = jax.grad(lambda a, b: lm_head_cross_entropy(a, b, lab).mean(),
                       argnums=(0, 1))(h, w)
-        np.testing.assert_allclose(gw, gr[1], atol=5e-3, rtol=5e-2)
-    finally:
-        paddle.set_flags({"use_pallas_lm_loss": False, "pallas_interpret_ok": False})
+    assert gh.dtype == jnp.bfloat16 and gw.dtype == jnp.float32
+    gr = jax.grad(lambda a, b: _dense(a.astype(jnp.float32), b, lab).mean(),
+                  argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(gw, gr[1], atol=5e-3, rtol=5e-2)
 
 
 @pytest.mark.parametrize("block_n", [256, 512])
 def test_small_compute_blocks_match_dense(block_n):
-    """FLAGS_pallas_lm_loss_block_n shrinks the 2D compute tiles while the
-    1D operands stay on their 1024-element XLA-tile blocks (revisit
-    sub-slices) — value and both grads must match the dense reference at
-    every supported block size. (The knob exists because Mosaic compile time
-    grows superlinearly in per-block vector ops — BASELINE.md round 3.)"""
-    paddle.set_flags({"pallas_lm_loss_block_n": block_n})
-    try:
-        rng = np.random.RandomState(7)
-        N, V, H = 2048, 640, 128  # N spans 2 revisit groups at block 256
-        h = jnp.asarray(rng.randn(N, H).astype(np.float32))
-        w = jnp.asarray((rng.randn(V, H) * 0.05).astype(np.float32))
-        lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
-        loss = lm_head_cross_entropy(h, w, lab)
-        ref = _dense(h, w, lab)
-        np.testing.assert_allclose(loss, ref, atol=1e-4, rtol=1e-4)
-        gp = jax.grad(lambda a, b: lm_head_cross_entropy(a, b, lab).mean(),
-                      argnums=(0, 1))(h, w)
-        gr = jax.grad(lambda a, b: _dense(a, b, lab).mean(),
-                      argnums=(0, 1))(h, w)
-        np.testing.assert_allclose(gp[0], gr[0], atol=1e-5)
-        np.testing.assert_allclose(gp[1], gr[1], atol=1e-5)
-    finally:
-        paddle.set_flags({"pallas_lm_loss_block_n": 1024})
+    """block_n shrinks the 2D compute tiles while the 1D operands stay on
+    their 1024-element XLA-tile blocks (revisit sub-slices) — value and both
+    grads must match the dense reference at every supported block size.
+    (The knob exists because Mosaic compile time grows superlinearly in
+    per-block vector ops — BASELINE.md round 3.)"""
+    rng = np.random.RandomState(7)
+    N, V, H = 2048, 640, 128  # N spans 2 revisit groups at block 256
+    h = jnp.asarray(rng.randn(N, H).astype(np.float32))
+    w = jnp.asarray((rng.randn(V, H) * 0.05).astype(np.float32))
+    lab = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
+    loss = lm_head_cross_entropy(h, w, lab, block_n=block_n)
+    ref = _dense(h, w, lab)
+    np.testing.assert_allclose(loss, ref, atol=1e-4, rtol=1e-4)
+    gp = jax.grad(lambda a, b: lm_head_cross_entropy(
+        a, b, lab, block_n=block_n).mean(), argnums=(0, 1))(h, w)
+    gr = jax.grad(lambda a, b: _dense(a, b, lab).mean(),
+                  argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(gp[0], gr[0], atol=1e-5)
+    np.testing.assert_allclose(gp[1], gr[1], atol=1e-5)

@@ -148,14 +148,13 @@ def main():
               [(vr, 1024) for vr in ("bare", "sliced", "picked", "masked")])
     for variant, block_n in combos:
         if variant == "full":
-            # the real production kernel at FLAGS_pallas_lm_loss_block_n =
-            # block_n (rows still padded to 1024 multiples by the wrapper)
+            # the real (retired, direct-call) kernel at the given block_n
+            # (rows still padded to 1024 multiples by callers)
             if n % 1024:
                 continue
-            import paddle_tpu as paddle
             from paddle_tpu.ops.pallas.lm_loss import lm_head_cross_entropy
-            paddle.set_flags({"pallas_lm_loss_block_n": block_n})
-            fn = jax.jit(lambda a, b, c: lm_head_cross_entropy(a, b, c))
+            fn = jax.jit(lambda a, b, c, _bn=block_n: lm_head_cross_entropy(
+                a, b, c, block_n=_bn))
         else:
             if n % block_n:
                 continue
