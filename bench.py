@@ -227,10 +227,15 @@ def main():
     # counts FULL attention matmuls (the causal flash kernel actually skips
     # ~half those blocks). Rounds 1-2 reported the 6*N-only figure; both are
     # recorded so the cross-round series stays comparable.
-    flops_per_tok_param = 6 * n_params
-    flops_per_tok = flops_per_tok_param + 12 * cfg.num_layers * cfg.hidden_size * seq
-    mfu = (flops_per_tok * tokens_per_sec_chip) / 197e12 if on_tpu else None
-    mfu_param = (flops_per_tok_param * tokens_per_sec_chip) / 197e12 \
+    from paddle_tpu.observability import (
+        peak_flops_per_sec, transformer_flops_per_token)
+
+    flops_per_tok_param = transformer_flops_per_token(n_params)
+    flops_per_tok = transformer_flops_per_token(
+        n_params, cfg.num_layers, cfg.hidden_size, seq)
+    peak = peak_flops_per_sec("tpu")
+    mfu = (flops_per_tok * tokens_per_sec_chip) / peak if on_tpu else None
+    mfu_param = (flops_per_tok_param * tokens_per_sec_chip) / peak \
         if on_tpu else None
 
     payload = {

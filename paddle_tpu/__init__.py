@@ -67,6 +67,7 @@ from . import strings  # noqa: E402
 from . import text  # noqa: E402
 from . import incubate  # noqa: E402
 from . import metric  # noqa: E402
+from . import observability  # noqa: E402
 from . import profiler  # noqa: E402
 from . import device  # noqa: E402
 from . import utils  # noqa: E402

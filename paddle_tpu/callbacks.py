@@ -2,5 +2,5 @@
 the hapi callbacks)."""
 from .hapi.callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
-    VisualDL,
+    TelemetryCallback, VisualDL,
 )

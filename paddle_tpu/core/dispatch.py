@@ -18,16 +18,37 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import tracer as _obs_tracer
 from . import dtype as dtypes
+from . import monitor as _monitor
 from .autograd import Node, is_grad_enabled
 from .flags import flag
 from .tensor import Tensor
 
 KERNELS: Dict[str, Callable] = {}
+
+# dispatch-layer counters (core.monitor registry): per-op call counts are
+# the KernelFactory-level observability the reference gets from its op
+# profiler tables. StatValues are cached here so the hot path pays one dict
+# lookup + one locked increment, not a registry lock per op.
+_DISPATCH_CALLS = _monitor.stat("dispatch.calls")
+_RULE_HITS = _monitor.stat("dispatch.rule_cache_hits")
+_RULE_MISSES = _monitor.stat("dispatch.rule_cache_misses")
+_NAN_INF_HITS = _monitor.stat("dispatch.nan_inf_hits")
+_PER_OP_STATS: Dict[str, "_monitor.StatValue"] = {}
+
+
+def _op_stat(name: str) -> "_monitor.StatValue":
+    st = _PER_OP_STATS.get(name)
+    if st is None:
+        st = _PER_OP_STATS[name] = _monitor.stat("dispatch.op." + name)
+    return st
 
 # static-graph capture hook (installed by paddle_tpu.static.framework): when an op
 # input is a symbolic Variable the op is recorded as an OpDesc, not executed
@@ -245,6 +266,10 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
     if _symbolic_handler is not None and any(
             getattr(t, "is_symbolic", False) for t in tensor_args):
         return _symbolic_handler(name, kernel, tensor_args, attrs, differentiable)
+    _DISPATCH_CALLS.increase()
+    _op_stat(name).increase()
+    _tr = _obs_tracer.get_tracer()
+    _span_t0 = time.perf_counter() if _tr.enabled else None
     arrays = [t._data for t in tensor_args]
 
     cast_to = _autocast_dtype_for(name, arrays)
@@ -277,10 +302,13 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
         if key is not None:
             rules = _RULE_CACHE.get(key, _UNSEEN)
             if rules is _UNSEEN:
+                _RULE_MISSES.increase()
                 if len(_RULE_CACHE) >= _RULE_CACHE_CAP:
                     _RULE_CACHE.clear()
                 rules = _build_rules(kernel, attrs, diff_idx, cast_to)
                 _RULE_CACHE[key] = rules
+            else:
+                _RULE_HITS.increase()
             # rules may be None: key previously proved untraceable
 
     if rules is not None:
@@ -344,6 +372,9 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
             o._node = node
             o._out_index = i
 
+    if _span_t0 is not None:
+        _tr.record_complete("op::" + name, _span_t0, time.perf_counter(),
+                            aggregate=False)
     if multi:
         return tuple(outs)
     return outs[0]
@@ -385,6 +416,7 @@ def _check_nan_inf(name, outs_data):
     for d in outs_data:
         if _is_float_array(d):
             if not bool(jnp.isfinite(d).all()):
+                _NAN_INF_HITS.increase()
                 raise FloatingPointError(
                     f"Operator {name} output contains Inf/Nan "
                     f"(FLAGS_check_nan_inf is set)"

@@ -19,6 +19,7 @@ over the hcg mesh. Parallelism is expressed as shardings:
 from __future__ import annotations
 
 import math
+import time
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -26,11 +27,41 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import monitor as _monitor
 from ..core import random as random_mod
 from ..core.tensor import Tensor
 from ..jit import functional_call
+from ..observability import tracer as _obs_tracer
+from ..observability.step_telemetry import StepTelemetry
 from ..optimizer import functional as opt_funct
 from .mesh import HybridCommunicateGroup, get_hybrid_communicate_group
+
+# jit-path observability (core.monitor registry): every compile of a step
+# program is counted and its dispatch wall time accumulated; a compile on a
+# step function that ALREADY had an executable is a recompile — the
+# shape/dtype-churn alarm the reference surfaces via its cache-miss logs.
+_JIT_COMPILES = _monitor.stat("engine.jit_compiles")
+_JIT_RECOMPILES = _monitor.stat("engine.jit_recompiles")
+_JIT_COMPILE_MS = _monitor.stat("engine.jit_compile_ms")
+
+
+def _jit_cache_size(fn) -> int:
+    try:
+        return fn._cache_size()
+    except Exception:
+        return -1
+
+
+def _note_compile(n_before: int, n_after: int, wall_s: float) -> bool:
+    """Update compile counters from a jitted fn's executable-cache growth
+    across one dispatch; returns whether this dispatch compiled."""
+    if n_before < 0 or n_after <= n_before:
+        return False
+    _JIT_COMPILES.increase()
+    _JIT_COMPILE_MS.increase(int(wall_s * 1000))
+    if n_before > 0:
+        _JIT_RECOMPILES.increase()
+    return True
 
 
 def _divides(n, d):
@@ -154,6 +185,57 @@ class TrainStepEngine:
         self._key = jax.random.key(random_mod.default_generator().initial_seed() or 0)
         self.last_loss = None
         self._lr_cache = (None, None)  # (python value, device scalar)
+        # PADDLE_TPU_TELEMETRY_DIR auto-attaches a JSONL sink; otherwise
+        # telemetry stays None and the step path pays nothing for it
+        self.telemetry = StepTelemetry.from_env()
+        if self.telemetry is not None and self.telemetry.flops_per_token is None:
+            self.telemetry.flops_per_token = 6 * self._n_params()
+
+    def _n_params(self) -> int:
+        return int(sum(
+            int(np.prod(self._state_refs[n].shape) or 1)
+            for n in self._param_names))
+
+    def enable_telemetry(self, sink=None, path=None,
+                         flops_per_token: Optional[int] = None,
+                         peak_flops: Optional[float] = None) -> StepTelemetry:
+        """Attach per-step telemetry. Default flop model is parameter-only
+        (6*N per token); pass flops_per_token from
+        observability.transformer_flops_per_token for the full bench.py
+        accounting with the attention term."""
+        from ..observability.step_telemetry import JsonlSink
+
+        if sink is None and path is not None:
+            sink = JsonlSink(path)
+        self.telemetry = StepTelemetry(
+            sink=sink,
+            flops_per_token=(flops_per_token if flops_per_token is not None
+                             else 6 * self._n_params()),
+            peak_flops=peak_flops)
+        return self.telemetry
+
+    def disable_telemetry(self) -> None:
+        if self.telemetry is not None:
+            self.telemetry.close()
+        self.telemetry = None
+
+    @staticmethod
+    def _batch_stats(arrays, lead_axes=0):
+        """(samples, tokens) per dispatch from the first batch array: the
+        leading dim is the sample axis. Tokens are only counted for integer
+        id batches ([b, s] LM inputs) — dim 1 of a float feature matrix is
+        features, not sequence, and must not inflate tokens/s."""
+        if not arrays:
+            return None, None
+        shape = arrays[0].shape[lead_axes:]
+        if not shape:
+            return None, None
+        samples = int(shape[0])
+        tokens = None
+        if len(shape) >= 2 and np.issubdtype(np.dtype(arrays[0].dtype),
+                                             np.integer):
+            tokens = samples * int(shape[1])
+        return samples, tokens
 
     def _opt_sharding(self, spec):
         """NamedSharding for one optimizer-state leaf; host-memory-resident
@@ -430,11 +512,33 @@ class TrainStepEngine:
         for _ in range(k):
             self._key, sub = jax.random.split(self._key)
             subs.append(sub)
-        losses, self.params, new_opt = self._scan_fns[fixed](
+        fn = self._scan_fns[fixed]
+        tele = self.telemetry
+        n0 = _jit_cache_size(fn)
+        t0 = time.perf_counter()
+        losses, self.params, new_opt = fn(
             self.params, self._opt_to_hbm(self.opt_state), lrs,
             jnp.int32(step0), jnp.stack(subs), *arrays)
+        if tele is not None:
+            jax.block_until_ready(losses)  # honest wall time: drain the K steps
+        t1 = time.perf_counter()
+        compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0)
+        tr = _obs_tracer.get_tracer()
+        if tr.enabled:
+            tr.record_complete("engine.run_steps", t0, t1,
+                               {"steps": k, "step0": step0,
+                                "compiled": compiled})
         self.opt_state = self._opt_to_home(new_opt)
         self.last_loss = Tensor(losses[-1])
+        if tele is not None:
+            samples, tokens = self._batch_stats(
+                arrays, lead_axes=0 if fixed else 1)
+            tele.record_step(
+                step=self._step_count, wall_time=t1 - t0,
+                samples=samples * k if samples else None,
+                tokens=tokens * k if tokens else None,
+                loss=float(jax.device_get(losses[-1])),
+                extra={"steps_fused": k})
         return Tensor(losses)
 
     def warm_scan(self, *batch, steps: int):
@@ -445,6 +549,8 @@ class TrainStepEngine:
         saved = (jax.tree_util.tree_map(jnp.copy, self.params),
                  jax.tree_util.tree_map(jnp.copy, self.opt_state),
                  self._step_count, self._key, self.last_loss)
+        tele, self.telemetry = self.telemetry, None  # warm run is not a step:
+        #                         a compile-heavy record would poison the stream
         try:
             losses = self.run_steps(*batch, steps=steps)
             float(losses[-1].item())  # drain: the warm execution must not
@@ -453,6 +559,7 @@ class TrainStepEngine:
             (self.params, self.opt_state, self._step_count, self._key,
              self.last_loss) = saved
             self.optimizer._step_count = self._step_count
+            self.telemetry = tele
 
     def step(self, *batch) -> Tensor:
         arrays = self._to_arrays(batch)
@@ -470,11 +577,29 @@ class TrainStepEngine:
             self._lr_cache = (lr_val, jnp.float32(lr_val))
         lr = self._lr_cache[1]
         self._key, sub = jax.random.split(self._key)
-        loss, self.params, new_opt = self._step_fn(
+        fn = self._step_fn
+        tele = self.telemetry
+        n0 = _jit_cache_size(fn)
+        t0 = time.perf_counter()
+        loss, self.params, new_opt = fn(
             self.params, self._opt_to_hbm(self.opt_state), lr,
             jnp.int32(self._step_count), sub, *arrays)
+        if tele is not None:
+            jax.block_until_ready(loss)  # honest wall time over async dispatch
+        t1 = time.perf_counter()
+        compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0)
+        tr = _obs_tracer.get_tracer()
+        if tr.enabled:
+            tr.record_complete("engine.step", t0, t1,
+                               {"step": self._step_count,
+                                "compiled": compiled})
         self.opt_state = self._opt_to_home(new_opt)
         self.last_loss = Tensor(loss)
+        if tele is not None:
+            samples, tokens = self._batch_stats(arrays)
+            tele.record_step(
+                step=self._step_count, wall_time=t1 - t0, samples=samples,
+                tokens=tokens, loss=float(jax.device_get(loss)))
         return self.last_loss
 
     train_batch = step

@@ -238,6 +238,50 @@ class EarlyStopping(Callback):
                 print(f"Epoch {self.stopped_epoch}: Early stopping.", flush=True)
 
 
+class TelemetryCallback(Callback):
+    """Per-step structured telemetry for Model.fit, emitting one
+    observability.StepTelemetry JSONL record per train batch (wall time,
+    samples/s, loss, tracked reader_cost, compile/dispatch counters).
+
+    Wall time spans on_train_batch_begin -> end; train_batch syncs on the
+    loss (float(item())) so the measurement is honest. Auto-attached by
+    config_callbacks when PADDLE_TPU_TELEMETRY_DIR is set."""
+
+    def __init__(self, telemetry=None, path=None, flops_per_token=None):
+        super().__init__()
+        if telemetry is None:
+            from ..observability import InMemorySink, JsonlSink, StepTelemetry
+
+            sink = JsonlSink(path) if path else InMemorySink()
+            telemetry = StepTelemetry(sink=sink,
+                                      flops_per_token=flops_per_token)
+        self.telemetry = telemetry
+        self._t0 = None
+        self._step = 0
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        logs = logs or {}
+        loss = logs.get("loss")
+        if isinstance(loss, (list, tuple)):
+            loss = loss[0] if loss else None
+        self._step += 1
+        self.telemetry.record_step(
+            step=self._step, wall_time=dt,
+            samples=logs.get("batch_size"),
+            loss=float(loss) if isinstance(loss, numbers.Number) else None,
+            reader_cost=logs.get("reader_cost"))
+
+    def on_train_end(self, logs=None):
+        self.telemetry.close()
+
+
 class VisualDL(Callback):
     """Scalar logging callback. The visualdl package is not available in this image;
     scalars are appended to a jsonl file the user can plot with any tool."""
@@ -274,6 +318,11 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
     cbks = list(callbacks or [])
     if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
         cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+    tele_dir = os.environ.get("PADDLE_TPU_TELEMETRY_DIR")
+    if (tele_dir and mode == "train"
+            and not any(isinstance(c, TelemetryCallback) for c in cbks)):
+        cbks.append(TelemetryCallback(
+            path=os.path.join(tele_dir, "fit_telemetry.jsonl")))
     if not any(isinstance(c, LRScheduler) for c in cbks):
         cbks.append(LRScheduler())
     if not any(isinstance(c, ModelCheckpoint) for c in cbks):

@@ -7,6 +7,7 @@ one fused XLA computation, so there is nothing to gain from a separate static pa
 """
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -157,7 +158,17 @@ class Model:
                 m.reset()
             logs = {}
             pending_update = False
-            for step, batch in enumerate(loader):
+            # manual iteration so the dataloader fetch is timed: reader_cost
+            # rides in logs for ProgBar/telemetry and is what Benchmark's
+            # step(reader_cost=...) hook receives instead of a fake 0.0
+            batches = iter(enumerate(loader))
+            while True:
+                t_fetch = time.perf_counter()
+                try:
+                    step, batch = next(batches)
+                except StopIteration:
+                    break
+                reader_dt = time.perf_counter() - t_fetch
                 if num_iters is not None and step >= num_iters:
                     break
                 cbks.on_train_batch_begin(step)
@@ -166,6 +177,7 @@ class Model:
                 out = self.train_batch(ins, labs, update=update)
                 pending_update = not update
                 logs = self._pack_logs(out, batch_size)
+                logs["reader_cost"] = reader_dt
                 cbks.on_train_batch_end(step, logs)
                 if self.stop_training:
                     break

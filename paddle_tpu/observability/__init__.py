@@ -1,0 +1,33 @@
+"""Unified runtime telemetry (ISSUE 1 tentpole).
+
+Three layers, one subsystem:
+
+- ``tracer``: thread-safe host span recorder -> chrome-trace JSON that
+  interleaves with the jax.profiler device timeline. profiler.RecordEvent
+  feeds it, so existing markers show up with zero caller changes.
+- compile/dispatch counters: core.dispatch and distributed.engine register
+  dispatch counts, rule-cache hit/miss, nan/inf hits, jit compile count and
+  wall time in ``core.monitor.registry()``.
+- ``StepTelemetry``: per-train-step JSONL records (wall time, tokens/s,
+  TFLOP/s, MFU, memory high-water, compile counters) with pluggable sinks;
+  wired into distributed.engine.TrainStepEngine and the hapi fit loop.
+
+Everything is off-by-default and stdlib-only at import time: enabling costs
+one env var (PADDLE_TPU_TELEMETRY_DIR) or one method call
+(engine.enable_telemetry()); disabled, no jax import, no I/O, no spans.
+"""
+from .flops import (  # noqa: F401
+    PEAK_TFLOPS, peak_flops_per_sec, transformer_flops_per_token,
+)
+from .step_telemetry import (  # noqa: F401
+    InMemorySink, JsonlSink, StepTelemetry,
+)
+from .tracer import (  # noqa: F401
+    Tracer, enabled, get_tracer, span,
+)
+
+__all__ = [
+    "Tracer", "get_tracer", "span", "enabled",
+    "StepTelemetry", "JsonlSink", "InMemorySink",
+    "transformer_flops_per_token", "peak_flops_per_sec", "PEAK_TFLOPS",
+]

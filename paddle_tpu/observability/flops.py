@@ -1,0 +1,27 @@
+"""Shared model-FLOPs accounting for throughput/MFU telemetry.
+
+One home for the convention bench.py and tools/mxu_roofline.py already use
+(PaLM appendix B): 6*N parameter FLOPs per token plus the full causal
+attention matmul term 12*L*h*s. StepTelemetry, bench, and the offline tools
+must all divide by the same number or cross-checking them is meaningless.
+"""
+from __future__ import annotations
+
+# Datasheet bf16 peak per chip, matching bench.py's MFU denominator.
+PEAK_TFLOPS = {"tpu": 197.0}  # v5e bf16
+
+
+def transformer_flops_per_token(n_params: int, num_layers: int = 0,
+                                hidden_size: int = 0, seq_len: int = 0) -> int:
+    """Training FLOPs per token: 6*N (fwd + 2x bwd over every parameter)
+    plus the attention-matmul term. Counts FULL attention matmuls even when
+    a causal flash kernel skips ~half the blocks — same deliberate choice as
+    bench.py so MFU series stay comparable."""
+    return 6 * n_params + 12 * num_layers * hidden_size * seq_len
+
+
+def peak_flops_per_sec(backend: str) -> float | None:
+    """Per-chip peak in FLOP/s for the MFU denominator; None when the
+    backend has no calibrated datasheet number (e.g. the CPU test mesh)."""
+    tf = PEAK_TFLOPS.get(backend)
+    return tf * 1e12 if tf is not None else None

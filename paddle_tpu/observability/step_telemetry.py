@@ -1,0 +1,188 @@
+"""Per-step training telemetry: one structured record per optimizer step.
+
+The run-time complement of the offline probes in tools/ (step_breakdown,
+mxu_roofline): instead of re-deriving throughput after the fact, the
+training loop itself emits a JSONL stream of step records — wall time,
+tokens/s, achieved TFLOP/s, estimated MFU (flops.py model, the bench.py
+convention), device-memory high-water, and the compile/dispatch counters
+from core.monitor — through a pluggable sink. The reference analogue is the
+benchmark/profiler timer feeding ips into logs (profiler/timer.py), grown
+into a machine-readable stream tools/trace_summary.py can tabulate.
+
+Disabled-path contract (asserted by tests/test_profiler.py): when no
+telemetry is attached nothing here runs — no jax import, no file I/O, no
+sync. This module itself imports only stdlib; device stats are fetched
+lazily inside record_step.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+
+class InMemorySink:
+    """Collects records in a list — for tests and notebook inspection."""
+
+    def __init__(self):
+        self.records: List[Dict[str, Any]] = []
+
+    def write(self, record: Dict[str, Any]) -> None:
+        self.records.append(record)
+
+    def close(self) -> None:
+        pass
+
+
+class JsonlSink:
+    """Appends one JSON line per record; opened lazily, flushed per write so
+    a crashed run keeps every completed step."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+
+    def write(self, record: Dict[str, Any]) -> None:
+        if self._f is None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a")
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class StepTelemetry:
+    """Builds and emits per-step records.
+
+    flops_per_token: model-FLOPs per trained token (see
+        flops.transformer_flops_per_token); enables tflops_per_sec and mfu.
+    peak_flops: MFU denominator in FLOP/s; defaults per backend at first
+        record (flops.peak_flops_per_sec), None on backends with no
+        calibrated peak — mfu is then omitted.
+    """
+
+    def __init__(self, sink=None, flops_per_token: Optional[int] = None,
+                 peak_flops: Optional[float] = None,
+                 collect_memory: bool = True):
+        self.sink = sink if sink is not None else InMemorySink()
+        self.flops_per_token = flops_per_token
+        self.peak_flops = peak_flops
+        self.collect_memory = collect_memory
+        self._records = 0
+        self._last_counters: Dict[str, int] = {}
+
+    # ---- construction helpers ----
+    @classmethod
+    def from_env(cls, **kw) -> Optional["StepTelemetry"]:
+        """JsonlSink telemetry when PADDLE_TPU_TELEMETRY_DIR is set, else
+        None (the cheap probe callers use to stay zero-cost when off)."""
+        d = os.environ.get("PADDLE_TPU_TELEMETRY_DIR")
+        if not d:
+            return None
+        return cls(sink=JsonlSink(os.path.join(d, "step_telemetry.jsonl")),
+                   **kw)
+
+    def set_flop_model(self, flops_per_token: int,
+                       peak_flops: Optional[float] = None) -> None:
+        self.flops_per_token = flops_per_token
+        if peak_flops is not None:
+            self.peak_flops = peak_flops
+
+    # ---- emission ----
+    def record_step(self, *, step: int, wall_time: float,
+                    samples: Optional[int] = None,
+                    tokens: Optional[int] = None,
+                    loss: Optional[float] = None,
+                    reader_cost: Optional[float] = None,
+                    phase: str = "train",
+                    extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Emit one record; returns it (tests read the return directly)."""
+        rec: Dict[str, Any] = {
+            "event": f"{phase}_step",
+            "step": int(step),
+            "ts": time.time(),
+            "wall_time_s": round(wall_time, 6),
+        }
+        if loss is not None:
+            rec["loss"] = float(loss)
+        if reader_cost is not None:
+            rec["reader_cost_s"] = round(reader_cost, 6)
+        if samples is not None:
+            rec["samples"] = int(samples)
+            rec["samples_per_sec"] = round(samples / max(wall_time, 1e-9), 2)
+        if tokens is not None:
+            rec["tokens"] = int(tokens)
+            tps = tokens / max(wall_time, 1e-9)
+            rec["tokens_per_sec"] = round(tps, 1)
+            if self.flops_per_token:
+                fps = self.flops_per_token * tps
+                rec["tflops_per_sec"] = round(fps / 1e12, 3)
+                peak = self._resolve_peak()
+                if peak:
+                    rec["mfu"] = round(fps / peak, 4)
+        rec.update(self._counter_deltas())
+        if self.collect_memory:
+            # always present so consumers see a stable shape; {} on backends
+            # where PJRT exposes no memory stats (the CPU test mesh)
+            rec["device_memory"] = self._memory_stats()
+        if extra:
+            rec.update(extra)
+        self.sink.write(rec)
+        self._records += 1
+        return rec
+
+    def close(self) -> None:
+        self.sink.close()
+
+    # ---- internals ----
+    def _resolve_peak(self) -> Optional[float]:
+        if self.peak_flops is not None:
+            return self.peak_flops
+        try:
+            import jax
+
+            from . import flops as _flops
+
+            self.peak_flops = _flops.peak_flops_per_sec(jax.default_backend())
+        except Exception:
+            self.peak_flops = None
+        return self.peak_flops
+
+    def _counter_deltas(self) -> Dict[str, Any]:
+        """Compile/dispatch counters from core.monitor: running totals plus
+        the delta since the previous record (a nonzero jit_compiles_delta
+        mid-run IS the recompile alarm)."""
+        from ..core import monitor
+
+        out: Dict[str, Any] = {}
+        rep = monitor.registry().report()
+        for key, field in (("engine.jit_compiles", "jit_compiles"),
+                           ("engine.jit_compile_ms", "jit_compile_ms"),
+                           ("engine.jit_recompiles", "jit_recompiles"),
+                           ("dispatch.calls", "dispatch_calls"),
+                           ("dispatch.nan_inf_hits", "nan_inf_hits")):
+            if key in rep:
+                v = rep[key]["value"]
+                out[field] = v
+                delta = v - self._last_counters.get(key, 0)
+                if field in ("jit_compiles", "jit_recompiles") and delta:
+                    out[field + "_delta"] = delta
+                self._last_counters[key] = v
+        return out
+
+    def _memory_stats(self) -> Dict[str, int]:
+        try:
+            from ..core import monitor
+
+            stats = monitor.device_memory_stats()
+        except Exception:
+            return {}
+        keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_alloc_size")
+        return {k: int(stats[k]) for k in keep if k in stats}

@@ -3,12 +3,21 @@ chrome-trace export) + profiler/timer.py Benchmark (ips).
 
 TPU-native: wraps jax.profiler (XPlane -> TensorBoard/perfetto) behind the same API; RecordEvent
 maps to jax.profiler.TraceAnnotation so host markers interleave with device timelines.
+
+Host-side events route through observability.tracer: aggregates (count/total/
+max/min per name) feed summary() exactly as the old ``_event_stats`` dict did,
+and while a trace window is open every span additionally lands in the tracer's
+ring buffer and exports as genuine chrome-trace JSON next to the device trace.
 """
 from __future__ import annotations
 
 import enum
+import json
 import os
+import sys
 import time
+
+from ..observability import tracer as _obs_tracer
 
 
 class ProfilerState(enum.Enum):
@@ -46,26 +55,39 @@ def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready handler exporting the host chrome trace into dir_name.
+
+    The directory is applied at Profiler CONSTRUCTION time (the handler
+    carries it as ``export_dir``) — previously it was assigned on
+    trace-ready, after _start_trace had already written the device trace to
+    the old directory, so the requested dir was silently ignored.
+    """
+
     def handler(prof):
         os.makedirs(dir_name, exist_ok=True)
-        # jax.profiler writes xplane/perfetto under its own dir during stop
-        prof._export_dir = dir_name
+        name = worker_name or f"host_{os.getpid()}"
+        # one file per closed window — a cycling scheduler must not let a
+        # later (possibly empty) window clobber an earlier export
+        prof.export(os.path.join(dir_name, f"{name}_w{prof._windows}.json"))
 
+    handler.export_dir = dir_name
     return handler
 
 
-# host-side event aggregation feeding Profiler.summary (the analogue of the
-# reference's HostEventRecorder -> profiler_statistic tables)
-_event_stats = {}  # name -> [count, total_s, max_s, min_s]
-
-
 def reset_event_stats():
-    _event_stats.clear()
+    _obs_tracer.get_tracer().clear_stats()
+
+
+def get_event_stats():
+    """name -> [count, total_s, max_s, min_s] for every RecordEvent seen
+    since the last reset (the summary() data source)."""
+    return _obs_tracer.get_tracer().stats()
 
 
 class RecordEvent:
     """RAII marker (reference RecordEvent, platform/profiler/event_tracing.h):
-    annotates the device trace AND aggregates host wall time for summary()."""
+    annotates the device trace AND records a host span (aggregate always;
+    full timeline event while the tracer is enabled)."""
 
     def __init__(self, name, event_type=None):
         self.name = name
@@ -82,31 +104,31 @@ class RecordEvent:
 
     def begin(self):
         self._t0 = time.perf_counter()
-        try:
-            import jax.profiler
+        # annotate the device timeline only when jax is already loaded — a
+        # host-only process pays nothing (observability disabled-path rule)
+        if "jax" in sys.modules:
+            try:
+                import jax.profiler
 
-            self._ta = jax.profiler.TraceAnnotation(self.name)
-            self._ta.__enter__()
-        except Exception:
-            self._ta = None
+                self._ta = jax.profiler.TraceAnnotation(self.name)
+                self._ta.__enter__()
+            except Exception:
+                self._ta = None
 
     def end(self):
         if self._ta is not None:
             self._ta.__exit__(None, None, None)
             self._ta = None
         if self._t0 is not None:
-            dt = time.perf_counter() - self._t0
-            st = _event_stats.setdefault(self.name, [0, 0.0, 0.0, float("inf")])
-            st[0] += 1
-            st[1] += dt
-            st[2] = max(st[2], dt)
-            st[3] = min(st[3], dt)
+            _obs_tracer.get_tracer().record_complete(
+                self.name, self._t0, time.perf_counter())
             self._t0 = None
 
 
 class Profiler:
     def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
-                 timer_only=False, record_shapes=False, profile_memory=False):
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 use_device_profiler=True):
         self._scheduler = scheduler if callable(scheduler) else None
         if isinstance(scheduler, (tuple, list)):
             start, end = scheduler
@@ -116,7 +138,12 @@ class Profiler:
         self._step = 0
         self._state = ProfilerState.CLOSED
         self._active = False
-        self._export_dir = os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+        self._windows = 0  # closed trace windows (distinct export files)
+        self._use_device_profiler = use_device_profiler
+        # handler-requested dir wins over the env default, and is applied
+        # HERE so _start_trace targets it from the first trace window
+        self._export_dir = getattr(on_trace_ready, "export_dir", None) or \
+            os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
         self._benchmark = Benchmark()
 
     def start(self):
@@ -129,8 +156,8 @@ class Profiler:
             self._stop_trace()
         self._benchmark.end()
 
-    def step(self, num_samples=None):
-        self._benchmark.step(num_samples)
+    def step(self, num_samples=None, reader_cost=None):
+        self._benchmark.step(num_samples, reader_cost=reader_cost)
         self._step += 1
         self._transition()
 
@@ -139,30 +166,44 @@ class Profiler:
             return
         new_state = self._scheduler(self._step)
         recording = new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        # RECORD_AND_RETURN covers the step ABOUT to run: its window closes at
+        # the next transition (the seed closed it in the same transition it
+        # opened, so a record=1 schedule exported an empty window)
+        if self._active and (self._state == ProfilerState.RECORD_AND_RETURN
+                             or not recording):
+            self._stop_trace()
         if recording and not self._active:
             self._start_trace()
-        ret = new_state == ProfilerState.RECORD_AND_RETURN
-        if self._active and (not recording or ret):
-            self._stop_trace()
+        self._state = new_state
 
     def _start_trace(self):
+        tr = _obs_tracer.get_tracer()
+        tr.clear()
+        tr.enable()
+        self._active = True
+        if not self._use_device_profiler:
+            return
         try:
             import jax.profiler
 
             os.makedirs(self._export_dir, exist_ok=True)
             jax.profiler.start_trace(self._export_dir)
-            self._active = True
+            self._device_trace = True
         except Exception:
-            self._active = False
+            self._device_trace = False
 
     def _stop_trace(self):
-        try:
-            import jax.profiler
+        if getattr(self, "_device_trace", False):
+            try:
+                import jax.profiler
 
-            jax.profiler.stop_trace()
-        except Exception:
-            pass
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_trace = False
+        _obs_tracer.get_tracer().disable()
         self._active = False
+        self._windows += 1
         if self._on_trace_ready:
             self._on_trace_ready(self)
 
@@ -175,7 +216,11 @@ class Profiler:
         return False
 
     def export(self, path=None, format="json"):
-        pass  # traces already exported by stop_trace
+        """Write the host-span chrome trace (the device xplane/perfetto trace
+        is exported by jax.profiler itself during stop_trace, same dir)."""
+        if path is None:
+            path = os.path.join(self._export_dir, f"host_{os.getpid()}.json")
+        return _obs_tracer.get_tracer().export_chrome_trace(path)
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
         """Throughput line + RecordEvent aggregation table (reference
@@ -183,7 +228,8 @@ class Profiler:
         info = self._benchmark.report()
         print(f"ips: {info.get('ips', 0.0):.2f} steps/s  reader_cost: "
               f"{info.get('reader_cost', 0.0) * 1000:.3f} ms")
-        if not _event_stats:
+        stats = get_event_stats()
+        if not stats:
             return
         units = {"ms": 1e3, "us": 1e6, "s": 1.0}
         if time_unit not in units:
@@ -199,7 +245,7 @@ class Profiler:
             SortedKeys.CPUMin: lambda st: -st[3],
         }
         key = key_fns.get(sorted_by, key_fns[None])
-        rows = sorted(_event_stats.items(), key=lambda kv: key(kv[1]))
+        rows = sorted(stats.items(), key=lambda kv: key(kv[1]))
         w = max(len(n) for n, _ in rows) + 2
         print(f"{'Event':<{w}}{'Calls':>8}{'Total':>12}{'Avg':>12}"
               f"{'Max':>12}{'Min':>12}  ({time_unit})")
@@ -210,7 +256,10 @@ class Profiler:
 
 
 class Benchmark:
-    """Throughput meter (reference profiler/timer.py:110)."""
+    """Throughput meter (reference profiler/timer.py:110). reader_cost is the
+    tracked dataloader fetch time fed through step(reader_cost=...) by the
+    hapi fit loop — it is no longer a hard-coded 0.0; report() averages it
+    per step so summary() prints what was actually measured."""
 
     def __init__(self):
         self.reset()
@@ -218,16 +267,19 @@ class Benchmark:
     def reset(self):
         self._steps = 0
         self._samples = 0
+        self._reader_total = 0.0
         self._start = None
         self._last = None
 
     def begin(self):
         self._start = self._last = time.perf_counter()
 
-    def step(self, num_samples=None):
+    def step(self, num_samples=None, reader_cost=None):
         self._steps += 1
         if num_samples:
             self._samples += num_samples
+        if reader_cost:
+            self._reader_total += reader_cost
         self._last = time.perf_counter()
 
     def end(self):
@@ -238,12 +290,75 @@ class Benchmark:
             return {"ips": 0.0, "reader_cost": 0.0}
         elapsed = max(self._last - self._start, 1e-9)
         ips = (self._samples or self._steps) / elapsed
-        return {"ips": ips, "reader_cost": 0.0, "steps": self._steps,
-                "elapsed": elapsed}
+        return {"ips": ips, "reader_cost": self._reader_total / self._steps,
+                "steps": self._steps, "elapsed": elapsed}
+
+
+class ProfilerResult:
+    """A loaded chrome trace: raw events plus the same per-name aggregate
+    table summary() prints (reference LoadProfilerResult,
+    profiler/profiler.py)."""
+
+    def __init__(self, events, path=None):
+        self.events = events  # [{"name", "ts_us", "dur_us", "tid", "pid", "args"}]
+        self.path = path
+
+    def stats(self):
+        """name -> [count, total_s, max_s, min_s], matching
+        get_event_stats() so round-tripped traces summarize identically."""
+        out = {}
+        for ev in self.events:
+            dur = ev.get("dur_us")
+            if dur is None:
+                continue
+            dur = dur / 1e6
+            st = out.setdefault(ev["name"], [0, 0.0, 0.0, float("inf")])
+            st[0] += 1
+            st[1] += dur
+            st[2] = max(st[2], dur)
+            st[3] = min(st[3], dur)
+        return out
+
+    def time_range(self):
+        """(min_ts_us, max_end_us) across complete events; (0, 0) if none."""
+        spans = [(e["ts_us"], e["ts_us"] + (e.get("dur_us") or 0.0))
+                 for e in self.events]
+        if not spans:
+            return (0.0, 0.0)
+        return (min(s for s, _ in spans), max(e for _, e in spans))
 
 
 def load_profiler_result(path):
-    raise NotImplementedError
+    """Load an exported chrome-trace JSON (a file, or a directory holding
+    *.json traces — multi-worker exports merge) back into a ProfilerResult."""
+    paths = [path]
+    if os.path.isdir(path):
+        paths = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".json"))
+        if not paths:
+            raise FileNotFoundError(f"no .json traces under {path!r}")
+    events = []
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        raw = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+        if not isinstance(raw, list):
+            raise ValueError(f"{p!r} is not a chrome trace "
+                             "(no traceEvents array)")
+        for ev in raw:
+            ph = ev.get("ph")
+            if ph not in ("X", "i", "I"):
+                continue  # metadata / flow / counter events
+            events.append({
+                "name": ev.get("name", ""),
+                "ts_us": float(ev.get("ts", 0.0)),
+                "dur_us": float(ev["dur"]) if "dur" in ev else None,
+                "tid": ev.get("tid"),
+                "pid": ev.get("pid"),
+                "args": ev.get("args") or {},
+            })
+    return ProfilerResult(events, path=path)
 
 
 class SortedKeys(enum.Enum):
@@ -265,6 +380,9 @@ def export_protobuf(dir_name, worker_name=None):
     contract)."""
 
     def handler(prof):
-        prof.export(dir_name, format="json")
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        prof.export(os.path.join(dir_name, f"{name}_w{prof._windows}.json"))
 
+    handler.export_dir = dir_name
     return handler

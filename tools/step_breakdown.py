@@ -19,6 +19,12 @@ Implied splits (full-fwd_bwd = optimizer+clip; fwd_bwd-fwd = backward) print
 alongside, with achieved TFLOP/s per region so the under-performer is
 obvious. Usage: python tools/step_breakdown.py [--model base|medium]
 [--batch N]. Writes one JSON line per region.
+
+Relation to paddle_tpu.observability: this probe re-times each region in a
+FRESH synthetic run; the in-process tracer + StepTelemetry record what a
+REAL run did (spans, per-step JSONL) with no separate probe launch. Use
+tools/trace_summary.py on a run's telemetry output, then this probe to dig
+into a region it flags.
 """
 import json
 

@@ -1,0 +1,137 @@
+"""Summarize telemetry artifacts: StepTelemetry JSONL or chrome-trace JSON.
+
+The offline half of paddle_tpu/observability: point it at what a run wrote
+and get per-region/per-step tables, so `tools/step_breakdown.py` (fresh
+synthetic probe runs) and the in-process tracer (what the REAL run did)
+can be compared region by region.
+
+  python tools/trace_summary.py /tmp/tele/step_telemetry.jsonl
+  python tools/trace_summary.py /tmp/paddle_tpu_profile/host_1234.json
+  python tools/trace_summary.py /tmp/paddle_tpu_profile/   # merged dir
+
+Format is auto-detected: a JSONL stream of step records gets the per-step
+throughput table; anything loadable by profiler.load_profiler_result gets
+the per-span table (calls/total/avg/max/min, the Profiler.summary layout).
+Output ends with one machine-readable JSON summary line, matching the other
+tools/ probes' convention.
+"""
+import json
+import os
+import sys
+
+import _bootstrap  # noqa: F401  (repo-root sys.path)
+
+
+def _fmt_table(header, rows):
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    def line(r):
+        return "  ".join(str(c).rjust(w) if i else str(c).ljust(w)
+                         for i, (c, w) in enumerate(zip(r, widths)))
+    print(line(header))
+    for r in rows:
+        print(line(r))
+
+
+def _is_jsonl(path):
+    with open(path) as f:
+        first = f.readline().strip()
+    if not first:
+        return False
+    try:
+        doc = json.loads(first)
+    except json.JSONDecodeError:
+        return False
+    return isinstance(doc, dict) and "traceEvents" not in doc
+
+
+def summarize_steps(path):
+    recs = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                recs.append(json.loads(ln))
+    if not recs:
+        print("no records")
+        return {}
+    n = len(recs)
+
+    def col(k):
+        return [r[k] for r in recs if isinstance(r.get(k), (int, float))]
+
+    def mean(xs):
+        return sum(xs) / len(xs) if xs else None
+
+    walls = col("wall_time_s")
+    rows = []
+    for k, fmt in (("wall_time_s", "{:.4f}"), ("reader_cost_s", "{:.4f}"),
+                   ("tokens_per_sec", "{:.1f}"), ("samples_per_sec", "{:.1f}"),
+                   ("tflops_per_sec", "{:.2f}"), ("mfu", "{:.4f}"),
+                   ("loss", "{:.4f}")):
+        xs = col(k)
+        if xs:
+            rows.append([k, len(xs), fmt.format(mean(xs)),
+                         fmt.format(min(xs)), fmt.format(max(xs))])
+    _fmt_table(["field", "n", "mean", "min", "max"], rows)
+    last = recs[-1]
+    summary = {
+        "kind": "step_telemetry", "steps": n,
+        "mean_wall_time_s": round(mean(walls), 6) if walls else None,
+        "total_wall_time_s": round(sum(walls), 4) if walls else None,
+        "mean_tokens_per_sec": (round(mean(col("tokens_per_sec")), 1)
+                                if col("tokens_per_sec") else None),
+        "mean_mfu": round(mean(col("mfu")), 4) if col("mfu") else None,
+        "jit_compiles": last.get("jit_compiles"),
+        "jit_recompiles": last.get("jit_recompiles"),
+        "jit_compile_ms": last.get("jit_compile_ms"),
+        "nan_inf_hits": last.get("nan_inf_hits"),
+    }
+    print(json.dumps({"summary": summary}))
+    return summary
+
+
+def summarize_trace(path):
+    from paddle_tpu.profiler import load_profiler_result
+
+    res = load_profiler_result(path)
+    stats = res.stats()
+    if not stats:
+        print("no complete events in trace")
+        return {}
+    rows = [[name, cnt, f"{tot * 1e3:.3f}", f"{tot / cnt * 1e3:.3f}",
+             f"{mx * 1e3:.3f}", f"{mn * 1e3:.3f}"]
+            for name, (cnt, tot, mx, mn) in
+            sorted(stats.items(), key=lambda kv: -kv[1][1])]
+    _fmt_table(["region", "calls", "total_ms", "avg_ms", "max_ms", "min_ms"],
+               rows)
+    t0, t1 = res.time_range()
+    top = max(stats.items(), key=lambda kv: kv[1][1])
+    summary = {
+        "kind": "chrome_trace", "events": len(res.events),
+        "regions": len(stats),
+        "span_s": round((t1 - t0) / 1e6, 4),
+        "hottest_region": top[0],
+        "hottest_total_ms": round(top[1][1] * 1e3, 3),
+    }
+    print(json.dumps({"summary": summary}))
+    return summary
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="StepTelemetry .jsonl, chrome-trace .json, "
+                                 "or a directory of traces")
+    args = ap.parse_args()
+    if not os.path.exists(args.path):
+        sys.exit(f"no such path: {args.path}")
+    if os.path.isfile(args.path) and _is_jsonl(args.path):
+        summarize_steps(args.path)
+    else:
+        summarize_trace(args.path)
+
+
+if __name__ == "__main__":
+    main()
