@@ -197,15 +197,48 @@ _RULE_CACHE: Dict[tuple, tuple] = {}
 _RULE_CACHE_CAP = 4096
 _UNSEEN = object()
 
+# id(code) -> (code, cell content objects, frozen closure, defaults tuple,
+# frozen defaults). The closure/defaults freeze is the recursive-walk cost of
+# every dispatch; for stable kernels (module-level op functions — the steady
+# state) the cell content objects are identity-stable across calls, so the
+# frozen projection is reusable. Validity is checked by IDENTITY of every
+# cell's content (and of the defaults tuple): a closure of the same code
+# object over different values, or a nonlocal rebind, misses and re-freezes.
+# Entries pin code + contents so ids cannot be recycled while cached; the
+# memo is dropped with the rule cache (_clear_rule_cache).
+_FREEZE_MEMO: Dict[int, tuple] = {}
+
+
+def _clear_rule_cache():
+    _RULE_CACHE.clear()
+    _FREEZE_MEMO.clear()
+
+
+def _frozen_kernel_parts(kernel, code):
+    """(frozen closure values, frozen defaults), memoized per code object.
+    Raises _Unhashable (and memoizes nothing — an array/tracer cell must not
+    be pinned) when the kernel cannot key a cache entry."""
+    cells = getattr(kernel, "__closure__", None) or ()
+    defaults = getattr(kernel, "__defaults__", None) or ()
+    memo = _FREEZE_MEMO.get(id(code))
+    if (memo is not None and len(memo[1]) == len(cells)
+            and memo[3] is defaults
+            and all(c.cell_contents is v for c, v in zip(cells, memo[1]))):
+        return memo[2], memo[4]
+    closure_vals = tuple(_freeze(c.cell_contents) for c in cells)
+    frozen_defaults = _freeze(defaults)
+    _FREEZE_MEMO[id(code)] = (
+        code, tuple(c.cell_contents for c in cells), closure_vals, defaults,
+        frozen_defaults)
+    return closure_vals, frozen_defaults
+
 
 def _rule_key(name, kernel, arrays, attrs, diff_idx, cast_to):
     code = getattr(kernel, "__code__", None)
     if code is None:
         return None  # pre-jitted / callable object: no stable identity to key on
     try:
-        closure_vals = tuple(
-            _freeze(c.cell_contents) for c in (getattr(kernel, "__closure__", None) or ()))
-        defaults = _freeze(getattr(kernel, "__defaults__", None) or ())
+        closure_vals, defaults = _frozen_kernel_parts(kernel, code)
         akey = tuple(sorted((k, _freeze(v)) for k, v in attrs.items()))
     except _Unhashable:
         return None
@@ -304,7 +337,7 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
             if rules is _UNSEEN:
                 _RULE_MISSES.increase()
                 if len(_RULE_CACHE) >= _RULE_CACHE_CAP:
-                    _RULE_CACHE.clear()
+                    _clear_rule_cache()
                 rules = _build_rules(kernel, attrs, diff_idx, cast_to)
                 _RULE_CACHE[key] = rules
             else:
@@ -446,7 +479,7 @@ def as_tensor(x, dtype=None):
 # tuned block choice into its trace)
 from . import autotune as _autotune  # noqa: E402
 
-_autotune.on_change(_RULE_CACHE.clear)
+_autotune.on_change(_clear_rule_cache)
 
 # flags listed in the cache key are safe; any OTHER flag change conservatively
 # clears the cache, so a future kernel reading a new flag at trace time can
@@ -458,7 +491,7 @@ _TRACE_KEY_FLAGS = frozenset({"tpu_matmul_precision", "use_flash_attention",
 
 def _on_flag_change(name):
     if name not in _TRACE_KEY_FLAGS:
-        _RULE_CACHE.clear()
+        _clear_rule_cache()
 
 
 from . import flags as _flags  # noqa: E402

@@ -134,7 +134,9 @@ class CostModel:
         fn = fwd_fns[name]
 
         def cost_of(f):
-            c = jax.jit(f).lower(x).compile().cost_analysis() or {}
+            from ..utils.hlo_inspect import cost_analysis_dict
+
+            c = cost_analysis_dict(jax.jit(f).lower(x).compile())
             flops = float(c.get("flops", 0.0))
             bytes_ = float(c.get("bytes accessed", 0.0))
             est_ms = (flops / _PEAK_FLOPS + bytes_ / _PEAK_BW) * 1e3

@@ -21,6 +21,7 @@ from .mesh import (  # noqa: F401
     set_hybrid_communicate_group,
 )
 from .engine import TrainStepEngine, parallelize  # noqa: F401
+from .prefetcher import DevicePrefetcher  # noqa: F401
 from .store import FileStore, TCPStore  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_tensor  # noqa: F401

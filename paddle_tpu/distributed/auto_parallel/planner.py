@@ -135,7 +135,9 @@ _FLOP_PER_BYTE = 250.0
 def score_compiled(comp) -> Dict:
     """Cost-model readout shared by the hybrid-config and mesh-shape
     planners: HBM traffic, ICI volume, peak memory, flops, time proxy."""
-    ca = comp.cost_analysis() or {}
+    from ...utils.hlo_inspect import cost_analysis_dict
+
+    ca = cost_analysis_dict(comp)
     ma = comp.memory_analysis()
     coll = collective_bytes(comp.as_text())
     hbm = int(ca.get("bytes accessed", 0))

@@ -34,6 +34,7 @@ from ..jit import functional_call
 from ..observability import tracer as _obs_tracer
 from ..observability.step_telemetry import StepTelemetry
 from ..optimizer import functional as opt_funct
+from . import prefetcher as _pf
 from .mesh import HybridCommunicateGroup, get_hybrid_communicate_group
 
 # jit-path observability (core.monitor registry): every compile of a step
@@ -179,6 +180,9 @@ class TrainStepEngine:
                 jax.device_put(s, self._opt_sharding(spec)) for s in st)
 
         self._step_fn = None
+        self._batch_shardings = None   # resolved lazily from the first batch
+        self._pending_h2d = None       # (h2d_ms, depth) staged by prefetch()
+        self.prefetcher = None         # last DevicePrefetcher built by prefetch()
         self._scan_fns = {True: None, False: None}  # fixed_batch -> jitted scan
         self._scan_batch_shardings = {}
         self._step_count = optimizer._step_count
@@ -369,15 +373,9 @@ class TrainStepEngine:
             n: tuple(NamedSharding(self.mesh, self.opt_specs[n])
                      for _ in self.opt_state[n])
             for n in self._param_names}
-        if self.input_specs is not None:
-            batch_shardings = tuple(NamedSharding(self.mesh, s) for s in self.input_specs)
-        else:
-            batch_shardings = tuple(
-                NamedSharding(self.mesh, _default_input_spec(a.shape, self.hcg))
-                for a in batch_avals)
+        batch_shardings = self._shardings_for(batch_avals)
         scalar = NamedSharding(self.mesh, P())
 
-        self._batch_shardings = batch_shardings
         return jax.jit(
             step,
             in_shardings=(param_shardings, opt_shardings, scalar, scalar, scalar)
@@ -445,6 +443,32 @@ class TrainStepEngine:
         )
 
     # ---- shared step plumbing ----
+    def _shardings_for(self, arrays):
+        """Per-position batch shardings (input_specs, or the default
+        dp/sharding/sp layout from the first batch's shapes). Cached — the
+        same tuple serves _build, step() placement, and the prefetcher."""
+        if self._batch_shardings is None:
+            if self.input_specs is not None:
+                self._batch_shardings = tuple(
+                    NamedSharding(self.mesh, s) for s in self.input_specs)
+            else:
+                self._batch_shardings = tuple(
+                    NamedSharding(self.mesh,
+                                  _default_input_spec(a.shape, self.hcg))
+                    for a in arrays)
+        return self._batch_shardings
+
+    def _place_batch(self, arrays, shardings, timed=False):
+        """Sharded host->device placement that SKIPS arrays already placed
+        with a matching sharding (a prefetched batch pays no second
+        device_put). Returns (arrays, h2d issue ms | None)."""
+        t0 = time.perf_counter() if timed else None
+        arrays = [a if _pf.is_placed(a, s) else jax.device_put(a, s)
+                  for a, s in zip(arrays, shardings)]
+        if timed:
+            return arrays, (time.perf_counter() - t0) * 1000.0
+        return arrays, None
+
     def _check_batch(self, arrays, lead_axes=0):
         """The dp*sharding divisibility guard, shared by step()/run_steps()."""
         batch_axes = self.hcg.degrees["dp"] * self.hcg.degrees["sharding"]
@@ -497,8 +521,9 @@ class TrainStepEngine:
         autotune.set_step(self._step_count + k)
         if self._scan_fns[fixed] is None:
             self._scan_fns[fixed] = self._build_scan(arrays, fixed)
-        arrays = [jax.device_put(a, s)
-                  for a, s in zip(arrays, self._scan_batch_shardings[fixed])]
+        arrays, h2d_ms = self._place_batch(
+            arrays, self._scan_batch_shardings[fixed],
+            timed=self.telemetry is not None)
         # host-side schedule bookkeeping, mirroring step(): one lr per step
         step0 = self._step_count + 1
         lrs = []
@@ -538,6 +563,7 @@ class TrainStepEngine:
                 samples=samples * k if samples else None,
                 tokens=tokens * k if tokens else None,
                 loss=float(jax.device_get(losses[-1])),
+                h2d_ms=h2d_ms,
                 extra={"steps_fused": k})
         return Tensor(losses)
 
@@ -568,8 +594,17 @@ class TrainStepEngine:
         autotune.set_step(self._step_count + 1)
         if self._step_fn is None:
             self._step_fn = self._build(arrays)
-        # place batch according to specs (host->device with the right sharding)
-        arrays = [jax.device_put(a, s) for a, s in zip(arrays, self._batch_shardings)]
+        # place batch according to specs (host->device with the right
+        # sharding); arrays staged by prefetch() arrive already placed and
+        # skip the put — their H2D stats were captured at issue time
+        staged, self._pending_h2d = self._pending_h2d, None
+        arrays, h2d_ms = self._place_batch(
+            arrays, self._batch_shardings,
+            timed=self.telemetry is not None and staged is None)
+        if staged is not None:
+            h2d_ms, prefetch_depth = staged
+        else:
+            prefetch_depth = None
         self._step_count += 1
         self.optimizer._step_count = self._step_count  # keep ckpt/resume consistent
         lr_val = self.optimizer.get_lr()
@@ -599,10 +634,41 @@ class TrainStepEngine:
             samples, tokens = self._batch_stats(arrays)
             tele.record_step(
                 step=self._step_count, wall_time=t1 - t0, samples=samples,
-                tokens=tokens, loss=float(jax.device_get(loss)))
+                tokens=tokens, loss=float(jax.device_get(loss)),
+                h2d_ms=h2d_ms, prefetch_depth=prefetch_depth)
         return self.last_loss
 
     train_batch = step
+
+    def prefetch(self, loader, depth: int = 2):
+        """Iterate `loader` as device-placed batches: the sharded H2D for the
+        next `depth` batches is issued while the current step's program is
+        still executing (JAX async dispatch), so transfer overlaps compute.
+
+            for batch in engine.prefetch(loader):
+                engine.step(*batch)
+
+        step() skips its own device_put for the pre-placed arrays (one
+        transfer per batch total) and records the prefetcher's per-batch
+        h2d_ms / prefetch_depth in StepTelemetry. The loader may yield
+        Tensors or raw arrays; batch layout must match step(*batch)."""
+        pf = _pf.DevicePrefetcher(self._shardings_for, depth=depth)
+        self.prefetcher = pf
+
+        def arrays_iter():
+            for batch in loader:
+                if not isinstance(batch, (tuple, list)):
+                    batch = (batch,)
+                arrays = self._to_arrays(batch)
+                self._check_batch(arrays)
+                yield arrays
+
+        def placed_iter():
+            for placed in pf.iterate(arrays_iter()):
+                self._pending_h2d = (pf.last_h2d_ms, pf.last_depth)
+                yield placed
+
+        return placed_iter()
 
     def sync_to_model(self):
         """Write engine-owned (possibly sharded) params back into the eager Layer."""

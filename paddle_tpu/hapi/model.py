@@ -117,14 +117,16 @@ class Model:
         return vals
 
     # ---- loops ----
-    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last=False):
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last=False,
+                     prefetch_factor=2):
         from ..io import DataLoader, Dataset, IterableDataset
 
         if data is None or isinstance(data, DataLoader):
             return data
         if isinstance(data, (Dataset, IterableDataset)):
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                              num_workers=num_workers, drop_last=drop_last)
+                              num_workers=num_workers, drop_last=drop_last,
+                              prefetch_factor=prefetch_factor)
         # any other iterable of ready-made batches: materialize so a generator
         # survives re-iteration across epochs
         return data if hasattr(data, "__getitem__") else list(data)
@@ -132,11 +134,11 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, prefetch_factor=2):
         assert train_data is not None, "train_data must be given"
         self._save_dir = save_dir
         loader = self._make_loader(train_data, batch_size, shuffle, num_workers,
-                                   drop_last)
+                                   drop_last, prefetch_factor=prefetch_factor)
         eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)
         try:
             steps = len(loader)
@@ -160,7 +162,11 @@ class Model:
             pending_update = False
             # manual iteration so the dataloader fetch is timed: reader_cost
             # rides in logs for ProgBar/telemetry and is what Benchmark's
-            # step(reader_cost=...) hook receives instead of a fake 0.0
+            # step(reader_cost=...) hook receives instead of a fake 0.0.
+            # With num_workers > 0 (or the default buffered reader) batch
+            # production runs in background threads, so this measures the
+            # RESIDUAL (non-overlapped) wait — near zero when the pipeline
+            # keeps up — not the full fetch+collate cost.
             batches = iter(enumerate(loader))
             while True:
                 t_fetch = time.perf_counter()

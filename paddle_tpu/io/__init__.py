@@ -3,10 +3,14 @@
 Reference: python/paddle/fluid/reader.py:146 (DataLoader), python/paddle/fluid/dataloader/
 (multiprocess workers over shared memory, batch samplers, DistributedBatchSampler).
 
-TPU-native: the hot path is host->HBM transfer; the loader keeps worker multiprocessing for
-CPU-bound decode (fork + queues — shared-memory numpy handoff) and adds device prefetch
-(double buffering) so input pipeline overlaps the TPU step, the role the reference's
-InMemoryDataFeed threads play (paddle/fluid/framework/data_feed.h:966).
+TPU-native: the hot path is host->HBM transfer; with num_workers > 0 a thread
+pool runs dataset fetch + collate ahead of the consumer into a bounded queue
+(collate releases the GIL in jnp's C layer, and the produced batches are
+device-ready arrays, so no pickling/shared-memory handoff is needed), the role
+the reference's InMemoryDataFeed threads play (paddle/fluid/framework/
+data_feed.h:966). The engine-side half of the pipeline —
+distributed.DevicePrefetcher / TrainStepEngine.prefetch — then issues the
+sharded device_put for the next batches while the current step executes.
 """
 from __future__ import annotations
 
@@ -245,33 +249,164 @@ def default_collate_fn(batch):
 
 
 class _PrefetchIterator:
-    """Background-thread prefetch: overlaps host batch assembly + H2D with the device step."""
+    """Background-thread prefetch: overlaps host batch assembly + H2D with the device step.
+
+    Single producer thread filling a bounded queue; the consumer pays only
+    residual (non-overlapped) wait. Producer exceptions are re-raised at the
+    consumer's next(); close() (also on GC) stops the producer promptly even
+    when the consumer abandons the iterator mid-epoch — without it the
+    producer would block forever on a full queue."""
+
+    _DONE = object()
 
     def __init__(self, it, depth=2):
-        self._q = queue_mod.Queue(maxsize=depth)
+        self._q = queue_mod.Queue(maxsize=max(1, depth))
         self._it = it
-        self._done = object()
+        self._stop = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
     def _worker(self):
         try:
             for item in self._it:
-                self._q.put(item)
-        except Exception as e:  # propagate
-            self._q.put(("__error__", e))
-        self._q.put(self._done)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # propagate to the consumer
+            if not self._stop.is_set():
+                self._q.put(("__error__", e))
+            return
+        self._q.put(self._DONE)
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
         item = self._q.get()
-        if item is self._done:
+        if item is self._DONE:
+            self._stop.set()
             raise StopIteration
         if isinstance(item, tuple) and len(item) == 2 and item[0] == "__error__":
+            self.close()
             raise item[1]
         return item
+
+    def close(self):
+        self._stop.set()
+        while True:  # unblock a producer stuck on a full queue
+            try:
+                self._q.get_nowait()
+            except queue_mod.Empty:
+                break
+        if self._thread.is_alive():
+            self._thread.join(timeout=1.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _WorkerError:
+    """Carrier re-raising a worker exception at the consumer, batch-ordered."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class _OrderedWorkerPool:
+    """num_workers threads run dataset fetch + collate AHEAD of the consumer.
+
+    The producer side of the async input pipeline: each worker pulls a
+    (batch_id, indices) task, materializes samples and collates them into
+    device-ready arrays, and pushes into a bounded output queue
+    (num_workers * prefetch_factor deep — total look-ahead is bounded, like
+    the reference's multiprocess DataLoader outstanding-batch cap). The
+    consumer reorders by batch_id so delivery order matches the sampler
+    regardless of worker scheduling. Shutdown is cooperative: close() (also
+    via GC / generator close) sets a stop event that both the task pull and
+    the output put observe, then joins the threads."""
+
+    def __init__(self, dataset, batches, collate_fn, num_workers,
+                 prefetch_factor):
+        self._dataset = dataset
+        self._collate_fn = collate_fn
+        self._n_batches = len(batches)
+        self._task_q = queue_mod.Queue()
+        for task in enumerate(batches):
+            self._task_q.put(task)
+        self._out_q = queue_mod.Queue(
+            maxsize=max(1, num_workers * max(1, prefetch_factor)))
+        self._stop = threading.Event()
+        self._pending = {}
+        self._next_bid = 0
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"paddle_tpu-io-worker-{i}")
+            for i in range(max(1, num_workers))]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                bid, indices = self._task_q.get_nowait()
+            except queue_mod.Empty:
+                return
+            try:
+                item = self._collate_fn([self._dataset[i] for i in indices])
+            except BaseException as e:
+                item = _WorkerError(e)
+            while not self._stop.is_set():
+                try:
+                    self._out_q.put((bid, item), timeout=0.1)
+                    break
+                except queue_mod.Full:
+                    continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set() or self._next_bid >= self._n_batches:
+            self.close()
+            raise StopIteration
+        # every task yields exactly one queue item, so this get terminates;
+        # task pickup is FIFO, so next_bid is always among the in-flight set
+        while self._next_bid not in self._pending:
+            bid, item = self._out_q.get()
+            self._pending[bid] = item
+        item = self._pending.pop(self._next_bid)
+        self._next_bid += 1
+        if isinstance(item, _WorkerError):
+            self.close()
+            raise item.exc
+        return item
+
+    def close(self):
+        self._stop.set()
+        while True:  # unblock workers stuck on a full output queue
+            try:
+                self._out_q.get_nowait()
+            except queue_mod.Empty:
+                break
+        for t in self._threads:
+            if t.is_alive():
+                t.join(timeout=1.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class DataLoader:
@@ -306,67 +441,21 @@ class DataLoader:
                 if len(batch) < self.batch_size and self.drop_last:
                     return
                 yield self.collate_fn(batch)
-        elif self.num_workers > 0:
-            yield from self._iter_multiprocess()
         else:
             for indices in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
-    def _iter_multiprocess(self):
-        import multiprocessing as mp
-
-        ctx = mp.get_context("fork")
-        index_q = ctx.Queue()
-        out_q = ctx.Queue(maxsize=self.num_workers * self.prefetch_factor)
-        batches = list(self.batch_sampler)
-        for bid, indices in enumerate(batches):
-            index_q.put((bid, indices))
-        for _ in range(self.num_workers):
-            index_q.put(None)
-
-        dataset = self.dataset
-
-        def worker():
-            while True:
-                item = index_q.get()
-                if item is None:
-                    out_q.put(None)
-                    return
-                bid, indices = item
-                samples = [dataset[i] for i in indices]
-                np_samples = _to_numpy_tree(samples)
-                out_q.put((bid, np_samples))
-
-        procs = [ctx.Process(target=worker, daemon=True) for _ in range(self.num_workers)]
-        for p in procs:
-            p.start()
-        finished = 0
-        pending = {}
-        next_bid = 0
-        received = 0
-        try:
-            while finished < self.num_workers or pending or received < len(batches):
-                if next_bid in pending:
-                    samples = pending.pop(next_bid)
-                    next_bid += 1
-                    yield self.collate_fn(samples)
-                    continue
-                if finished == self.num_workers and received == len(batches):
-                    break
-                item = out_q.get()
-                if item is None:
-                    finished += 1
-                    continue
-                bid, samples = item
-                received += 1
-                pending[bid] = samples
-        finally:
-            for p in procs:
-                p.terminate()
-
     def __iter__(self):
+        # num_workers > 0: a thread pool runs fetch + collate ahead of the
+        # consumer into a bounded queue (batch order preserved; exceptions
+        # re-raised at next(); clean shutdown on close/GC). Iterable datasets
+        # cannot be index-partitioned, so they keep a single producer thread.
+        if self.num_workers > 0 and not self._iterable_mode:
+            return _OrderedWorkerPool(
+                self.dataset, list(self.batch_sampler), self.collate_fn,
+                self.num_workers, self.prefetch_factor)
         it = self._iter_batches()
-        if self.use_buffer_reader:
+        if self.num_workers > 0 or self.use_buffer_reader:
             return _PrefetchIterator(it, depth=self.prefetch_factor)
         return it
 
@@ -374,16 +463,6 @@ class DataLoader:
         if self._iterable_mode:
             raise TypeError("IterableDataset has no length")
         return len(self.batch_sampler)
-
-
-def _to_numpy_tree(obj):
-    if isinstance(obj, Tensor):
-        return np.asarray(obj._data)
-    if isinstance(obj, (list, tuple)):
-        return type(obj)(_to_numpy_tree(o) for o in obj)
-    if isinstance(obj, dict):
-        return {k: _to_numpy_tree(v) for k, v in obj.items()}
-    return obj
 
 
 def get_worker_info():

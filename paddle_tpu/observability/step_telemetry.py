@@ -100,6 +100,8 @@ class StepTelemetry:
                     tokens: Optional[int] = None,
                     loss: Optional[float] = None,
                     reader_cost: Optional[float] = None,
+                    h2d_ms: Optional[float] = None,
+                    prefetch_depth: Optional[int] = None,
                     phase: str = "train",
                     extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Emit one record; returns it (tests read the return directly)."""
@@ -113,6 +115,13 @@ class StepTelemetry:
             rec["loss"] = float(loss)
         if reader_cost is not None:
             rec["reader_cost_s"] = round(reader_cost, 6)
+        if h2d_ms is not None:
+            # host->device staging: the batch's sharded device_put issue wall
+            # time (async dispatch — issue cost, not transfer completion)
+            rec["h2d_ms"] = round(h2d_ms, 3)
+        if prefetch_depth is not None:
+            # look-ahead the consumer actually had when this batch was taken
+            rec["prefetch_depth"] = int(prefetch_depth)
         if samples is not None:
             rec["samples"] = int(samples)
             rec["samples_per_sec"] = round(samples / max(wall_time, 1e-9), 2)

@@ -370,8 +370,8 @@ def _tuned_blocks(bh, sq, sk, d, dtype, sm_scale, causal):
     if not autotune.should_tune():  # closed window / multi-controller: no timing
         return default
     # 1024 joins the space only where the BACKWARD working set fits: the
-    # tuned choice is shared with the bwd kernels (the tuner times fwd
-    # only), whose bodies hold ~4 score-sized f32 intermediates
+    # tuned choice is shared with the bwd kernels (which the tuner also
+    # compiles + times, see below), whose bodies hold ~4 score-sized f32 intermediates
     # (s/p/dp/ds) — so the guard budgets 4 * bq * bk * 4 B <= 8 MB of
     # v5e's 16 MB VMEM, admitting (512,1024)/(1024,512) but not
     # (1024,1024), whose ~16 MB bwd set would spill or fail Mosaic. At
@@ -394,16 +394,28 @@ def _tuned_blocks(bh, sq, sk, d, dtype, sm_scale, causal):
     va = jnp.asarray(rng.randn(bh, sk, d), dtype=dtype)
 
     # one jitted executable per candidate, shared by the warmup and timed calls
-    # (a fresh lambda per call would re-compile and time the compiler instead)
-    compiled = {
-        blocks: jax.jit(functools.partial(
-            lambda bl, a, b, c: _fwd(a, b, c, sm_scale, causal, bl)[0], blocks))
-        for blocks in candidates}
+    # (a fresh lambda per call would re-compile and time the compiler instead).
+    # The tuned choice binds the FA2 BACKWARD kernels too (the pick is reused
+    # at training time), so each candidate is compiled AND timed through
+    # value_and_grad: fwd + both bwd kernels. A block pair whose backward
+    # fails Mosaic compile raises here and is skipped by pick() — it can no
+    # longer win on forward time and then fail only at training time
+    # (ADVICE r5 #1), and the argmin now optimizes the full train-step cost.
+    def _make_fb(blocks):
+        def loss(a, b, c):
+            return jnp.sum(
+                _flash_bhsd(a, b, c, sm_scale, causal, blocks)
+                .astype(jnp.float32))
+
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    compiled = {blocks: _make_fb(blocks) for blocks in candidates}
 
     def run(blocks):
-        out = compiled[blocks](qa, ka, va)
-        np.asarray(out[0, 0, 0])  # D2H sync (block_until_ready can return
-        #                           early through a remote PJRT tunnel)
+        dq, dk, dv = compiled[blocks](qa, ka, va)
+        np.asarray(dq[0, 0, 0])  # D2H sync (block_until_ready can return
+        np.asarray(dk[0, 0, 0])  # early through a remote PJRT tunnel); the
+        np.asarray(dv[0, 0, 0])  # grads drain both backward kernels
 
     return autotune.pick("flash_attention", key, candidates, run, default=default)
 

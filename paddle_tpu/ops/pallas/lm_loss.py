@@ -317,13 +317,21 @@ def _bwd_rule(block_n, block_v, v_true, res, g):
 _lm_loss.defvjp(_fwd_rule, _bwd_rule)
 
 
-def lm_head_cross_entropy(h2, w, labels, block_n=1024):
+def lm_head_cross_entropy(h2, w, labels, block_n=256):
     """h2 [N, H], w [V, H], labels [N] int32 (already ignore-masked to a safe
     index by the caller) -> per-row loss [N] f32. Caller guarantees
     supported(N, V, H). W is padded to a 512-multiple vocab internally (padded
     columns masked to NEG_INF; dW for them is zero and sliced off by autodiff
     of the pad). RETIRED from the training path (BASELINE.md round 5): not
-    routed by ops/fused.py; available as a direct-call library kernel only."""
+    routed by ops/fused.py; available as a direct-call library kernel only.
+
+    block_n hazard: 1024 is the documented Mosaic compile pathology at bench
+    vocab (50304 -> the round-3 probe measured >9.5 min of Mosaic compile for
+    the forward alone at 1024x512 and wedged the chip tunnel twice,
+    BASELINE.md round 3) — compile time grows superlinearly in the kernel
+    body's tile count. The default is therefore 256, the value bench actually
+    shipped; only raise it at small vocab after probing compile time
+    (tools/lmloss_compile_probe.py)."""
     n = h2.shape[0]
     v = w.shape[0]
     assert _pick_rows(n) == 1024  # callers pad rows to a 1024 multiple

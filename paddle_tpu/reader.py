@@ -2,7 +2,9 @@
 decorator.py). Kept for API parity with old-style input pipelines."""
 from __future__ import annotations
 
+import queue as _queue
 import random as _random
+import threading as _threading
 
 
 def shuffle(reader, buf_size):
@@ -22,8 +24,54 @@ def shuffle(reader, buf_size):
 
 
 def buffered(reader, size):
+    """Decorate `reader` with a bounded background buffer of `size` items.
+
+    Reference semantics (python/paddle/reader/decorator.py buffered): a
+    producer thread runs the underlying reader up to `size` items ahead so
+    the consumer only pays residual wait. Producer exceptions re-raise at the
+    consumer; closing the returned generator stops the producer thread."""
+    _DONE = object()
+
     def reader_():
-        yield from reader()  # single-process parity shim
+        q = _queue.Queue(maxsize=max(1, int(size)))
+        stop = _threading.Event()
+
+        def produce():
+            try:
+                for item in reader():
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except _queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:
+                if not stop.is_set():
+                    q.put(("__error__", e))
+                return
+            q.put(_DONE)
+
+        t = _threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _DONE:
+                    return
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] == "__error__":
+                    raise item[1]
+                yield item
+        finally:
+            stop.set()
+            while True:  # unblock a producer stuck on a full queue
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    break
+            t.join(timeout=1.0)
 
     return reader_
 

@@ -9,7 +9,18 @@ optimized HLO.
 from __future__ import annotations
 
 import re
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+
+def cost_analysis_dict(compiled) -> Dict:
+    """`compiled.cost_analysis()` normalized to ONE flat dict across jax
+    versions: older releases return a list with one dict per device program,
+    newer ones the dict itself. Every cost-model consumer goes through here
+    so the version drift is absorbed in one place."""
+    ca = compiled.cost_analysis() or {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca
 
 _SHAPE_RE = re.compile(r"=\s*\S*\s*(bf16|f32|f16|s32|s64)\[([\d,]*)\]")
 _BF16_CONVERT_RE = re.compile(r"=\s*bf16\[([\d,]+)\]\S*\s+convert\(")

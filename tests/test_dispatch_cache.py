@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 
 def setup_function(_):
-    dispatch._RULE_CACHE.clear()
+    dispatch._clear_rule_cache()
 
 
 def test_cache_hit_same_kernel():
@@ -142,5 +142,58 @@ def test_autotune_config_invalidates_rules():
     assert len(dispatch._RULE_CACHE) == 1
     at.set_config({"kernel": {"enable": False}})  # bump -> on_change clears
     assert len(dispatch._RULE_CACHE) == 0  # stale traces dropped wholesale
+    assert len(dispatch._FREEZE_MEMO) == 0  # the freeze memo goes with it
     dispatch.apply("t_at", lambda x: jnp.matmul(x, x), [a])
     assert len(dispatch._RULE_CACHE) == 1  # rebuilt fresh
+
+
+def test_freeze_memo_short_circuits_steady_state(monkeypatch):
+    """Cache hits must not re-freeze the kernel's closure/defaults: after the
+    first call the frozen projection is memoized per code object and the hit
+    path does zero _freeze walks (perf_opt PR 2 satellite)."""
+    a = Tensor(jnp.ones((4,)), stop_gradient=False)
+    scale = 2.5
+
+    def kernel(x):
+        return x * scale  # one closure cell
+
+    dispatch.apply("t_memo", kernel, [a])
+    assert id(kernel.__code__) in dispatch._FREEZE_MEMO
+    calls = {"n": 0}
+    real = dispatch._freeze
+
+    def counting(v):
+        calls["n"] += 1
+        return real(v)
+
+    monkeypatch.setattr(dispatch, "_freeze", counting)
+    out = dispatch.apply("t_memo", kernel, [a])
+    assert calls["n"] == 0  # memo hit: no re-freeze on the hot path
+    np.testing.assert_allclose(out.numpy(), 2.5 * np.ones(4))
+
+
+def test_freeze_memo_nonlocal_rebind_not_stale():
+    """A nonlocal rebind changes the cell CONTENT object, which must miss the
+    identity-checked memo — a stale frozen value would alias two different
+    kernels under one rule."""
+    a = Tensor(jnp.ones((4,)), stop_gradient=False)
+
+    def make():
+        s = 2.0
+
+        def kernel(x):
+            return x * s
+
+        def rebind(v):
+            nonlocal s
+            s = v
+
+        return kernel, rebind
+
+    kernel, rebind = make()
+    o1 = dispatch.apply("t_rebind", kernel, [a])
+    rebind(3.0)
+    o2 = dispatch.apply("t_rebind", kernel, [a])
+    np.testing.assert_allclose(o1.numpy(), 2 * np.ones(4))
+    np.testing.assert_allclose(o2.numpy(), 3 * np.ones(4))
+    assert len(dispatch._RULE_CACHE) == 2  # two distinct keys, no aliasing

@@ -101,7 +101,9 @@ def test_train_step_flops_accounting():
     minimum the MFU claim in bench.py is computed from."""
     eng, arrays = _dp8_engine(n_linear=4)
     comp = _compile_step(eng, arrays)
-    flops = comp.cost_analysis()["flops"]
+    from paddle_tpu.utils.hlo_inspect import cost_analysis_dict
+
+    flops = cost_analysis_dict(comp)["flops"]
     n_params = sum(int(np.prod(a.shape)) for a in eng.params.values())
     # cost_analysis is per-device; the batch dim is sharded over dp=8
     tokens = arrays[0].shape[0] // 8
@@ -250,9 +252,11 @@ def test_selective_recompute_sits_between_full_and_none():
     assert b_full < b_sel < b_none, (b_full, b_sel, b_none)
 
     def grad_flops(f):
+        from paddle_tpu.utils.hlo_inspect import cost_analysis_dict
+
         g = jax.jit(jax.grad(lambda p: f(p).sum()))
-        c = g.lower(a).compile().cost_analysis() or {}
-        return float(c.get("flops", 0.0))
+        return float(cost_analysis_dict(g.lower(a).compile())
+                     .get("flops", 0.0))
 
     fl_none, fl_full, fl_sel = map(grad_flops, (f_none, f_full, f_sel))
     assert fl_none < fl_sel < fl_full, (fl_none, fl_sel, fl_full)

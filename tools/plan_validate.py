@@ -22,9 +22,11 @@ would be pretending.
 
 Usage:
   python tools/plan_validate.py [--quick] [--measured BENCH_HISTORY.jsonl]
-One JSON line per variant {"tag", "score", "pred_tokens_per_s_rel"}; then a
-summary line. On chip: run the watcher's bench variants first, then re-run
-with --measured to close the loop.
+One JSON line per variant (tag, score, pred_tokens_per_s_rel AND the
+replay-corrected score_corrected / pred_tokens_per_s_rel_corrected — rows
+print after the correction pass); then a summary line. On chip: run the
+watcher's bench variants first, then re-run with --measured to close the
+loop.
 """
 from __future__ import annotations
 
@@ -176,7 +178,10 @@ def measured_tokens(path, seq):
             # row loads it, and excluding them would freeze the measured
             # join at the pre-cache rows. Structurally different programs
             # (scan trainer, pallas kernel variants) stay out.
-            if any(ex.get(k) for k in ("scan", "pallas_ln", "pallas_loss")):
+            # prefetch rows are excluded like scan: input-staging overlap is
+            # dispatch-level, invisible to a per-program cost model
+            if any(ex.get(k) for k in ("scan", "pallas_ln", "pallas_loss",
+                                       "prefetch")):
                 continue
             rec = ex.get("recompute")
             if rec not in (None, "", False, "selective"):
@@ -230,9 +235,19 @@ def main():
                          round(m["peak_policy_bytes"] / 1e6, 1)
                          if m.get("peak_policy_bytes") else None),
                      "pred_tokens_per_s_rel": tokens / m["score"]})
-        print(json.dumps(rows[-1]), flush=True)
+        # progress line while variants score (minutes each in full mode); the
+        # authoritative per-variant row is printed AFTER the replay
+        # correction below, so corrected scores are in the tool output
+        print(f"# scored {v['tag']}", file=sys.stderr, flush=True)
 
     apply_replay_correction(rows, args.seq)
+    for r in rows:
+        # one JSON line per variant, emitted post-correction: carries both
+        # the raw AOT score/prediction and score_corrected /
+        # pred_tokens_per_s_rel_corrected (ADVICE r5 #3 — previously the
+        # rows printed pre-correction and the corrected values were
+        # unrecoverable from tool output)
+        print(json.dumps(r), flush=True)
 
     def ranked(key):
         return sorted(rows, key=lambda r: -r[key])

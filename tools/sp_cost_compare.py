@@ -108,8 +108,9 @@ def main():
             comp = jf.lower(eng.params, eng.opt_state, jnp.float32(1e-4),
                             jnp.int32(1), jax.random.key(0), ids,
                             labels).compile()
-            ca = comp.cost_analysis()
-            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            from paddle_tpu.utils.hlo_inspect import cost_analysis_dict
+
+            ca = cost_analysis_dict(comp)
             ma = comp.memory_analysis()
             txt = comp.as_text()
             peak_mb = round((ma.temp_size_in_bytes +
