@@ -33,6 +33,36 @@ def bench_config(model_name="base"):
                       num_heads=12, max_seq_len=1024), 8, 1024, 20, 3)
 
 
+def _window_plan(steps, n_windows):
+    """Split the timed region into n window lengths (first windows take the
+    remainder) so per-window throughput exposes run variance."""
+    n = max(1, min(n_windows, steps))
+    base, rem = divmod(steps, n)
+    return [base + (1 if i < rem else 0) for i in range(n)]
+
+
+def _window_stats(window_dts, batch, seq):
+    """Per-window tokens/s + median + relative spread: the variance field
+    that makes 1-2%-margin pair comparisons decidable (VERDICT r5 weak #4).
+    window_dts: list of (wall_seconds, steps_in_window)."""
+    import statistics
+
+    rates = [n * batch * seq / d for d, n in window_dts if n and d > 0]
+    if not rates:
+        return None
+    med = statistics.median(rates)
+    return {
+        "windows": len(rates),
+        "window_tokens_per_sec": [round(r, 1) for r in rates],
+        "median_tokens_per_sec": round(med, 1),
+        # (max-min)/median across windows; None needs >= 2 windows. A pair
+        # of configs closer than each other's rel_spread is NOT decidable
+        # from single runs — tools/plan_validate.py applies the same rule
+        "rel_spread": (round((max(rates) - min(rates)) / med, 4)
+                       if len(rates) > 1 else None),
+    }
+
+
 def main():
     import os
 
@@ -137,13 +167,20 @@ def main():
         def repeat_batch(n):
             for _ in range(n):
                 yield (t_ids, t_labels)
+        # windowed timing (VERDICT r5 weak #4): the timed region runs as
+        # n_windows sub-regions, each ended by its own D2H sync, so every
+        # BENCH_HISTORY row carries a median + spread instead of one sample
+        n_windows = int(os.environ.get("PADDLE_TPU_BENCH_WINDOWS", "3"))
+        window_dts = []
         # bf16 matmuls on the MXU (params stay f32, optimizer math f32)
         with paddle.amp.auto_cast(enable=on_tpu, dtype="bfloat16"):
             if scan_mode:
                 # warmup trains the same `warmup` steps as eager mode (so
                 # final_loss stays comparable); the K=steps program for the
                 # timed region compiles via AOT lower/compile — no extra
-                # training, and the timed call hits the jit cache
+                # training, and the timed call hits the jit cache. ONE
+                # fused dispatch: windowing would change the compiled
+                # program, so the row reports a single window honestly.
                 losses = engine.run_steps(t_ids, t_labels, steps=warmup)
                 float(losses[-1].item())
                 engine.warm_scan(t_ids, t_labels, steps=steps)
@@ -151,14 +188,26 @@ def main():
                 losses = engine.run_steps(t_ids, t_labels, steps=steps)
                 final_loss = float(losses[-1].item())
                 dt = time.perf_counter() - t0
+                window_dts.append((dt, steps))
             elif prefetch_mode:
-                for batch in engine.prefetch(repeat_batch(warmup)):
-                    loss = engine.step(*batch)
+                for pb in engine.prefetch(repeat_batch(warmup)):
+                    loss = engine.step(*pb)
                 float(loss.item())  # D2H sync: drains the dispatch queue
+                ends, acc = set(), 0
+                for w in _window_plan(steps, n_windows):
+                    acc += w
+                    ends.add(acc)
                 t0 = time.perf_counter()
-                for batch in engine.prefetch(repeat_batch(steps)):
-                    loss = engine.step(*batch)
-                final_loss = float(loss.item())  # sync ends the timed region
+                tw, done_prev, done = t0, 0, 0
+                for pb in engine.prefetch(repeat_batch(steps)):
+                    loss = engine.step(*pb)
+                    done += 1
+                    if done in ends:
+                        float(loss.item())  # window boundary sync
+                        now = time.perf_counter()
+                        window_dts.append((now - tw, done - done_prev))
+                        tw, done_prev = now, done
+                final_loss = float(loss.item())
                 dt = time.perf_counter() - t0
             else:
                 for _ in range(warmup):
@@ -167,11 +216,14 @@ def main():
                 #                     (block_until_ready can return early
                 #                     through the remote PJRT tunnel)
                 t0 = time.perf_counter()
-                for _ in range(steps):
-                    loss = engine.step(t_ids, t_labels)
-                final_loss = float(loss.item())  # sync ends the timed region
+                for wn in _window_plan(steps, n_windows):
+                    tw = time.perf_counter()
+                    for _ in range(wn):
+                        loss = engine.step(t_ids, t_labels)
+                    final_loss = float(loss.item())  # sync ends the window
+                    window_dts.append((time.perf_counter() - tw, wn))
                 dt = time.perf_counter() - t0
-        return n_params, final_loss, dt
+        return n_params, final_loss, dt, _window_stats(window_dts, batch, seq)
 
     def _autotune_epilogue():
         """loaded = a tuned choice was actually CONSULTED (cache hit), not
@@ -188,7 +240,7 @@ def main():
 
     first_error = None
     try:
-        n_params, final_loss, dt = run_once()
+        n_params, final_loss, dt, timing = run_once()
     except Exception as e:  # e.g. a Mosaic compile failure: degrade, don't zero
         import sys
         import traceback
@@ -204,7 +256,7 @@ def main():
         # surface as a bench error; the tag names the original exception so a
         # degraded number is never mistaken for the tuned one.
         paddle.set_flags({"use_flash_attention": False})
-        n_params, final_loss, dt = run_once()
+        n_params, final_loss, dt, timing = run_once()
         degraded = "+".join(filter(None, [
             degraded, f"pallas_disabled_after_{first_error}"]))
 
@@ -268,6 +320,9 @@ def main():
             "hidden": cfg.hidden_size, "layers": cfg.num_layers,
             "batch": batch, "seq": seq, "steps": steps,
             "final_loss": round(final_loss, 4),
+            # windowed step timing: median + rel_spread per row, so pair
+            # comparisons at the 1-2% margin are decidable (or abstained)
+            "timing": timing,
             "platform": jax.default_backend(), "devices": n_dev,
             "mfu_vs_v5e_bf16_peak": round(mfu, 4) if mfu else None,
             "mfu_param_flops_only": round(mfu_param, 4) if mfu_param else None,

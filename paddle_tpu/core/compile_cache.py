@@ -1,0 +1,124 @@
+"""Persistent XLA compilation cache (FLAGS_compile_cache_dir).
+
+Every new process pays full XLA compile cost for programs it has compiled a
+thousand times before — for the bench-config GPT step that is minutes of
+startup on TPU. The reference ships no analogue (its Executor caches live
+only in-process); XLA's persistent compilation cache closes the gap: with a
+cache directory configured, compiled executables are serialized keyed on
+(HLO, compile options, backend version), and a second process deserializes
+in milliseconds instead of recompiling.
+
+Wiring: `FLAGS_compile_cache_dir` (env `FLAGS_compile_cache_dir` or
+`PADDLE_TPU_COMPILE_CACHE`) names the directory; empty means OFF and
+nothing here touches jax.config — the default is bit-identical behavior.
+`configure()` runs once at package import and again on set_flags, so
+
+    PADDLE_TPU_COMPILE_CACHE=/var/cache/xla python train.py
+
+is the whole deployment story. The min-compile-time / min-entry-size
+thresholds are zeroed so even the CPU test programs cache (jax's defaults
+skip sub-second compiles — exactly the ones the subprocess test measures).
+
+Cold/warm accounting: `entries()` counts serialized executables; the train
+engines snapshot it around a dispatch that compiled — if the persistent
+store grew, the compile was COLD (paid XLA), otherwise it was WARM (served
+from the cache). Counters land in core.monitor (`engine.compile_cold` /
+`engine.compile_warm` and their _ms twins) and ride into StepTelemetry.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from . import monitor as _monitor
+from .flags import flag
+
+_configured_dir: Optional[str] = None
+
+_COLD = _monitor.stat("engine.compile_cold")
+_WARM = _monitor.stat("engine.compile_warm")
+_COLD_MS = _monitor.stat("engine.compile_cold_ms")
+_WARM_MS = _monitor.stat("engine.compile_warm_ms")
+
+
+def cache_dir() -> Optional[str]:
+    """The active persistent-cache directory, or None when off."""
+    return _configured_dir
+
+
+def enabled() -> bool:
+    return _configured_dir is not None
+
+
+def configure() -> Optional[str]:
+    """Apply FLAGS_compile_cache_dir to jax.config. Idempotent; called at
+    package import and on every set_flags touching the flag. Returns the
+    active dir (None = off). Turning the cache OFF mid-process only stops
+    new writes/reads for future backends — jax does not support unsetting
+    an initialized cache cleanly, so we leave config untouched then."""
+    global _configured_dir
+    d = str(flag("compile_cache_dir") or "").strip()
+    if not d or d == _configured_dir:
+        return _configured_dir
+    import jax
+
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    # cache EVERYTHING: the default thresholds skip fast compiles, which on
+    # CPU is every test program — and on TPU would skip the small eager
+    # rules whose aggregate compile time dominates dygraph warmup
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        # jax latches cache-used once per process at the first compile; a
+        # compile that ran before this configuration would otherwise pin
+        # the cache off for the process lifetime
+        from jax._src import compilation_cache as _jcc
+
+        _jcc.reset_cache()
+    except Exception:
+        pass
+    _configured_dir = d
+    return d
+
+
+def entries() -> int:
+    """Number of serialized executables in the cache dir (-1 when off).
+    Cheap enough to snapshot around a compile: one readdir."""
+    if _configured_dir is None:
+        return -1
+    try:
+        return sum(1 for n in os.listdir(_configured_dir)
+                   if n.endswith("-cache"))
+    except OSError:
+        return -1
+
+
+def note_compile(wall_ms: int, persistent_before: int,
+                 persistent_after: int) -> Optional[str]:
+    """Classify one observed executable-cache compile as cold/warm.
+
+    Only meaningful when the persistent cache is on: a compile that left no
+    new serialized entry was served FROM the store (warm — deserialization
+    cost only); one that wrote an entry paid XLA (cold). Returns
+    "cold" / "warm" / None (cache off)."""
+    if persistent_before < 0 or persistent_after < 0:
+        return None
+    if persistent_after > persistent_before:
+        _COLD.increase()
+        _COLD_MS.increase(wall_ms)
+        return "cold"
+    _WARM.increase()
+    _WARM_MS.increase(wall_ms)
+    return "warm"
+
+
+def _on_flag_change(name):
+    if name == "compile_cache_dir":
+        configure()
+
+
+from . import flags as _flags  # noqa: E402
+
+_flags.on_change(_on_flag_change)
+configure()  # env-set FLAGS_compile_cache_dir / PADDLE_TPU_COMPILE_CACHE

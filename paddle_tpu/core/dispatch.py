@@ -209,9 +209,38 @@ _UNSEEN = object()
 _FREEZE_MEMO: Dict[int, tuple] = {}
 
 
+# Fast-lane cache (FLAGS_eager_fast_path): key -> (rules, diff_idx,
+# need_grad) resolved by ONE slow-path dispatch, or None for kernels proven
+# value-dependent. The key deliberately omits the AMP cast (the lane only
+# runs with AMP off) and the trace-time flags (any flag change clears this
+# cache wholesale), so a steady-state hit pays: counter bump, memoized
+# freeze lookup, signature tuple, one dict hit, jitted call — none of the
+# per-call autocast resolution, nondiff dtype scans, closure building, or
+# debug-flag probes of the general path. Entries share the rules objects
+# with _RULE_CACHE; both are cleared together.
+_FAST_CACHE: Dict[tuple, tuple] = {}
+_FAST_CACHE_CAP = 8192
+_FAST_HITS = _monitor.stat("dispatch.fast_hits")
+
+# flag-derived globals, recomputed on any flag change: the hot path reads
+# two module globals instead of probing the flag registry five times
+_FAST_LANE_OK = True
+_FUSION_ON = False
+
+
+def _refresh_flag_globals():
+    global _FAST_LANE_OK, _FUSION_ON
+    _FAST_LANE_OK = (flag("eager_op_jit") and flag("eager_fast_path")
+                     and not flag("check_nan_inf")
+                     and not flag("enable_unused_var_check"))
+    _FUSION_ON = bool(flag("eager_fusion"))
+
+
 def _clear_rule_cache():
     _RULE_CACHE.clear()
     _FREEZE_MEMO.clear()
+    _FAST_CACHE.clear()
+    _fusion.clear_cache()
 
 
 def _frozen_kernel_parts(kernel, code):
@@ -286,6 +315,96 @@ def _build_rules(kernel, attrs, diff_idx, cast_to):
     return jax.jit(fwd), jax.jit(bwd)
 
 
+def _finish_outputs(name, out_data, need_grad, vjp_fn, bwd_spec, tensor_args,
+                    diff_idx):
+    """Wrap kernel outputs as Tensors and wire the autograd node — the
+    shared tail of the fast lane and the general dispatch path."""
+    multi = isinstance(out_data, (tuple, list))
+    outs_data = list(out_data) if multi else [out_data]
+    outs = [_wrap_out(d, stop_gradient=not need_grad) for d in outs_data]
+    if vjp_fn is not None:
+        node = Node(
+            vjp_fn,
+            [tensor_args[i] for i in diff_idx],
+            [(tuple(d.shape), np.dtype(d.dtype)) for d in outs_data],
+            name=name,
+            bwd_spec=bwd_spec,
+        )
+        for i, o in enumerate(outs):
+            o._node = node
+            o._out_index = i
+    if multi:
+        return tuple(outs)
+    return outs[0]
+
+
+def _fast_apply(name, kernel, tensor_args, attrs, nondiff_mask, differentiable,
+                may_fuse):
+    """Fast lane: returns (True, result) on a cache hit, (False, fast_key)
+    when the general path should run and then populate the lane, and
+    (False, None) when the call is ineligible. Preconditions (checked by the
+    caller): FLAGS_eager_fast_path lane open, no AMP context, no symbolic
+    inputs."""
+    code = getattr(kernel, "__code__", None)
+    if code is None:
+        return False, None
+    try:
+        closure_vals, defaults = _frozen_kernel_parts(kernel, code)
+        akey = (tuple(sorted((k, _freeze(v)) for k, v in attrs.items()))
+                if attrs else ())
+    except _Unhashable:
+        return False, None
+    ge = is_grad_enabled()
+    sg = tuple(t._stop_gradient for t in tensor_args)
+    if may_fuse and differentiable and (not ge or all(sg)):
+        out = _fusion.try_fuse(name, kernel, tensor_args, attrs,
+                               closure_vals, defaults, akey)
+        if out is not None:
+            return True, out
+    arrays = [t._data for t in tensor_args]
+    try:
+        sig = tuple((a.shape, a.dtype) for a in arrays)
+    except AttributeError:
+        return False, None
+    key = (name, id(code), closure_vals, defaults, akey, sig,
+           None if nondiff_mask is None else tuple(nondiff_mask),
+           differentiable, ge, sg)
+    entry = _FAST_CACHE.get(key, _UNSEEN)
+    if entry is _UNSEEN:
+        return False, key  # one general dispatch resolves + stores the entry
+    if entry is None:
+        return False, None  # proven value-dependent: always runs eagerly
+    rules, diff_idx, need_grad = entry
+    arrays_tuple = tuple(arrays)
+    out_data = rules[0](arrays_tuple)
+    _FAST_HITS.increase()
+    vjp_fn = bwd_spec = None
+    if need_grad and diff_idx:
+        bwd = rules[1]
+        diff_set = set(diff_idx)
+        bwd_spec = (bwd, tuple(
+            t if i in diff_set else t.detach()
+            for i, t in enumerate(tensor_args)))
+
+        def vjp_fn(cts, _bwd=bwd, _at=arrays_tuple):
+            if _has_float0(cts):
+                # float0 cotangents can't enter the jitted backward — take
+                # the uncached vjp for this rare call (mirrors the general
+                # path's fallback)
+                def g(*diff_arrays):
+                    full = list(_at)
+                    for i, a in zip(diff_idx, diff_arrays):
+                        full[i] = a
+                    return kernel(*full, **attrs)
+
+                _, vf = jax.vjp(g, *[_at[i] for i in diff_idx])
+                return vf(cts)
+            return _bwd(_at, cts)
+
+    return True, _finish_outputs(name, out_data, need_grad, vjp_fn, bwd_spec,
+                                 tensor_args, diff_idx)
+
+
 def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=None,
           differentiable: bool = True):
     """Run `kernel(*arrays, **attrs)` with autograd recording.
@@ -303,6 +422,20 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
     _op_stat(name).increase()
     _tr = _obs_tracer.get_tracer()
     _span_t0 = time.perf_counter() if _tr.enabled else None
+
+    fast_key = None
+    if _FAST_LANE_OK and getattr(_amp_state, "ctx", None) is None:
+        # fusion is skipped while a trace window is open so per-op spans
+        # keep measuring real executions
+        hit, val = _fast_apply(name, kernel, tensor_args, attrs, nondiff_mask,
+                               differentiable,
+                               may_fuse=_FUSION_ON and _span_t0 is None)
+        if hit:
+            if _span_t0 is not None:
+                _tr.record_complete("op::" + name, _span_t0,
+                                    time.perf_counter(), aggregate=False)
+            return val
+        fast_key = val
     arrays = [t._data for t in tensor_args]
 
     cast_to = _autocast_dtype_for(name, arrays)
@@ -383,34 +516,27 @@ def apply(name: str, kernel: Callable, tensor_args, attrs=None, nondiff_mask=Non
             out_data = f(*diff_arrays)
             vjp_fn = None
 
-    multi = isinstance(out_data, (tuple, list))
-    outs_data = list(out_data) if multi else [out_data]
+    if fast_key is not None:
+        # this call ran under fast-lane preconditions: publish the resolved
+        # entry so identical later calls skip straight to the cached rules
+        # (None marks kernels proven uncacheable — they stay on this path)
+        if len(_FAST_CACHE) >= _FAST_CACHE_CAP:
+            _FAST_CACHE.clear()
+        _FAST_CACHE[fast_key] = (None if rules is None
+                                 else (rules, tuple(diff_idx), need_grad))
 
     if flag("check_nan_inf"):
-        _check_nan_inf(name, outs_data)
+        _check_nan_inf(name, list(out_data)
+                       if isinstance(out_data, (tuple, list)) else [out_data])
     if flag("enable_unused_var_check"):
         _check_unused_vars(name, f, diff_arrays)
 
-    outs = [_wrap_out(d, stop_gradient=not need_grad) for d in outs_data]
-
-    if vjp_fn is not None:
-        node = Node(
-            vjp_fn,
-            [tensor_args[i] for i in diff_idx],
-            [(tuple(d.shape), np.dtype(d.dtype)) for d in outs_data],
-            name=name,
-            bwd_spec=bwd_spec,
-        )
-        for i, o in enumerate(outs):
-            o._node = node
-            o._out_index = i
-
+    res = _finish_outputs(name, out_data, need_grad, vjp_fn, bwd_spec,
+                          tensor_args, diff_idx)
     if _span_t0 is not None:
         _tr.record_complete("op::" + name, _span_t0, time.perf_counter(),
                             aggregate=False)
-    if multi:
-        return tuple(outs)
-    return outs[0]
+    return res
 
 
 _unused_var_warned = set()
@@ -475,6 +601,10 @@ def as_tensor(x, dtype=None):
     return Tensor(jnp.array(a), stop_gradient=True)
 
 
+# no import cycle: eager_fusion depends only on tensor/dtype/monitor — the
+# frozen kernel parts it needs arrive as arguments from the fast lane
+from . import eager_fusion as _fusion  # noqa: E402
+
 # autotune-state changes invalidate cached rules (flash attention bakes the
 # tuned block choice into its trace)
 from . import autotune as _autotune  # noqa: E402
@@ -490,6 +620,11 @@ _TRACE_KEY_FLAGS = frozenset({"tpu_matmul_precision", "use_flash_attention",
 
 
 def _on_flag_change(name):
+    # the fast lane's key carries no trace-time flags at all — ANY flag
+    # change drops it (and the fused-chain cache) wholesale
+    _FAST_CACHE.clear()
+    _fusion.clear_cache()
+    _refresh_flag_globals()
     if name not in _TRACE_KEY_FLAGS:
         _clear_rule_cache()
 
@@ -497,3 +632,4 @@ def _on_flag_change(name):
 from . import flags as _flags  # noqa: E402
 
 _flags.on_change(_on_flag_change)
+_refresh_flag_globals()

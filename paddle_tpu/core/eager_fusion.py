@@ -1,0 +1,324 @@
+"""Opt-in eager micro-fusion (FLAGS_eager_fusion): lazy elementwise chains.
+
+The per-op eager path pays one XLA execute per op — microseconds of fixed
+dispatch cost that dwarf the arithmetic of a small elementwise kernel. The
+MPK/mega-kernel observation (PAPERS.md) is that chains of such dispatches
+should collapse into one compiled unit. Here, whitelisted elementwise ops on
+float tensors with no grad requirement are RECORDED instead of executed: the
+op returns a `LazyTensor` holding a graph node, and only when a result is
+actually needed (data access, or a non-fusable consumer) is the whole
+pending chain compiled — once per chain *structure*, cached — and executed
+as ONE jitted composite. A loop of N scalar-ish ops then costs one PJRT
+execute per chain segment instead of N.
+
+Correctness boundaries:
+- admission requires: op in the whitelist, all inputs floating and of one
+  dtype, no autograd recording needed, hashable kernel closure/attrs (the
+  same `_frozen_kernel_parts` freeze the dispatch rule cache uses);
+- anything else — including any access to `.numpy()` / `.item()` / `_data`
+  from arbitrary framework code — transparently forces the chain first, so
+  laziness can never be observed as a wrong value;
+- `shape`/`dtype`/`ndim` are answered from recorded avals without forcing
+  (elementwise ops: broadcast shape, common dtype);
+- chains are capped (`MAX_CHAIN`) so pathological programs cannot build
+  unbounded graphs, and the composite cache is cleared with the dispatch
+  rule cache (flags/autotune changes).
+
+Off by default: deferral changes op-granular timing/tracing semantics, so
+dispatch skips fusion entirely while a trace window is open.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Dict
+
+import jax
+import numpy as np
+
+from . import dtype as dtypes
+from . import monitor as _monitor
+from .tensor import Tensor
+
+# arity by op name. Shape-preserving / broadcasting elementwise ops only —
+# the aval rules below (broadcast shape, common float dtype) must hold.
+_FUSABLE_UNARY = frozenset({
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "square", "reciprocal", "abs", "neg", "tanh", "sigmoid", "relu",
+    "relu6", "silu", "softsign", "tanhshrink", "mish", "hardswish",
+    "hardsigmoid", "log_sigmoid", "sin", "cos", "tan", "asin", "acos",
+    "atan", "sinh", "cosh", "asinh", "acosh", "atanh", "erf", "floor",
+    "ceil", "round", "trunc", "scale",
+})
+_FUSABLE_BINARY = frozenset({
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "pow", "fmax", "fmin", "atan2", "hypot", "logaddexp",
+})
+MAX_CHAIN = 64
+
+_FUSED_CHAINS = _monitor.stat("dispatch.fused_chains")
+_FUSED_OPS = _monitor.stat("dispatch.fused_ops")
+
+# chain-structure key -> jitted composite (kernels pinned by the key's
+# steps living in the closure). Cleared with the dispatch rule cache.
+_FUSION_CACHE: Dict[tuple, object] = {}
+_FUSION_CACHE_CAP = 512
+
+_PENDING = object()
+
+
+def clear_cache() -> None:
+    _FUSION_CACHE.clear()
+
+
+class _Node:
+    __slots__ = ("name", "kernel", "attrs", "inputs", "shape", "dtype",
+                 "key_part", "size", "consumers", "value", "tensor_ref")
+
+    def __init__(self, name, kernel, attrs, inputs, shape, dtype, key_part,
+                 size):
+        self.name = name
+        self.kernel = kernel
+        self.attrs = attrs
+        self.inputs = inputs          # _Node | concrete jax array per slot
+        self.shape = shape
+        self.dtype = dtype
+        self.key_part = key_part      # hashable (code id, closure, defaults, attrs)
+        self.size = size              # approx pending-subgraph op count
+        self.consumers = 0            # how many nodes consume this output
+        self.value = _PENDING
+        self.tensor_ref = None        # weakref to the LazyTensor
+
+
+class LazyTensor(Tensor):
+    """A Tensor whose storage may still be a pending fused chain. `_data`
+    access forces the chain; shape/dtype metadata never does."""
+
+    __slots__ = ()
+
+    @property
+    def _data(self):
+        node = self.__dict__.get("_lazy_node")
+        if node is not None:
+            _force(node)
+        return self.__dict__["_concrete"]
+
+    @_data.setter
+    def _data(self, v):
+        d = self.__dict__
+        d["_concrete"] = v
+        d["_lazy_node"] = None
+
+    @property
+    def shape(self):
+        node = self.__dict__.get("_lazy_node")
+        if node is not None:
+            return list(node.shape)
+        return list(self.__dict__["_concrete"].shape)
+
+    @property
+    def ndim(self):
+        node = self.__dict__.get("_lazy_node")
+        if node is not None:
+            return len(node.shape)
+        return self.__dict__["_concrete"].ndim
+
+    @property
+    def dtype(self):
+        node = self.__dict__.get("_lazy_node")
+        if node is not None:
+            return np.dtype(node.dtype)
+        return np.dtype(self.__dict__["_concrete"].dtype)
+
+    @property
+    def size(self):
+        node = self.__dict__.get("_lazy_node")
+        if node is not None:
+            n = 1
+            for s in node.shape:
+                n *= int(s)
+            return n
+        return int(self.__dict__["_concrete"].size)
+
+    @property
+    def is_pending(self):
+        return self.__dict__.get("_lazy_node") is not None
+
+
+def _lazy_tensor(node: _Node) -> LazyTensor:
+    t = LazyTensor.__new__(LazyTensor)
+    Tensor.__init__(t, None, stop_gradient=True)
+    t.__dict__["_lazy_node"] = node
+    node.tensor_ref = weakref.ref(t)
+    return t
+
+
+# dtype -> is-float memo: np.issubdtype costs microseconds per probe, and
+# the same handful of dtypes recur on every op of a chain
+_FLOAT_MEMO: Dict = {}
+
+
+def _is_float(d) -> bool:
+    r = _FLOAT_MEMO.get(d)
+    if r is None:
+        r = _FLOAT_MEMO[d] = bool(dtypes.is_floating(d))
+    return r
+
+
+def try_fuse(name, kernel, tensor_args, attrs, closure_vals, defaults, akey):
+    """Record one whitelisted elementwise op as a pending node; returns a
+    LazyTensor, or None when the call must take the normal dispatch path.
+    closure_vals/defaults/akey are the frozen kernel parts the dispatch fast
+    lane already computed (shared admission work, not recomputed here)."""
+    n_args = len(tensor_args)
+    if n_args == 1:
+        # binary names arrive with one tensor arg through the op wrappers'
+        # python-scalar fast path (the scalar is baked into the kernel's
+        # defaults) — still an elementwise op of one tensor operand
+        if name not in _FUSABLE_UNARY and name not in _FUSABLE_BINARY:
+            return None
+    elif n_args == 2:
+        if name not in _FUSABLE_BINARY:
+            return None
+    else:
+        return None
+    code = kernel.__code__  # fast lane guarantees a python kernel
+
+    dt = None
+    inputs = []
+    shapes = []
+    size = 1
+    for t in tensor_args:
+        node = (t.__dict__.get("_lazy_node")
+                if type(t) is LazyTensor else None)
+        if node is not None:
+            d, shp = node.dtype, node.shape
+            size += node.size
+            inputs.append(node)
+        else:
+            a = t._data
+            if not hasattr(a, "dtype"):
+                return None
+            d, shp = a.dtype, a.shape
+            inputs.append(a)
+        if not _is_float(d):
+            return None
+        if dt is None:
+            dt = d
+        elif d != dt:
+            return None  # mixed dtypes: promotion rules stay on the slow path
+        shapes.append(shp)
+
+    if len(shapes) == 1 or shapes[0] == shapes[1]:
+        out_shape = tuple(shapes[0])
+    else:
+        try:
+            out_shape = np.broadcast_shapes(*shapes)
+        except ValueError:
+            return None  # let the real kernel raise the shape error
+
+    new = _Node(name, kernel, attrs, inputs, out_shape, dt,
+                (name, id(code), closure_vals, defaults, akey), size)
+    for inp in inputs:
+        if isinstance(inp, _Node):
+            inp.consumers += 1
+    t = _lazy_tensor(new)
+    if size >= MAX_CHAIN:
+        _force(new)
+    return t
+
+
+def _gather(target: _Node):
+    """Pending ancestors of target in topological (inputs-first) order."""
+    order = []
+    seen = set()
+    stack = [(target, False)]
+    while stack:
+        n, done = stack.pop()
+        if done:
+            order.append(n)
+            continue
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        stack.append((n, True))
+        for inp in n.inputs:
+            if isinstance(inp, _Node) and inp.value is _PENDING \
+                    and id(inp) not in seen:
+                stack.append((inp, False))
+    return order
+
+
+def _force(target: _Node) -> None:
+    """Compile (cached by structure) and execute target's pending subgraph
+    as one jitted composite; deliver results to every node whose value can
+    still be observed (live tensor, or a consumer outside this subgraph)."""
+    if target.value is not _PENDING:
+        return
+    order = _gather(target)
+
+    # pass 1: collect concrete operand arrays (deduped by identity) so leaf
+    # slots [0, n_leaves) are known before node slots [n_leaves, ...) are
+    # assigned in execution order
+    leaves = []
+    leaf_slot = {}
+    internal = {}          # id(node) -> consumptions inside this subgraph
+    for n in order:
+        for inp in n.inputs:
+            if isinstance(inp, _Node) and inp.value is _PENDING:
+                internal[id(inp)] = internal.get(id(inp), 0) + 1
+                continue
+            if isinstance(inp, _Node):
+                inp = inp.value  # forced earlier: a concrete leaf now
+            if id(inp) not in leaf_slot:
+                leaf_slot[id(inp)] = len(leaves)
+                leaves.append(inp)
+    n_leaves = len(leaves)
+
+    # pass 2: steps with fully-resolved input slots
+    slot_of = {id(n): n_leaves + i for i, n in enumerate(order)}
+    steps = []
+    key_steps = []
+    for n in order:
+        in_slots = tuple(
+            slot_of[id(inp)]
+            if isinstance(inp, _Node) and inp.value is _PENDING
+            else leaf_slot[id(inp.value if isinstance(inp, _Node) else inp)]
+            for inp in n.inputs)
+        steps.append((n.kernel, n.attrs, in_slots))
+        key_steps.append(n.key_part + (in_slots,))
+
+    out_nodes = [
+        n for n in order
+        if n is target
+        or (n.tensor_ref is not None and n.tensor_ref() is not None)
+        or n.consumers > internal.get(id(n), 0)]
+    out_slots = tuple(slot_of[id(n)] for n in out_nodes)
+
+    key = (tuple(key_steps), out_slots,
+           tuple((a.shape, a.dtype) for a in leaves))
+    fn = _FUSION_CACHE.get(key)
+    if fn is None:
+        if len(_FUSION_CACHE) >= _FUSION_CACHE_CAP:
+            _FUSION_CACHE.clear()
+        exec_steps = tuple(steps)
+
+        def fused(*leaf_arrays, _steps=exec_steps, _n=n_leaves,
+                  _out=out_slots):
+            vals = list(leaf_arrays)
+            for kernel, attrs, in_slots in _steps:
+                vals.append(kernel(*(vals[i] for i in in_slots), **attrs))
+            return tuple(vals[s] for s in _out)
+
+        fn = _FUSION_CACHE[key] = jax.jit(fused)
+
+    results = fn(*leaves)
+    _FUSED_CHAINS.increase()
+    _FUSED_OPS.increase(len(order))  # batched: one locked bump per chain
+    delivered = {id(n): r for n, r in zip(out_nodes, results)}
+    for n in order:
+        r = delivered.get(id(n))
+        n.value = r  # None for dead intermediates: unobservable by design
+        t = n.tensor_ref() if n.tensor_ref is not None else None
+        if t is not None and r is not None:
+            t._data = r  # setter clears the pending node
+        n.inputs = ()   # release operand pins; the chain is done

@@ -88,3 +88,20 @@ define_flag("fused_ce_chunk", 2048,
             "HBM, so larger chunks trade transient logits memory "
             "(chunk x vocab f32) for fewer weight reads")
 define_flag("pallas_interpret_ok", False, "allow pallas kernels in interpret mode on CPU (tests)")
+define_flag("eager_fast_path", True,
+            "shape/dtype-keyed dispatch fast lane: steady-state eager ops "
+            "skip the per-call closure freeze / AMP resolution / debug-check "
+            "probes when AMP and the debug flags are off (single cached-rule "
+            "hit). Purely an overhead cut — results are bit-identical to the "
+            "slow path, which remains the first-call and fallback route")
+define_flag("eager_fusion", False,
+            "opt-in eager micro-fusion: chains of cacheable elementwise ops "
+            "are recorded lazily and compiled as ONE jitted composite when a "
+            "result is forced (MPK-style dispatch collapsing). Off by "
+            "default: evaluation becomes deferred for whitelisted ops, which "
+            "changes op-granular timing/tracing semantics")
+define_flag("compile_cache_dir", os.environ.get("PADDLE_TPU_COMPILE_CACHE", ""),
+            "persistent XLA compilation cache directory (also settable as "
+            "PADDLE_TPU_COMPILE_CACHE). Empty = off (bit-identical default); "
+            "set, every process reuses serialized executables so steady-state "
+            "restarts skip recompilation (core/compile_cache.py)")

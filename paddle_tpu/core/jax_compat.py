@@ -1,0 +1,87 @@
+"""Version-bridging shims for jax API moves.
+
+The distributed stack targets the current jax surface (`jax.shard_map` with
+`check_vma=`); older jax releases ship the same machinery as
+`jax.experimental.shard_map.shard_map` with the flag spelled `check_rep=`.
+One shim here keeps every call site on the modern spelling.
+"""
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, check_vma flag
+    from jax import shard_map as _shard_map
+
+    _MODERN = True
+except (ImportError, AttributeError):  # jax 0.4.x: experimental, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _MODERN = False
+
+
+def host_memory_kind() -> str:
+    """The host-resident PJRT memory kind for offloaded state: 'pinned_host'
+    where the client exposes it (TPU/GPU, and newer CPU clients); older CPU
+    clients only model 'unpinned_host'."""
+    import jax
+
+    try:
+        kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+    except Exception:
+        return "pinned_host"
+    for k in ("pinned_host", "unpinned_host"):
+        if k in kinds:
+            return k
+    return "pinned_host"
+
+
+def axis_size(axis_name):
+    """`jax.lax.axis_size` appeared after 0.4.x; the portable spelling of a
+    bound axis's size inside a manual region is psum(1) over it."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              axis_names=None, **kw):
+    """Modern-spelling shard_map. axis_names: the axes the body handles
+    MANUALLY (others stay under GSPMD auto-sharding); on older jax this is
+    expressed as the complement via `auto=`."""
+    if _MODERN:
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma, **kw)
+    # Old spelling would be `auto` = the complement of axis_names — but
+    # partial-manual (non-empty auto) is the experimental, crash-prone path
+    # on old jax (SIGABRT in the partitioner for ppermute-in-loop bodies).
+    # Every axis is made manual instead: axes the specs never mention and
+    # the body never binds are treated as replicated inside the region —
+    # semantically identical, trading the auto axes' sharding for
+    # replication within the region (a perf concession only old-jax
+    # environments pay). The one program shape full-manual cannot express
+    # is a body that SHARDING-CONSTRAINS a non-manual axis (e.g. an MoE
+    # all-to-all over 'ep' inside an 'sp' region): jax rejects that with a
+    # clean trace-time ValueError, and only then do we fall back to the
+    # true partial-manual complement.
+    full = _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma, **kw)
+    if axis_names is None:
+        return full
+    rest = frozenset(a for a in mesh.axis_names if a not in set(axis_names)
+                     and mesh.shape[a] > 1)
+    if not rest:
+        return full
+
+    def call(*args):
+        try:
+            return full(*args)
+        except ValueError as e:
+            if "manual_axes" not in str(e):
+                raise
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma,
+                              auto=rest, **kw)(*args)
+
+    return call

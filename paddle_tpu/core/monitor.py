@@ -84,3 +84,23 @@ def device_memory_stats(device=None) -> Dict[str, int]:
     except Exception:
         stats = None
     return dict(stats) if stats else {}
+
+
+def live_buffer_stats() -> Dict[str, int]:
+    """Count + bytes of live jax arrays in this process — the
+    backend-independent complement of device_memory_stats (which the CPU
+    test mesh cannot provide). Donated buffers leave this census the moment
+    XLA aliases them, so a training loop whose params are donated holds ONE
+    copy of its state here while an undonated loop transiently holds two.
+    O(live arrays): for telemetry opt-in, not per-op paths."""
+    import jax
+
+    count = 0
+    total = 0
+    for a in jax.live_arrays():
+        count += 1
+        try:
+            total += int(a.size) * a.dtype.itemsize
+        except Exception:
+            pass
+    return {"count": count, "bytes": total}

@@ -99,7 +99,7 @@ def _sharded_over(data, axis_name):
 
 def _eager_axis_collective(x, axis, fn_traced):
     """Run a collective over a mesh axis on an axis-sharded global array via shard_map."""
-    from jax import shard_map
+    from ..core.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = fleet_default_mesh()
@@ -315,7 +315,7 @@ def _p2p_pair_program(src: int, dst: int, shape, dtype_str: str):
 
 @_functools.lru_cache(maxsize=256)
 def _p2p_program_cached(src, dst, shape, dtype_str):
-    from jax import shard_map
+    from ..core.jax_compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     # one device per endpoint process (rank = process; a multi-chip host
@@ -479,7 +479,7 @@ def batch_isend_irecv(p2p_op_list):
     Limits: at most one isend and one irecv per rank per batch (one mesh
     row each way), all tensors one shape/dtype.
     """
-    from jax import shard_map
+    from ..core.jax_compat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     rank = jax.process_index()

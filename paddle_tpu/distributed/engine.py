@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import compile_cache as _compile_cache
 from ..core import monitor as _monitor
 from ..core import random as random_mod
 from ..core.tensor import Tensor
@@ -53,15 +54,22 @@ def _jit_cache_size(fn) -> int:
         return -1
 
 
-def _note_compile(n_before: int, n_after: int, wall_s: float) -> bool:
+def _note_compile(n_before: int, n_after: int, wall_s: float,
+                  persistent_before: int = -1) -> bool:
     """Update compile counters from a jitted fn's executable-cache growth
-    across one dispatch; returns whether this dispatch compiled."""
+    across one dispatch; returns whether this dispatch compiled. With the
+    persistent compilation cache on, the compile is also classified
+    cold/warm (engine.compile_cold / engine.compile_warm + _ms): a compile
+    that wrote no new serialized entry was deserialized from the store."""
     if n_before < 0 or n_after <= n_before:
         return False
     _JIT_COMPILES.increase()
-    _JIT_COMPILE_MS.increase(int(wall_s * 1000))
+    ms = int(wall_s * 1000)
+    _JIT_COMPILE_MS.increase(ms)
     if n_before > 0:
         _JIT_RECOMPILES.increase()
+    _compile_cache.note_compile(ms, persistent_before,
+                                _compile_cache.entries())
     return True
 
 
@@ -167,7 +175,10 @@ class TrainStepEngine:
         # group_sharded_optimizer_stage2.py:48): optimizer state lives in host
         # memory between steps — XLA streams it to HBM for the update and back,
         # freeing per-device HBM at the cost of host<->device traffic.
-        self._opt_memory_kind = ("pinned_host"
+        # (pinned_host on TPU/GPU; older CPU clients expose unpinned_host only)
+        from ..core.jax_compat import host_memory_kind
+
+        self._opt_memory_kind = (host_memory_kind()
                                  if getattr(optimizer, "_offload", False) else None)
         self.opt_specs = {}
         self.opt_state = {}
@@ -202,11 +213,14 @@ class TrainStepEngine:
 
     def enable_telemetry(self, sink=None, path=None,
                          flops_per_token: Optional[int] = None,
-                         peak_flops: Optional[float] = None) -> StepTelemetry:
+                         peak_flops: Optional[float] = None,
+                         collect_live_buffers: bool = False) -> StepTelemetry:
         """Attach per-step telemetry. Default flop model is parameter-only
         (6*N per token); pass flops_per_token from
         observability.transformer_flops_per_token for the full bench.py
-        accounting with the attention term."""
+        accounting with the attention term. collect_live_buffers=True adds
+        a per-record live-array census + high-water — the donation proof on
+        backends where PJRT exposes no memory stats."""
         from ..observability.step_telemetry import JsonlSink
 
         if sink is None and path is not None:
@@ -215,7 +229,8 @@ class TrainStepEngine:
             sink=sink,
             flops_per_token=(flops_per_token if flops_per_token is not None
                              else 6 * self._n_params()),
-            peak_flops=peak_flops)
+            peak_flops=peak_flops,
+            collect_live_buffers=collect_live_buffers)
         return self.telemetry
 
     def disable_telemetry(self) -> None:
@@ -540,6 +555,7 @@ class TrainStepEngine:
         fn = self._scan_fns[fixed]
         tele = self.telemetry
         n0 = _jit_cache_size(fn)
+        p0 = _compile_cache.entries() if n0 == 0 else -1
         t0 = time.perf_counter()
         losses, self.params, new_opt = fn(
             self.params, self._opt_to_hbm(self.opt_state), lrs,
@@ -547,7 +563,7 @@ class TrainStepEngine:
         if tele is not None:
             jax.block_until_ready(losses)  # honest wall time: drain the K steps
         t1 = time.perf_counter()
-        compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0)
+        compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0, p0)
         tr = _obs_tracer.get_tracer()
         if tr.enabled:
             tr.record_complete("engine.run_steps", t0, t1,
@@ -615,6 +631,10 @@ class TrainStepEngine:
         fn = self._step_fn
         tele = self.telemetry
         n0 = _jit_cache_size(fn)
+        # persistent-store snapshot only around a first compile: one readdir,
+        # and only when the fn has no executable yet (recompiles from shape
+        # churn stay unclassified rather than taxing every steady-state step)
+        p0 = _compile_cache.entries() if n0 == 0 else -1
         t0 = time.perf_counter()
         loss, self.params, new_opt = fn(
             self.params, self._opt_to_hbm(self.opt_state), lr,
@@ -622,7 +642,7 @@ class TrainStepEngine:
         if tele is not None:
             jax.block_until_ready(loss)  # honest wall time over async dispatch
         t1 = time.perf_counter()
-        compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0)
+        compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0, p0)
         tr = _obs_tracer.get_tracer()
         if tr.enabled:
             tr.record_complete("engine.step", t0, t1,

@@ -152,6 +152,10 @@ class DistributedStrategy:
         # near-dense peak memory and bytes-moved at sp 2-4, all-to-alls ride
         # ICI); ring remains available for the seq >> 100k regime where its
         # O(1) per-step working set wins.
+        # PROVENANCE (VERDICT r5 weak #7): this default is cost-model-chosen
+        # ONLY — it has never been measured on real multi-chip hardware (the
+        # dryrun certifies correctness, not the ranking). Re-validate
+        # ring-vs-Ulysses on a pod before trusting the default at scale.
         self.sep_impl = "ulysses"
 
         # sub-configs

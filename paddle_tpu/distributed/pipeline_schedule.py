@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..core.jax_compat import shard_map
+
 
 def spmd_pipeline(body_fn, stage_params, x_mb, mesh, axis: str = "pp"):
     """Run a homogeneous pipeline over the `axis` mesh dimension.
@@ -77,7 +79,7 @@ def spmd_pipeline(body_fn, stage_params, x_mb, mesh, axis: str = "pp"):
         # replicate the result over the pp axis (only the last stage holds it)
         return jax.lax.psum(jnp.where(stage == S - 1, out, jnp.zeros_like(out)), axis)
 
-    return jax.shard_map(local, mesh=mesh, in_specs=(param_specs, xspec),
+    return shard_map(local, mesh=mesh, in_specs=(param_specs, xspec),
                          out_specs=xspec, axis_names={axis},
                          check_vma=False)(stage_params, x_mb)
 
@@ -258,7 +260,7 @@ def spmd_pipeline_interleaved(body_fn, stage_params, x_mb, mesh,
     sch_args = tuple(jnp.asarray(sched[k]) for k in
                      ("v_sel", "ingest", "buf_read", "buf_write",
                       "out_write", "valid"))
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh,
         in_specs=(param_specs, xspec) + (sspec,) * 6,
         out_specs=xspec, axis_names={axis},

@@ -59,6 +59,12 @@ class Model:
         return self.network.parameters(*args, **kwargs)
 
     # ---- single-batch primitives ----
+    # Donation audit: this eager path never donates — loss.backward() /
+    # opt.step() mutate Parameter._data in place through the optimizer, so
+    # no buffer a caller can hold is ever handed to XLA for aliasing. The
+    # donated world is TrainStepEngine/auto_parallel.Engine, which rebind
+    # engine.params before returning (tests/test_donation_safety.py pins
+    # the boundary); fit() composes with either without reuse hazards.
     def train_batch(self, inputs, labels=None, update=True):
         assert self._optimizer is not None, "call prepare() with an optimizer first"
         self.network.train()

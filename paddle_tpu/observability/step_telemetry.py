@@ -69,12 +69,18 @@ class StepTelemetry:
 
     def __init__(self, sink=None, flops_per_token: Optional[int] = None,
                  peak_flops: Optional[float] = None,
-                 collect_memory: bool = True):
+                 collect_memory: bool = True,
+                 collect_live_buffers: bool = False):
         self.sink = sink if sink is not None else InMemorySink()
         self.flops_per_token = flops_per_token
         self.peak_flops = peak_flops
         self.collect_memory = collect_memory
+        # live-buffer census (count + bytes of live jax arrays): the
+        # donation high-water proof on backends without PJRT memory stats
+        # (CPU test mesh). O(live arrays) per record — opt-in.
+        self.collect_live_buffers = collect_live_buffers
         self._records = 0
+        self._live_high_water = 0
         self._last_counters: Dict[str, int] = {}
 
     # ---- construction helpers ----
@@ -140,6 +146,13 @@ class StepTelemetry:
             # always present so consumers see a stable shape; {} on backends
             # where PJRT exposes no memory stats (the CPU test mesh)
             rec["device_memory"] = self._memory_stats()
+        if self.collect_live_buffers:
+            lb = self._live_buffers()
+            if lb:
+                self._live_high_water = max(self._live_high_water,
+                                            lb["bytes"])
+                lb["high_water_bytes"] = self._live_high_water
+                rec["live_buffers"] = lb
         if extra:
             rec.update(extra)
         self.sink.write(rec)
@@ -174,6 +187,14 @@ class StepTelemetry:
         for key, field in (("engine.jit_compiles", "jit_compiles"),
                            ("engine.jit_compile_ms", "jit_compile_ms"),
                            ("engine.jit_recompiles", "jit_recompiles"),
+                           # persistent-compilation-cache split: cold paid
+                           # XLA, warm deserialized from the store
+                           # (core/compile_cache.py) — a restarted process
+                           # with a warm cache shows compile_warm_ms only
+                           ("engine.compile_cold", "compile_cold"),
+                           ("engine.compile_cold_ms", "compile_cold_ms"),
+                           ("engine.compile_warm", "compile_warm"),
+                           ("engine.compile_warm_ms", "compile_warm_ms"),
                            ("dispatch.calls", "dispatch_calls"),
                            ("dispatch.nan_inf_hits", "nan_inf_hits")):
             if key in rep:
@@ -184,6 +205,14 @@ class StepTelemetry:
                     out[field + "_delta"] = delta
                 self._last_counters[key] = v
         return out
+
+    def _live_buffers(self) -> Dict[str, int]:
+        try:
+            from ..core import monitor
+
+            return dict(monitor.live_buffer_stats())
+        except Exception:
+            return {}
 
     def _memory_stats(self) -> Dict[str, int]:
         try:

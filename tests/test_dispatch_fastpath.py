@@ -1,0 +1,249 @@
+"""Eager dispatch fast lane (FLAGS_eager_fast_path) + micro-fusion
+(FLAGS_eager_fusion): results must be bit-identical to the general path,
+laziness must never be observable as a wrong value, and every guard flag
+must close the lane.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import dispatch
+from paddle_tpu.core import eager_fusion as ef
+from paddle_tpu.core.tensor import Tensor
+
+import jax.numpy as jnp
+
+
+def setup_function(_):
+    dispatch._clear_rule_cache()
+
+
+# ---- fast lane ----
+
+def test_fast_lane_hit_after_one_general_dispatch():
+    a = Tensor(jnp.ones((4, 4), jnp.float32))
+    h0 = dispatch._FAST_HITS.get()
+    paddle.tanh(a)                       # general path resolves + publishes
+    assert len(dispatch._FAST_CACHE) >= 1
+    assert dispatch._FAST_HITS.get() == h0
+    out = paddle.tanh(a)                 # second call rides the lane
+    assert dispatch._FAST_HITS.get() == h0 + 1
+    np.testing.assert_array_equal(out.numpy(), np.tanh(np.ones((4, 4),
+                                                               np.float32)))
+
+
+def test_fast_lane_bit_identical_to_general_path():
+    rng = np.random.RandomState(0)
+    xn = rng.randn(16, 16).astype(np.float32)
+
+    def run():
+        x = paddle.to_tensor(xn, stop_gradient=False)
+        y = paddle.tanh(x * 2.0 + 1.0)
+        loss = (y * y).mean()
+        loss.backward()
+        return loss.numpy(), x.grad.numpy()
+
+    l_fast, g_fast = run()
+    l_fast2, g_fast2 = run()             # steady state: lane hits
+    paddle.set_flags({"eager_fast_path": False})
+    l_slow, g_slow = run()
+    np.testing.assert_array_equal(l_fast, l_slow)
+    np.testing.assert_array_equal(g_fast, g_slow)
+    np.testing.assert_array_equal(l_fast2, l_slow)
+    np.testing.assert_array_equal(g_fast2, g_slow)
+
+
+def test_fast_lane_closed_under_amp_and_debug_flags():
+    a = Tensor(jnp.ones((4, 4)))
+    paddle.tanh(a)
+    h0 = dispatch._FAST_HITS.get()
+    with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+        paddle.tanh(a)                   # AMP ctx: must take the general path
+    assert dispatch._FAST_HITS.get() == h0
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        assert not dispatch._FAST_LANE_OK
+        bad = Tensor(jnp.asarray([np.inf], jnp.float32))
+        with pytest.raises(FloatingPointError):
+            paddle.exp(bad)              # the sentinel still fires
+    finally:
+        paddle.set_flags({"check_nan_inf": False})
+    assert dispatch._FAST_LANE_OK
+
+
+def test_fast_lane_scalar_closure_not_aliased():
+    """The python-scalar binary fast path bakes the scalar into the kernel's
+    defaults — two different scalars must resolve to two lane entries."""
+    a = Tensor(jnp.ones((4,)))
+    o2 = (a * 2.0).numpy()
+    o3 = (a * 3.0).numpy()
+    o2b = (a * 2.0).numpy()              # steady-state hit
+    np.testing.assert_array_equal(o2, 2 * np.ones(4, np.float32))
+    np.testing.assert_array_equal(o3, 3 * np.ones(4, np.float32))
+    np.testing.assert_array_equal(o2b, o2)
+
+
+def test_fast_lane_value_dependent_kernel_stays_eager():
+    ids = Tensor(jnp.asarray(np.array([0, 0, 1], np.int64)))
+
+    def kernel(i):
+        n = int(jnp.max(i)) + 1          # concretization: untraceable
+        return jnp.zeros((n,))
+
+    o1 = dispatch.apply("t_fp_valdep", kernel, [ids], differentiable=False)
+    o2 = dispatch.apply("t_fp_valdep", kernel, [ids], differentiable=False)
+    assert list(o1.shape) == list(o2.shape) == [2]
+    # the lane remembers the kernel is uncacheable, never retries the rules
+    assert any(v is None for v in dispatch._FAST_CACHE.values())
+
+
+def test_any_flag_change_drops_fast_cache():
+    a = Tensor(jnp.ones((4,)))
+    paddle.tanh(a)
+    assert len(dispatch._FAST_CACHE) >= 1
+    paddle.set_flags({"tpu_matmul_precision": "highest"})
+    try:
+        assert len(dispatch._FAST_CACHE) == 0
+    finally:
+        paddle.set_flags({"tpu_matmul_precision": "default"})
+
+
+# ---- micro-fusion ----
+
+def _fusion(on=True):
+    paddle.set_flags({"eager_fusion": on})
+
+
+def test_fusion_off_by_default_returns_plain_tensors():
+    a = Tensor(jnp.ones((4,)))
+    assert type(paddle.tanh(a)) is Tensor
+
+
+def test_fusion_chain_defers_then_matches_eager():
+    rng = np.random.RandomState(0)
+    xn = rng.randn(32, 32).astype(np.float32)
+    x = paddle.to_tensor(xn)
+    _fusion(True)
+    try:
+        y = x
+        for _ in range(6):
+            y = paddle.tanh(y) * 1.01
+        assert type(y) is ef.LazyTensor and y.is_pending
+        # metadata answers WITHOUT forcing
+        assert y.shape == [32, 32]
+        assert y.dtype == np.float32
+        assert y.is_pending
+        got = y.numpy()
+        assert not y.is_pending
+    finally:
+        _fusion(False)
+    ref = x
+    for _ in range(6):
+        ref = paddle.tanh(ref) * 1.01
+    np.testing.assert_allclose(got, ref.numpy(), rtol=2e-6, atol=1e-7)
+
+
+def test_fusion_diamond_delivers_every_live_tensor():
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    _fusion(True)
+    try:
+        a = paddle.exp(x)
+        b = a * 2.0
+        c = a + 1.0                      # a has two consumers
+        bn = b.numpy()                   # forces {a, b}; a stays observable
+        cn = c.numpy()
+        an = a.numpy()
+    finally:
+        _fusion(False)
+    ref = np.exp(np.arange(8, dtype=np.float32))
+    np.testing.assert_allclose(an, ref, rtol=1e-6)
+    np.testing.assert_allclose(bn, ref * 2, rtol=1e-6)
+    np.testing.assert_allclose(cn, ref + 1, rtol=1e-6)
+
+
+def test_fusion_nonfusable_consumer_forces_chain():
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(8, 8).astype(np.float32))
+    _fusion(True)
+    try:
+        s = paddle.tanh(x)
+        m = paddle.matmul(s, s)          # not fusable: forces s transparently
+    finally:
+        _fusion(False)
+    t = np.tanh(x.numpy())
+    np.testing.assert_allclose(m.numpy(), t @ t, rtol=1e-4, atol=1e-6)
+
+
+def test_fusion_chain_cap_bounds_graph():
+    x = paddle.to_tensor(np.ones(16, np.float32))
+    _fusion(True)
+    try:
+        c0 = ef._FUSED_CHAINS.get()
+        y = x
+        for _ in range(3 * ef.MAX_CHAIN):
+            y = y * 1.0001
+        # the cap forced intermediate segments without any explicit access
+        assert ef._FUSED_CHAINS.get() > c0
+        got = y.numpy()
+    finally:
+        _fusion(False)
+    np.testing.assert_allclose(
+        got, np.float32(1.0001) ** (3 * ef.MAX_CHAIN) * np.ones(16),
+        rtol=1e-5)
+
+
+def test_fusion_structure_cache_reused_across_iterations():
+    x = paddle.to_tensor(np.ones(16, np.float32))
+    _fusion(True)
+    try:
+        for _ in range(3):               # identical chain structure each time
+            y = x
+            for _ in range(5):
+                y = paddle.tanh(y) + 0.5
+            y.numpy()
+        assert len(ef._FUSION_CACHE) == 1
+    finally:
+        _fusion(False)
+
+
+@pytest.mark.parametrize("make_arg", [
+    lambda: paddle.to_tensor(np.arange(4)),                     # int dtype
+    lambda: paddle.to_tensor(np.ones(4, np.float32),
+                             stop_gradient=False),              # needs grad
+])
+def test_fusion_ineligible_inputs_fall_through(make_arg):
+    _fusion(True)
+    try:
+        t = make_arg()
+        out = t + 1
+        assert type(out) is Tensor       # executed eagerly, not deferred
+    finally:
+        _fusion(False)
+
+
+def test_fusion_grad_flows_through_forced_chain_boundary():
+    """A lazy (stop-grad) chain feeding a differentiable op must force and
+    then participate in autograd like any constant input."""
+    xn = np.random.RandomState(0).randn(8).astype(np.float32)
+    w = paddle.to_tensor(np.ones(8, np.float32), stop_gradient=False)
+    x = paddle.to_tensor(xn)
+    _fusion(True)
+    try:
+        feat = paddle.tanh(x) * 2.0      # lazy, stop_gradient
+        loss = (feat * w).sum()
+        loss.backward()
+    finally:
+        _fusion(False)
+    np.testing.assert_allclose(w.grad.numpy(), np.tanh(xn) * 2, rtol=1e-5)
+
+
+def test_fusion_scale_op_attrs():
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    _fusion(True)
+    try:
+        y = paddle.scale(paddle.scale(x, scale=2.0), scale=3.0, bias=1.0)
+        got = y.numpy()
+    finally:
+        _fusion(False)
+    np.testing.assert_allclose(got, np.arange(4, dtype=np.float32) * 6 + 1,
+                               rtol=1e-6)

@@ -26,6 +26,7 @@ _BOOT = "import jax; jax.config.update('jax_platforms', 'cpu'); " \
     ("export_and_serve.py", "predictor output matches eager forward"),
     ("generate_gpt.py", "decode ok: prompt"),
     ("quantize_int8.py", "ptq int8 output shape ok"),
+    ("pallas_library_ops.py", "pallas layer_norm ok"),
 ])
 def test_example_runs(example, expect):
     path = os.path.join(REPO, "examples", example)

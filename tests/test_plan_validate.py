@@ -72,9 +72,12 @@ def test_policy_peak_distinguishes_remat_variants():
     assert m_sel["peak_policy_bytes"] is not None
     # the blind spot itself (documents WHY the corrected term exists); if
     # XLA's analysis ever learns to credit remat this guard goes stale
-    # loudly and the correction can be retired
+    # loudly and the correction can be retired. Tolerance 10%: CPU-target
+    # scheduling wobbles the temp estimate a few percent between releases
+    # (seen at 6.5% with zero repo changes) — full remat credit would show
+    # as a several-10s-of-percent drop, nowhere near this band.
     assert abs(m_plain["peak_bytes"] - m_sel["peak_bytes"]) \
-        < 0.05 * m_plain["peak_bytes"]
+        < 0.10 * m_plain["peak_bytes"]
     assert m_sel["peak_policy_bytes"] < 0.9 * m_plain["peak_policy_bytes"], (
         m_sel["peak_policy_bytes"], m_plain["peak_policy_bytes"])
 
@@ -169,3 +172,27 @@ def test_replay_correction_survives_missing_residuals():
             {"tag": "b32_selective", "score": 101.0, "residual_bytes": None}]
     pv.apply_replay_correction(rows, 1024)
     assert [r["score_corrected"] for r in rows] == [100.0, 101.0]
+
+
+def test_pair_verdict_abstains_batch_axis_inside_resolution():
+    """VERDICT r5 next #5: batch-axis comparisons inside the model's stated
+    resolution are 'not decidable', not ranked — the b16/b24 regime (the
+    proxy's batch margins are sub-1% while the measured mis-rank margin was
+    2.3%). Structurally different programs keep full-margin ranking."""
+    from paddle_tpu.distributed.auto_parallel.planner import (
+        PREDICTION_RESOLUTION, pair_verdict)
+
+    # the known mis-rank shape: tiny predicted batch margin -> abstain
+    v, margin = pair_verdict(1.013, 1.0097, batch_axis_only=True)
+    assert v == "not_decidable" and margin < PREDICTION_RESOLUTION
+    # same margin on a structurally different pair -> still ranked
+    v, _ = pair_verdict(1.013, 1.0097, batch_axis_only=False)
+    assert v == "a"
+    # a batch pair OUTSIDE the resolution stays decidable
+    v, _ = pair_verdict(1.10, 1.00, batch_axis_only=True)
+    assert v == "a"
+    v, _ = pair_verdict(1.00, 1.10, batch_axis_only=True)
+    assert v == "b"
+    # degenerate zero prediction never divides by zero
+    v, margin = pair_verdict(1.0, 0.0, batch_axis_only=True)
+    assert v == "a" and margin == float("inf")

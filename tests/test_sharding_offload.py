@@ -73,9 +73,15 @@ def test_engine_offload_places_opt_state_in_host_memory():
         paddle.to_tensor(rs.rand(8, 8).astype(np.float32))).item())
         for _ in range(3)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # host kind is backend-dependent: pinned_host on TPU/GPU and newer CPU
+    # clients, unpinned_host on older CPU clients (core.jax_compat); the
+    # offload-vs-resident distinction below is sharp wherever they differ
+    from paddle_tpu.core.jax_compat import host_memory_kind
+
+    host_kind = host_memory_kind()
     for n, st in engine.opt_state.items():
         for leaf in st:
-            assert leaf.sharding.memory_kind == "pinned_host", (
+            assert leaf.sharding.memory_kind == host_kind, (
                 n, leaf.sharding)
 
     # parity vs the non-offloaded engine
@@ -92,9 +98,12 @@ def test_engine_offload_places_opt_state_in_host_memory():
         paddle.to_tensor(rs.rand(8, 8).astype(np.float32))).item())
         for _ in range(3)]
     np.testing.assert_allclose(losses, losses2, rtol=1e-5)
+    import jax
+
+    default_kind = jax.devices()[0].default_memory().kind
     for n, st in engine2.opt_state.items():
         for leaf in st:
-            assert leaf.sharding.memory_kind == "device"
+            assert leaf.sharding.memory_kind in (None, default_kind)
 
 
 def test_stage3_segment_size_keeps_small_params_whole():

@@ -1,0 +1,142 @@
+"""Eager dispatch micro-benchmark: ops/sec through the REAL op path.
+
+The per-op eager path (SURVEY §7 hard part #1) finally gets a tracked
+number. Three dispatch modes over the same workloads:
+
+  legacy  — FLAGS_eager_fast_path=0: the general dispatch path (per-call
+            closure freeze, AMP resolution, debug-flag probes)
+  fast    — default: the shape/dtype-keyed fast lane (single cached-rule
+            hit per op)
+  fusion  — FLAGS_eager_fusion=1: lazy elementwise chains compiled as one
+            jitted composite per segment
+
+Workloads (all through public paddle_tpu ops):
+  unary_chain   y = tanh(y), transcendental-heavy (compute can bind)
+  scalar_chain  y = y * 1.01 + b, the cheap-elementwise regime fusion
+                targets (dispatch overhead dominates per-op execution)
+  small_chain   scalar_chain on a [16] vector — pure dispatch cost
+  grad_chain    y = tanh(y) with autograd recording (tape + vjp wiring)
+
+Prints one JSON line per (mode, workload) with ops_per_sec, then a summary
+with the fast/legacy and fusion/legacy speedups. Run on CPU:
+
+  JAX_PLATFORMS=cpu python tools/dispatch_bench.py [--n 4000] [--repeats 3]
+
+Median-of-repeats is reported; per-repeat numbers ride along so variance
+is visible (the same discipline bench.py applies to train steps).
+"""
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path)
+
+import argparse
+import json
+import statistics
+import time
+
+
+def _workloads(paddle, np):
+    x128 = paddle.to_tensor(np.random.RandomState(0)
+                            .randn(128, 128).astype(np.float32))
+    b128 = paddle.to_tensor(np.random.RandomState(1)
+                            .randn(128, 128).astype(np.float32))
+    x16 = paddle.to_tensor(np.random.RandomState(2)
+                           .randn(16).astype(np.float32))
+    b16 = paddle.to_tensor(np.random.RandomState(3)
+                           .randn(16).astype(np.float32))
+    xg = paddle.to_tensor(np.random.RandomState(4)
+                          .randn(64, 64).astype(np.float32),
+                          stop_gradient=False)
+
+    def unary_chain(n):
+        y = x128
+        for _ in range(n):
+            y = paddle.tanh(y)
+        y.numpy()  # force + drain: the chain must fully execute
+        return n
+
+    def scalar_chain(n):
+        y = x128
+        for _ in range(n):
+            y = y * 1.01 + b128
+        y.numpy()
+        return 2 * n
+
+    def small_chain(n):
+        y = x16
+        for _ in range(n):
+            y = y * 1.01 + b16
+        y.numpy()
+        return 2 * n
+
+    def grad_chain(n):
+        y = xg
+        for _ in range(n):
+            y = paddle.tanh(y)
+        y.numpy()
+        return n
+
+    return [("unary_chain", unary_chain), ("scalar_chain", scalar_chain),
+            ("small_chain", small_chain), ("grad_chain", grad_chain)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4000,
+                    help="ops per timed run (grad workload runs n/2)")
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    modes = [
+        ("legacy", {"eager_fast_path": False, "eager_fusion": False}),
+        ("fast", {"eager_fast_path": True, "eager_fusion": False}),
+        ("fusion", {"eager_fast_path": True, "eager_fusion": True}),
+    ]
+    results = {}
+    for mode, flags in modes:
+        paddle.set_flags(flags)
+        for wname, fn in _workloads(paddle, np):
+            if mode == "fusion" and wname == "grad_chain":
+                continue  # fusion never records grads: same as fast
+            n = args.n // 2 if wname == "grad_chain" else args.n
+            fn(max(50, n // 10))  # warm: compile rules/composites
+            rates = []
+            for _ in range(args.repeats):
+                t0 = time.perf_counter()
+                ops = fn(n)
+                rates.append(ops / (time.perf_counter() - t0))
+            med = statistics.median(rates)
+            results[(mode, wname)] = med
+            print(json.dumps({
+                "mode": mode, "workload": wname,
+                "ops_per_sec": round(med, 1),
+                "repeats": [round(r, 1) for r in rates],
+                "rel_spread": round(
+                    (max(rates) - min(rates)) / med, 4) if med else None,
+            }), flush=True)
+
+    import jax
+
+    summary = {"platform": jax.default_backend(), "n_ops": args.n}
+    for wname in ("unary_chain", "scalar_chain", "small_chain", "grad_chain"):
+        leg = results.get(("legacy", wname))
+        if not leg:
+            continue
+        if ("fast", wname) in results:
+            summary[f"fast_speedup_{wname}"] = round(
+                results[("fast", wname)] / leg, 2)
+        if ("fusion", wname) in results:
+            summary[f"fusion_speedup_{wname}"] = round(
+                results[("fusion", wname)] / leg, 2)
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -212,6 +212,9 @@ def main():
                          "measured ranking")
     ap.add_argument("--tags", default=None,
                     help="comma list restricting the variants scored")
+    ap.add_argument("--resolution", type=float, default=None,
+                    help="override the planner's stated prediction "
+                         "resolution (fraction) for batch-axis abstention")
     args = ap.parse_args()
 
     from paddle_tpu.device.probe import force_cpu_platform
@@ -252,36 +255,68 @@ def main():
     def ranked(key):
         return sorted(rows, key=lambda r: -r[key])
 
+    from paddle_tpu.distributed.auto_parallel.planner import (
+        PREDICTION_RESOLUTION, pair_verdict)
+
+    resolution = (args.resolution if args.resolution is not None
+                  else PREDICTION_RESOLUTION)
     pred = ranked("pred_tokens_per_s_rel")
     pred_c = ranked("pred_tokens_per_s_rel_corrected")
     summary = {"predicted_rank": [r["tag"] for r in pred],
-               "predicted_rank_corrected": [r["tag"] for r in pred_c]}
+               "predicted_rank_corrected": [r["tag"] for r in pred_c],
+               "resolution": resolution}
     if args.measured:
         meas = measured_tokens(args.measured, args.seq)
+        vmeta = {v["tag"]: v for v in VARIANTS}
 
-        def agreement(order):
+        def batch_only(a, b):
+            """Same program family, different batch: the axis the model's
+            stated resolution cannot rank (planner.pair_verdict)."""
+            va, vb = vmeta.get(a, {}), vmeta.get(b, {})
+            return (va.get("recompute") == vb.get("recompute")
+                    and va.get("ce_chunk") == vb.get("ce_chunk")
+                    and va.get("batch") != vb.get("batch"))
+
+        def agreement(order, key):
             # `order` is in predicted-rank order, so for each (a, b) pair
-            # the model predicts a >= b; agreement = measurement concurring
+            # the model predicts a >= b; agreement = measurement concurring.
+            # Batch-axis pairs predicted inside the stated resolution are
+            # ABSTAINED (reported, not scored): the known b16/b24 regime
+            # where ranking would be pretending (VERDICT r5 next #5)
             both = [r["tag"] for r in order if r["tag"] in meas]
+            preds = {r["tag"]: r[key] for r in order}
             agree = total = 0
-            misses = []
+            misses, abstained = [], []
             for a, b in itertools.combinations(both, 2):
+                verdict, margin = pair_verdict(
+                    preds[a], preds[b], batch_only(a, b),
+                    resolution=resolution)
+                if verdict == "not_decidable":
+                    abstained.append([a, b, round(margin, 4)])
+                    continue
                 total += 1
                 if meas[a] >= meas[b]:
                     agree += 1
                 else:
                     misses.append([a, b, round(meas[b] / meas[a] - 1, 4)])
             return both, (round(agree / total, 3) if total else None), \
-                total, misses
+                total, misses, abstained
 
-        both, pw, total, misses = agreement(pred)
-        _, pw_c, _, misses_c = agreement(pred_c)
+        both, pw, total, misses, abst = agreement(
+            pred, "pred_tokens_per_s_rel")
+        _, pw_c, total_c, misses_c, abst_c = agreement(
+            pred_c, "pred_tokens_per_s_rel_corrected")
         summary.update({
             "measured_tags": both,
             "measured_rank": sorted(both, key=lambda t: -meas[t]),
+            # agreement over DECIDED pairs only (abstentions excluded)
             "pairwise_agreement": pw,
             "pairwise_agreement_corrected": pw_c,
             "pairs": total,
+            "pairs_corrected": total_c,
+            # each abstention: [pred-faster, pred-slower, predicted margin]
+            # — batch-axis pairs inside the model's stated resolution
+            "abstained_pairs_corrected": abst_c,
             # each miss: [predicted-faster, measured-faster, measured margin]
             "miss_pairs_corrected": misses_c})
     print(json.dumps(summary), flush=True)
