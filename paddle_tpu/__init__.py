@@ -58,6 +58,7 @@ from . import distributed  # noqa: E402
 from . import jit  # noqa: E402
 from . import static  # noqa: E402
 from . import inference  # noqa: E402
+from . import serving  # noqa: E402
 from . import fft  # noqa: E402
 from .ops import linalg as linalg  # noqa: E402
 import sys as _sys

@@ -100,6 +100,11 @@ define_flag("eager_fusion", False,
             "result is forced (MPK-style dispatch collapsing). Off by "
             "default: evaluation becomes deferred for whitelisted ops, which "
             "changes op-granular timing/tracing semantics")
+define_flag("decode_jit_cache_size", 16,
+            "max cached decode executables per model for generate()/"
+            "generate_beam() (LRU over sampling-config keys). Evictions "
+            "count in core.monitor decode.cache_evictions; new entries in "
+            "decode.jit_compiles. <= 0 disables the bound")
 define_flag("compile_cache_dir", os.environ.get("PADDLE_TPU_COMPILE_CACHE", ""),
             "persistent XLA compilation cache directory (also settable as "
             "PADDLE_TPU_COMPILE_CACHE). Empty = off (bit-identical default); "

@@ -100,14 +100,27 @@ class GPTAttention(nn.Layer):
         kc, vc = k_cache._data, v_cache._data
         off = offset._data if isinstance(offset, Tensor) else offset
         off = off.astype(jnp.int32)
-        zero = jnp.int32(0)
-        kc = jax.lax.dynamic_update_slice(
-            kc, k._data.astype(kc.dtype), (zero, off, zero, zero))
-        vc = jax.lax.dynamic_update_slice(
-            vc, v._data.astype(vc.dtype), (zero, off, zero, zero))
         total = kc.shape[1]
-        qpos = off + jnp.arange(s)                       # [s]
-        mask = jnp.arange(total)[None, :] <= qpos[:, None]  # [s, T] causal+len
+        if getattr(off, "ndim", 0) == 1:
+            # per-row offsets (serving slot cache): each row writes its new
+            # chunk at its own position. Rows past a row's offset are never
+            # attended (mask below), so retired/short slots stay inert and
+            # one batched step can serve slots at arbitrary depths.
+            rows = jnp.arange(b)[:, None]                     # [b, 1]
+            pos = jnp.clip(off[:, None] + jnp.arange(s)[None, :], 0, total - 1)
+            kc = kc.at[rows, pos].set(k._data.astype(kc.dtype))
+            vc = vc.at[rows, pos].set(v._data.astype(vc.dtype))
+            qpos = off[:, None] + jnp.arange(s)[None, :]      # [b, s]
+            mask = (jnp.arange(total)[None, None, :]
+                    <= qpos[:, :, None])[:, None]             # [b, 1, s, T]
+        else:
+            zero = jnp.int32(0)
+            kc = jax.lax.dynamic_update_slice(
+                kc, k._data.astype(kc.dtype), (zero, off, zero, zero))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v._data.astype(vc.dtype), (zero, off, zero, zero))
+            qpos = off + jnp.arange(s)                       # [s]
+            mask = jnp.arange(total)[None, :] <= qpos[:, None]  # [s, T]
         out = F.scaled_dot_product_attention(
             q, Tensor(kc), Tensor(vc), attn_mask=Tensor(mask),
             dropout_p=0.0, training=False)
@@ -174,7 +187,11 @@ class GPTModel(nn.Layer):
             off_arr = off._data if isinstance(off, Tensor) else off
             import jax.numpy as jnp
 
-            pos = Tensor(off_arr + jnp.arange(s, dtype=jnp.int64))
+            if getattr(off_arr, "ndim", 0) == 1:  # per-row offsets -> [b, s]
+                pos = Tensor(off_arr[:, None].astype(jnp.int64)
+                             + jnp.arange(s, dtype=jnp.int64)[None, :])
+            else:
+                pos = Tensor(off_arr + jnp.arange(s, dtype=jnp.int64))
         else:
             pos = C.arange(0, s, dtype="int64")
         x = self.wte(input_ids) + self.wpe(pos)
@@ -386,6 +403,36 @@ def _pipe_block_fwd(x, p, nh, hd):
     return x + m @ p["fc2_w"] + p["fc2_b"]
 
 
+def _decode_jit_get(model, key, build):
+    """LRU-bounded per-model decode-executable cache (generate/generate_beam).
+
+    Keyed by the full sampling/shape tuple and bounded by
+    FLAGS_decode_jit_cache_size, so traffic cycling through sampling configs
+    cannot grow the per-model dict without bound. core.monitor counters:
+    decode.jit_compiles (new executables), decode.cache_evictions (LRU drops).
+    """
+    from collections import OrderedDict
+
+    from ..core import flags as _flags
+    from ..core import monitor as _monitor
+
+    cache = model.__dict__.setdefault("_generate_jit_cache", OrderedDict())
+    if not isinstance(cache, OrderedDict):
+        cache = model.__dict__["_generate_jit_cache"] = OrderedDict(cache)
+    fn = cache.get(key)
+    if fn is not None:
+        cache.move_to_end(key)
+        return fn
+    fn = cache[key] = build()
+    _monitor.stat("decode.jit_compiles").increase()
+    limit = int(_flags.flag("decode_jit_cache_size"))
+    if limit > 0:
+        while len(cache) > limit:
+            cache.popitem(last=False)
+            _monitor.stat("decode.cache_evictions").increase()
+    return fn
+
+
 class GPTForPretraining(nn.Layer):
     """forward(input_ids, labels) -> scalar LM loss (the engine's expected signature)."""
 
@@ -446,7 +493,8 @@ class GPTForPretraining(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=0, top_p=1.0, eos_token_id=None, seed=0,
-                 decode_strategy=None, num_beams=1, length_penalty=1.0):
+                 decode_strategy=None, num_beams=1, length_penalty=1.0,
+                 prompt_bucket=None):
         """Autoregressive decode with KV cache — ONE jitted program: prefill
         fills fixed [b, total, nh, hd] cache buffers, then a lax.scan emits a
         token per step (static shapes end to end, the TPU-native decode loop).
@@ -456,6 +504,15 @@ class GPTForPretraining(nn.Layer):
         decode_strategy follows the reference generate() API: None picks
         greedy/sampling from temperature; "beam_search" (or num_beams > 1)
         routes to generate_beam.
+
+        prompt_bucket (opt-in): an int target length or a ladder of rungs
+        (e.g. serving.DEFAULT_LADDER) — the prompt is right-padded to the
+        smallest rung >= its length and the executable is keyed on the RUNG,
+        so every prompt length in a bucket shares one compiled program.
+        Causal attention makes the pad harmless: logits are read at the last
+        real position and decode resumes at offset=prompt_len, overwriting
+        one pad cache row per generated token before it is ever attended —
+        tokens are identical to the unpadded run.
 
         Single-replica inference path (mp decode would shard the head and
         psum logits; see PARITY row 49). Returns [b, prompt + max_new_tokens].
@@ -471,6 +528,9 @@ class GPTForPretraining(nn.Layer):
                 raise ValueError(
                     "beam_search needs num_beams >= 2 (reference generate() "
                     f"semantics), got {num_beams}")
+            if prompt_bucket is not None:
+                raise ValueError(
+                    "prompt_bucket is not supported with beam_search")
             return self.generate_beam(
                 input_ids, max_new_tokens=max_new_tokens,
                 num_beams=int(num_beams),
@@ -489,12 +549,22 @@ class GPTForPretraining(nn.Layer):
 
         cfg = self.config
         ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        orig_ids = ids
         b, prompt = ids.shape
-        total = prompt + max_new_tokens
+        bucketed = prompt_bucket is not None
+        if bucketed:
+            from ..serving.bucketing import resolve_bucket
+
+            padded_len = resolve_bucket(prompt, prompt_bucket)
+            ids = jnp.pad(ids, ((0, 0), (0, padded_len - prompt)))
+        else:
+            padded_len = prompt
+        total = padded_len + max_new_tokens
         if total > cfg.max_seq_len:
-            raise ValueError(f"prompt {prompt} + max_new_tokens "
-                             f"{max_new_tokens} exceeds max_seq_len "
-                             f"{cfg.max_seq_len}")
+            raise ValueError(f"prompt {padded_len}"
+                             f"{' (bucketed)' if bucketed else ''} + "
+                             f"max_new_tokens {max_new_tokens} exceeds "
+                             f"max_seq_len {cfg.max_seq_len}")
         nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
         state = self.state_dict(include_non_persistable_buffer=True)
         params = {k: v._data for k, v in state.items()}
@@ -529,7 +599,10 @@ class GPTForPretraining(nn.Layer):
                 return jnp.argmax(logits, axis=-1)
             logits = logits / jnp.float32(max(temperature, 1e-6))
             if top_k and top_k > 0:
-                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                # clamp to vocab: top_k >= vocab must mean "keep everything",
+                # not an out-of-range [:, -top_k] row index
+                k_eff = min(int(top_k), logits.shape[-1])
+                kth = jnp.sort(logits, axis=-1)[:, -k_eff][:, None]
                 logits = jnp.where(logits < kth, -jnp.inf, logits)
             if top_p < 1.0:
                 sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
@@ -549,7 +622,7 @@ class GPTForPretraining(nn.Layer):
             with _swapped_state(self, params), _tracing(), no_grad():
                 return self._head_logits(Tensor(h_arr))._data
 
-        def run(params, ids, key):
+        def run(params, ids, plen, key):
             if _w_dtype is not None:
                 params = {k: (v.astype(_w_dtype)
                               if v.ndim >= 2 and jnp.issubdtype(
@@ -566,7 +639,19 @@ class GPTForPretraining(nn.Layer):
                        Tensor(jnp.int32(0))) for _ in range(cfg.num_layers)]
             h, caches = functional_call(self.gpt, gpt_params, Tensor(ids),
                                         caches=caches)
-            logits = head(params, h._data[:, -1])
+            if bucketed:
+                # plen is a TRACED scalar: logits come from the last REAL
+                # position and decode resumes at offset=plen, so one padded
+                # executable serves every prompt length in the bucket. Each
+                # generated token overwrites one pad cache row before it is
+                # ever attended (causal mask) — numerics match unpadded.
+                last_h = jax.lax.dynamic_index_in_dim(h._data, plen - 1, 1,
+                                                      keepdims=False)
+                caches = [(kc2, vc2, Tensor(plen)) for (kc2, vc2, _o)
+                          in caches]
+            else:
+                last_h = h._data[:, -1]
+            logits = head(params, last_h)
             key, sub = jax.random.split(key)
             tok = sample(logits, sub).astype(ids.dtype)
             done = (jnp.zeros((b,), bool) if eos_token_id is None
@@ -613,15 +698,20 @@ class GPTForPretraining(nn.Layer):
                         frozenset(amp.black)) if amp is not None else None)
             # cache_dtype is baked into run()'s closure: key it, or a later
             # call on the no-amp fallback path (param dtype changed, amp_key
-            # identical) would retrace the stale closure
-            cache_key = (b, prompt, max_new_tokens, float(temperature),
-                         int(top_k), float(top_p), eos_token_id, amp_key,
-                         str(cache_dtype))
-            jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
-            fn = jit_cache.get(cache_key)
-            if fn is None:
-                fn = jit_cache[cache_key] = jax.jit(run)
-            out = fn(params, ids, jax.random.key(seed))
+            # identical) would retrace the stale closure. Bucketed keys use
+            # the RUNG, not the prompt length — the whole bucket shares one
+            # executable (plen stays a traced argument).
+            cache_key = (b, padded_len, bucketed, max_new_tokens,
+                         float(temperature), int(top_k), float(top_p),
+                         eos_token_id, amp_key, str(cache_dtype))
+            fn = _decode_jit_get(self, cache_key, lambda: jax.jit(run))
+            out = fn(params, ids, jnp.int32(prompt), jax.random.key(seed))
+            if bucketed:
+                # reassemble outside the jit: echo the UNPADDED prompt, then
+                # the generated tokens (which sit after the padded region) —
+                # slicing inside the executable would re-specialize per
+                # prompt length and defeat the bucket
+                out = jnp.concatenate([orig_ids, out[:, padded_len:]], axis=1)
         finally:
             if was_training:
                 self.train()
@@ -773,10 +863,7 @@ class GPTForPretraining(nn.Layer):
             cache_key = ("beam", b, prompt, max_new_tokens, K,
                          float(length_penalty), eos_token_id, amp_key,
                          str(cache_dtype))
-            jit_cache = self.__dict__.setdefault("_generate_jit_cache", {})
-            fn = jit_cache.get(cache_key)
-            if fn is None:
-                fn = jit_cache[cache_key] = jax.jit(run)
+            fn = _decode_jit_get(self, cache_key, lambda: jax.jit(run))
             out = fn(params, ids)
         finally:
             if was_training:

@@ -196,7 +196,19 @@ class StepTelemetry:
                            ("engine.compile_warm", "compile_warm"),
                            ("engine.compile_warm_ms", "compile_warm_ms"),
                            ("dispatch.calls", "dispatch_calls"),
-                           ("dispatch.nan_inf_hits", "nan_inf_hits")):
+                           ("dispatch.nan_inf_hits", "nan_inf_hits"),
+                           # decode/serving executables (models/gpt.py LRU
+                           # + serving/engine.py): compile growth here mid-
+                           # serve means something re-keyed on prompt shape
+                           ("decode.jit_compiles", "decode_jit_compiles"),
+                           ("decode.cache_evictions",
+                            "decode_cache_evictions"),
+                           ("serving.prefill_compiles",
+                            "serving_prefill_compiles"),
+                           ("serving.decode_compiles",
+                            "serving_decode_compiles"),
+                           ("serving.steps", "serving_steps"),
+                           ("serving.tokens", "serving_tokens")):
             if key in rep:
                 v = rep[key]["value"]
                 out[field] = v

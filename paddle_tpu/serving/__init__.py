@@ -1,0 +1,33 @@
+"""Shape-stable serving: bucketed prefill, slot KV cache, continuous
+batching (see engine.py for the design).
+
+Quick start::
+
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(model, slot_count=4, ladder=(16, 32, 64),
+                        max_new_cap=32)
+    reqs = [eng.submit(prompt, max_new_tokens=24, eos_token_id=eos)
+            for prompt in prompts]
+    eng.run()                      # continuous batching until drained
+    outs = [r.output_ids() for r in reqs]
+
+core.monitor counters: serving.prefill_compiles (bounded by the bucket
+ladder), serving.decode_compiles (one executable), serving.steps,
+serving.tokens, serving.requests; legacy generate() adds
+decode.jit_compiles / decode.cache_evictions (LRU-bounded executable
+cache).
+"""
+from .bucketing import (  # noqa: F401
+    DEFAULT_LADDER, bucket_for, clip_ladder, resolve_bucket,
+)
+from .engine import Request, ServingEngine  # noqa: F401
+from .sampling import (  # noqa: F401
+    filter_topk_topp, request_key, sample_tokens,
+)
+
+__all__ = [
+    "ServingEngine", "Request",
+    "DEFAULT_LADDER", "bucket_for", "clip_ladder", "resolve_bucket",
+    "sample_tokens", "filter_topk_topp", "request_key",
+]

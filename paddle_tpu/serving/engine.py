@@ -1,0 +1,525 @@
+"""Shape-stable serving engine: bucketed prefill + slot KV cache +
+continuous-batching decode.
+
+Legacy generate() compiles one monolithic prefill+scan program per exact
+(batch, prompt_len, max_new_tokens, sampling-config) tuple and always burns
+max_new_tokens scan steps. Under mixed traffic that is a recompile per
+shape class and wasted steps past every early EOS. The engine splits
+generation into two shape-stable compiled artifacts instead (the
+resident-program philosophy of MPK, arxiv 2512.22219):
+
+- **bucketed prefill**, one executable per prompt-bucket rung: the prompt
+  is right-padded to the rung, run through the model with causal masking,
+  and its K/V scattered into this request's row of the slot cache. The
+  true prompt length, target slot, sampling params, and seed are all
+  traced, so a whole traffic distribution shares O(#rungs) executables.
+- **a single-token decode step**, ONE executable total: operates on the
+  fixed [slots, max_seq_len, nh, hd] donated KV cache with per-slot write
+  offsets, per-slot sampling params (traced — mixed greedy/top-k/top-p
+  share the program), per-slot EOS/budget masks, and per-slot RNG streams.
+
+On top sits continuous batching: finished sequences retire their slot
+mid-flight and queued requests are prefilled into free slots between decode
+steps — the decode loop itself never recompiles and never runs a step for
+work that is already done (only for idle slots while ANY slot is live,
+which is the slot-occupancy metric the telemetry records).
+
+CPU-demonstrable (tools/serve_bench.py); the same two executables are what
+a TPU deployment keeps resident.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+from .bucketing import DEFAULT_LADDER, bucket_for, clip_ladder
+
+_NO_EOS = -1
+
+
+def _jit_cache_size(fn) -> int:
+    try:
+        return fn._cache_size()
+    except Exception:
+        return -1
+
+
+class Request:
+    """One generation request and its lifecycle record."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt_ids, max_new_tokens, temperature, top_k, top_p,
+                 eos_token_id, seed):
+        import numpy as np
+
+        self.id = next(Request._ids)
+        self.prompt_ids = np.asarray(prompt_ids, np.int64).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_token_id = (int(eos_token_id) if eos_token_id is not None
+                             else None)
+        self.seed = int(seed)
+        self.tokens: List[int] = []      # generated tokens (incl. eos if hit)
+        self.bucket: Optional[int] = None
+        self.slot: Optional[int] = None
+        self.queue_depth_at_submit = 0
+        self.submit_ts: Optional[float] = None
+        self.first_token_ts: Optional[float] = None
+        self.done_ts: Optional[float] = None
+        self.finish_reason: Optional[str] = None  # "eos" | "length"
+
+    @property
+    def done(self) -> bool:
+        return self.done_ts is not None
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_ts is None or self.submit_ts is None:
+            return None
+        return self.first_token_ts - self.submit_ts
+
+    def output_ids(self):
+        """[prompt + generated] (no post-EOS padding; pad with eos to
+        compare against legacy generate() fixed-length output)."""
+        import numpy as np
+
+        return np.concatenate(
+            [self.prompt_ids, np.asarray(self.tokens, np.int64)])
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, prompt={len(self.prompt_ids)}, "
+                f"new={len(self.tokens)}/{self.max_new_tokens}, "
+                f"done={self.done})")
+
+
+class ServingEngine:
+    """Continuous-batching GPT serving over a slot-based KV cache.
+
+    model: a GPTForPretraining (eval mode is forced). slot_count fixes the
+    decode batch; ladder the prefill rungs (clipped to what fits
+    max_seq_len with max_new_cap headroom). Weights are snapshotted (and
+    pre-cast to the active AMP compute dtype) at construction — call
+    refresh_params() after updating the model.
+
+    sink: StepTelemetry-style sink (write(dict)/close()) receiving one
+    "serve_request" record per completed request (TTFT, tokens/s, slot,
+    bucket, queue depth) and one "serve_step" record per decode step (slot
+    occupancy, queue depth). None = no telemetry, no overhead.
+
+    Single-driver: submit() is thread-safe, step()/run() must be called
+    from one thread.
+    """
+
+    def __init__(self, model, slot_count: int = 4,
+                 ladder: Sequence[int] = DEFAULT_LADDER,
+                 max_seq_len: Optional[int] = None,
+                 max_new_cap: int = 64, steps_per_dispatch: int = 8,
+                 sink=None):
+        import jax.numpy as jnp
+        import numpy as np
+
+        cfg = model.config
+        self.model = model
+        model.eval()
+        self.slot_count = int(slot_count)
+        if self.slot_count < 1:
+            raise ValueError(f"slot_count must be >= 1, got {slot_count}")
+        self.max_seq_len = int(min(max_seq_len or cfg.max_seq_len,
+                                   cfg.max_seq_len))
+        self.max_new_cap = int(max_new_cap)
+        if self.max_new_cap < 1 or self.max_new_cap >= self.max_seq_len:
+            raise ValueError(
+                f"max_new_cap {max_new_cap} must be in [1, max_seq_len)")
+        self.ladder = clip_ladder(ladder, self.max_seq_len,
+                                  reserve=self.max_new_cap)
+        # decode steps fused into one dispatch (inner lax.scan): divides the
+        # per-step host round-trip by N at the cost of (a) retired slots
+        # idling masked until the chunk ends (<= N-1 wasted slot-steps per
+        # retirement) and (b) admissions landing on chunk boundaries. Still
+        # ONE decode executable; N is static in its key.
+        self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        self.sink = sink
+
+        self._lock = threading.Lock()
+        self._queue: deque[Request] = deque()
+        self._completed: List[Request] = []
+        self._steps = 0
+
+        self.refresh_params()
+
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // cfg.num_heads
+        S, T = self.slot_count, self.max_seq_len
+        self._kcs = [jnp.zeros((S, T, nh, hd), self._cache_dtype)
+                     for _ in range(cfg.num_layers)]
+        self._vcs = [jnp.zeros((S, T, nh, hd), self._cache_dtype)
+                     for _ in range(cfg.num_layers)]
+
+        # host-side per-slot state (tiny arrays, re-staged every step)
+        self._offsets = np.zeros(S, np.int32)
+        self._last_tok = np.zeros(S, np.int32)
+        self._active = np.zeros(S, bool)
+        self._temps = np.zeros(S, np.float32)
+        self._topk = np.zeros(S, np.int32)
+        self._topp = np.ones(S, np.float32)
+        self._eos = np.full(S, _NO_EOS, np.int32)
+        self._remaining = np.zeros(S, np.int32)
+        self._seeds = np.zeros(S, np.int32)
+        self._slot_req: List[Optional[Request]] = [None] * S
+
+        self._prefill_fns: Dict[int, Any] = {}
+        # decode executables keyed by sampling FAMILY only ("greedy" skips
+        # the sort/cumsum sampling machinery entirely; "sample" carries all
+        # sampling params as traced per-slot vectors) — never by prompt
+        # length, max_new_tokens, or the sampling values themselves
+        self._decode_fns: Dict[str, Any] = {}
+        self._fn_cache_sizes: Dict[int, int] = {}  # id(fn) -> last size
+
+    # ------------------------------------------------------------- params
+    def refresh_params(self) -> None:
+        """Re-snapshot model weights (pre-cast once to the AMP compute
+        dtype, the weights-in-compute-dtype inference layout legacy
+        generate() establishes per call)."""
+        import jax.numpy as jnp
+
+        from ..core.dispatch import _autocast_dtype_for
+
+        state = self.model.state_dict(include_non_persistable_buffer=True)
+        params = {k: v._data for k, v in state.items()}
+        mm_dtype = _autocast_dtype_for("attention", ())
+        self._cache_dtype = (mm_dtype if mm_dtype is not None
+                             else self.model.gpt.wte.weight._data.dtype)
+        w_dtype = _autocast_dtype_for("matmul", ())
+        if w_dtype is not None:
+            params = {k: (v.astype(w_dtype)
+                          if v.ndim >= 2 and jnp.issubdtype(
+                              v.dtype, jnp.floating) else v)
+                      for k, v in params.items()}
+        self._params = params
+
+    # ------------------------------------------------------------- public
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               eos_token_id=None, seed: int = 0) -> Request:
+        """Enqueue a request; returns the live Request handle (tokens fill
+        in as the engine runs). max_new_tokens is clamped to the engine cap
+        and to the cache room left after the prompt's bucket."""
+        req = Request(prompt_ids, max_new_tokens, temperature, top_k, top_p,
+                      eos_token_id, seed)
+        plen = len(req.prompt_ids)
+        req.bucket = bucket_for(plen, self.ladder)  # raises if oversize
+        room = self.max_seq_len - req.bucket
+        req.max_new_tokens = max(1, min(req.max_new_tokens,
+                                        self.max_new_cap, room))
+        req.submit_ts = time.perf_counter()
+        with self._lock:
+            req.queue_depth_at_submit = len(self._queue)
+            self._queue.append(req)
+        return req
+
+    def step(self) -> int:
+        """Admit queued requests into free slots (bucketed prefill), then
+        run ONE decode step for all slots. Returns the number of live
+        slots after the step (0 = fully drained)."""
+        self._admit()
+        if self._active.any():
+            self._decode_step()
+        return int(self._active.sum())
+
+    def run(self, max_steps: Optional[int] = None) -> List[Request]:
+        """Drive until queue and slots drain (or max_steps decode
+        dispatches); returns the requests completed during this call."""
+        done0 = len(self._completed)
+        steps = 0
+        while self._queue or self._active.any():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return self._completed[done0:]
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "steps": self._steps,
+            "completed": len(self._completed),
+            "queued": len(self._queue),
+            "active_slots": int(self._active.sum()),
+            "slot_count": self.slot_count,
+            "ladder": self.ladder,
+            "prefill_executables": len(self._prefill_fns),
+            "decode_executables": len(self._decode_fns),
+        }
+
+    # ---------------------------------------------------------- internals
+    def _note_exec_compiles(self, fn, counter: str) -> None:
+        """Count executable-cache growth of a jitted fn into core.monitor —
+        the regression alarm that keeps prefill/decode keyed off prompt
+        length (tests assert totals <= ladder size)."""
+        from ..core import monitor
+
+        n = _jit_cache_size(fn)
+        prev = self._fn_cache_sizes.get(id(fn))
+        if n < 0:  # no _cache_size on this jax: count one per wrapper
+            if prev is None:
+                self._fn_cache_sizes[id(fn)] = 0
+                monitor.stat(counter).increase()
+            return
+        if prev is None:
+            prev = 0
+        if n > prev:
+            monitor.stat(counter).increase(n - prev)
+        self._fn_cache_sizes[id(fn)] = n
+
+    def _head_traced(self, params, h_arr):
+        """last-position hidden -> logits with weights from traced params."""
+        from ..core.autograd import no_grad
+        from ..core.tensor import Tensor
+        from ..jit import _swapped_state, _tracing
+
+        with _swapped_state(self.model, params), _tracing(), no_grad():
+            return self.model._head_logits(Tensor(h_arr))._data
+
+    # ---- prefill -------------------------------------------------------
+    def _build_prefill(self, bucket: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..jit import functional_call
+        from .sampling import request_key, sample_tokens
+
+        cfg = self.model.config
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // cfg.num_heads
+        cache_dtype = self._cache_dtype
+        gpt = self.model.gpt
+
+        def prefill(params, kcs, vcs, ids, plen, slot, temp, top_k, top_p,
+                    seed):
+            gpt_params = {k[len("gpt."):]: v for k, v in params.items()
+                          if k.startswith("gpt.")}
+            # fresh request-local cache sized to the rung; causal masking
+            # makes the right-pad inert (queries past plen are discarded)
+            caches = [(Tensor(jnp.zeros((1, bucket, nh, hd), cache_dtype)),
+                       Tensor(jnp.zeros((1, bucket, nh, hd), cache_dtype)),
+                       Tensor(jnp.int32(0))) for _ in range(cfg.num_layers)]
+            h, caches = functional_call(gpt, gpt_params, Tensor(ids),
+                                        caches=caches)
+            last_h = jax.lax.dynamic_index_in_dim(h._data, plen - 1, 1,
+                                                  keepdims=False)
+            logits = self._head_traced(params, last_h)       # [1, V]
+            key = request_key(seed, plen)  # first token sits at position plen
+            tok = sample_tokens(logits, key[None], temp[None], top_k[None],
+                                top_p[None])[0]
+            # scatter this request's K/V into its slot row of the big cache
+            new_kcs, new_vcs = [], []
+            start = (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0))
+            for big_k, big_v, layer in zip(kcs, vcs, caches):
+                new_kcs.append(jax.lax.dynamic_update_slice(
+                    big_k, layer[0]._data.astype(big_k.dtype), start))
+                new_vcs.append(jax.lax.dynamic_update_slice(
+                    big_v, layer[1]._data.astype(big_v.dtype), start))
+            return new_kcs, new_vcs, tok
+
+        return jax.jit(prefill, donate_argnums=(1, 2))
+
+    def _admit(self) -> None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return
+                free = [i for i in range(self.slot_count)
+                        if not self._active[i] and self._slot_req[i] is None]
+                if not free:
+                    return
+                req = self._queue.popleft()
+            slot = free[0]
+            bucket = req.bucket
+            plen = len(req.prompt_ids)
+            fn = self._prefill_fns.get(bucket)
+            if fn is None:
+                fn = self._prefill_fns[bucket] = self._build_prefill(bucket)
+            padded = np.zeros((1, bucket), np.int64)
+            padded[0, :plen] = req.prompt_ids
+            self._kcs, self._vcs, tok = fn(
+                self._params, self._kcs, self._vcs, jnp.asarray(padded),
+                jnp.int32(plen), jnp.int32(slot),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.float32(req.top_p), jnp.int32(req.seed))
+            self._note_exec_compiles(fn, "serving.prefill_compiles")
+            first = int(tok)                      # device sync = first token
+            req.first_token_ts = time.perf_counter()
+            req.slot = slot
+            req.tokens.append(first)
+            self._count_tokens(1)
+            eos = req.eos_token_id if req.eos_token_id is not None else _NO_EOS
+            if (eos != _NO_EOS and first == eos) or req.max_new_tokens <= 1:
+                req.finish_reason = ("eos" if eos != _NO_EOS and first == eos
+                                     else "length")
+                self._finish(req)
+                continue
+            self._offsets[slot] = plen
+            self._last_tok[slot] = first
+            self._active[slot] = True
+            self._temps[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._topp[slot] = req.top_p
+            self._eos[slot] = eos
+            self._remaining[slot] = req.max_new_tokens - 1
+            self._seeds[slot] = req.seed
+            self._slot_req[slot] = req
+
+    # ---- decode --------------------------------------------------------
+    def _build_decode(self, family: str):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..jit import functional_call
+        from .sampling import request_key, sample_tokens
+
+        gpt = self.model.gpt
+        T = self.max_seq_len
+        n_inner = self.steps_per_dispatch
+        greedy_only = family == "greedy"
+
+        def step_chunk(params, kcs, vcs, off, tok, active, temps, top_k,
+                       top_p, eos, remaining, seeds):
+            gpt_params = {k[len("gpt."):]: v for k, v in params.items()
+                          if k.startswith("gpt.")}
+
+            def one(carry, _):
+                kcs, vcs, off, tok, active, remaining = carry
+                # idle slots keep writing their (ignored) tip row; clamp so
+                # a full slot can never index past the cache
+                off_m = jnp.minimum(off, jnp.int32(T - 1))
+                caches = [(Tensor(kc), Tensor(vc), Tensor(off_m))
+                          for kc, vc in zip(kcs, vcs)]
+                h, caches = functional_call(
+                    gpt, gpt_params, Tensor(tok[:, None].astype(jnp.int64)),
+                    caches=caches)
+                logits = self._head_traced(params, h._data[:, 0])  # [S, V]
+                act = active.astype(jnp.int32)
+                new_off = off + act         # the sampled token's position
+                if greedy_only:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    keys = jax.vmap(request_key)(seeds, new_off)
+                    nxt = sample_tokens(logits, keys, temps, top_k, top_p)
+                nxt = jnp.where(active, nxt, tok)
+                new_remaining = remaining - act
+                hit_eos = active & (eos != _NO_EOS) & (nxt == eos)
+                new_active = (active & ~hit_eos & (new_remaining > 0)
+                              & (new_off < T))
+                new_kcs = [c[0]._data for c in caches]
+                new_vcs = [c[1]._data for c in caches]
+                return ((new_kcs, new_vcs, new_off, nxt, new_active,
+                         new_remaining), (nxt, active, hit_eos))
+
+            carry = (kcs, vcs, off, tok, active, remaining)
+            (kcs, vcs, off, tok, active, remaining), (toks, was_active,
+                                                      hits) = jax.lax.scan(
+                one, carry, None, length=n_inner)
+            # toks/was_active/hits: [n_inner, S]
+            return (kcs, vcs, off, tok, active, remaining, toks, was_active,
+                    hits)
+
+        return jax.jit(step_chunk, donate_argnums=(1, 2))
+
+    def _decode_step(self) -> None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        # per-dispatch family pick: an all-greedy slot set runs the slim
+        # executable; any sampling slot routes to the full one. Two decode
+        # executables max, regardless of traffic mix.
+        family = ("greedy"
+                  if not self._temps[self._active].any() else "sample")
+        fn = self._decode_fns.get(family)
+        if fn is None:
+            fn = self._decode_fns[family] = self._build_decode(family)
+        (self._kcs, self._vcs, off, tok, active, remaining, toks, was_active,
+         hits) = fn(
+            self._params, self._kcs, self._vcs,
+            jnp.asarray(self._offsets), jnp.asarray(self._last_tok),
+            jnp.asarray(self._active), jnp.asarray(self._temps),
+            jnp.asarray(self._topk), jnp.asarray(self._topp),
+            jnp.asarray(self._eos), jnp.asarray(self._remaining),
+            jnp.asarray(self._seeds))
+        self._note_exec_compiles(fn, "serving.decode_compiles")
+        # np.array (copy): zero-copy views of jax buffers are read-only, and
+        # _admit mutates these in place when it seats the next request
+        self._offsets = np.array(off)
+        self._last_tok = np.array(tok)
+        self._active = np.array(active)
+        self._remaining = np.array(remaining)
+        toks = np.asarray(toks)               # [n_inner, S]
+        was_active = np.asarray(was_active)
+        hits = np.asarray(hits)
+        n_inner = toks.shape[0]
+        self._steps += n_inner
+        now = time.perf_counter()
+        for j in range(n_inner):
+            alive_after = (was_active[j + 1] if j + 1 < n_inner
+                           else self._active)
+            for slot in np.nonzero(was_active[j])[0]:
+                req = self._slot_req[slot]
+                req.tokens.append(int(toks[j, slot]))
+                if not alive_after[slot]:     # retired at this inner step
+                    req.finish_reason = "eos" if hits[j, slot] else "length"
+                    self._slot_req[slot] = None
+                    self._finish(req, now)
+        emitted = int(was_active.sum())
+        self._count_tokens(emitted)
+        from ..core import monitor
+
+        monitor.stat("serving.steps").increase(n_inner)
+        if self.sink is not None:
+            self.sink.write({
+                "event": "serve_step", "step": self._steps, "ts": time.time(),
+                "steps_per_dispatch": n_inner,
+                "active_slots": int(was_active[0].sum()),
+                "slot_count": self.slot_count,
+                # mean occupancy across the fused steps: retired slots are
+                # masked (idle) until the chunk boundary
+                "occupancy": round(float(was_active.mean()), 4),
+                "queue_depth": len(self._queue),
+                "tokens": emitted,
+            })
+
+    # ---- bookkeeping ---------------------------------------------------
+    def _count_tokens(self, n: int) -> None:
+        if n:
+            from ..core import monitor
+
+            monitor.stat("serving.tokens").increase(n)
+
+    def _finish(self, req: Request, now: Optional[float] = None) -> None:
+        from ..core import monitor
+
+        req.done_ts = now if now is not None else time.perf_counter()
+        self._completed.append(req)
+        monitor.stat("serving.requests").increase()
+        if self.sink is not None:
+            wall = max(req.done_ts - req.submit_ts, 1e-9)
+            self.sink.write({
+                "event": "serve_request", "request_id": req.id,
+                "ts": time.time(),
+                "prompt_len": int(len(req.prompt_ids)),
+                "bucket": req.bucket, "slot": req.slot,
+                "new_tokens": len(req.tokens),
+                "finish_reason": req.finish_reason,
+                "ttft_s": round(req.ttft_s, 6),
+                "wall_s": round(wall, 6),
+                "tokens_per_sec": round(len(req.tokens) / wall, 2),
+                "queue_depth_at_submit": req.queue_depth_at_submit,
+            })

@@ -25,6 +25,7 @@ _BOOT = "import jax; jax.config.update('jax_platforms', 'cpu'); " \
     ("train_widedeep_ps.py", "step 8: loss"),
     ("export_and_serve.py", "predictor output matches eager forward"),
     ("generate_gpt.py", "decode ok: prompt"),
+    ("serve_engine.py", "serving ok:"),
     ("quantize_int8.py", "ptq int8 output shape ok"),
     ("pallas_library_ops.py", "pallas layer_norm ok"),
 ])

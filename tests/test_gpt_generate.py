@@ -119,6 +119,99 @@ def test_generate_under_amp_caches_separately():
     np.testing.assert_array_equal(out_bf16.numpy()[:, :8], ids.numpy())
 
 
+# ---- round 6 satellites: prompt bucketing, LRU jit cache, top-k clamp ------
+def test_prompt_bucket_identical_tokens_and_shared_executable(model):
+    """prompt_bucket right-pads to the rung but must emit IDENTICAL tokens
+    to the unpadded run (greedy), and every prompt length in a bucket must
+    share ONE executable (keyed on the rung, prompt length traced)."""
+    rng = np.random.RandomState(11)
+    model._generate_jit_cache.clear()
+    for plen in (3, 5, 7, 8):                 # all land in the 8-rung
+        ids = rng.randint(0, 1024, (2, plen)).astype(np.int64)
+        plain = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                               temperature=0).numpy()
+        bucketed = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                                  temperature=0,
+                                  prompt_bucket=(8, 16, 32)).numpy()
+        np.testing.assert_array_equal(plain, bucketed)
+    # 4 exact-shape executables + ONE shared bucketed executable
+    keys = list(model._generate_jit_cache.keys())
+    assert len(keys) == 5
+    # sampling under a bucket is deterministic per seed too
+    ids = rng.randint(0, 1024, (1, 5)).astype(np.int64)
+    a = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                       temperature=0.8, top_k=20, seed=3,
+                       prompt_bucket=16).numpy()
+    b = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                       temperature=0.8, top_k=20, seed=3,
+                       prompt_bucket=16).numpy()
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a[:, :5], ids)  # unpadded prompt echoed
+    assert a.shape == (1, 9)
+
+
+def test_prompt_bucket_validation(model):
+    ids = np.zeros((1, 20), np.int64)
+    with pytest.raises(ValueError, match="exceeds"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                       prompt_bucket=16)          # prompt 20 > rung 16
+    with pytest.raises(ValueError, match="max_seq_len"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                       prompt_bucket=128)         # 128 + 8 > max_seq_len
+    with pytest.raises(ValueError, match="beam_search"):
+        model.generate(paddle.to_tensor(ids), max_new_tokens=4, num_beams=2,
+                       prompt_bucket=32)
+
+
+def test_generate_jit_cache_lru_bounded(model):
+    """The per-model decode-executable dict is LRU-bounded by
+    FLAGS_decode_jit_cache_size; evictions and compiles count in
+    core.monitor (decode.cache_evictions / decode.jit_compiles)."""
+    from paddle_tpu.core import monitor
+
+    def counter(name):
+        return monitor.registry().report().get(name, {}).get("value", 0)
+
+    ids = paddle.to_tensor(np.random.RandomState(12).randint(
+        0, 1024, (1, 4)).astype(np.int64))
+    old = paddle.get_flags(
+        ["decode_jit_cache_size"])["FLAGS_decode_jit_cache_size"]
+    try:
+        paddle.set_flags({"decode_jit_cache_size": 2})
+        model._generate_jit_cache.clear()
+        c0 = counter("decode.jit_compiles")
+        e0 = counter("decode.cache_evictions")
+        for t in (0.5, 0.6, 0.7, 0.8):        # 4 configs, bound 2
+            model.generate(ids, max_new_tokens=2, temperature=t, seed=1)
+        assert len(model._generate_jit_cache) == 2
+        assert counter("decode.jit_compiles") - c0 == 4
+        assert counter("decode.cache_evictions") - e0 == 2
+        # LRU: most recent configs survive — no recompile on re-use
+        c1 = counter("decode.jit_compiles")
+        model.generate(ids, max_new_tokens=2, temperature=0.8, seed=1)
+        assert counter("decode.jit_compiles") == c1
+        # beam executables share the same bounded cache
+        model.generate(ids, max_new_tokens=2, num_beams=2)
+        assert len(model._generate_jit_cache) == 2
+    finally:
+        paddle.set_flags({"decode_jit_cache_size": old})
+        model._generate_jit_cache.clear()
+
+
+def test_top_k_clamped_to_vocab(model):
+    """top_k >= vocab must mean 'keep everything' (identical to top_k ==
+    vocab), not an out-of-range sort index."""
+    ids = paddle.to_tensor(np.random.RandomState(13).randint(
+        0, 1024, (2, 5)).astype(np.int64))
+    v = model.config.vocab_size
+    exact = model.generate(ids, max_new_tokens=4, temperature=0.9,
+                           top_k=v, seed=5).numpy()
+    huge = model.generate(ids, max_new_tokens=4, temperature=0.9,
+                          top_k=10 * v, seed=5).numpy()
+    np.testing.assert_array_equal(exact, huge)
+    assert (huge >= 0).all() and (huge < v).all()
+
+
 # ---- round 4: beam search (one-scan, beam dim in the KV cache) -------------
 def test_beam_search_beats_or_matches_greedy_logprob():
     """Beam-K's selected sequence must score >= greedy's under the model's

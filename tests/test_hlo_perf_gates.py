@@ -411,7 +411,10 @@ def test_decode_loop_cache_in_place_no_weight_casts():
         jf = next(iter(model._generate_jit_cache.values()))
         params = {k: v._data for k, v in model.state_dict(
             include_non_persistable_buffer=True).items()}
-        txt = jf.lower(params, ids, jax.random.key(0)).compile().as_text()
+        # run(params, ids, plen, key): plen traced since the prompt-bucket
+        # round (round 6) — exact-shape calls simply pass plen == prompt
+        txt = jf.lower(params, ids, jnp.int32(prompt),
+                       jax.random.key(0)).compile().as_text()
 
     from paddle_tpu.utils import hlo_inspect as hi
 
@@ -509,7 +512,9 @@ def test_decode_loop_weights_precast_to_bf16():
         jf = next(iter(model._generate_jit_cache.values()))
         params = {k: v._data for k, v in model.state_dict(
             include_non_persistable_buffer=True).items()}
-        jaxpr = jax.make_jaxpr(jf)(params, ids, jax.random.key(0))
+        # run(params, ids, plen, key) — see the cache-in-place gate above
+        jaxpr = jax.make_jaxpr(jf)(params, ids, jnp.int32(16),
+                                   jax.random.key(0))
 
     wmin = cfg.hidden_size * cfg.hidden_size
     big_inputs, n_converts = hi.jaxpr_loop_report(jaxpr, wmin)

@@ -35,6 +35,7 @@ MODULES = [
     "paddle_tpu.distribution",
     "paddle_tpu.incubate",
     "paddle_tpu.inference",
+    "paddle_tpu.serving",
     "paddle_tpu.profiler",
     "paddle_tpu.onnx",
 ]
