@@ -53,15 +53,34 @@ def enabled() -> bool:
 def configure() -> Optional[str]:
     """Apply FLAGS_compile_cache_dir to jax.config. Idempotent; called at
     package import and on every set_flags touching the flag. Returns the
-    active dir (None = off). Turning the cache OFF mid-process only stops
-    new writes/reads for future backends — jax does not support unsetting
-    an initialized cache cleanly, so we leave config untouched then."""
+    active dir (None = off).
+
+    Turning the cache OFF (flag set back to empty) fully unwires it: the
+    config dir is unset AND jax's latched in-memory cache object is dropped
+    via reset_cache(). The latter matters — jax initializes its cache
+    singleton at the first post-configure compile and keeps serving it even
+    after the config dir is cleared, so without the reset a test that
+    enabled the cache would leak it into every later compile in the
+    process. (On this jax/XLA CPU, cache-SERVED multi-device executables
+    can additionally produce nondeterministic collective results — the
+    order-dependent test_dist_checkpoint failure traced to exactly this
+    leak — so severing it on disable is a correctness fix, not hygiene.)"""
     global _configured_dir
     d = str(flag("compile_cache_dir") or "").strip()
-    if not d or d == _configured_dir:
+    if d == (_configured_dir or ""):
         return _configured_dir
     import jax
 
+    if not d:
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            from jax._src import compilation_cache as _jcc
+
+            _jcc.reset_cache()
+        except Exception:
+            pass
+        _configured_dir = None
+        return None
     os.makedirs(d, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", d)
     # cache EVERYTHING: the default thresholds skip fast compiles, which on

@@ -105,6 +105,23 @@ define_flag("decode_jit_cache_size", 16,
             "generate_beam() (LRU over sampling-config keys). Evictions "
             "count in core.monitor decode.cache_evictions; new entries in "
             "decode.jit_compiles. <= 0 disables the bound")
+define_flag("grad_comm_dtype", "f32",
+            "gradient all-reduce precision for the grad_comm path "
+            "(distributed/grad_comm.py): f32 (default — bit-identical to "
+            "the plain fused step), bf16 (half the wire bytes), or int8 "
+            "(EQuARX-style chunk-scaled quantized collective, ~4x fewer "
+            "bytes). Applies on pure data-parallel meshes; hybrid (mp/sp) "
+            "topologies ignore it and reduce in f32")
+define_flag("grad_comm_error_feedback", False,
+            "carry the local quantization error of the low-precision "
+            "gradient collective into the next step (error-feedback "
+            "residual). Removes the bias of repeated bf16/int8 rounding at "
+            "the cost of one f32 gradient-sized buffer per data replica")
+define_flag("grad_comm_chunk", 1024,
+            "elements per scaling block of the int8 gradient collective: "
+            "each chunk ships one f32 absmax scale with its int8 payload "
+            "(smaller chunks track gradient dynamic range better, larger "
+            "chunks amortize scale overhead)")
 define_flag("compile_cache_dir", os.environ.get("PADDLE_TPU_COMPILE_CACHE", ""),
             "persistent XLA compilation cache directory (also settable as "
             "PADDLE_TPU_COMPILE_CACHE). Empty = off (bit-identical default); "

@@ -35,6 +35,7 @@ from ..jit import functional_call
 from ..observability import tracer as _obs_tracer
 from ..observability.step_telemetry import StepTelemetry
 from ..optimizer import functional as opt_funct
+from . import grad_comm as _gc
 from . import prefetcher as _pf
 from .mesh import HybridCommunicateGroup, get_hybrid_communicate_group
 
@@ -142,7 +143,8 @@ class TrainStepEngine:
     def __init__(self, model, optimizer, loss_fn: Optional[Callable] = None,
                  hcg: Optional[HybridCommunicateGroup] = None, strategy=None,
                  input_specs: Optional[List[P]] = None, donate: bool = True,
-                 num_model_inputs: Optional[int] = None):
+                 num_model_inputs: Optional[int] = None,
+                 microbatches: int = 1):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -191,6 +193,14 @@ class TrainStepEngine:
                 jax.device_put(s, self._opt_sharding(spec)) for s in st)
 
         self._step_fn = None
+        # microbatch gradient accumulation (distributed/grad_comm.py): K
+        # splits the global batch inside ONE compiled program — one dispatch
+        # and one deferred fused gradient all-reduce per optimizer step.
+        # Mutable until the first accumulated step; fns cached per config.
+        self.microbatches = max(1, int(microbatches))
+        self._accum_fns = {}
+        self._grad_residual = None     # error-feedback state, lazily built
+        self._gspmd_warned = False
         self._batch_shardings = None   # resolved lazily from the first batch
         self._pending_h2d = None       # (h2d_ms, depth) staged by prefetch()
         self.prefetcher = None         # last DevicePrefetcher built by prefetch()
@@ -457,6 +467,180 @@ class TrainStepEngine:
             donate_argnums=(0, 1) if self._donate else (),
         )
 
+    # ---- microbatch gradient accumulation (grad_comm) ----
+    def _batch_axes(self):
+        return tuple(a for a in ("dp", "sharding")
+                     if self.hcg.degrees[a] > 1)
+
+    def _dp_pure(self) -> bool:
+        """True when the mesh is pure data-parallel (dp and/or ZeRO sharding
+        only) and every param is replicated — the shard_map deferred-reduce
+        fast path (ONE fused gradient all-reduce independent of K)."""
+        if any(self.hcg.degrees[a] > 1 for a in ("mp", "sp", "ep", "pp")):
+            return False
+        return all(all(e is None for e in tuple(s))
+                   for s in self.param_specs.values())
+
+    def _grad_comm_config(self):
+        """(k, dtype, use_residual, chunk) resolved from the engine +
+        flags. The accumulation path engages when K > 1 or a low-precision
+        gradient collective is requested; otherwise step() stays on the
+        original (bit-identical) fused step."""
+        k = max(1, int(self.microbatches))
+        dtype = _gc.comm_dtype()
+        if not self._dp_pure():
+            if dtype != "f32" and not self._gspmd_warned:
+                import warnings
+
+                warnings.warn(
+                    f"FLAGS_grad_comm_dtype={dtype} applies only to pure "
+                    f"data-parallel meshes; topology {self.hcg.topology()} "
+                    f"uses GSPMD collectives (f32)")
+                self._gspmd_warned = True
+            dtype = "f32"
+        use_residual = (dtype != "f32" and self._dp_pure()
+                        and _gc.error_feedback())
+        return k, dtype, use_residual, _gc.chunk_size()
+
+    def _n_grad_elems(self) -> int:
+        return int(sum(int(np.prod(self._state_refs[n].shape) or 1)
+                       for n in self._param_names))
+
+    def _residual_sharding(self):
+        axes = self._batch_axes()
+        spec = P(axes if len(axes) > 1 else (axes[0] if axes else None))
+        return NamedSharding(self.mesh, spec)
+
+    def _ensure_residual(self):
+        if self._grad_residual is None:
+            nrep = _gc.replica_count(self.mesh, self._batch_axes())
+            self._grad_residual = jax.device_put(
+                np.zeros((nrep, self._n_grad_elems()), np.float32),
+                self._residual_sharding())
+        return self._grad_residual
+
+    def _build_accum(self, batch_avals, k, dtype, use_residual, chunk):
+        """Jit the K-microbatch accumulation step. The dp-pure fast path
+        runs the scan + ONE deferred collective under shard_map
+        (grad_comm.make_accum_step); hybrid meshes take the GSPMD
+        accumulation scan fallback."""
+        compute = self._build_compute_loss()
+        update = opt_funct.make_tree_update(
+            self.optimizer, {n: self._state_refs[n]
+                             for n in self._param_names})
+        clip = self.optimizer._grad_clip
+        zero_specs = (self.opt_specs
+                      if self.hcg.degrees["sharding"] > 1 else None)
+        batch_shardings = self._shardings_for(batch_avals)
+        if self._dp_pure():
+            step = _gc.make_accum_step(
+                compute_loss=compute, update=update, clip=clip,
+                mesh=self.mesh, batch_axes=self._batch_axes(), k=k,
+                dtype=dtype, chunk=chunk, use_residual=use_residual,
+                param_specs=self.param_specs, zero_specs=zero_specs)
+        else:
+            step = _gc.make_accum_step_gspmd(
+                compute_loss=compute, update=update, clip=clip,
+                mesh=self.mesh, k=k,
+                batch_specs=[s.spec for s in batch_shardings],
+                param_specs=self.param_specs, zero_specs=zero_specs)
+        param_shardings = {n: NamedSharding(self.mesh, s)
+                           for n, s in self.param_specs.items()}
+        opt_shardings = {
+            n: tuple(NamedSharding(self.mesh, self.opt_specs[n])
+                     for _ in self.opt_state[n])
+            for n in self._param_names}
+        scalar = NamedSharding(self.mesh, P())
+        in_sh = (param_shardings, opt_shardings)
+        out_sh = (scalar, param_shardings, opt_shardings)
+        donate = (0, 1)
+        if use_residual:
+            res_sh = self._residual_sharding()
+            in_sh += (res_sh,)
+            out_sh += (res_sh,)
+            donate = (0, 1, 2)  # the residual is carried state: donate it
+        return jax.jit(
+            step,
+            in_shardings=in_sh + (scalar, scalar, scalar) + batch_shardings,
+            out_shardings=out_sh,
+            donate_argnums=donate if self._donate else (),
+        )
+
+    def _accum_step(self, arrays) -> Tensor:
+        """One optimizer step over K in-program microbatches: the grad_comm
+        twin of step() (same plumbing contract: telemetry, compile
+        accounting, donation-safe rebind of params/opt state)."""
+        k, dtype, use_residual, chunk = self._grad_comm_config()
+        self._check_batch(arrays)
+        nrep = _gc.replica_count(self.mesh, self._batch_axes())
+        for a in arrays:
+            if a.ndim and a.shape[0] % (nrep * k) != 0:
+                raise ValueError(
+                    f"batch dim {a.shape[0]} is not divisible by "
+                    f"microbatches*replicas = {k}*{nrep}; pad or resize "
+                    f"the batch (topology: {self.hcg.topology()})")
+        from ..core import autotune
+        autotune.set_step(self._step_count + 1)
+        cache_key = (k, dtype, use_residual, chunk)
+        if cache_key not in self._accum_fns:
+            self._accum_fns[cache_key] = self._build_accum(
+                arrays, k, dtype, use_residual, chunk)
+        fn = self._accum_fns[cache_key]
+        staged, self._pending_h2d = self._pending_h2d, None
+        arrays, h2d_ms = self._place_batch(
+            arrays, self._batch_shardings,
+            timed=self.telemetry is not None and staged is None)
+        prefetch_depth = None
+        if staged is not None:
+            h2d_ms, prefetch_depth = staged
+        self._step_count += 1
+        self.optimizer._step_count = self._step_count
+        lr_val = self.optimizer.get_lr()
+        if self._lr_cache[0] != lr_val:
+            self._lr_cache = (lr_val, jnp.float32(lr_val))
+        lr = self._lr_cache[1]
+        self._key, sub = jax.random.split(self._key)
+        tele = self.telemetry
+        n0 = _jit_cache_size(fn)
+        p0 = _compile_cache.entries() if n0 == 0 else -1
+        t0 = time.perf_counter()
+        if use_residual:
+            loss, self.params, new_opt, self._grad_residual = fn(
+                self.params, self._opt_to_hbm(self.opt_state),
+                self._ensure_residual(), lr, jnp.int32(self._step_count),
+                sub, *arrays)
+        else:
+            loss, self.params, new_opt = fn(
+                self.params, self._opt_to_hbm(self.opt_state), lr,
+                jnp.int32(self._step_count), sub, *arrays)
+        if tele is not None:
+            jax.block_until_ready(loss)
+        t1 = time.perf_counter()
+        compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0, p0)
+        comm_bytes = (_gc.payload_bytes(self._n_grad_elems(), dtype, chunk)
+                      if nrep > 1 else 0)
+        _gc.STEPS.increase()
+        _gc.MICROBATCHES.increase(k)
+        _gc.BYTES_MOVED.increase(comm_bytes)
+        if dtype != "f32":
+            _gc.LOWP_STEPS.increase()
+        tr = _obs_tracer.get_tracer()
+        if tr.enabled:
+            tr.record_complete("engine.accum_step", t0, t1,
+                               {"step": self._step_count, "compiled": compiled,
+                                "microbatches": k, "grad_comm_dtype": dtype})
+        self.opt_state = self._opt_to_home(new_opt)
+        self.last_loss = Tensor(loss)
+        if tele is not None:
+            samples, tokens = self._batch_stats(arrays)
+            tele.record_step(
+                step=self._step_count, wall_time=t1 - t0, samples=samples,
+                tokens=tokens, loss=float(jax.device_get(loss)),
+                h2d_ms=h2d_ms, prefetch_depth=prefetch_depth,
+                microbatches=k, grad_comm_dtype=dtype,
+                grad_comm_bytes=comm_bytes)
+        return self.last_loss
+
     # ---- shared step plumbing ----
     def _shardings_for(self, arrays):
         """Per-position batch shardings (input_specs, or the default
@@ -525,6 +709,11 @@ class TrainStepEngine:
         arrays plus steps=K to reuse the same batch every step (benchmark /
         overfit loops; the batch is uploaded ONCE, not K times). Loss history
         comes back as one f32 array.
+
+        Orthogonal to `microbatches`: run_steps fuses K OPTIMIZER STEPS into
+        one dispatch (each over its full batch); the grad_comm accumulation
+        path fuses K microbatches into ONE optimizer step. run_steps always
+        runs the plain per-step program regardless of engine.microbatches.
         """
         arrays = self._to_arrays(batch)
         fixed = steps is not None
@@ -605,6 +794,12 @@ class TrainStepEngine:
 
     def step(self, *batch) -> Tensor:
         arrays = self._to_arrays(batch)
+        if self.microbatches > 1 or _gc.comm_dtype() != "f32":
+            # grad_comm path: K in-program microbatches + one deferred fused
+            # gradient all-reduce (and/or low-precision collectives). The
+            # default (K=1, f32) stays below on the original step program —
+            # bit-identical to pre-grad_comm behavior.
+            return self._accum_step(arrays)
         self._check_batch(arrays)
         from ..core import autotune
         autotune.set_step(self._step_count + 1)

@@ -41,6 +41,10 @@ class Model:
         self._metrics = []
         self._save_dir = None
         self.stop_training = False
+        # fused gradient-accumulation engine (distributed/grad_comm.py):
+        # built lazily by fit(accumulate_grad_batches=K) when the engine
+        # path applies; None means the eager K-dispatch fallback is in use
+        self._engine = None
 
     # ---- configuration ----
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -122,6 +126,45 @@ class Model:
             vals.append(res)
         return vals
 
+    # ---- fused gradient accumulation (engine path) ----
+    def _accum_engine(self, k, n_inputs):
+        """TrainStepEngine with microbatches=K for fit(accumulate_grad_
+        batches=K): the K accumulation microbatches run inside ONE compiled
+        dispatch with a single deferred fused gradient all-reduce
+        (distributed/grad_comm.py), instead of K eager dispatches with K
+        reductions. Applies when no metrics are configured (the engine
+        returns only the loss); anything unsupported falls back to the
+        eager K-dispatch path. Returns the engine or None."""
+        if self._metrics or self._optimizer is None:
+            return None
+        try:
+            from ..distributed.engine import TrainStepEngine
+
+            # fresh engine per fit: it snapshots network weights at
+            # construction, so reuse across fits would train stale params
+            self._engine = TrainStepEngine(
+                self.network, self._optimizer, loss_fn=self._loss,
+                microbatches=k,
+                num_model_inputs=n_inputs if self._loss is not None else None)
+        except Exception:
+            self._engine = None
+        return self._engine
+
+    def _engine_group_step(self, engine, group):
+        """Concatenate K stashed (inputs, labels) loader batches along the
+        batch dim and run them as one accumulated engine step."""
+        import numpy as np
+
+        k = len(group)
+        cols = []
+        for pos in range(len(group[0])):
+            arrs = [np.asarray(b[pos].numpy() if isinstance(b[pos], Tensor)
+                               else b[pos]) for b in group]
+            cols.append(np.concatenate(arrs, axis=0))
+        engine.microbatches = k
+        loss = engine.step(*[Tensor(c) for c in cols])
+        return [float(loss.item())]
+
     # ---- loops ----
     def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last=False,
                      prefetch_factor=2):
@@ -151,6 +194,7 @@ class Model:
         except TypeError:
             steps = None
         self._accumulate = max(1, accumulate_grad_batches)
+        engine = None  # resolved at the first batch (needs the input count)
         cbks = config_callbacks(callbacks, model=self, epochs=epochs, steps=steps,
                                 batch_size=batch_size, verbose=verbose,
                                 log_freq=log_freq, save_freq=save_freq,
@@ -166,6 +210,8 @@ class Model:
                 m.reset()
             logs = {}
             pending_update = False
+            group = []          # engine path: stashed microbatches
+            group_reader = 0.0
             # manual iteration so the dataloader fetch is timed: reader_cost
             # rides in logs for ProgBar/telemetry and is what Benchmark's
             # step(reader_cost=...) hook receives instead of a fake 0.0.
@@ -183,8 +229,32 @@ class Model:
                 reader_dt = time.perf_counter() - t_fetch
                 if num_iters is not None and step >= num_iters:
                     break
-                cbks.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
+                if self._accumulate > 1 and engine is None:
+                    # one engine decision per fit: the fused K-microbatch
+                    # dispatch (grad_comm) when it applies, else the eager
+                    # K-dispatch accumulation below
+                    engine = self._accum_engine(self._accumulate, len(ins)) \
+                        or False
+                if engine:
+                    # engine path: stash K loader batches, then ONE compiled
+                    # dispatch accumulates them with a single deferred
+                    # gradient all-reduce. Callback cadence: begin per
+                    # loader batch, end on the dispatching batch.
+                    cbks.on_train_batch_begin(step)
+                    group.append(ins + labs)
+                    group_reader += reader_dt
+                    if len(group) == self._accumulate:
+                        out = self._engine_group_step(engine, group)
+                        group, reader_sum = [], group_reader
+                        group_reader = 0.0
+                        logs = self._pack_logs(out, batch_size)
+                        logs["reader_cost"] = reader_sum
+                        cbks.on_train_batch_end(step, logs)
+                    if self.stop_training:
+                        break
+                    continue
+                cbks.on_train_batch_begin(step)
                 update = (step + 1) % accumulate_grad_batches == 0
                 out = self.train_batch(ins, labs, update=update)
                 pending_update = not update
@@ -193,11 +263,23 @@ class Model:
                 cbks.on_train_batch_end(step, logs)
                 if self.stop_training:
                     break
+            if group:
+                # engine-path tail: fewer than K batches left in the epoch —
+                # run them as a shorter accumulation group (own compiled
+                # variant, cached per K) so nothing leaks into the next epoch
+                out = self._engine_group_step(engine, group)
+                logs = self._pack_logs(out, batch_size)
+                logs["reader_cost"] = group_reader
+                cbks.on_train_batch_end(step, logs)
             if pending_update:
                 # flush tail gradients when the epoch length is not divisible by
                 # accumulate_grad_batches, so nothing leaks into the next epoch
                 self._optimizer.step()
                 self._optimizer.clear_grad()
+            if engine:
+                # eval / checkpoint callbacks read the eager network — write
+                # the engine-owned params back at every epoch boundary
+                engine.sync_to_model()
             if eval_loader is not None and epoch % eval_freq == 0:
                 eval_logs = self._run_eval(eval_loader, cbks)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})

@@ -108,6 +108,9 @@ class StepTelemetry:
                     reader_cost: Optional[float] = None,
                     h2d_ms: Optional[float] = None,
                     prefetch_depth: Optional[int] = None,
+                    microbatches: Optional[int] = None,
+                    grad_comm_dtype: Optional[str] = None,
+                    grad_comm_bytes: Optional[int] = None,
                     phase: str = "train",
                     extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Emit one record; returns it (tests read the return directly)."""
@@ -128,6 +131,16 @@ class StepTelemetry:
         if prefetch_depth is not None:
             # look-ahead the consumer actually had when this batch was taken
             rec["prefetch_depth"] = int(prefetch_depth)
+        if microbatches is not None:
+            # in-program gradient accumulation (distributed/grad_comm.py):
+            # K microbatches per optimizer step, ONE dispatch
+            rec["microbatches"] = int(microbatches)
+        if grad_comm_dtype is not None:
+            rec["grad_comm_dtype"] = str(grad_comm_dtype)
+        if grad_comm_bytes is not None:
+            # per-device payload handed to the gradient collective — the
+            # number the low-precision dtypes shrink
+            rec["grad_comm_bytes"] = int(grad_comm_bytes)
         if samples is not None:
             rec["samples"] = int(samples)
             rec["samples_per_sec"] = round(samples / max(wall_time, 1e-9), 2)
@@ -195,6 +208,14 @@ class StepTelemetry:
                            ("engine.compile_cold_ms", "compile_cold_ms"),
                            ("engine.compile_warm", "compile_warm"),
                            ("engine.compile_warm_ms", "compile_warm_ms"),
+                           # gradient-communication subsystem
+                           # (distributed/grad_comm.py): accumulated steps,
+                           # microbatches, and collective payload bytes
+                           ("grad_comm.steps", "grad_comm_steps"),
+                           ("grad_comm.microbatches",
+                            "grad_comm_microbatches"),
+                           ("grad_comm.bytes_moved", "grad_comm_bytes_moved"),
+                           ("grad_comm.lowp_steps", "grad_comm_lowp_steps"),
                            ("dispatch.calls", "dispatch_calls"),
                            ("dispatch.nan_inf_hits", "nan_inf_hits"),
                            # decode/serving executables (models/gpt.py LRU

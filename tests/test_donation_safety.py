@@ -120,6 +120,58 @@ def test_donation_drops_compiled_step_peak_by_state_bytes():
         f"{peak_on}, state {state}")
 
 
+def test_accum_step_donation_and_engine_paths():
+    """The microbatch-accumulation step (grad_comm) donates exactly like
+    the single-shot step: stale pre-step trees are deleted, every
+    engine-owned path stays clean, and mixing accumulated and plain steps
+    never observes a donated buffer."""
+    e = _make()
+    e.microbatches = 2
+    x, y = _batch()
+    stale = dict(e.params)
+    e.step(x, y)                     # accumulation path (K=2)
+    name = next(iter(stale))
+    assert stale[name].is_deleted()
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(stale[name])
+    assert np.isfinite(np.asarray(e.params[name])).all()
+    e.microbatches = 1
+    e.step(x, y)                     # plain path on the same engine
+    e.microbatches = 4
+    e.step(x, y)                     # new accumulation variant
+    sd = e.state_dict()
+    for t in sd.values():
+        assert np.isfinite(t.numpy()).all()
+    e.sync_to_model()
+    for p in e.model.parameters():
+        assert np.isfinite(p.numpy()).all()
+
+
+def test_accum_error_feedback_residual_is_donated():
+    """With error feedback on, the residual buffer is carried state: the
+    step donates it, rebinds the fresh one, and the live-buffer census
+    stays flat across steps (no residual copies accumulate)."""
+    import paddle_tpu
+
+    paddle_tpu.set_flags({"grad_comm_dtype": "int8",
+                          "grad_comm_error_feedback": True})
+    e = _make()
+    e.microbatches = 2
+    tele = e.enable_telemetry(collect_live_buffers=True)
+    x, y = _batch()
+    e.step(x, y)
+    stale_res = e._grad_residual
+    first = tele.sink.records[0]["live_buffers"]
+    for _ in range(3):
+        e.step(x, y)
+    assert stale_res.is_deleted()    # donated into the next step
+    assert not e._grad_residual.is_deleted()
+    last = tele.sink.records[-1]["live_buffers"]
+    assert last["high_water_bytes"] <= first["bytes"] * 1.05, (
+        "live-buffer high-water grew across error-feedback steps: residual "
+        "or state copies are being retained")
+
+
 def test_step_telemetry_live_buffer_high_water_stays_flat():
     """With donation on, the per-step live-array census must not grow: the
     update is in place, so N steps hold one copy of the training state (a

@@ -1,0 +1,295 @@
+"""grad_comm: in-program microbatch gradient accumulation + deferred fused
+all-reduce + opt-in low-precision gradient collectives
+(distributed/grad_comm.py, wired through TrainStepEngine.microbatches and
+hapi Model.fit(accumulate_grad_batches=K)).
+
+Numeric contracts pinned here; the compiled-HLO structure (ONE fused
+gradient all-reduce independent of K, donation aliasing, activation-peak
+drop) is gated in tests/test_hlo_perf_gates.py and
+tests/test_donation_safety.py.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor
+from paddle_tpu.distributed.engine import TrainStepEngine
+
+
+def _make(k=1, seed=0):
+    paddle.seed(seed)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    return TrainStepEngine(net, opt, loss_fn=paddle.nn.CrossEntropyLoss(),
+                           microbatches=k)
+
+
+def _batch(n=32):
+    rng = np.random.RandomState(0)
+    return (paddle.to_tensor(rng.randn(n, 16).astype(np.float32)),
+            paddle.to_tensor(rng.randint(0, 4, (n,)).astype(np.int64)))
+
+
+def _losses(engine, x, y, steps=3):
+    return [float(engine.step(x, y).item()) for _ in range(steps)]
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_microbatch_step_is_loss_parity_with_single_batch(k):
+    """f32 K-microbatch accumulation == the single-shot step at equal
+    effective batch: losses track and the trained params agree."""
+    x, y = _batch()
+    e1, ek = _make(1), _make(k)
+    l1, lk = _losses(e1, x, y), _losses(ek, x, y)
+    np.testing.assert_allclose(lk, l1, rtol=2e-5, atol=1e-6)
+    for n in e1.params:
+        np.testing.assert_allclose(np.asarray(ek.params[n]),
+                                   np.asarray(e1.params[n]),
+                                   rtol=2e-4, atol=1e-5)
+    # exactly one dispatch per optimizer step: one jitted accum fn, no
+    # single-shot step fn ever built on the accum engine
+    assert ek._step_fn is None and len(ek._accum_fns) == 1
+
+
+def test_default_path_is_bit_identical_and_bypasses_grad_comm():
+    """FLAGS_grad_comm_dtype unset + microbatches=1: the original step
+    program runs — grad_comm never engages, and explicitly setting the
+    default f32 value changes nothing, bit for bit."""
+    x, y = _batch()
+    steps0 = monitor.stat("grad_comm.steps").get()
+    e_default = _make(1)
+    _losses(e_default, x, y)
+    assert monitor.stat("grad_comm.steps").get() == steps0
+    assert e_default._accum_fns == {} and e_default._step_fn is not None
+
+    paddle.set_flags({"grad_comm_dtype": "f32"})  # explicit default
+    e_explicit = _make(1)
+    _losses(e_explicit, x, y)
+    for n in e_default.params:
+        np.testing.assert_array_equal(np.asarray(e_default.params[n]),
+                                      np.asarray(e_explicit.params[n]))
+
+
+def test_bf16_allreduce_within_tolerance():
+    x, y = _batch()
+    e1 = _make(1)
+    l1 = _losses(e1, x, y, steps=4)
+    paddle.set_flags({"grad_comm_dtype": "bf16"})
+    eb = _make(2)
+    lb = _losses(eb, x, y, steps=4)
+    # bf16 has ~3 decimal digits; training must track the f32 trajectory
+    np.testing.assert_allclose(lb, l1, rtol=2e-2)
+    assert lb[-1] < lb[0]  # and actually converge
+
+
+def test_int8_allreduce_within_tolerance_and_fewer_bytes():
+    from paddle_tpu.distributed import grad_comm
+
+    x, y = _batch()
+    e1 = _make(1)
+    l1 = _losses(e1, x, y, steps=4)
+    paddle.set_flags({"grad_comm_dtype": "int8"})
+    ei = _make(2)
+    li = _losses(ei, x, y, steps=4)
+    np.testing.assert_allclose(li, l1, rtol=2e-2)
+    assert li[-1] < li[0]
+    # chunk-scaled int8 payload ~= a quarter of the f32 collective at real
+    # model sizes (the toy engine's 676 grads are all chunk overhead)
+    chunk = grad_comm.chunk_size()
+    for n in (10 ** 6, 10 ** 8):
+        assert grad_comm.payload_bytes(n, "int8", chunk) < \
+            0.3 * grad_comm.payload_bytes(n, "f32", chunk)
+
+
+def test_int8_error_feedback_residual():
+    """FLAGS_grad_comm_error_feedback: the quantization error is carried
+    across steps (residual allocated, donated, and non-zero) and training
+    still tracks the f32 trajectory."""
+    x, y = _batch()
+    e1 = _make(1)
+    l1 = _losses(e1, x, y, steps=5)
+    paddle.set_flags({"grad_comm_dtype": "int8",
+                      "grad_comm_error_feedback": True})
+    ee = _make(2)
+    le = _losses(ee, x, y, steps=5)
+    np.testing.assert_allclose(le, l1, rtol=2e-2)
+    res = np.asarray(ee._grad_residual)
+    assert res.shape[-1] == ee._n_grad_elems()
+    assert np.abs(res).max() > 0  # rounding error was actually captured
+
+
+def test_quantize_roundtrip_error_bounded():
+    """Unit contract of the EQuARX-style chunk scaling: dequant(quant(x))
+    is within scale/2 = absmax/254 per chunk element."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.grad_comm import (_dequantize_int8,
+                                                  _quantize_int8)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray((rng.randn(5000) * np.logspace(-4, 0, 5000))
+                    .astype(np.float32))
+    q, scale = _quantize_int8(x, 256)
+    back = np.asarray(_dequantize_int8(q, scale, 5000))
+    err = np.abs(back - np.asarray(x))
+    bound = np.repeat(np.asarray(scale), 256)[:5000] / 2 + 1e-12
+    assert (err <= bound).all()
+
+
+def test_accum_step_telemetry_and_counters():
+    x, y = _batch()
+    e = _make(2)
+    tele = e.enable_telemetry()
+    s0 = monitor.stat("grad_comm.steps").get()
+    m0 = monitor.stat("grad_comm.microbatches").get()
+    e.step(x, y)
+    e.step(x, y)
+    rec = tele.sink.records[-1]
+    assert rec["microbatches"] == 2
+    assert rec["grad_comm_dtype"] == "f32"
+    assert "grad_comm_bytes" in rec
+    assert rec["grad_comm_steps"] == monitor.stat("grad_comm.steps").get()
+    assert monitor.stat("grad_comm.steps").get() == s0 + 2
+    assert monitor.stat("grad_comm.microbatches").get() == m0 + 4
+
+
+def test_batch_not_divisible_by_microbatches_raises():
+    e = _make(4)
+    rng = np.random.RandomState(0)
+    n_dev = len(jax.devices())
+    bad = n_dev * 2  # divisible by the mesh but not by mesh*K
+    x = paddle.to_tensor(rng.randn(bad, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 4, (bad,)).astype(np.int64))
+    with pytest.raises(ValueError, match="microbatches"):
+        e.step(x, y)
+
+
+def test_bad_grad_comm_dtype_rejected():
+    paddle.set_flags({"grad_comm_dtype": "fp8"})
+    e = _make(2)
+    x, y = _batch()
+    with pytest.raises(ValueError, match="grad_comm_dtype"):
+        e.step(x, y)
+
+
+def test_gspmd_fallback_on_hybrid_mesh():
+    """mp>1: accumulation falls back to the GSPMD scan (still one dispatch,
+    K fused reduces) with loss parity, and a low-precision request warns
+    and reduces in f32."""
+    import warnings
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4-device mesh")
+
+    def build(k):
+        strategy = dist.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        model = GPTForPretraining(gpt_tiny())
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        return fleet.distributed_engine(model, opt, microbatches=k)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 1024, (8, 32)).astype(np.int64)
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(np.roll(ids, -1, 1))
+    e1, e2 = build(1), build(2)
+    assert not e2._dp_pure()
+    l1 = [float(e1.step(x, y).item()) for _ in range(2)]
+    l2 = [float(e2.step(x, y).item()) for _ in range(2)]
+    np.testing.assert_allclose(l2, l1, rtol=1e-4)
+
+    paddle.set_flags({"grad_comm_dtype": "bf16"})
+    e3 = build(2)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        l3 = float(e3.step(x, y).item())
+    assert any("grad_comm_dtype" in str(x.message) for x in w)
+    np.testing.assert_allclose(l3, l1[0], rtol=1e-4)  # reduced in f32
+
+
+def test_hapi_fit_routes_accumulation_to_engine():
+    """fit(accumulate_grad_batches=K) with no metrics: K loader batches run
+    as ONE engine dispatch; weights land back in the eager network."""
+    from paddle_tpu.hapi.model import Model
+
+    class DS(paddle.io.Dataset):
+        def __init__(self, n=64):
+            rng = np.random.RandomState(0)
+            self.x = rng.randn(n, 8).astype("float32")
+            self.y = np.argmax(self.x[:, :4], axis=1,
+                               keepdims=True).astype("int64")
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    m = Model(net)
+    m.prepare(paddle.optimizer.Adam(parameters=net.parameters()),
+              paddle.nn.CrossEntropyLoss())
+    w0 = net[0].weight.numpy().copy()
+    s0 = monitor.stat("grad_comm.steps").get()
+    hist = m.fit(DS(), epochs=1, batch_size=16, verbose=0,
+                 accumulate_grad_batches=2, shuffle=False)
+    assert m._engine is not None
+    # 4 loader batches / K=2 -> 2 accumulated optimizer steps
+    assert monitor.stat("grad_comm.steps").get() == s0 + 2
+    assert np.abs(net[0].weight.numpy() - w0).max() > 1e-5
+    assert np.isfinite(hist[0]["loss"])
+
+
+def test_hapi_fit_tail_group_and_metrics_fallback():
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.metric import Accuracy
+
+    class DS(paddle.io.Dataset):
+        def __init__(self, n):
+            rng = np.random.RandomState(0)
+            self.x = rng.randn(n, 8).astype("float32")
+            self.y = np.zeros((n, 1), dtype="int64")
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+    m = Model(net)
+    m.prepare(paddle.optimizer.SGD(parameters=net.parameters()),
+              paddle.nn.CrossEntropyLoss())
+    # 3 batches of 16, K=2: one full accumulated group (grad_comm) + a tail
+    # group of 1 (a single microbatch runs as the plain fused step) ->
+    # exactly 2 optimizer steps, nothing leaked into the next epoch
+    s0 = monitor.stat("grad_comm.steps").get()
+    m.fit(DS(48), epochs=1, batch_size=16, verbose=0,
+          accumulate_grad_batches=2, shuffle=False)
+    assert monitor.stat("grad_comm.steps").get() == s0 + 1
+    assert m._engine._step_count == 2
+
+    # metrics need per-batch outputs: engine path must NOT engage
+    paddle.seed(0)
+    net2 = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+    m2 = Model(net2)
+    m2.prepare(paddle.optimizer.SGD(parameters=net2.parameters()),
+               paddle.nn.CrossEntropyLoss(), Accuracy())
+    h = m2.fit(DS(64), epochs=1, batch_size=16, verbose=0,
+               accumulate_grad_batches=2)
+    assert m2._engine is None
+    assert "acc" in h[0]

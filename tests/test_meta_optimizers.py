@@ -275,14 +275,20 @@ class TestRecomputeMeta:
 
 class TestLarsTraining:
     def test_lars_converges(self):
+        # lr/coeff calibrated for the trust ratio: LARS scales each layer's
+        # step to ~lr * lars_coeff * ||w||, so the reference default
+        # coeff=0.001 moves weights ~2e-5*||w||/step — at 20 steps the loss
+        # floor reachable was the constant predictor, exactly the old 0.8
+        # threshold (the test failed by construction). coeff=0.1 at lr=0.1
+        # converges to ~25% of the initial loss across seeds in 40 steps.
         net, x, y = _net_and_data()
-        opt = paddle.optimizer.Lars(learning_rate=0.02,
+        opt = paddle.optimizer.Lars(learning_rate=0.1, lars_coeff=0.1,
                                     parameters=net.parameters())
         losses = []
-        for _ in range(20):
+        for _ in range(40):
             loss = ((net(x) - y) ** 2).mean()
             loss.backward()
             opt.step()
             opt.clear_grad()
             losses.append(float(loss))
-        assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])

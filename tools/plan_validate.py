@@ -179,9 +179,12 @@ def measured_tokens(path, seq):
             # join at the pre-cache rows. Structurally different programs
             # (scan trainer, pallas kernel variants) stay out.
             # prefetch rows are excluded like scan: input-staging overlap is
-            # dispatch-level, invisible to a per-program cost model
+            # dispatch-level, invisible to a per-program cost model.
+            # microbatch-accumulation rows (PADDLE_TPU_BENCH_ACCUM) are a
+            # structurally different program (scan over K microbatches +
+            # deferred grad reduce) — also out
             if any(ex.get(k) for k in ("scan", "pallas_ln", "pallas_loss",
-                                       "prefetch")):
+                                       "prefetch", "microbatches")):
                 continue
             rec = ex.get("recompute")
             if rec not in (None, "", False, "selective"):
