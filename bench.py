@@ -63,6 +63,16 @@ def _window_stats(window_dts, batch, seq):
     }
 
 
+def _metrics_snapshot():
+    """Compact registry snapshot for the row's extra.metrics: histogram
+    summary stats when the registry is active, plus the absorbed
+    core.monitor counters (jit compiles, dispatch counts, grad_comm bytes)
+    either way — observability context with zero effect on the timed run."""
+    from paddle_tpu.observability import metrics
+
+    return metrics.default_registry().snapshot(compact=True)
+
+
 def main():
     import os
 
@@ -357,6 +367,11 @@ def main():
             # a kernel variant (historical rows keep their fields)
             "autotune": os.environ.get("PADDLE_TPU_BENCH_AUTOTUNE"),
             "autotune_cache_loaded": _autotune_epilogue() or None,
+            # registry snapshot (compact histograms + absorbed monitor
+            # counters): observability context for the row. Inert to
+            # plan_validate joins — its key matching reads the variant
+            # knobs above, never "metrics".
+            "metrics": _metrics_snapshot(),
         },
     }
     if on_tpu and degraded is None:

@@ -576,6 +576,11 @@ def _check_nan_inf(name, outs_data):
         if _is_float_array(d):
             if not bool(jnp.isfinite(d).all()):
                 _NAN_INF_HITS.increase()
+                # failure branch only: tee a post-mortem dump when the
+                # flight recorder is enabled (no-op/no import cost otherwise)
+                from ..observability import flight_recorder as _flight
+
+                _flight.on_nan_inf(f"op_{name}")
                 raise FloatingPointError(
                     f"Operator {name} output contains Inf/Nan "
                     f"(FLAGS_check_nan_inf is set)"

@@ -32,6 +32,9 @@ from ..core import monitor as _monitor
 from ..core import random as random_mod
 from ..core.tensor import Tensor
 from ..jit import functional_call
+from ..observability import exporter as _obs_exporter
+from ..observability import flight_recorder as _obs_flight
+from ..observability import metrics as _obs_metrics
 from ..observability import tracer as _obs_tracer
 from ..observability.step_telemetry import StepTelemetry
 from ..optimizer import functional as opt_funct
@@ -46,6 +49,7 @@ from .mesh import HybridCommunicateGroup, get_hybrid_communicate_group
 _JIT_COMPILES = _monitor.stat("engine.jit_compiles")
 _JIT_RECOMPILES = _monitor.stat("engine.jit_recompiles")
 _JIT_COMPILE_MS = _monitor.stat("engine.jit_compile_ms")
+_NAN_LOSS_STEPS = _monitor.stat("engine.nan_loss_steps")
 
 
 def _jit_cache_size(fn) -> int:
@@ -215,6 +219,10 @@ class TrainStepEngine:
         self.telemetry = StepTelemetry.from_env()
         if self.telemetry is not None and self.telemetry.flops_per_token is None:
             self.telemetry.flops_per_token = 6 * self._n_params()
+        # PADDLE_TPU_METRICS_PORT / PADDLE_TPU_FLIGHT_DIR opt-ins: one
+        # getenv each when unset, zero per-step cost while off
+        _obs_exporter.ensure_started_from_env()
+        _obs_flight.ensure_from_env()
 
     def _n_params(self) -> int:
         return int(sum(
@@ -247,6 +255,32 @@ class TrainStepEngine:
         if self.telemetry is not None:
             self.telemetry.close()
         self.telemetry = None
+
+    def _obs_step_tail(self, fr, mreg, rec, t0, t1, h2d_ms, compiled, loss,
+                       hist="train.step_ms"):
+        """Shared observability tail for step/_accum_step/run_steps: feed
+        the metrics histograms and tee the step record into the flight
+        recorder ring. Both fr and mreg are usually None (one check each in
+        the callers); loss is only fetched when a recorder needs it."""
+        if mreg is not None:
+            mreg.histogram(hist).observe((t1 - t0) * 1e3)
+            if h2d_ms:
+                mreg.histogram("train.h2d_ms").observe(h2d_ms)
+            if compiled:
+                mreg.histogram("train.compile_ms").observe((t1 - t0) * 1e3)
+        if fr is not None:
+            if rec is None:
+                rec = {"event": "train_step", "step": self._step_count,
+                       "wall_time_s": t1 - t0,
+                       "loss": float(jax.device_get(loss)),
+                       "h2d_ms": h2d_ms, "compiled": compiled}
+            fr.record(rec)
+            lv = rec.get("loss")
+            if lv is not None and not math.isfinite(lv):
+                # diverged step: bump the counter and capture a post-mortem
+                # dump whose ring tail ends with this very record
+                _NAN_LOSS_STEPS.increase()
+                fr.on_nan_inf("train_loss", {"step": self._step_count})
 
     @staticmethod
     def _batch_stats(arrays, lead_axes=0):
@@ -601,20 +635,28 @@ class TrainStepEngine:
         lr = self._lr_cache[1]
         self._key, sub = jax.random.split(self._key)
         tele = self.telemetry
+        fr = _obs_flight.get()
+        mreg = _obs_metrics.active_registry()
         n0 = _jit_cache_size(fn)
         p0 = _compile_cache.entries() if n0 == 0 else -1
         t0 = time.perf_counter()
-        if use_residual:
-            loss, self.params, new_opt, self._grad_residual = fn(
-                self.params, self._opt_to_hbm(self.opt_state),
-                self._ensure_residual(), lr, jnp.int32(self._step_count),
-                sub, *arrays)
-        else:
-            loss, self.params, new_opt = fn(
-                self.params, self._opt_to_hbm(self.opt_state), lr,
-                jnp.int32(self._step_count), sub, *arrays)
-        if tele is not None:
-            jax.block_until_ready(loss)
+        try:
+            if use_residual:
+                loss, self.params, new_opt, self._grad_residual = fn(
+                    self.params, self._opt_to_hbm(self.opt_state),
+                    self._ensure_residual(), lr, jnp.int32(self._step_count),
+                    sub, *arrays)
+            else:
+                loss, self.params, new_opt = fn(
+                    self.params, self._opt_to_hbm(self.opt_state), lr,
+                    jnp.int32(self._step_count), sub, *arrays)
+            if tele is not None or fr is not None or mreg is not None:
+                jax.block_until_ready(loss)
+        except Exception as e:
+            if fr is not None:
+                fr.dump("train_step_exception",
+                        {"step": self._step_count, "error": repr(e)})
+            raise
         t1 = time.perf_counter()
         compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0, p0)
         comm_bytes = (_gc.payload_bytes(self._n_grad_elems(), dtype, chunk)
@@ -631,14 +673,17 @@ class TrainStepEngine:
                                 "microbatches": k, "grad_comm_dtype": dtype})
         self.opt_state = self._opt_to_home(new_opt)
         self.last_loss = Tensor(loss)
+        rec = None
         if tele is not None:
             samples, tokens = self._batch_stats(arrays)
-            tele.record_step(
+            rec = tele.record_step(
                 step=self._step_count, wall_time=t1 - t0, samples=samples,
                 tokens=tokens, loss=float(jax.device_get(loss)),
                 h2d_ms=h2d_ms, prefetch_depth=prefetch_depth,
                 microbatches=k, grad_comm_dtype=dtype,
                 grad_comm_bytes=comm_bytes)
+        if fr is not None or mreg is not None:
+            self._obs_step_tail(fr, mreg, rec, t0, t1, h2d_ms, compiled, loss)
         return self.last_loss
 
     # ---- shared step plumbing ----
@@ -743,14 +788,22 @@ class TrainStepEngine:
             subs.append(sub)
         fn = self._scan_fns[fixed]
         tele = self.telemetry
+        fr = _obs_flight.get()
+        mreg = _obs_metrics.active_registry()
         n0 = _jit_cache_size(fn)
         p0 = _compile_cache.entries() if n0 == 0 else -1
         t0 = time.perf_counter()
-        losses, self.params, new_opt = fn(
-            self.params, self._opt_to_hbm(self.opt_state), lrs,
-            jnp.int32(step0), jnp.stack(subs), *arrays)
-        if tele is not None:
-            jax.block_until_ready(losses)  # honest wall time: drain the K steps
+        try:
+            losses, self.params, new_opt = fn(
+                self.params, self._opt_to_hbm(self.opt_state), lrs,
+                jnp.int32(step0), jnp.stack(subs), *arrays)
+            if tele is not None or fr is not None or mreg is not None:
+                jax.block_until_ready(losses)  # honest wall: drain the K steps
+        except Exception as e:
+            if fr is not None:
+                fr.dump("run_steps_exception",
+                        {"step0": step0, "steps": k, "error": repr(e)})
+            raise
         t1 = time.perf_counter()
         compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0, p0)
         tr = _obs_tracer.get_tracer()
@@ -760,16 +813,20 @@ class TrainStepEngine:
                                 "compiled": compiled})
         self.opt_state = self._opt_to_home(new_opt)
         self.last_loss = Tensor(losses[-1])
+        rec = None
         if tele is not None:
             samples, tokens = self._batch_stats(
                 arrays, lead_axes=0 if fixed else 1)
-            tele.record_step(
+            rec = tele.record_step(
                 step=self._step_count, wall_time=t1 - t0,
                 samples=samples * k if samples else None,
                 tokens=tokens * k if tokens else None,
                 loss=float(jax.device_get(losses[-1])),
                 h2d_ms=h2d_ms,
                 extra={"steps_fused": k})
+        if fr is not None or mreg is not None:
+            self._obs_step_tail(fr, mreg, rec, t0, t1, h2d_ms, compiled,
+                                losses[-1], hist="train.run_steps_ms")
         return Tensor(losses)
 
     def warm_scan(self, *batch, steps: int):
@@ -825,17 +882,25 @@ class TrainStepEngine:
         self._key, sub = jax.random.split(self._key)
         fn = self._step_fn
         tele = self.telemetry
+        fr = _obs_flight.get()
+        mreg = _obs_metrics.active_registry()
         n0 = _jit_cache_size(fn)
         # persistent-store snapshot only around a first compile: one readdir,
         # and only when the fn has no executable yet (recompiles from shape
         # churn stay unclassified rather than taxing every steady-state step)
         p0 = _compile_cache.entries() if n0 == 0 else -1
         t0 = time.perf_counter()
-        loss, self.params, new_opt = fn(
-            self.params, self._opt_to_hbm(self.opt_state), lr,
-            jnp.int32(self._step_count), sub, *arrays)
-        if tele is not None:
-            jax.block_until_ready(loss)  # honest wall time over async dispatch
+        try:
+            loss, self.params, new_opt = fn(
+                self.params, self._opt_to_hbm(self.opt_state), lr,
+                jnp.int32(self._step_count), sub, *arrays)
+            if tele is not None or fr is not None or mreg is not None:
+                jax.block_until_ready(loss)  # honest wall over async dispatch
+        except Exception as e:
+            if fr is not None:
+                fr.dump("train_step_exception",
+                        {"step": self._step_count, "error": repr(e)})
+            raise
         t1 = time.perf_counter()
         compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0, p0)
         tr = _obs_tracer.get_tracer()
@@ -845,12 +910,15 @@ class TrainStepEngine:
                                 "compiled": compiled})
         self.opt_state = self._opt_to_home(new_opt)
         self.last_loss = Tensor(loss)
+        rec = None
         if tele is not None:
             samples, tokens = self._batch_stats(arrays)
-            tele.record_step(
+            rec = tele.record_step(
                 step=self._step_count, wall_time=t1 - t0, samples=samples,
                 tokens=tokens, loss=float(jax.device_get(loss)),
                 h2d_ms=h2d_ms, prefetch_depth=prefetch_depth)
+        if fr is not None or mreg is not None:
+            self._obs_step_tail(fr, mreg, rec, t0, t1, h2d_ms, compiled, loss)
         return self.last_loss
 
     train_batch = step

@@ -1,6 +1,6 @@
-"""Unified runtime telemetry (ISSUE 1 tentpole).
+"""Unified runtime telemetry (ISSUE 1 tentpole, extended by ISSUE 7).
 
-Three layers, one subsystem:
+Layers, one subsystem:
 
 - ``tracer``: thread-safe host span recorder -> chrome-trace JSON that
   interleaves with the jax.profiler device timeline. profiler.RecordEvent
@@ -11,13 +11,30 @@ Three layers, one subsystem:
 - ``StepTelemetry``: per-train-step JSONL records (wall time, tokens/s,
   TFLOP/s, MFU, memory high-water, compile counters) with pluggable sinks;
   wired into distributed.engine.TrainStepEngine and the hapi fit loop.
+- ``metrics``: typed registry (counters/gauges/log-bucket histograms with
+  p50/p90/p99) absorbing the monitor counters into one snapshot.
+- ``exporter``: stdlib-HTTP pull endpoint (Prometheus text + JSON),
+  enabled via PADDLE_TPU_METRICS_PORT.
+- ``flight_recorder``: bounded ring of recent step/serve records dumped to
+  disk on NaN/exception/explicit trigger (PADDLE_TPU_FLIGHT_DIR).
 
 Everything is off-by-default and stdlib-only at import time: enabling costs
-one env var (PADDLE_TPU_TELEMETRY_DIR) or one method call
-(engine.enable_telemetry()); disabled, no jax import, no I/O, no spans.
+one env var (PADDLE_TPU_TELEMETRY_DIR / PADDLE_TPU_METRICS_PORT /
+PADDLE_TPU_FLIGHT_DIR) or one method call; disabled, no jax import, no I/O,
+no spans, no per-step work beyond a None check.
 """
+from . import exporter, flight_recorder, metrics  # noqa: F401
+from .exporter import (  # noqa: F401
+    MetricsExporter, ensure_started_from_env, get_exporter, start_exporter,
+    stop_exporter,
+)
+from .flight_recorder import FlightRecorder  # noqa: F401
 from .flops import (  # noqa: F401
     PEAK_TFLOPS, peak_flops_per_sec, transformer_flops_per_token,
+)
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricRegistry, active_registry,
+    default_registry, estimate_percentile, log_buckets,
 )
 from .step_telemetry import (  # noqa: F401
     InMemorySink, JsonlSink, StepTelemetry,
@@ -30,4 +47,10 @@ __all__ = [
     "Tracer", "get_tracer", "span", "enabled",
     "StepTelemetry", "JsonlSink", "InMemorySink",
     "transformer_flops_per_token", "peak_flops_per_sec", "PEAK_TFLOPS",
+    "Counter", "Gauge", "Histogram", "MetricRegistry",
+    "default_registry", "active_registry", "estimate_percentile",
+    "log_buckets",
+    "MetricsExporter", "start_exporter", "stop_exporter", "get_exporter",
+    "ensure_started_from_env",
+    "FlightRecorder", "metrics", "exporter", "flight_recorder",
 ]

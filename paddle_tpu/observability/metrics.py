@@ -1,0 +1,368 @@
+"""Typed metric registry: counters, gauges, log-bucketed histograms.
+
+The production-metrics half of paddle_tpu/observability (PR 1 added spans +
+per-step JSONL; this adds *distributions* and a scrapeable registry):
+
+- ``Counter`` / ``Gauge``: thread-safe scalars.
+- ``Histogram``: fixed-boundary buckets (log-spaced by default) with exact
+  ``min/max/sum/count`` and interpolated p50/p90/p99 estimation — the same
+  shape Prometheus client libraries expose, so `observability/exporter.py`
+  can render the text format directly from a snapshot.
+- ``MetricRegistry``: name -> metric, get-or-create, one lock per metric.
+  ``snapshot()`` additionally absorbs the raw monotonic counters living in
+  `core.monitor` (jit_compiles, nan_inf_hits, serving.*, grad_comm.* ...),
+  so one scrape sees both worlds without double instrumentation.
+
+Everything here is stdlib-only and importable without jax (the disabled
+path of the engines never pays an import); see
+tests/test_profiler.py::test_observability_is_stdlib_without_jax.
+
+Off by default: `active_registry()` returns None until `enable()` (called
+by the exporter's env-var autostart or a test). Engine hot paths gate all
+observations on that single None check.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def log_buckets(lo: float, hi: float, factor: float = 2.0) -> Tuple[float, ...]:
+    """Geometric bucket upper bounds covering [lo, hi]: lo, lo*f, ... >= hi."""
+    if lo <= 0 or hi <= lo or factor <= 1:
+        raise ValueError("need 0 < lo < hi and factor > 1")
+    out = [lo]
+    while out[-1] < hi:
+        out.append(out[-1] * factor)
+    return tuple(out)
+
+
+# Default boundaries for millisecond-valued latency histograms: 0.1ms .. ~3.4min
+DEFAULT_MS_BUCKETS = log_buckets(0.1, 200_000.0, 2.0)
+
+
+class Counter:
+    """Monotonic float counter."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> dict:
+        return {"kind": self.kind, "value": self.value}
+
+
+class Gauge:
+    """Last-write-wins scalar (queue depth, occupancy, ...)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def snapshot(self) -> dict:
+        return {"kind": self.kind, "value": self.value}
+
+
+class Histogram:
+    """Fixed-boundary histogram with exact moments and estimated percentiles.
+
+    ``boundaries`` are bucket *upper* bounds (like Prometheus ``le``); an
+    implicit +Inf bucket catches overflow. Percentiles are estimated by
+    linear interpolation inside the bucket holding the target rank, then
+    clamped to the exactly-tracked [min, max] — so the estimate is never
+    off by more than one bucket width.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, boundaries: Sequence[float] = None,
+                 description: str = ""):
+        self.name = name
+        self.description = description
+        bs = tuple(boundaries) if boundaries is not None else DEFAULT_MS_BUCKETS
+        if list(bs) != sorted(bs) or len(set(bs)) != len(bs):
+            raise ValueError("boundaries must be strictly increasing")
+        self.boundaries: Tuple[float, ...] = bs
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(bs) + 1)  # last = +Inf overflow
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect.bisect_left(self.boundaries, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            count, total = self._count, self._sum
+            mn = self._min if self._count else None
+            mx = self._max if self._count else None
+        snap = {
+            "kind": self.kind,
+            "boundaries": list(self.boundaries),
+            "counts": counts,
+            "count": count,
+            "sum": total,
+            "min": mn,
+            "max": mx,
+        }
+        for q in (0.5, 0.9, 0.99):
+            snap["p%g" % (q * 100)] = estimate_percentile(snap, q)
+        return snap
+
+    def percentile(self, q: float) -> Optional[float]:
+        return estimate_percentile(self.snapshot(), q)
+
+
+def estimate_percentile(snap: dict, q: float) -> Optional[float]:
+    """Interpolated percentile from a histogram snapshot dict.
+
+    Works on any dict with boundaries/counts/count/min/max — usable offline
+    (tools/trace_summary.py) on a JSON snapshot without a live registry.
+    """
+    if not 0 <= q <= 1:
+        raise ValueError("q in [0, 1]")
+    count = snap.get("count", 0)
+    if not count:
+        return None
+    boundaries = snap["boundaries"]
+    counts = snap["counts"]
+    mn, mx = snap["min"], snap["max"]
+    rank = q * count
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if cum + c >= rank and c > 0:
+            # interpolate within bucket i between its lower/upper bounds
+            lo = boundaries[i - 1] if i > 0 else mn
+            hi = boundaries[i] if i < len(boundaries) else mx
+            frac = (rank - cum) / c
+            est = lo + (hi - lo) * frac
+            return float(min(max(est, mn), mx))
+        cum += c
+    return float(mx)
+
+
+class MetricRegistry:
+    """Thread-safe name -> metric map with get-or-create accessors."""
+
+    def __init__(self, namespace: str = "paddle_tpu"):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_create(self, cls, name, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str, description: str = "") -> Counter:
+        return self._get_or_create(Counter, name, description=description)
+
+    def gauge(self, name: str, description: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, description=description)
+
+    def histogram(self, name: str, boundaries: Sequence[float] = None,
+                  description: str = "") -> Histogram:
+        return self._get_or_create(Histogram, name, boundaries=boundaries,
+                                   description=description)
+
+    def metrics(self) -> Dict[str, object]:
+        with self._lock:
+            return dict(self._metrics)
+
+    # ---- snapshots --------------------------------------------------------
+
+    def snapshot(self, include_monitor: bool = True,
+                 compact: bool = False) -> dict:
+        """Point-in-time view of every metric + absorbed monitor counters.
+
+        ``compact=True`` replaces per-bucket arrays with the summary stats
+        (count/sum/min/max/p50/p90/p99) — the right shape for bench rows.
+        """
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in sorted(self.metrics().items()):
+            snap = m.snapshot()
+            if m.kind == "histogram":
+                if compact:
+                    snap = {k: v for k, v in snap.items()
+                            if k not in ("boundaries", "counts", "kind")}
+                out["histograms"][name] = snap
+            elif m.kind == "gauge":
+                out["gauges"][name] = snap["value"]
+            else:
+                out["counters"][name] = snap["value"]
+        if include_monitor:
+            out["monitor"] = self._monitor_report()
+        return out
+
+    @staticmethod
+    def _monitor_report() -> dict:
+        # Lazy import: core.monitor is stdlib-only too, but keeping it out
+        # of module load preserves standalone importability of this file.
+        try:
+            from paddle_tpu.core import monitor
+        except ImportError:  # standalone module load (stdlib-only test)
+            return {}
+        return {name: dict(rep)
+                for name, rep in sorted(monitor.registry().report().items())}
+
+    # ---- Prometheus text exposition ---------------------------------------
+
+    def to_prometheus(self) -> str:
+        """Render the registry (+ monitor counters) in Prometheus text
+        format 0.0.4: histograms as cumulative ``_bucket{le=...}`` series
+        plus ``_sum``/``_count``."""
+        lines: List[str] = []
+        ns = _sanitize(self.namespace)
+
+        def emit(name, kind, help_, series):
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {kind}")
+            lines.extend(series)
+
+        for name, m in sorted(self.metrics().items()):
+            full = f"{ns}_{_sanitize(name)}"
+            help_ = m.description or name
+            if m.kind == "histogram":
+                snap = m.snapshot()
+                series, cum = [], 0
+                for b, c in zip(snap["boundaries"], snap["counts"]):
+                    cum += c
+                    series.append(
+                        f'{full}_bucket{{le="{_fmt_le(b)}"}} {cum}')
+                cum += snap["counts"][-1]
+                series.append(f'{full}_bucket{{le="+Inf"}} {cum}')
+                series.append(f"{full}_sum {_fmt_val(snap['sum'])}")
+                series.append(f"{full}_count {snap['count']}")
+                emit(full, "histogram", help_, series)
+            elif m.kind == "gauge":
+                emit(full, "gauge", help_, [f"{full} {_fmt_val(m.value)}"])
+            else:
+                emit(f"{full}_total", "counter", help_,
+                     [f"{full}_total {_fmt_val(m.value)}"])
+        for name, rep in self._monitor_report().items():
+            full = f"{ns}_monitor_{_sanitize(name)}"
+            emit(full, "gauge", f"core.monitor stat {name}",
+                 [f"{full} {_fmt_val(rep['value'])}"])
+            lines.append(f"{full}_peak {_fmt_val(rep['peak'])}")
+        return "\n".join(lines) + "\n"
+
+    def to_json(self, compact: bool = False) -> str:
+        return json.dumps(self.snapshot(compact=compact), sort_keys=True)
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def _fmt_le(b: float) -> str:
+    return "%g" % b
+
+
+def _fmt_val(v: float) -> str:
+    f = float(v)
+    return "%d" % f if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+# ---- process-global default registry (off until enabled) -------------------
+
+_default = MetricRegistry()
+_active = False
+_state_lock = threading.Lock()
+
+
+def default_registry() -> MetricRegistry:
+    """The process-wide registry (always exists; may be inactive)."""
+    return _default
+
+
+def active_registry() -> Optional[MetricRegistry]:
+    """The registry iff metrics are enabled, else None.
+
+    This is the engines' hot-path gate: one module-global read + None
+    check per step when metrics are off.
+    """
+    return _default if _active else None
+
+
+def enable() -> MetricRegistry:
+    global _active
+    with _state_lock:
+        _active = True
+    return _default
+
+
+def disable() -> None:
+    global _active
+    with _state_lock:
+        _active = False
+
+
+def reset() -> None:
+    """Drop all metrics and deactivate (test isolation)."""
+    global _default, _active
+    with _state_lock:
+        _default = MetricRegistry()
+        _active = False

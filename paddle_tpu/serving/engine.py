@@ -35,9 +35,17 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..observability import exporter as _obs_exporter
+from ..observability import flight_recorder as _obs_flight
+from ..observability import metrics as _obs_metrics
+from ..observability import tracer as _obs_tracer
 from .bucketing import DEFAULT_LADDER, bucket_for, clip_ladder
 
 _NO_EOS = -1
+
+# slot-occupancy fractions live in (0, 1]: linear buckets, not the default
+# log-spaced latency boundaries
+_OCCUPANCY_BUCKETS = tuple(round(0.1 * i, 1) for i in range(1, 11))
 
 
 def _jit_cache_size(fn) -> int:
@@ -70,6 +78,7 @@ class Request:
         self.slot: Optional[int] = None
         self.queue_depth_at_submit = 0
         self.submit_ts: Optional[float] = None
+        self.admit_ts: Optional[float] = None
         self.first_token_ts: Optional[float] = None
         self.done_ts: Optional[float] = None
         self.finish_reason: Optional[str] = None  # "eos" | "length"
@@ -83,6 +92,21 @@ class Request:
         if self.first_token_ts is None or self.submit_ts is None:
             return None
         return self.first_token_ts - self.submit_ts
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.admit_ts is None or self.submit_ts is None:
+            return None
+        return self.admit_ts - self.submit_ts
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean time-per-output-token after the first (None until done or
+        when only one token was generated)."""
+        if (self.done_ts is None or self.first_token_ts is None
+                or len(self.tokens) < 2):
+            return None
+        return (self.done_ts - self.first_token_ts) / (len(self.tokens) - 1)
 
     def output_ids(self):
         """[prompt + generated] (no post-EOS padding; pad with eos to
@@ -145,6 +169,10 @@ class ServingEngine:
         # ONE decode executable; N is static in its key.
         self.steps_per_dispatch = max(1, int(steps_per_dispatch))
         self.sink = sink
+        # PADDLE_TPU_METRICS_PORT / PADDLE_TPU_FLIGHT_DIR opt-ins: one
+        # getenv each when unset, zero per-step cost while off
+        _obs_exporter.ensure_started_from_env()
+        _obs_flight.ensure_from_env()
 
         self._lock = threading.Lock()
         self._queue: deque[Request] = deque()
@@ -221,6 +249,10 @@ class ServingEngine:
         with self._lock:
             req.queue_depth_at_submit = len(self._queue)
             self._queue.append(req)
+        tr = _obs_tracer.get_tracer()
+        if tr.enabled:
+            tr.instant("serve.enqueue", request=req.id,
+                       queue_depth=req.queue_depth_at_submit)
         return req
 
     def step(self) -> int:
@@ -345,19 +377,42 @@ class ServingEngine:
             slot = free[0]
             bucket = req.bucket
             plen = len(req.prompt_ids)
+            req.admit_ts = time.perf_counter()    # queue wait ends here
             fn = self._prefill_fns.get(bucket)
             if fn is None:
                 fn = self._prefill_fns[bucket] = self._build_prefill(bucket)
             padded = np.zeros((1, bucket), np.int64)
             padded[0, :plen] = req.prompt_ids
-            self._kcs, self._vcs, tok = fn(
-                self._params, self._kcs, self._vcs, jnp.asarray(padded),
-                jnp.int32(plen), jnp.int32(slot),
-                jnp.float32(req.temperature), jnp.int32(req.top_k),
-                jnp.float32(req.top_p), jnp.int32(req.seed))
-            self._note_exec_compiles(fn, "serving.prefill_compiles")
-            first = int(tok)                      # device sync = first token
+            try:
+                self._kcs, self._vcs, tok = fn(
+                    self._params, self._kcs, self._vcs, jnp.asarray(padded),
+                    jnp.int32(plen), jnp.int32(slot),
+                    jnp.float32(req.temperature), jnp.int32(req.top_k),
+                    jnp.float32(req.top_p), jnp.int32(req.seed))
+                self._note_exec_compiles(fn, "serving.prefill_compiles")
+                first = int(tok)                  # device sync = first token
+            except Exception as e:
+                fr = _obs_flight.get()
+                if fr is not None:
+                    fr.dump("serve_prefill_exception",
+                            {"request": req.id, "bucket": bucket,
+                             "error": repr(e)})
+                raise
             req.first_token_ts = time.perf_counter()
+            tr = _obs_tracer.get_tracer()
+            if tr.enabled:
+                tr.record_complete("serve.queue_wait", req.submit_ts,
+                                   req.admit_ts, {"request": req.id})
+                tr.record_complete("serve.prefill", req.admit_ts,
+                                   req.first_token_ts,
+                                   {"request": req.id, "bucket": bucket,
+                                    "slot": slot})
+            mreg = _obs_metrics.active_registry()
+            if mreg is not None:
+                mreg.histogram("serve.queue_wait_ms").observe(
+                    req.queue_wait_s * 1e3)
+                mreg.histogram("serve.prefill_ms").observe(
+                    (req.first_token_ts - req.admit_ts) * 1e3)
             req.slot = slot
             req.tokens.append(first)
             self._count_tokens(1)
@@ -447,24 +502,38 @@ class ServingEngine:
         fn = self._decode_fns.get(family)
         if fn is None:
             fn = self._decode_fns[family] = self._build_decode(family)
-        (self._kcs, self._vcs, off, tok, active, remaining, toks, was_active,
-         hits) = fn(
-            self._params, self._kcs, self._vcs,
-            jnp.asarray(self._offsets), jnp.asarray(self._last_tok),
-            jnp.asarray(self._active), jnp.asarray(self._temps),
-            jnp.asarray(self._topk), jnp.asarray(self._topp),
-            jnp.asarray(self._eos), jnp.asarray(self._remaining),
-            jnp.asarray(self._seeds))
-        self._note_exec_compiles(fn, "serving.decode_compiles")
-        # np.array (copy): zero-copy views of jax buffers are read-only, and
-        # _admit mutates these in place when it seats the next request
-        self._offsets = np.array(off)
-        self._last_tok = np.array(tok)
-        self._active = np.array(active)
-        self._remaining = np.array(remaining)
-        toks = np.asarray(toks)               # [n_inner, S]
-        was_active = np.asarray(was_active)
-        hits = np.asarray(hits)
+        t0 = time.perf_counter()
+        try:
+            (self._kcs, self._vcs, off, tok, active, remaining, toks,
+             was_active, hits) = fn(
+                self._params, self._kcs, self._vcs,
+                jnp.asarray(self._offsets), jnp.asarray(self._last_tok),
+                jnp.asarray(self._active), jnp.asarray(self._temps),
+                jnp.asarray(self._topk), jnp.asarray(self._topp),
+                jnp.asarray(self._eos), jnp.asarray(self._remaining),
+                jnp.asarray(self._seeds))
+            self._note_exec_compiles(fn, "serving.decode_compiles")
+            # np.array (copy): zero-copy views of jax buffers are read-only,
+            # and _admit mutates these in place when it seats the next request
+            self._offsets = np.array(off)
+            self._last_tok = np.array(tok)
+            self._active = np.array(active)
+            self._remaining = np.array(remaining)
+            toks = np.asarray(toks)           # [n_inner, S]
+            was_active = np.asarray(was_active)
+            hits = np.asarray(hits)
+        except Exception as e:
+            fr = _obs_flight.get()
+            if fr is not None:
+                fr.dump("serve_decode_exception",
+                        {"step": self._steps, "family": family,
+                         "error": repr(e)})
+            raise
+        t1 = time.perf_counter()
+        tr = _obs_tracer.get_tracer()
+        if tr.enabled:
+            tr.record_complete("serve.decode_step", t0, t1,
+                               {"step": self._steps, "family": family})
         n_inner = toks.shape[0]
         self._steps += n_inner
         now = time.perf_counter()
@@ -483,18 +552,31 @@ class ServingEngine:
         from ..core import monitor
 
         monitor.stat("serving.steps").increase(n_inner)
-        if self.sink is not None:
-            self.sink.write({
+        occupancy = float(was_active.mean())
+        mreg = _obs_metrics.active_registry()
+        if mreg is not None:
+            mreg.histogram("serve.decode_step_ms").observe((t1 - t0) * 1e3)
+            mreg.histogram("serve.occupancy",
+                           boundaries=_OCCUPANCY_BUCKETS).observe(occupancy)
+            mreg.gauge("serve.queue_depth").set(len(self._queue))
+            mreg.gauge("serve.active_slots").set(int(self._active.sum()))
+        fr = _obs_flight.get()
+        if self.sink is not None or fr is not None:
+            rec = {
                 "event": "serve_step", "step": self._steps, "ts": time.time(),
                 "steps_per_dispatch": n_inner,
                 "active_slots": int(was_active[0].sum()),
                 "slot_count": self.slot_count,
                 # mean occupancy across the fused steps: retired slots are
                 # masked (idle) until the chunk boundary
-                "occupancy": round(float(was_active.mean()), 4),
+                "occupancy": round(occupancy, 4),
                 "queue_depth": len(self._queue),
                 "tokens": emitted,
-            })
+            }
+            if self.sink is not None:
+                self.sink.write(rec)
+            if fr is not None:
+                fr.record(rec)
 
     # ---- bookkeeping ---------------------------------------------------
     def _count_tokens(self, n: int) -> None:
@@ -509,9 +591,30 @@ class ServingEngine:
         req.done_ts = now if now is not None else time.perf_counter()
         self._completed.append(req)
         monitor.stat("serving.requests").increase()
-        if self.sink is not None:
+        tr = _obs_tracer.get_tracer()
+        if tr.enabled:
+            # the request's full span lifecycle: enqueue (instant at submit)
+            # -> queue_wait -> prefill (both recorded at admit) -> decode ->
+            # request envelope -> retire marker
+            if req.first_token_ts is not None:
+                tr.record_complete("serve.decode", req.first_token_ts,
+                                   req.done_ts,
+                                   {"request": req.id,
+                                    "tokens": len(req.tokens)})
+            tr.record_complete("serve.request", req.submit_ts, req.done_ts,
+                               {"request": req.id,
+                                "finish": req.finish_reason})
+            tr.instant("serve.retire", request=req.id, slot=req.slot)
+        mreg = _obs_metrics.active_registry()
+        if mreg is not None:
+            if req.ttft_s is not None:
+                mreg.histogram("serve.ttft_ms").observe(req.ttft_s * 1e3)
+            if req.tpot_s is not None:
+                mreg.histogram("serve.tpot_ms").observe(req.tpot_s * 1e3)
+        fr = _obs_flight.get()
+        if self.sink is not None or fr is not None:
             wall = max(req.done_ts - req.submit_ts, 1e-9)
-            self.sink.write({
+            rec = {
                 "event": "serve_request", "request_id": req.id,
                 "ts": time.time(),
                 "prompt_len": int(len(req.prompt_ids)),
@@ -519,7 +622,15 @@ class ServingEngine:
                 "new_tokens": len(req.tokens),
                 "finish_reason": req.finish_reason,
                 "ttft_s": round(req.ttft_s, 6),
+                "queue_wait_s": (round(req.queue_wait_s, 6)
+                                 if req.queue_wait_s is not None else None),
+                "tpot_s": (round(req.tpot_s, 6)
+                           if req.tpot_s is not None else None),
                 "wall_s": round(wall, 6),
                 "tokens_per_sec": round(len(req.tokens) / wall, 2),
                 "queue_depth_at_submit": req.queue_depth_at_submit,
-            })
+            }
+            if self.sink is not None:
+                self.sink.write(rec)
+            if fr is not None:
+                fr.record(rec)

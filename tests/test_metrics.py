@@ -1,0 +1,335 @@
+"""Production metrics layer (ISSUE 7 tentpole): histogram registry,
+Prometheus exporter, and the crash/NaN flight recorder.
+
+Pinned contracts:
+- histogram math: exact count/sum/min/max, and interpolated percentile
+  estimates within one bucket width of numpy's exact answer;
+- exporter: a live HTTP scrape round-trips the registry in both Prometheus
+  text 0.0.4 (cumulative ``_bucket{le=}`` series) and JSON;
+- flight recorder: a NaN loss step / an uncaught step exception / a
+  dispatch NaN-check hit each produce a dump directory containing the
+  offending step's record;
+- off by default: the registry/recorder globals stay None-gated so the
+  engines' hot path pays a single None check when observability is off.
+
+The conftest autouse fixture does not reset these process-globals, so every
+test that enables them cleans up in ``finally``.
+"""
+import json
+import math
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import exporter, flight_recorder, metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    """Metrics/exporter/recorder are process-globals the shared conftest
+    doesn't know about: start every test dark, leave it dark."""
+    exporter.stop_exporter()
+    metrics.reset()
+    flight_recorder.disable()
+    yield
+    exporter.stop_exporter()
+    metrics.reset()
+    flight_recorder.disable()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type"), \
+            resp.read().decode("utf-8")
+
+
+# ------------------------------------------------------------- histogram math
+
+def test_log_buckets_geometric_cover():
+    bs = metrics.log_buckets(0.5, 100.0, 2.0)
+    assert bs[0] == 0.5 and bs[-1] >= 100.0
+    ratios = [b / a for a, b in zip(bs, bs[1:])]
+    assert all(abs(r - 2.0) < 1e-12 for r in ratios)
+    with pytest.raises(ValueError):
+        metrics.log_buckets(0, 10)
+    with pytest.raises(ValueError):
+        metrics.log_buckets(1, 10, factor=1.0)
+
+
+def test_histogram_exact_moments_and_bucket_counts():
+    h = metrics.Histogram("t", boundaries=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 1.5, 3.0, 100.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(106.0)
+    assert snap["min"] == 0.5 and snap["max"] == 100.0
+    # le-style buckets: v == boundary lands in that boundary's bucket,
+    # values past the last boundary land in the implicit +Inf overflow
+    assert snap["counts"] == [2, 1, 1, 1]
+    assert sum(snap["counts"]) == snap["count"]
+
+
+def test_histogram_percentiles_within_one_bucket_of_numpy():
+    rng = np.random.RandomState(7)
+    xs = np.exp(rng.randn(5000)) * 10.0  # lognormal ms-ish latencies
+    h = metrics.Histogram("lat", boundaries=metrics.DEFAULT_MS_BUCKETS)
+    for v in xs:
+        h.observe(float(v))
+    snap = h.snapshot()
+    bs = snap["boundaries"]
+    for q in (50, 90, 99):
+        exact = float(np.percentile(xs, q))
+        est = metrics.estimate_percentile(snap, q / 100)
+        # error bounded by the width of the bucket holding the exact value
+        i = int(np.searchsorted(bs, exact))
+        lo = bs[i - 1] if i > 0 else snap["min"]
+        hi = bs[i] if i < len(bs) else snap["max"]
+        assert abs(est - exact) <= (hi - lo) + 1e-9, (q, exact, est)
+        # estimates are always clamped inside the observed range
+        assert snap["min"] <= est <= snap["max"]
+    # empty histogram -> None, not a crash
+    assert metrics.estimate_percentile(
+        metrics.Histogram("e").snapshot(), 0.5) is None
+
+
+def test_registry_get_or_create_and_kind_mismatch():
+    reg = metrics.MetricRegistry()
+    c = reg.counter("hits")
+    assert reg.counter("hits") is c
+    with pytest.raises(TypeError):
+        reg.gauge("hits")
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters only go up
+    g = reg.gauge("depth")
+    g.set(3)
+    g.inc()
+    g.dec(2)
+    assert g.value == 2.0
+
+
+def test_snapshot_absorbs_monitor_and_compact_strips_buckets():
+    from paddle_tpu.core import monitor
+
+    reg = metrics.MetricRegistry()
+    reg.histogram("lat_ms").observe(5.0)
+    monitor.stat("test_metrics.probe").increase(3)
+    snap = reg.snapshot()
+    assert snap["monitor"]["test_metrics.probe"]["value"] >= 3
+    assert "counts" in snap["histograms"]["lat_ms"]
+    compact = reg.snapshot(compact=True)
+    h = compact["histograms"]["lat_ms"]
+    assert "counts" not in h and "boundaries" not in h
+    assert h["count"] == 1 and h["p50"] is not None
+
+
+def test_prometheus_text_cumulative_buckets():
+    reg = metrics.MetricRegistry()
+    h = reg.histogram("step_ms", boundaries=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    reg.counter("requests").inc(7)
+    reg.gauge("depth").set(2)
+    text = reg.to_prometheus()
+    lines = text.splitlines()
+    assert "# TYPE paddle_tpu_step_ms histogram" in lines
+    buckets = [ln for ln in lines
+               if ln.startswith("paddle_tpu_step_ms_bucket")]
+    cum = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert cum == sorted(cum), "bucket series must be cumulative"
+    assert buckets[-1].startswith('paddle_tpu_step_ms_bucket{le="+Inf"}')
+    assert cum[-1] == 4
+    assert "paddle_tpu_step_ms_count 4" in lines
+    assert "paddle_tpu_step_ms_sum 555.5" in lines
+    assert "paddle_tpu_requests_total 7" in lines
+    assert "paddle_tpu_depth 2" in lines
+    # absorbed monitor stats render as gauges with a _peak companion
+    assert any(ln.startswith("paddle_tpu_monitor_") for ln in lines)
+
+
+# ------------------------------------------------------------------- exporter
+
+def test_exporter_scrape_round_trip():
+    try:
+        reg = metrics.enable()
+        reg.histogram("probe_ms", boundaries=(1.0, 10.0)).observe(3.0)
+        reg.counter("probe_hits").inc(2)
+        ex = exporter.start_exporter(port=0)
+        assert ex.port > 0  # ephemeral port read back after bind
+        code, ctype, body = _get(ex.url + "/metrics")
+        assert code == 200 and ctype == exporter.PROM_CONTENT_TYPE
+        assert "paddle_tpu_probe_ms_count 1" in body
+        assert 'paddle_tpu_probe_ms_bucket{le="10"} 1' in body
+        assert "paddle_tpu_probe_hits_total 2" in body
+        code, ctype, body = _get(ex.url + "/metrics.json")
+        assert code == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["histograms"]["probe_ms"]["count"] == 1
+        assert doc["counters"]["probe_hits"] == 2.0
+        assert "monitor" in doc
+        code, _, body = _get(ex.url + "/healthz")
+        assert code == 200 and body == "ok\n"
+        with pytest.raises(urllib.error.HTTPError):
+            _get(ex.url + "/nope")
+    finally:
+        exporter.stop_exporter()
+        metrics.reset()
+
+
+def test_exporter_env_autostart_is_gated(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_METRICS_PORT", raising=False)
+    assert exporter.ensure_started_from_env() is None
+    monkeypatch.setenv("PADDLE_TPU_METRICS_PORT", "not-a-port")
+    assert exporter.ensure_started_from_env() is None
+    try:
+        monkeypatch.setenv("PADDLE_TPU_METRICS_PORT", "0")
+        ex = exporter.ensure_started_from_env()
+        assert ex is not None and ex.running
+        # starting the exporter activates the registry feed
+        assert metrics.active_registry() is not None
+        assert exporter.ensure_started_from_env() is ex  # idempotent
+    finally:
+        exporter.stop_exporter()
+        metrics.reset()
+
+
+def test_observability_off_by_default():
+    """The hot-path gates: both globals are None until explicitly enabled,
+    and flip back to None on disable/reset."""
+    assert metrics.active_registry() is None
+    assert flight_recorder.get() is None
+    assert exporter.get_exporter() is None
+    # module-level NaN hook is a no-op when dark
+    assert flight_recorder.on_nan_inf("nobody") is None
+    reg = metrics.enable()
+    assert metrics.active_registry() is reg
+    metrics.disable()
+    assert metrics.active_registry() is None
+
+
+# ------------------------------------------------------------ flight recorder
+
+def test_flight_recorder_ring_and_explicit_dump(tmp_path):
+    fr = flight_recorder.FlightRecorder(str(tmp_path), capacity=3)
+    for i in range(5):
+        fr.record({"event": "train_step", "step": i})
+    assert [r["step"] for r in fr.records()] == [2, 3, 4]  # bounded ring
+    d = fr.dump("manual probe!", extra={"note": "hi"})
+    assert os.path.basename(d).endswith("manual_probe_")
+    with open(os.path.join(d, "records.jsonl")) as f:
+        recs = [json.loads(ln) for ln in f]
+    assert [r["step"] for r in recs] == [2, 3, 4]
+    with open(os.path.join(d, "state.json")) as f:
+        state = json.load(f)
+    assert state["reason"] == "manual probe!"
+    assert state["extra"] == {"note": "hi"}
+    assert "dispatch.calls" in state["counters"]
+
+
+def test_flight_recorder_nan_dumps_rate_limited(tmp_path):
+    fr = flight_recorder.enable(str(tmp_path), nan_dump_limit=1)
+    assert flight_recorder.get() is fr
+    assert fr.on_nan_inf("op_add") is not None
+    assert fr.on_nan_inf("op_add") is None  # limit reached
+    assert fr.dump("explicit") is not None  # explicit dumps are not limited
+    assert len(fr.dumps) == 2
+
+
+def _tiny_engine(seed=0):
+    from paddle_tpu.distributed.engine import TrainStepEngine
+
+    paddle.seed(seed)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    return TrainStepEngine(net, opt, loss_fn=paddle.nn.CrossEntropyLoss())
+
+
+def _batch(n=8, poison=False):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 16).astype(np.float32)
+    if poison:
+        x[0, 0] = np.nan
+    return (paddle.to_tensor(x),
+            paddle.to_tensor(rng.randint(0, 4, (n,)).astype(np.int64)))
+
+
+def test_train_engine_dump_on_nan_loss(tmp_path):
+    """Acceptance: a forced-NaN step produces a flight dump containing the
+    offending step's record."""
+    fr = flight_recorder.enable(str(tmp_path))
+    eng = _tiny_engine()
+    eng.step(*_batch())                  # healthy step -> ring only
+    assert fr.dumps == []
+    eng.step(*_batch(poison=True))       # NaN x -> NaN loss -> dump
+    assert len(fr.dumps) == 1
+    d = fr.dumps[0]
+    assert "nan_inf_train_loss" in os.path.basename(d)
+    with open(os.path.join(d, "records.jsonl")) as f:
+        recs = [json.loads(ln) for ln in f]
+    # ring holds both steps; the offending one is last and non-finite
+    assert [r["step"] for r in recs] == [1, 2]
+    assert math.isfinite(recs[0]["loss"])
+    assert not math.isfinite(recs[1]["loss"])
+    with open(os.path.join(d, "state.json")) as f:
+        state = json.load(f)
+    assert state["extra"] == {"step": 2}
+    assert state["counters"]["engine.nan_loss_steps"]["value"] >= 1
+
+
+def test_train_engine_dump_on_step_exception(tmp_path):
+    fr = flight_recorder.enable(str(tmp_path))
+    eng = _tiny_engine()
+    eng.step(*_batch())  # builds _step_fn
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected step failure")
+
+    eng._step_fn = boom
+    with pytest.raises(RuntimeError, match="injected step failure"):
+        eng.step(*_batch())
+    assert len(fr.dumps) == 1
+    assert "train_step_exception" in os.path.basename(fr.dumps[0])
+    with open(os.path.join(fr.dumps[0], "state.json")) as f:
+        state = json.load(f)
+    assert "injected step failure" in state["extra"]["error"]
+    # the healthy step's record survived into the post-mortem ring
+    with open(os.path.join(fr.dumps[0], "records.jsonl")) as f:
+        assert json.loads(f.readline())["step"] == 1
+
+
+def test_dispatch_nan_check_triggers_dump(tmp_path):
+    fr = flight_recorder.enable(str(tmp_path))
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            _ = x / paddle.to_tensor(np.zeros(2, np.float32))
+        assert len(fr.dumps) == 1
+        assert os.path.basename(fr.dumps[0]).startswith(
+            f"flight_{os.getpid()}_001_nan_inf_op_")
+    finally:
+        paddle.set_flags({"check_nan_inf": False})
+
+
+# --------------------------------------------------------- engine histograms
+
+def test_train_engine_feeds_step_histograms():
+    try:
+        reg = metrics.enable()
+        eng = _tiny_engine()
+        for _ in range(3):
+            eng.step(*_batch())
+        snap = reg.snapshot(include_monitor=False)
+        h = snap["histograms"]["train.step_ms"]
+        assert h["count"] == 3
+        assert h["sum"] > 0 and h["min"] > 0
+        # first step compiled -> compile_ms saw exactly the compiled steps
+        assert snap["histograms"]["train.compile_ms"]["count"] >= 1
+    finally:
+        metrics.reset()

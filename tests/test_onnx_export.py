@@ -215,7 +215,16 @@ def _golden_model(kind):
 def test_golden_wire_format_pinned(tmp_path, kind):
     """The emitted .onnx BYTES must match the committed golden fixture —
     pins the hand-rolled protobuf wire format against regressions
-    (VERDICT r2 weak #6: no more same-author round-tripping only)."""
+    (VERDICT r2 weak #6: no more same-author round-tripping only).
+
+    History: golden_gpt.onnx was regenerated after the serving-engine PR's
+    GPT attention rewrite (vector-offset KV-cache plumbing) moved the
+    causal-mask position math from int64 to int32, changing the dtype of
+    the traced iota/scalar position constants in the exported graph
+    (iota_*/const_* initializers: int64 -> int32). Node list, op multiset,
+    and initializer names were unchanged and the new export is numerically
+    identical to eager (same max-abs-err as the old fixture), so the
+    regeneration pins the new — intentional — layout."""
     import os
 
     fixture = os.path.join(os.path.dirname(__file__), "fixtures",

@@ -344,7 +344,8 @@ def test_observability_is_stdlib_without_jax():
 import importlib.util, os, sys
 base = os.path.join({REPO!r}, "paddle_tpu", "observability")
 mods = {{}}
-for name in ("tracer", "step_telemetry", "flops"):
+for name in ("tracer", "step_telemetry", "flops", "metrics",
+             "flight_recorder"):
     spec = importlib.util.spec_from_file_location(
         "obs_" + name, os.path.join(base, name + ".py"))
     m = importlib.util.module_from_spec(spec)
@@ -359,6 +360,12 @@ with t.span("on"):
 assert [e["name"] for e in t.events()] == ["on"]
 s = mods["step_telemetry"].StepTelemetry(
     sink=mods["step_telemetry"].InMemorySink(), collect_memory=False)
+h = mods["metrics"].MetricRegistry().histogram("lat_ms")
+h.observe(1.5)
+assert h.count == 1
+fr = mods["flight_recorder"].FlightRecorder("/tmp/unused", capacity=4)
+fr.record({{"event": "probe"}})
+assert len(fr.records()) == 1
 assert "jax" not in sys.modules, "observability pulled in jax"
 """
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,

@@ -282,3 +282,100 @@ def test_engine_validation_and_bucketing(model):
     assert r.max_new_tokens == 8
     eng2.run()
     assert r.done and len(r.tokens) == 8
+
+
+# ------------------------------------------------- observability (ISSUE 7)
+def test_serve_span_lifecycle_ordering(model):
+    """Every request's span lifecycle lands in causal order: enqueue ->
+    queue_wait -> prefill -> decode -> request envelope -> retire, all
+    tagged with the request id."""
+    from paddle_tpu.observability import get_tracer
+
+    rng = np.random.RandomState(11)
+    tr = get_tracer()
+    tr.enable()
+    tr.clear()
+    try:
+        eng = ServingEngine(model, slot_count=2, ladder=(8, 16),
+                            max_new_cap=8, steps_per_dispatch=2)
+        reqs = [eng.submit(rng.randint(0, 1024, (4 + i,)).astype(np.int64),
+                           max_new_tokens=4, temperature=0.0)
+                for i in range(4)]  # 4 requests / 2 slots -> real queueing
+        eng.run()
+        events = tr.events()
+    finally:
+        tr.disable()
+        tr.clear()
+        tr.clear_stats()
+
+    assert {e["name"] for e in events} >= {
+        "serve.enqueue", "serve.queue_wait", "serve.prefill", "serve.decode",
+        "serve.request", "serve.retire", "serve.decode_step"}
+    for req in reqs:
+        evs = {e["name"]: e for e in events
+               if (e.get("args") or {}).get("request") == req.id}
+        assert set(evs) == {"serve.enqueue", "serve.queue_wait",
+                            "serve.prefill", "serve.decode", "serve.request",
+                            "serve.retire"}
+
+        def end(e):
+            return e["ts"] + e["dur"]
+
+        qw, pf, dec, env = (evs["serve.queue_wait"], evs["serve.prefill"],
+                            evs["serve.decode"], evs["serve.request"])
+        # queue_wait starts at submit; the enqueue instant fires just after
+        assert qw["ts"] <= evs["serve.enqueue"]["ts"]
+        assert end(qw) == pytest.approx(pf["ts"])       # admit boundary
+        assert end(pf) == pytest.approx(dec["ts"])      # first-token boundary
+        # envelope spans submit -> done and contains every phase
+        assert env["ts"] == pytest.approx(qw["ts"])
+        assert end(dec) == pytest.approx(end(env))
+        assert evs["serve.retire"]["ts"] >= end(dec) - 1e-6
+        assert env["args"]["finish"] == req.finish_reason
+        assert evs["serve.decode"]["args"]["tokens"] == len(req.tokens)
+    # later-submitted requests genuinely waited for a slot
+    waits = [e["dur"] for e in events if e["name"] == "serve.queue_wait"]
+    assert len(waits) == 4 and max(waits) > min(waits)
+
+
+def test_serve_metrics_scrape_acceptance(model, monkeypatch):
+    """ISSUE 7 acceptance: a ServingEngine run with PADDLE_TPU_METRICS_PORT
+    set serves a scrape where the TTFT/TPOT/queue-wait histogram counts
+    equal the number of completed requests."""
+    import urllib.request
+
+    from paddle_tpu.observability import exporter, metrics
+
+    exporter.stop_exporter()
+    metrics.reset()
+    monkeypatch.setenv("PADDLE_TPU_METRICS_PORT", "0")  # ephemeral bind
+    try:
+        rng = np.random.RandomState(13)
+        eng = ServingEngine(model, slot_count=2, ladder=(8, 16),
+                            max_new_cap=8, steps_per_dispatch=2)
+        ex = exporter.get_exporter()
+        assert ex is not None and ex.running  # engine autostarted it
+        reqs = [eng.submit(rng.randint(0, 1024, (5 + i,)).astype(np.int64),
+                           max_new_tokens=4, temperature=0.0)
+                for i in range(4)]
+        eng.run()
+        assert all(r.done for r in reqs)
+        with urllib.request.urlopen(ex.url + "/metrics", timeout=10) as resp:
+            body = resp.read().decode("utf-8")
+        n = len(reqs)
+        assert f"paddle_tpu_serve_ttft_ms_count {n}" in body
+        assert f"paddle_tpu_serve_tpot_ms_count {n}" in body
+        assert f"paddle_tpu_serve_queue_wait_ms_count {n}" in body
+        assert f"paddle_tpu_serve_prefill_ms_count {n}" in body
+        assert "paddle_tpu_serve_decode_step_ms_bucket" in body
+        assert "paddle_tpu_serve_occupancy_count" in body
+        # JSON twin agrees with the text exposition
+        with urllib.request.urlopen(ex.url + "/metrics.json",
+                                    timeout=10) as resp:
+            import json as _json
+            doc = _json.loads(resp.read().decode("utf-8"))
+        assert doc["histograms"]["serve.ttft_ms"]["count"] == n
+        assert doc["histograms"]["serve.ttft_ms"]["min"] > 0
+    finally:
+        exporter.stop_exporter()
+        metrics.reset()

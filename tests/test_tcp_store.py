@@ -104,7 +104,13 @@ def test_multiprocess_rendezvous(tmp_path):
     world = 4
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
-    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    # the worker script lives in tmp_path, so sys.path[0] won't contain the
+    # repo — put it on PYTHONPATH explicitly instead of relying on the
+    # invoking environment having done so
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pp = os.environ.get("PYTHONPATH")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + (os.pathsep + pp if pp else "")}
     procs = [subprocess.Popen([sys.executable, str(script), str(r), str(world),
                                str(port)], env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True)

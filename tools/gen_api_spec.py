@@ -37,6 +37,7 @@ MODULES = [
     "paddle_tpu.inference",
     "paddle_tpu.serving",
     "paddle_tpu.profiler",
+    "paddle_tpu.observability",
     "paddle_tpu.onnx",
 ]
 

@@ -103,6 +103,11 @@ def main():
     import paddle_tpu as paddle
     from paddle_tpu.core import monitor
     from paddle_tpu.models import GPTForPretraining, gpt_tiny
+    from paddle_tpu.observability import metrics
+
+    # populate TTFT/TPOT/queue-wait/occupancy histograms during the engine
+    # runs (a few dict ops per request — noise against model compute)
+    metrics.enable()
 
     ladder = tuple(int(x) for x in args.ladder.split(","))
     paddle.seed(args.seed)
@@ -180,6 +185,10 @@ def main():
     summary["warm_speedup"] = round(
         summary["engine"]["warm_tokens_per_s"]
         / max(summary["legacy"]["warm_tokens_per_s"], 1e-9), 2)
+    # registry snapshot (compact): serve latency percentiles + absorbed
+    # monitor counters. extra.metrics is inert to plan_validate joins.
+    summary["extra"] = {"metrics": metrics.default_registry().snapshot(
+        compact=True)}
     print(json.dumps(summary, indent=2), flush=True)
     if args.json:
         with open(args.json, "w") as f:

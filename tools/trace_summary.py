@@ -1,4 +1,5 @@
-"""Summarize telemetry artifacts: StepTelemetry JSONL or chrome-trace JSON.
+"""Summarize telemetry artifacts: StepTelemetry/serve JSONL, chrome-trace
+JSON, or a metrics-registry snapshot.
 
 The offline half of paddle_tpu/observability: point it at what a run wrote
 and get per-region/per-step tables, so `tools/step_breakdown.py` (fresh
@@ -6,14 +7,20 @@ synthetic probe runs) and the in-process tracer (what the REAL run did)
 can be compared region by region.
 
   python tools/trace_summary.py /tmp/tele/step_telemetry.jsonl
+  python tools/trace_summary.py /tmp/serve/serve.jsonl      # serve_request
   python tools/trace_summary.py /tmp/paddle_tpu_profile/host_1234.json
   python tools/trace_summary.py /tmp/paddle_tpu_profile/   # merged dir
+  python tools/trace_summary.py snapshot.json  # exporter /metrics.json dump
 
 Format is auto-detected: a JSONL stream of step records gets the per-step
-throughput table; anything loadable by profiler.load_profiler_result gets
-the per-span table (calls/total/avg/max/min, the Profiler.summary layout).
-Output ends with one machine-readable JSON summary line, matching the other
-tools/ probes' convention.
+throughput table (plus a TTFT/TPOT/step-time p50/p90/p99 percentile table
+when serve_request records are present); a JSON object with "histograms"
+(the exporter's /metrics.json shape, also written into flight-recorder
+state.json) gets the registry-percentile table; anything loadable by
+profiler.load_profiler_result gets the per-span table (calls/total/avg/
+max/min, the Profiler.summary layout). Output ends with one
+machine-readable JSON summary line, matching the other tools/ probes'
+convention.
 """
 import json
 import os
@@ -33,6 +40,19 @@ def _fmt_table(header, rows):
         print(line(r))
 
 
+def _is_snapshot(path):
+    """A (possibly pretty-printed) JSON object carrying a metrics-registry
+    snapshot: the exporter's /metrics.json or a flight-recorder state.json."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return False
+    return isinstance(doc, dict) and (
+        "histograms" in doc
+        or "histograms" in doc.get("metrics", {}))
+
+
 def _is_jsonl(path):
     with open(path) as f:
         first = f.readline().strip()
@@ -45,6 +65,33 @@ def _is_jsonl(path):
     return isinstance(doc, dict) and "traceEvents" not in doc
 
 
+def _pctl(xs, q):
+    """Exact linear-interpolated percentile (numpy.percentile 'linear')."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    k = (len(xs) - 1) * q
+    lo = int(k)
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (k - lo)
+
+
+def _pctl_table(series):
+    """series: [(label, unit, values)] -> printed p50/p90/p99 table + dict."""
+    rows, out = [], {}
+    for label, unit, xs in series:
+        if not xs:
+            continue
+        ps = {q: _pctl(xs, q / 100) for q in (50, 90, 99)}
+        rows.append([f"{label}_{unit}", len(xs)] +
+                    [f"{ps[q]:.3f}" for q in (50, 90, 99)])
+        out[label] = {"n": len(xs),
+                      **{f"p{q}_{unit}": round(ps[q], 4) for q in (50, 90, 99)}}
+    if rows:
+        _fmt_table(["percentiles", "n", "p50", "p90", "p99"], rows)
+    return out
+
+
 def summarize_steps(path):
     recs = []
     with open(path) as f:
@@ -55,6 +102,12 @@ def summarize_steps(path):
     if not recs:
         print("no records")
         return {}
+    serve_reqs = [r for r in recs if r.get("event") == "serve_request"]
+    serve_steps = [r for r in recs if r.get("event") == "serve_step"]
+    recs = [r for r in recs if r.get("event") not in ("serve_request",
+                                                      "serve_step")]
+    if not recs:
+        return _summarize_serve(serve_reqs, serve_steps)
     n = len(recs)
 
     def col(k):
@@ -74,6 +127,7 @@ def summarize_steps(path):
             rows.append([k, len(xs), fmt.format(mean(xs)),
                          fmt.format(min(xs)), fmt.format(max(xs))])
     _fmt_table(["field", "n", "mean", "min", "max"], rows)
+    pcts = _pctl_table([("step_time", "ms", [w * 1e3 for w in walls])])
     last = recs[-1]
     summary = {
         "kind": "step_telemetry", "steps": n,
@@ -86,6 +140,76 @@ def summarize_steps(path):
         "jit_recompiles": last.get("jit_recompiles"),
         "jit_compile_ms": last.get("jit_compile_ms"),
         "nan_inf_hits": last.get("nan_inf_hits"),
+        "percentiles": pcts,
+    }
+    if serve_reqs or serve_steps:
+        summary["serve"] = _summarize_serve(serve_reqs, serve_steps,
+                                            emit_json=False)
+    print(json.dumps({"summary": summary}))
+    return summary
+
+
+def _summarize_serve(serve_reqs, serve_steps, emit_json=True):
+    """Percentile table over serve_request/serve_step records (ServingEngine
+    sink stream): TTFT/TPOT/queue-wait/request-wall + occupancy."""
+
+    def col(recs, k, scale=1.0):
+        return [r[k] * scale for r in recs
+                if isinstance(r.get(k), (int, float))]
+
+    pcts = _pctl_table([
+        ("ttft", "ms", col(serve_reqs, "ttft_s", 1e3)),
+        ("tpot", "ms", col(serve_reqs, "tpot_s", 1e3)),
+        ("queue_wait", "ms", col(serve_reqs, "queue_wait_s", 1e3)),
+        ("request_wall", "ms", col(serve_reqs, "wall_s", 1e3)),
+        ("occupancy", "frac", col(serve_steps, "occupancy")),
+    ])
+    toks = col(serve_reqs, "new_tokens")
+    summary = {
+        "kind": "serve_telemetry",
+        "requests": len(serve_reqs),
+        "decode_dispatches": len(serve_steps),
+        "total_new_tokens": int(sum(toks)) if toks else 0,
+        "percentiles": pcts,
+    }
+    if emit_json:
+        print(json.dumps({"summary": summary}))
+    return summary
+
+
+def summarize_snapshot(path):
+    """Percentile table from a metrics-registry snapshot (the exporter's
+    /metrics.json document or a flight-recorder state.json)."""
+    from paddle_tpu.observability.metrics import estimate_percentile
+
+    with open(path) as f:
+        doc = json.load(f)
+    hists = doc.get("histograms") or doc.get("metrics", {}).get("histograms",
+                                                                {})
+    rows = []
+    pcts = {}
+    for name, snap in sorted(hists.items()):
+        if not snap.get("count"):
+            continue
+        if "counts" in snap:  # full snapshot: re-estimate from the buckets
+            ps = {q: estimate_percentile(snap, q / 100) for q in (50, 90, 99)}
+        else:                 # compact snapshot: percentiles precomputed
+            ps = {q: snap.get(f"p{q}") for q in (50, 90, 99)}
+        rows.append([name, snap["count"]] +
+                    [f"{ps[q]:.3f}" if ps[q] is not None else "-"
+                     for q in (50, 90, 99)])
+        pcts[name] = {"n": snap["count"],
+                      **{f"p{q}": ps[q] for q in (50, 90, 99)}}
+    if rows:
+        _fmt_table(["histogram", "n", "p50", "p90", "p99"], rows)
+    else:
+        print("no populated histograms in snapshot")
+    summary = {
+        "kind": "metrics_snapshot",
+        "histograms": len(pcts),
+        "counters": len(doc.get("counters", {})),
+        "gauges": len(doc.get("gauges", {})),
+        "percentiles": pcts,
     }
     print(json.dumps({"summary": summary}))
     return summary
@@ -127,7 +251,9 @@ def main():
     args = ap.parse_args()
     if not os.path.exists(args.path):
         sys.exit(f"no such path: {args.path}")
-    if os.path.isfile(args.path) and _is_jsonl(args.path):
+    if os.path.isfile(args.path) and _is_snapshot(args.path):
+        summarize_snapshot(args.path)
+    elif os.path.isfile(args.path) and _is_jsonl(args.path):
         summarize_steps(args.path)
     else:
         summarize_trace(args.path)
