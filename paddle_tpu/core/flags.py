@@ -122,6 +122,31 @@ define_flag("grad_comm_chunk", 1024,
             "each chunk ships one f32 absmax scale with its int8 payload "
             "(smaller chunks track gradient dynamic range better, larger "
             "chunks amortize scale overhead)")
+define_flag("health_monitor", False,
+            "compute training-health statistics (global + per-parameter "
+            "grad/weight norms, update-to-weight ratios, non-finite "
+            "localization) IN-PROGRAM as an auxiliary output of the compiled "
+            "train step (observability/health.py). Zero extra dispatches; "
+            "the device->host fetch is gated to FLAGS_health_interval. Also "
+            "enabled by PADDLE_TPU_HEALTH_DIR (which adds a health.jsonl "
+            "sink). Read at engine construction")
+define_flag("health_interval", 10,
+            "steps between device->host fetches of the packed health-stats "
+            "buffer (ONE transfer of one f32 [4P] array per fetch). The "
+            "stats are computed every step regardless — only the host "
+            "readback, registry feed, and JSONL write are gated")
+define_flag("health_spike_factor", 10.0,
+            "grad-norm spike threshold: a fetched global grad norm above "
+            "factor*EMA(grad_norm) bumps health.spikes and triggers a "
+            "flight-recorder dump (reason health_grad_spike). <= 0 disables "
+            "spike detection")
+define_flag("exec_introspect", False,
+            "capture XLA memory_analysis()/cost_analysis() for every step/"
+            "prefill/decode executable the engines compile "
+            "(observability/exec_introspect.py: registry gauges "
+            "exec.<label>.* + tools/mem_report.py rows). Costs ONE extra "
+            "AOT compile per program (the jit cache is not reused by the "
+            "introspection lowering) — a diagnostic flag, off by default")
 define_flag("compile_cache_dir", os.environ.get("PADDLE_TPU_COMPILE_CACHE", ""),
             "persistent XLA compilation cache directory (also settable as "
             "PADDLE_TPU_COMPILE_CACHE). Empty = off (bit-identical default); "

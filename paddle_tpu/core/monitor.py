@@ -72,6 +72,15 @@ def registry() -> StatRegistry:
     return _registry
 
 
+def report_prefix(prefix: str) -> Dict[str, Dict[str, int]]:
+    """report() filtered to one dotted namespace: report_prefix("health")
+    returns health.* counters only. The subsystem-scoped view the health
+    and exec-introspection tools print without dragging the whole registry."""
+    pre = prefix.rstrip(".") + "."
+    return {n: rep for n, rep in _registry.report().items()
+            if n.startswith(pre) or n == prefix.rstrip(".")}
+
+
 def device_memory_stats(device=None) -> Dict[str, int]:
     """Device memory stats via PJRT (the reference's STAT_GPU_MEM hwm family,
     memory/stats.h). Keys depend on the backend; bytes_in_use/peak_bytes_in_use

@@ -28,12 +28,15 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import compile_cache as _compile_cache
+from ..core import flags as _flags
 from ..core import monitor as _monitor
 from ..core import random as random_mod
 from ..core.tensor import Tensor
 from ..jit import functional_call
+from ..observability import exec_introspect as _obs_exec
 from ..observability import exporter as _obs_exporter
 from ..observability import flight_recorder as _obs_flight
+from ..observability import health as _obs_health
 from ..observability import metrics as _obs_metrics
 from ..observability import tracer as _obs_tracer
 from ..observability.step_telemetry import StepTelemetry
@@ -223,6 +226,14 @@ class TrainStepEngine:
         # getenv each when unset, zero per-step cost while off
         _obs_exporter.ensure_started_from_env()
         _obs_flight.ensure_from_env()
+        # FLAGS_health_monitor / PADDLE_TPU_HEALTH_DIR: in-program training
+        # health stats as an aux output of the compiled step. None (the
+        # default) keeps the step program byte-identical to pre-health builds
+        self._health = _obs_health.from_env_or_flags(
+            {n: tuple(self._state_refs[n].shape) for n in self._param_names})
+        # label -> (jitted fn, abstract args): what introspect_executables()
+        # AOT-lowers for memory/cost analysis without holding live buffers
+        self._exec_stash = {}
 
     def _n_params(self) -> int:
         return int(sum(
@@ -255,6 +266,75 @@ class TrainStepEngine:
         if self.telemetry is not None:
             self.telemetry.close()
         self.telemetry = None
+
+    # ---- training-health telemetry (observability/health.py) ----
+    def enable_health(self, interval: Optional[int] = None,
+                      spike_factor: Optional[float] = None, sink=None,
+                      path: Optional[str] = None, ring_capacity: int = 64):
+        """Attach the in-program TrainingHealthMonitor: grad/weight/update
+        norms + non-finite localization computed as an aux output of the
+        SAME compiled step (zero extra dispatches), fetched to host every
+        `interval` steps as ONE packed f32 [4P] transfer. Invalidates the
+        cached step executables (the program's output arity changes)."""
+        from ..observability.step_telemetry import JsonlSink
+
+        if sink is None and path is not None:
+            sink = JsonlSink(path)
+        self._health = _obs_health.TrainingHealthMonitor(
+            {n: tuple(self._state_refs[n].shape) for n in self._param_names},
+            interval=interval, spike_factor=spike_factor, sink=sink,
+            ring_capacity=ring_capacity)
+        self._invalidate_step_fns()
+        return self._health
+
+    def disable_health(self) -> None:
+        if self._health is not None:
+            self._health.close()
+        self._health = None
+        self._invalidate_step_fns()
+
+    def _invalidate_step_fns(self) -> None:
+        """Drop cached step executables + their introspection stash — the
+        next step() recompiles with the new output signature."""
+        self._step_fn = None
+        self._accum_fns = {}
+        self._exec_stash = {}
+
+    # ---- compiled-executable introspection (observability/exec_introspect) --
+    def _stash_exec(self, label: str, fn, call_args) -> None:
+        """First call per label: remember (jitted fn, abstract args) so
+        introspect_executables() can AOT-lower the same program later, and
+        auto-capture now when FLAGS_exec_introspect is on. Abstract
+        ShapeDtypeStructs replace the arrays (no live-buffer retention);
+        PRNG keys stay concrete (extended dtypes don't round-trip avals)."""
+        if label in self._exec_stash:
+            return
+
+        def aval(a):
+            try:
+                if jax.dtypes.issubdtype(a.dtype, jax.dtypes.prng_key):
+                    return a
+            except Exception:
+                pass
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        avals = jax.tree_util.tree_map(aval, call_args)
+        self._exec_stash[label] = (fn, avals)
+        if _flags.flag("exec_introspect"):
+            try:
+                _obs_exec.capture_jit(label, fn, avals)
+            except Exception:
+                pass  # diagnostic path must never break training
+
+    def introspect_executables(self, force: bool = False) -> Dict[str, dict]:
+        """Capture XLA memory_analysis()/cost_analysis() for every train
+        executable this engine has dispatched (label -> stats dict; also
+        mirrored into registry gauges exec.<label>.* when metrics are
+        active). Costs one extra AOT compile per uncaptured label."""
+        out = {}
+        for label, (fn, avals) in list(self._exec_stash.items()):
+            out[label] = _obs_exec.capture_jit(label, fn, avals, force=force)
+        return out
 
     def _obs_step_tail(self, fr, mreg, rec, t0, t1, h2d_ms, compiled, loss,
                        hist="train.step_ms"):
@@ -378,7 +458,7 @@ class TrainStepEngine:
         key = jax.random.key(0)
         return lambda params: compute(params, key, *arrays)
 
-    def _raw_step(self):
+    def _raw_step(self, health_stats=None):
         update = opt_funct.make_tree_update(
             self.optimizer, {n: self._state_refs[n] for n in self._param_names})
         clip = self.optimizer._grad_clip
@@ -395,6 +475,7 @@ class TrainStepEngine:
         def step(params, opt_state, lr, step_i, key, *batch):
             loss, grads = jax.value_and_grad(
                 lambda ps: compute(ps, key, *batch))(params)
+            raw_grads = grads  # pre-clip: what health attribution must see
             if zero_specs is not None:
                 # ZeRO stage-1/2 boundary (reference group_sharded_optimizer_
                 # stage2.py:48 semantics), in TWO chained constraints:
@@ -419,12 +500,17 @@ class TrainStepEngine:
                     for n, g in grads.items()}
             grads = opt_funct.clip_grads(grads, clip)
             new_params, new_opt = update(params, grads, opt_state, lr, step_i)
-            return loss, new_params, new_opt
+            if health_stats is None:
+                return loss, new_params, new_opt
+            return loss, new_params, new_opt, health_stats(
+                raw_grads, params, new_params)
 
         return step
 
     def _build(self, batch_avals):
-        step = self._raw_step()
+        health = self._health
+        step = self._raw_step(
+            health.make_packed_stats() if health is not None else None)
         param_shardings = {n: NamedSharding(self.mesh, s) for n, s in self.param_specs.items()}
         # the jitted step is all-device; offload transfers happen at the
         # python boundary in step() (jax 0.9 dropped in-jit memory transfers)
@@ -434,12 +520,15 @@ class TrainStepEngine:
             for n in self._param_names}
         batch_shardings = self._shardings_for(batch_avals)
         scalar = NamedSharding(self.mesh, P())
+        out_sh = (scalar, param_shardings, opt_shardings)
+        if health is not None:
+            out_sh += (scalar,)  # packed f32 [4P] health buffer, replicated
 
         return jax.jit(
             step,
             in_shardings=(param_shardings, opt_shardings, scalar, scalar, scalar)
             + batch_shardings,
-            out_shardings=(scalar, param_shardings, opt_shardings),
+            out_shardings=out_sh,
             donate_argnums=(0, 1) if self._donate else (),
         )
 
@@ -566,18 +655,23 @@ class TrainStepEngine:
         zero_specs = (self.opt_specs
                       if self.hcg.degrees["sharding"] > 1 else None)
         batch_shardings = self._shardings_for(batch_avals)
+        health = self._health
+        health_stats = (health.make_packed_stats()
+                        if health is not None else None)
         if self._dp_pure():
             step = _gc.make_accum_step(
                 compute_loss=compute, update=update, clip=clip,
                 mesh=self.mesh, batch_axes=self._batch_axes(), k=k,
                 dtype=dtype, chunk=chunk, use_residual=use_residual,
-                param_specs=self.param_specs, zero_specs=zero_specs)
+                param_specs=self.param_specs, zero_specs=zero_specs,
+                health_stats=health_stats)
         else:
             step = _gc.make_accum_step_gspmd(
                 compute_loss=compute, update=update, clip=clip,
                 mesh=self.mesh, k=k,
                 batch_specs=[s.spec for s in batch_shardings],
-                param_specs=self.param_specs, zero_specs=zero_specs)
+                param_specs=self.param_specs, zero_specs=zero_specs,
+                health_stats=health_stats)
         param_shardings = {n: NamedSharding(self.mesh, s)
                            for n, s in self.param_specs.items()}
         opt_shardings = {
@@ -593,6 +687,8 @@ class TrainStepEngine:
             in_sh += (res_sh,)
             out_sh += (res_sh,)
             donate = (0, 1, 2)  # the residual is carried state: donate it
+        if health is not None:
+            out_sh += (scalar,)  # packed health buffer rides LAST
         return jax.jit(
             step,
             in_shardings=in_sh + (scalar, scalar, scalar) + batch_shardings,
@@ -615,7 +711,8 @@ class TrainStepEngine:
                     f"the batch (topology: {self.hcg.topology()})")
         from ..core import autotune
         autotune.set_step(self._step_count + 1)
-        cache_key = (k, dtype, use_residual, chunk)
+        health_on = self._health is not None
+        cache_key = (k, dtype, use_residual, chunk, health_on)
         if cache_key not in self._accum_fns:
             self._accum_fns[cache_key] = self._build_accum(
                 arrays, k, dtype, use_residual, chunk)
@@ -639,17 +736,24 @@ class TrainStepEngine:
         mreg = _obs_metrics.active_registry()
         n0 = _jit_cache_size(fn)
         p0 = _compile_cache.entries() if n0 == 0 else -1
+        label = f"train.accum_k{k}_{dtype}" + ("_res" if use_residual else "")
         t0 = time.perf_counter()
         try:
             if use_residual:
-                loss, self.params, new_opt, self._grad_residual = fn(
-                    self.params, self._opt_to_hbm(self.opt_state),
-                    self._ensure_residual(), lr, jnp.int32(self._step_count),
-                    sub, *arrays)
+                call_args = (self.params, self._opt_to_hbm(self.opt_state),
+                             self._ensure_residual(), lr,
+                             jnp.int32(self._step_count), sub) + tuple(arrays)
+                self._stash_exec(label, fn, call_args)
+                outs = fn(*call_args)
+                loss, self.params, new_opt, self._grad_residual = outs[:4]
             else:
-                loss, self.params, new_opt = fn(
-                    self.params, self._opt_to_hbm(self.opt_state), lr,
-                    jnp.int32(self._step_count), sub, *arrays)
+                call_args = (self.params, self._opt_to_hbm(self.opt_state),
+                             lr, jnp.int32(self._step_count),
+                             sub) + tuple(arrays)
+                self._stash_exec(label, fn, call_args)
+                outs = fn(*call_args)
+                loss, self.params, new_opt = outs[:3]
+            hbuf = outs[-1] if health_on else None
             if tele is not None or fr is not None or mreg is not None:
                 jax.block_until_ready(loss)
         except Exception as e:
@@ -672,6 +776,8 @@ class TrainStepEngine:
                                {"step": self._step_count, "compiled": compiled,
                                 "microbatches": k, "grad_comm_dtype": dtype})
         self.opt_state = self._opt_to_home(new_opt)
+        if hbuf is not None:
+            self._health.on_step(self._step_count, hbuf)
         self.last_loss = Tensor(loss)
         rec = None
         if tele is not None:
@@ -759,6 +865,10 @@ class TrainStepEngine:
         one dispatch (each over its full batch); the grad_comm accumulation
         path fuses K microbatches into ONE optimizer step. run_steps always
         runs the plain per-step program regardless of engine.microbatches.
+
+        Health telemetry (enable_health) does NOT ride this path: the scan
+        yields only losses, so per-step health stats would multiply the
+        program's outputs by K. Use step()/_accum_step for monitored runs.
         """
         arrays = self._to_arrays(batch)
         fixed = steps is not None
@@ -794,9 +904,10 @@ class TrainStepEngine:
         p0 = _compile_cache.entries() if n0 == 0 else -1
         t0 = time.perf_counter()
         try:
-            losses, self.params, new_opt = fn(
-                self.params, self._opt_to_hbm(self.opt_state), lrs,
-                jnp.int32(step0), jnp.stack(subs), *arrays)
+            call_args = (self.params, self._opt_to_hbm(self.opt_state), lrs,
+                         jnp.int32(step0), jnp.stack(subs)) + tuple(arrays)
+            self._stash_exec("train.run_steps", fn, call_args)
+            losses, self.params, new_opt = fn(*call_args)
             if tele is not None or fr is not None or mreg is not None:
                 jax.block_until_ready(losses)  # honest wall: drain the K steps
         except Exception as e:
@@ -889,11 +1000,15 @@ class TrainStepEngine:
         # and only when the fn has no executable yet (recompiles from shape
         # churn stay unclassified rather than taxing every steady-state step)
         p0 = _compile_cache.entries() if n0 == 0 else -1
+        health_on = self._health is not None
         t0 = time.perf_counter()
         try:
-            loss, self.params, new_opt = fn(
-                self.params, self._opt_to_hbm(self.opt_state), lr,
-                jnp.int32(self._step_count), sub, *arrays)
+            call_args = (self.params, self._opt_to_hbm(self.opt_state), lr,
+                         jnp.int32(self._step_count), sub) + tuple(arrays)
+            self._stash_exec("train.step", fn, call_args)
+            outs = fn(*call_args)
+            loss, self.params, new_opt = outs[:3]
+            hbuf = outs[-1] if health_on else None
             if tele is not None or fr is not None or mreg is not None:
                 jax.block_until_ready(loss)  # honest wall over async dispatch
         except Exception as e:
@@ -909,6 +1024,8 @@ class TrainStepEngine:
                                {"step": self._step_count,
                                 "compiled": compiled})
         self.opt_state = self._opt_to_home(new_opt)
+        if hbuf is not None:
+            self._health.on_step(self._step_count, hbuf)
         self.last_loss = Tensor(loss)
         rec = None
         if tele is not None:

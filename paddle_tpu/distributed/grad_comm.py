@@ -182,15 +182,23 @@ def make_accum_step(*, compute_loss: Callable, update: Callable, clip,
                     mesh: Mesh, batch_axes: Sequence[str], k: int,
                     dtype: str, chunk: int, use_residual: bool,
                     param_specs: Optional[Dict[str, P]] = None,
-                    zero_specs: Optional[Dict[str, P]] = None):
+                    zero_specs: Optional[Dict[str, P]] = None,
+                    health_stats: Optional[Callable] = None):
     """Build the microbatch-accumulation train step for a pure-dp mesh.
 
     Returns step(params, opt_state[, residual], lr, step_i, key, *batch) ->
-    (loss, new_params, new_opt[, new_residual]). The data-parallel region
-    (accumulation scan + the one deferred collective) runs under shard_map;
-    clip and the optimizer update run outside it under GSPMD, so ZeRO
-    opt-state sharding composes unchanged (the grads are pinned to the param
-    spec then the opt spec exactly as the single-shot step does).
+    (loss, new_params, new_opt[, new_residual][, health]). The data-parallel
+    region (accumulation scan + the one deferred collective) runs under
+    shard_map; clip and the optimizer update run outside it under GSPMD, so
+    ZeRO opt-state sharding composes unchanged (the grads are pinned to the
+    param spec then the opt spec exactly as the single-shot step does).
+
+    health_stats (observability/health.py make_packed_stats): optional
+    in-program stats fn (grads, params, new_params) -> f32 [4P], appended
+    as the LAST output. It receives the PRE-clip reduced mean grads — i.e.
+    slices of the flat gradient buffer the collective just carried — so
+    per-parameter attribution rides the flat-buffer segment map for free
+    (no extra collectives, no extra dispatch).
     """
     axes = tuple(a for a in batch_axes if mesh.shape[a] > 1)
     d0 = _spec_axes(axes)
@@ -243,6 +251,7 @@ def make_accum_step(*, compute_loss: Callable, update: Callable, clip,
         return fn(params, key, *batch)
 
     def _finish(params, opt_state, grads, lr, step_i):
+        raw_grads = grads  # pre-clip: what health attribution must see
         if zero_specs is not None:
             # ZeRO boundary, same two-constraint chain as the single-shot
             # step (distributed/engine.py _raw_step): grads at the param
@@ -256,21 +265,30 @@ def make_accum_step(*, compute_loss: Callable, update: Callable, clip,
         from ..optimizer import functional as opt_funct
 
         grads = opt_funct.clip_grads(grads, clip)
-        return update(params, grads, opt_state, lr, step_i)
+        new_params, new_opt = update(params, grads, opt_state, lr, step_i)
+        if health_stats is None:
+            return new_params, new_opt, None
+        return new_params, new_opt, health_stats(raw_grads, params,
+                                                 new_params)
 
     if use_residual:
         def step(params, opt_state, residual, lr, step_i, key, *batch):
             grads, loss, new_res = _dp_region(params, key, residual, batch)
-            new_params, new_opt = _finish(params, opt_state, grads, lr,
-                                          step_i)
-            return loss, new_params, new_opt, new_res
+            new_params, new_opt, aux = _finish(params, opt_state, grads, lr,
+                                               step_i)
+            if aux is None:
+                return loss, new_params, new_opt, new_res
+            return loss, new_params, new_opt, new_res, aux
 
         return step
 
     def step(params, opt_state, lr, step_i, key, *batch):
         grads, loss = _dp_region(params, key, None, batch)
-        new_params, new_opt = _finish(params, opt_state, grads, lr, step_i)
-        return loss, new_params, new_opt
+        new_params, new_opt, aux = _finish(params, opt_state, grads, lr,
+                                           step_i)
+        if aux is None:
+            return loss, new_params, new_opt
+        return loss, new_params, new_opt, aux
 
     return step
 
@@ -278,12 +296,15 @@ def make_accum_step(*, compute_loss: Callable, update: Callable, clip,
 def make_accum_step_gspmd(*, compute_loss: Callable, update: Callable, clip,
                           mesh: Mesh, k: int, batch_specs: Sequence[P],
                           param_specs: Optional[Dict[str, P]] = None,
-                          zero_specs: Optional[Dict[str, P]] = None):
+                          zero_specs: Optional[Dict[str, P]] = None,
+                          health_stats: Optional[Callable] = None):
     """Hybrid-mesh (mp/sp) fallback: GSPMD accumulation scan. Still ONE
     compiled dispatch per optimizer step with a microbatch-sized activation
     peak and an f32 accumulator, but the partitioner inserts its own fused
     gradient reduction per microbatch (K combined all-reduces, not 1) and
-    the low-precision knob does not apply — the collectives are implicit."""
+    the low-precision knob does not apply — the collectives are implicit.
+    health_stats appends the packed f32 [4P] stats buffer as the last
+    output, same contract as make_accum_step."""
 
     def step(params, opt_state, lr, step_i, key, *batch):
         mbs = []
@@ -305,6 +326,7 @@ def make_accum_step_gspmd(*, compute_loss: Callable, update: Callable, clip,
         (acc, _), losses = jax.lax.scan(body, (zero_flat, jnp.int32(0)),
                                         tuple(mbs))
         grads = unravel(acc / k)
+        raw_grads = grads
         if zero_specs is not None:
             grads = {n: jax.lax.with_sharding_constraint(
                 g, NamedSharding(mesh, param_specs[n]))
@@ -316,6 +338,9 @@ def make_accum_step_gspmd(*, compute_loss: Callable, update: Callable, clip,
 
         grads = opt_funct.clip_grads(grads, clip)
         new_params, new_opt = update(params, grads, opt_state, lr, step_i)
-        return losses.mean(), new_params, new_opt
+        if health_stats is None:
+            return losses.mean(), new_params, new_opt
+        return losses.mean(), new_params, new_opt, health_stats(
+            raw_grads, params, new_params)
 
     return step

@@ -17,18 +17,26 @@ Layers, one subsystem:
   enabled via PADDLE_TPU_METRICS_PORT.
 - ``flight_recorder``: bounded ring of recent step/serve records dumped to
   disk on NaN/exception/explicit trigger (PADDLE_TPU_FLIGHT_DIR).
+- ``health``: in-program training-health stats (grad/weight/update norms,
+  non-finite localization by parameter name) riding the compiled step as
+  ONE packed aux output, fetched every FLAGS_health_interval steps
+  (FLAGS_health_monitor / PADDLE_TPU_HEALTH_DIR).
+- ``exec_introspect``: XLA memory_analysis()/cost_analysis() capture for
+  every train/serve executable (FLAGS_exec_introspect, registry gauges
+  exec.<label>.*, tools/mem_report.py).
 
 Everything is off-by-default and stdlib-only at import time: enabling costs
 one env var (PADDLE_TPU_TELEMETRY_DIR / PADDLE_TPU_METRICS_PORT /
 PADDLE_TPU_FLIGHT_DIR) or one method call; disabled, no jax import, no I/O,
 no spans, no per-step work beyond a None check.
 """
-from . import exporter, flight_recorder, metrics  # noqa: F401
+from . import exec_introspect, exporter, flight_recorder, health, metrics  # noqa: F401
 from .exporter import (  # noqa: F401
     MetricsExporter, ensure_started_from_env, get_exporter, start_exporter,
     stop_exporter,
 )
 from .flight_recorder import FlightRecorder  # noqa: F401
+from .health import TrainingHealthMonitor, segment_layout  # noqa: F401
 from .flops import (  # noqa: F401
     PEAK_TFLOPS, peak_flops_per_sec, transformer_flops_per_token,
 )
@@ -53,4 +61,5 @@ __all__ = [
     "MetricsExporter", "start_exporter", "stop_exporter", "get_exporter",
     "ensure_started_from_env",
     "FlightRecorder", "metrics", "exporter", "flight_recorder",
+    "TrainingHealthMonitor", "segment_layout", "health", "exec_introspect",
 ]

@@ -1,0 +1,127 @@
+"""Compiled-executable introspection: XLA memory/cost analysis, surfaced.
+
+The second leg of the health tentpole (ISSUE 8): every executable the train
+and serve engines build can report what it will cost BEFORE a chip runs it
+— ``compiled.memory_analysis()`` (argument/output/temp/alias bytes: the
+activation high-water and the donation proof) and ``cost_analysis()``
+(flops, bytes accessed). This module is the one place those numbers land:
+
+- ``capture(label, compiled)``: extract a flat stats dict, remember it
+  (deduped by label), mirror it into registry gauges ``exec.<label>.<stat>``
+  when metrics are active, and bump the ``exec.captured`` monitor counter.
+- ``capture_jit(label, fn, args)``: AOT ``fn.lower(*args).compile()`` +
+  capture — what the engines' ``introspect_executables()`` methods and the
+  FLAGS_exec_introspect auto-capture hook call. The AOT path does NOT reuse
+  the jit executable cache, so each capture costs one extra compile; that
+  is why the flag defaults off and the dedup is by label.
+- ``report_rows()``: the table ``tools/mem_report.py`` prints — the memory
+  levers the ROADMAP's ZeRO item targets, measurable before it is built.
+
+Stdlib-only at module level (observability posture); jax objects only pass
+through as arguments.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_captured: Dict[str, Dict[str, Any]] = {}
+
+_MEM_FIELDS = ("argument_size_in_bytes", "output_size_in_bytes",
+               "temp_size_in_bytes", "alias_size_in_bytes",
+               "generated_code_size_in_bytes")
+_COST_FIELDS = ("flops", "transcendentals", "bytes accessed")
+
+
+def stats_for(label: str, compiled) -> Dict[str, Any]:
+    """Flat stats dict for one compiled executable. Every field is
+    best-effort: backends that expose no memory_analysis (or partial cost
+    models) just omit keys rather than fail."""
+    out: Dict[str, Any] = {"label": label}
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    if ma is not None:
+        for f in _MEM_FIELDS:
+            v = getattr(ma, f, None)
+            if v is not None:
+                out[f] = int(v)
+        # peak HBM estimate: everything resident at once, minus what
+        # donation aliases back into the arguments
+        out["peak_bytes"] = (out.get("argument_size_in_bytes", 0)
+                             + out.get("output_size_in_bytes", 0)
+                             + out.get("temp_size_in_bytes", 0)
+                             - out.get("alias_size_in_bytes", 0))
+    try:
+        from ..utils.hlo_inspect import cost_analysis_dict
+
+        ca = cost_analysis_dict(compiled)
+    except Exception:
+        ca = {}
+    for f in _COST_FIELDS:
+        v = ca.get(f)
+        if isinstance(v, (int, float)):
+            out[f.replace(" ", "_")] = float(v)
+    return out
+
+
+def capture(label: str, compiled, force: bool = False) -> Dict[str, Any]:
+    """Extract + remember stats for `compiled` (deduped by label unless
+    force), feed registry gauges when metrics are active."""
+    with _lock:
+        if not force and label in _captured:
+            return _captured[label]
+    st = stats_for(label, compiled)
+    with _lock:
+        _captured[label] = st
+    from ..core import monitor as _monitor
+
+    _monitor.stat("exec.captured").increase()
+    from . import metrics as _metrics
+
+    reg = _metrics.active_registry()
+    if reg is not None:
+        for k, v in st.items():
+            if isinstance(v, (int, float)):
+                reg.gauge(f"exec.{label}.{k}").set(float(v))
+    return st
+
+
+def capture_jit(label: str, fn, args, force: bool = False) -> Dict[str, Any]:
+    """AOT-lower + compile a jitted fn at the given avals and capture its
+    analysis. One extra XLA compile per (new) label — diagnostic cost."""
+    with _lock:
+        if not force and label in _captured:
+            return _captured[label]
+    compiled = fn.lower(*args).compile()
+    return capture(label, compiled, force=True)
+
+
+def captured() -> Dict[str, Dict[str, Any]]:
+    with _lock:
+        return dict(_captured)
+
+
+def reset() -> None:
+    """Drop all captured stats (test isolation)."""
+    with _lock:
+        _captured.clear()
+
+
+def report_rows() -> List[List[Any]]:
+    """[label, flops, argument, output, temp, alias, peak] rows sorted by
+    label — the shape tools/mem_report.py tabulates."""
+    rows = []
+    for label, st in sorted(captured().items()):
+        rows.append([
+            label,
+            st.get("flops"),
+            st.get("argument_size_in_bytes"),
+            st.get("output_size_in_bytes"),
+            st.get("temp_size_in_bytes"),
+            st.get("alias_size_in_bytes"),
+            st.get("peak_bytes"),
+        ])
+    return rows

@@ -87,6 +87,14 @@ class FlightRecorder:
             json.dump(self._state(reason, extra), f, indent=2, sort_keys=True,
                       default=str)
         self.dumps.append(d)
+        try:  # dump accounting in the metrics registry, by reason label
+            from . import metrics as _metrics
+            reg = _metrics.active_registry()
+            if reg is not None:
+                reg.counter("flight.dumps").inc()
+                reg.counter("flight.dumps." + safe).inc()
+        except ImportError:
+            pass
         return d
 
     def on_nan_inf(self, source: str, extra: Optional[dict] = None
@@ -132,6 +140,16 @@ class FlightRecorder:
                 state["metrics"] = reg.snapshot(include_monitor=False,
                                                 compact=True)
         except ImportError:
+            pass
+        try:
+            # training-health tail: the last decoded health records (grad
+            # norms, nonfinite attribution) when a monitor is live — the
+            # post-mortem context a health-triggered dump points at
+            from . import health as _health
+            hm = _health.get_monitor()
+            if hm is not None:
+                state["health_tail"] = hm.recent(32)
+        except Exception:
             pass
         return state
 

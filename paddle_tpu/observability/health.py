@@ -1,0 +1,314 @@
+"""In-program training-health telemetry (ISSUE 8 tentpole).
+
+The signals that predict a pod-scale run going sideways — the global grad
+norm, per-parameter grad/weight norms and update-to-weight ratios, and
+*which parameter first went non-finite* — are computed INSIDE the compiled
+train step as one auxiliary output, riding the flat gradient buffer the
+grad_comm accumulation path already builds (the cross-replica-sharding
+paper's flat-buffer decomposition, arXiv:2004.13336, supplies the segment
+map used for per-parameter attribution). The contract:
+
+- **zero extra dispatches**: the stats are extra outputs of the SAME jitted
+  step program (pinned by tests/test_health.py's HLO gates: one dispatch,
+  one fused gradient all-reduce, unchanged by health);
+- **at most one device->host transfer per FLAGS_health_interval steps**:
+  everything is packed into ONE f32 ``[4P]`` buffer (P = parameter count)
+  laid out as ``[grad_sq | weight_sq | update_sq | nonfinite_count]`` in
+  flat-buffer segment order, fetched only on interval steps;
+- **host-side attribution**: the first flat-buffer segment with a
+  non-finite gradient is mapped back to the parameter NAME, fed to the
+  metrics registry (``health.nonfinite.<param>``), written to the
+  ``health.jsonl`` sink, and stamped into the flight-recorder dump that the
+  breach triggers.
+
+Segment boundaries come from ``segment_layout`` — sorted parameter names
+with cumulative offsets, exactly the order ``ravel_pytree`` flattens a dict
+(pinned by a test), so the per-segment stats computed from the grads dict
+are literally per-slice stats of grad_comm's flat buffer.
+
+Module-level imports stay stdlib-only (the observability posture); jax,
+numpy, flags, and the monitor are imported lazily inside the methods that
+need them.
+"""
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+# log-spaced boundaries for norm/ratio histograms: grad norms and update
+# ratios span many decades (1e-8 .. 1e6), unlike the default ms buckets
+NORM_BUCKETS: Tuple[float, ...] = tuple(
+    10.0 ** e for e in range(-8, 7))
+
+_RING_CAPACITY = 64
+_DUMP_LIMIT = 2  # per reason class, so a diverged run can't flood the disk
+
+
+def segment_layout(param_shapes: Dict[str, Sequence[int]]
+                   ) -> List[Tuple[str, int, int]]:
+    """(name, flat_offset, size) per parameter, in flat-buffer order.
+
+    Order is sorted-by-name — the order ``jax.flatten_util.ravel_pytree``
+    flattens a dict and therefore the segment map of grad_comm's flat
+    gradient buffer (tests pin the equivalence). Scalar params count as
+    size 1.
+    """
+    out = []
+    off = 0
+    for name in sorted(param_shapes):
+        size = 1
+        for d in param_shapes[name]:
+            size *= int(d)
+        out.append((name, off, size))
+        off += size
+    return out
+
+
+def _jf(x: float) -> Optional[float]:
+    """JSON-safe float: finite values pass, inf/nan become None (the
+    ``nonfinite_count`` field carries the signal)."""
+    x = float(x)
+    return x if math.isfinite(x) else None
+
+
+class TrainingHealthMonitor:
+    """Decodes the packed in-program health buffer and fans it out.
+
+    The traced half (``make_packed_stats``) runs inside the compiled step;
+    the host half (``on_step``) fetches the packed buffer every
+    ``interval`` steps, decodes it against the segment layout, feeds the
+    metrics registry (``train.grad_norm`` / ``train.weight_norm`` /
+    ``train.update_ratio`` histograms), appends to the JSONL sink and the
+    in-memory ring (the flight recorder's ``health_tail``), and triggers a
+    flight-recorder dump on a grad-norm spike or a non-finite gradient —
+    naming the offending parameter in both cases.
+    """
+
+    def __init__(self, param_shapes: Dict[str, Sequence[int]],
+                 interval: Optional[int] = None,
+                 spike_factor: Optional[float] = None,
+                 sink=None, ring_capacity: int = _RING_CAPACITY):
+        from ..core import flags as _flags
+
+        self.segments = segment_layout(param_shapes)
+        self.names = [s[0] for s in self.segments]
+        self.packed_size = 4 * len(self.segments)
+        self.interval = max(1, int(interval if interval is not None
+                                   else _flags.flag("health_interval")))
+        self.spike_factor = float(
+            spike_factor if spike_factor is not None
+            else _flags.flag("health_spike_factor"))
+        self.sink = sink
+        self._ring = collections.deque(maxlen=int(ring_capacity))
+        self._lock = threading.Lock()
+        self._ema: Optional[float] = None
+        self._dumps: Dict[str, int] = {}
+        _set_current(self)
+
+    # ---- traced half (runs inside the compiled step) ----------------------
+
+    def make_packed_stats(self) -> Callable:
+        """Build the in-program stats fn: (grads, params, new_params) ->
+        f32 [4P] packed buffer. Pure elementwise + per-segment reductions —
+        no collectives, so the step's HLO collective shape is unchanged.
+        Call with PRE-clip gradients (the true global mean grads; in the
+        accumulation path these are slices of the flat buffer)."""
+        names = list(self.names)
+
+        def packed_stats(grads, params, new_params):
+            import jax.numpy as jnp
+
+            g2, w2, u2, nf = [], [], [], []
+            for n in names:
+                g = grads[n].astype(jnp.float32).ravel()
+                w = params[n].astype(jnp.float32).ravel()
+                d = new_params[n].astype(jnp.float32).ravel() - w
+                g2.append(jnp.sum(g * g))
+                w2.append(jnp.sum(w * w))
+                u2.append(jnp.sum(d * d))
+                nf.append(jnp.sum(~jnp.isfinite(g)).astype(jnp.float32))
+            return jnp.stack(g2 + w2 + u2 + nf)
+
+        return packed_stats
+
+    # ---- host half --------------------------------------------------------
+
+    def wants(self, step: int) -> bool:
+        return step % self.interval == 0
+
+    def on_step(self, step: int, packed) -> Optional[dict]:
+        """Interval-gated ingest: fetch the ONE packed buffer, decode, fan
+        out. Off-interval steps cost one modulo — the device array is never
+        touched, so no transfer happens."""
+        if packed is None or not self.wants(step):
+            return None
+        return self._ingest(step, packed)
+
+    def _ingest(self, step: int, packed) -> dict:
+        import numpy as np
+
+        from ..core import monitor as _monitor
+
+        buf = np.asarray(packed, dtype=np.float64)  # the one D2H transfer
+        _monitor.stat("health.fetches").increase()
+        p = len(self.segments)
+        g2, w2, u2, nf = buf[:p], buf[p:2 * p], buf[2 * p:3 * p], buf[3 * p:]
+        nf_counts = np.nan_to_num(nf, nan=0.0, posinf=0.0).astype(np.int64)
+
+        grad_norm = float(np.sqrt(g2.sum()))
+        weight_norm = float(np.sqrt(w2.sum()))
+        update_norm = float(np.sqrt(u2.sum()))
+        update_ratio = update_norm / weight_norm if weight_norm > 0 else 0.0
+
+        total_nf = int(nf_counts.sum())
+        first_seg = first_param = None
+        if total_nf:
+            first_seg = int(np.argmax(nf_counts > 0))
+            first_param = self.names[first_seg]
+
+        per_param = {}
+        for i, (name, _, _) in enumerate(self.segments):
+            wn = math.sqrt(w2[i]) if math.isfinite(w2[i]) else math.inf
+            un = math.sqrt(u2[i]) if math.isfinite(u2[i]) else math.inf
+            per_param[name] = {
+                "grad_norm": _jf(math.sqrt(g2[i]) if g2[i] >= 0
+                                 else math.nan),
+                "weight_norm": _jf(wn),
+                "update_ratio": _jf(un / wn if wn > 0 else 0.0),
+                "nonfinite": int(nf_counts[i]),
+            }
+
+        spike = (self.spike_factor > 0 and self._ema is not None
+                 and math.isfinite(grad_norm)
+                 and grad_norm > self.spike_factor * max(self._ema, 1e-30))
+        rec = {
+            "event": "health",
+            "step": int(step),
+            "ts": time.time(),
+            "grad_norm": _jf(grad_norm),
+            "weight_norm": _jf(weight_norm),
+            "update_ratio": _jf(update_ratio),
+            "nonfinite_count": total_nf,
+            "first_nonfinite_param": first_param,
+            "first_nonfinite_segment": first_seg,
+            "spike": bool(spike),
+            "per_param": per_param,
+        }
+        with self._lock:
+            self._ring.append(rec)
+        if self.sink is not None:
+            self.sink.write(rec)
+        self._feed_registry(rec)
+        if total_nf:
+            _monitor.stat("health.nonfinite_steps").increase()
+            self._dump("health_nonfinite",
+                       {"param": first_param, "segment": first_seg,
+                        "step": int(step), "count": total_nf})
+        if spike:
+            _monitor.stat("health.spikes").increase()
+            self._dump("health_grad_spike",
+                       {"step": int(step), "grad_norm": grad_norm,
+                        "ema": self._ema})
+        if math.isfinite(grad_norm):
+            self._ema = (grad_norm if self._ema is None
+                         else 0.9 * self._ema + 0.1 * grad_norm)
+        return rec
+
+    def _feed_registry(self, rec: dict) -> None:
+        from . import metrics as _metrics
+
+        reg = _metrics.active_registry()
+        if reg is None:
+            return
+        for field, hist in (("grad_norm", "train.grad_norm"),
+                            ("weight_norm", "train.weight_norm"),
+                            ("update_ratio", "train.update_ratio")):
+            v = rec.get(field)
+            if v is not None:  # non-finite values carry no distribution info
+                reg.histogram(hist, boundaries=NORM_BUCKETS).observe(v)
+        reg.gauge("health.last_step").set(rec["step"])
+        if rec["nonfinite_count"]:
+            reg.counter("health.nonfinite_steps").inc()
+            reg.counter(
+                "health.nonfinite." + rec["first_nonfinite_param"]).inc()
+        if rec["spike"]:
+            reg.counter("health.spikes").inc()
+
+    def _dump(self, reason: str, extra: dict) -> Optional[str]:
+        """Flight-recorder dump for a threshold breach, per-reason
+        rate-limited. The dump's state.json carries the extra dict (which
+        names the offending parameter) AND the health ring tail."""
+        from . import flight_recorder as _flight
+
+        fr = _flight.get()
+        if fr is None:
+            return None
+        n = self._dumps.get(reason, 0)
+        if n >= _DUMP_LIMIT:
+            return None
+        self._dumps[reason] = n + 1
+        suffix = ""
+        if extra.get("param"):
+            suffix = "_" + str(extra["param"])
+        return fr.dump(reason + suffix, extra)
+
+    # ---- inspection -------------------------------------------------------
+
+    def recent(self, n: int = 32) -> List[dict]:
+        """Most recent decoded health records, oldest first (the flight
+        recorder embeds this as ``health_tail`` in state.json dumps)."""
+        with self._lock:
+            recs = list(self._ring)
+        return recs[-int(n):]
+
+    def close(self) -> None:
+        if self.sink is not None:
+            self.sink.close()
+
+
+# ---- process-global current monitor (for the flight recorder) --------------
+
+_current: Optional[TrainingHealthMonitor] = None
+_glock = threading.Lock()
+
+
+def _set_current(m: TrainingHealthMonitor) -> None:
+    global _current
+    with _glock:
+        _current = m
+
+
+def get_monitor() -> Optional[TrainingHealthMonitor]:
+    """The most recently constructed monitor, or None — what the flight
+    recorder asks for when assembling a state.json health tail."""
+    return _current
+
+
+def reset() -> None:
+    """Drop the global monitor reference (test isolation)."""
+    global _current
+    with _glock:
+        _current = None
+
+
+def from_env_or_flags(param_shapes: Dict[str, Sequence[int]]
+                      ) -> Optional[TrainingHealthMonitor]:
+    """Monitor iff FLAGS_health_monitor or PADDLE_TPU_HEALTH_DIR is set,
+    else None — the engines' zero-cost construction probe. The env var also
+    attaches a ``health.jsonl`` JsonlSink in that directory."""
+    import os
+
+    from ..core import flags as _flags
+
+    d = os.environ.get("PADDLE_TPU_HEALTH_DIR")
+    if not d and not _flags.flag("health_monitor"):
+        return None
+    sink = None
+    if d:
+        from .step_telemetry import JsonlSink
+
+        sink = JsonlSink(os.path.join(d, "health.jsonl"))
+    return TrainingHealthMonitor(param_shapes, sink=sink)

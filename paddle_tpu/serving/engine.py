@@ -35,6 +35,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..core import flags as _flags
+from ..observability import exec_introspect as _obs_exec
 from ..observability import exporter as _obs_exporter
 from ..observability import flight_recorder as _obs_flight
 from ..observability import metrics as _obs_metrics
@@ -208,6 +210,8 @@ class ServingEngine:
         # length, max_new_tokens, or the sampling values themselves
         self._decode_fns: Dict[str, Any] = {}
         self._fn_cache_sizes: Dict[int, int] = {}  # id(fn) -> last size
+        # label -> (jitted fn, abstract args) for introspect_executables()
+        self._exec_stash: Dict[str, Any] = {}
 
     # ------------------------------------------------------------- params
     def refresh_params(self) -> None:
@@ -289,6 +293,34 @@ class ServingEngine:
         }
 
     # ---------------------------------------------------------- internals
+    def _stash_exec(self, label: str, fn, call_args) -> None:
+        """First call per label: remember (jitted fn, abstract args) so
+        introspect_executables() can AOT-lower the same program later, and
+        auto-capture now when FLAGS_exec_introspect is on. ShapeDtypeStructs
+        replace the arrays — no live (or donated) buffer is retained."""
+        if label in self._exec_stash:
+            return
+        import jax
+
+        avals = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), call_args)
+        self._exec_stash[label] = (fn, avals)
+        if _flags.flag("exec_introspect"):
+            try:
+                _obs_exec.capture_jit(label, fn, avals)
+            except Exception:
+                pass  # diagnostic path must never break serving
+
+    def introspect_executables(self, force: bool = False) -> Dict[str, dict]:
+        """Capture XLA memory_analysis()/cost_analysis() for every prefill/
+        decode executable this engine has dispatched (label -> stats dict;
+        mirrored into registry gauges exec.<label>.* when metrics are
+        active). Costs one extra AOT compile per uncaptured label."""
+        out = {}
+        for label, (fn, avals) in list(self._exec_stash.items()):
+            out[label] = _obs_exec.capture_jit(label, fn, avals, force=force)
+        return out
+
     def _note_exec_compiles(self, fn, counter: str) -> None:
         """Count executable-cache growth of a jitted fn into core.monitor —
         the regression alarm that keeps prefill/decode keyed off prompt
@@ -383,12 +415,14 @@ class ServingEngine:
                 fn = self._prefill_fns[bucket] = self._build_prefill(bucket)
             padded = np.zeros((1, bucket), np.int64)
             padded[0, :plen] = req.prompt_ids
+            call_args = (self._params, self._kcs, self._vcs,
+                         jnp.asarray(padded), jnp.int32(plen),
+                         jnp.int32(slot), jnp.float32(req.temperature),
+                         jnp.int32(req.top_k), jnp.float32(req.top_p),
+                         jnp.int32(req.seed))
+            self._stash_exec(f"serve.prefill_b{bucket}", fn, call_args)
             try:
-                self._kcs, self._vcs, tok = fn(
-                    self._params, self._kcs, self._vcs, jnp.asarray(padded),
-                    jnp.int32(plen), jnp.int32(slot),
-                    jnp.float32(req.temperature), jnp.int32(req.top_k),
-                    jnp.float32(req.top_p), jnp.int32(req.seed))
+                self._kcs, self._vcs, tok = fn(*call_args)
                 self._note_exec_compiles(fn, "serving.prefill_compiles")
                 first = int(tok)                  # device sync = first token
             except Exception as e:
@@ -502,16 +536,17 @@ class ServingEngine:
         fn = self._decode_fns.get(family)
         if fn is None:
             fn = self._decode_fns[family] = self._build_decode(family)
+        call_args = (self._params, self._kcs, self._vcs,
+                     jnp.asarray(self._offsets), jnp.asarray(self._last_tok),
+                     jnp.asarray(self._active), jnp.asarray(self._temps),
+                     jnp.asarray(self._topk), jnp.asarray(self._topp),
+                     jnp.asarray(self._eos), jnp.asarray(self._remaining),
+                     jnp.asarray(self._seeds))
+        self._stash_exec(f"serve.decode_{family}", fn, call_args)
         t0 = time.perf_counter()
         try:
             (self._kcs, self._vcs, off, tok, active, remaining, toks,
-             was_active, hits) = fn(
-                self._params, self._kcs, self._vcs,
-                jnp.asarray(self._offsets), jnp.asarray(self._last_tok),
-                jnp.asarray(self._active), jnp.asarray(self._temps),
-                jnp.asarray(self._topk), jnp.asarray(self._topp),
-                jnp.asarray(self._eos), jnp.asarray(self._remaining),
-                jnp.asarray(self._seeds))
+             was_active, hits) = fn(*call_args)
             self._note_exec_compiles(fn, "serving.decode_compiles")
             # np.array (copy): zero-copy views of jax buffers are read-only,
             # and _admit mutates these in place when it seats the next request

@@ -1,0 +1,117 @@
+"""tools/bench_gate.py self-test: the perf-trajectory gate over synthetic
+history/baseline files, plus a live run against the repo's real
+BENCH_HISTORY.jsonl + tools/bench_baseline.json (which must always pass —
+a red gate at HEAD means either a regression landed or the baseline was
+not re-pinned after a deliberate perf change)."""
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import bench_gate  # noqa: E402
+
+
+def _write_history(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _write_baseline(path, baselines):
+    with open(path, "w") as f:
+        json.dump({"baselines": baselines}, f)
+
+
+def _row(value, **extra):
+    return {"metric": "toks_per_sec", "value": value, "extra": extra or None}
+
+
+@pytest.fixture
+def files(tmp_path):
+    hist = str(tmp_path / "history.jsonl")
+    base = str(tmp_path / "baseline.json")
+
+    def run(rows, baselines, *flags):
+        _write_history(hist, rows)
+        _write_baseline(base, baselines)
+        return bench_gate.main(["--history", hist, "--baseline", base,
+                                *flags])
+
+    run.hist, run.base = hist, base
+    return run
+
+
+def test_newest_matching_row_wins(files):
+    """File order is recency: the gate must judge the LAST matching row,
+    not the first — an old slow row followed by a recovered one passes."""
+    rows = [_row(50.0, cfg="a"), _row(100.0, cfg="b"), _row(99.0, cfg="a")]
+    base = [{"name": "a", "metric": "toks_per_sec", "match": {"cfg": "a"},
+             "value": 100.0, "direction": "higher", "rel_tol": 0.05}]
+    assert files(rows, base) == 0
+
+
+def test_regression_fails_with_nonzero_exit(files, capsys):
+    rows = [_row(100.0, cfg="a"), _row(60.0, cfg="a")]  # newest is -40%
+    base = [{"name": "a", "metric": "toks_per_sec", "match": {"cfg": "a"},
+             "value": 100.0, "direction": "higher", "rel_tol": 0.2}]
+    assert files(rows, base) == 1
+    summary = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])["summary"]
+    assert summary["regressed"] == ["a"] and summary["failed"] is True
+
+
+def test_lower_is_better_direction(files):
+    """Latency-style metrics gate in the other direction."""
+    base = [{"name": "lat", "metric": "toks_per_sec", "match": {"cfg": "a"},
+             "value": 10.0, "direction": "lower", "rel_tol": 0.1}]
+    assert files([_row(10.5, cfg="a")], base) == 0   # within +10%
+    assert files([_row(12.0, cfg="a")], base) == 1   # 20% slower
+
+
+def test_none_matches_null_and_absent(files):
+    """A baseline pinning {knob: None} must accept both rows that write
+    null for the disabled knob and older rows that omit the key entirely —
+    but never a row where the knob is set."""
+    base = [{"name": "plain", "metric": "toks_per_sec",
+             "match": {"cfg": "a", "knob": None}, "value": 100.0,
+             "direction": "higher", "rel_tol": 0.1}]
+    assert files([_row(100.0, cfg="a", knob=None)], base) == 0
+    assert files([_row(100.0, cfg="a")], base) == 0
+    # knob set -> no matching row at all (missing, non-strict default ok)
+    assert files([_row(5.0, cfg="a", knob="on")], base) == 0
+    assert files([_row(5.0, cfg="a", knob="on")], base, "--strict") == 1
+
+
+def test_missing_row_strict_vs_default(files, capsys):
+    base = [{"name": "ghost", "metric": "toks_per_sec",
+             "match": {"cfg": "never"}, "value": 1.0}]
+    assert files([_row(1.0, cfg="a")], base) == 0
+    summary = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])["summary"]
+    assert summary["missing"] == ["ghost"]
+    assert files([_row(1.0, cfg="a")], base, "--strict") == 1
+
+
+def test_update_repins_to_newest(files):
+    """--update rewrites the baseline values from the newest matching rows;
+    the rewritten file then gates green against the same history."""
+    rows = [_row(100.0, cfg="a"), _row(42.0, cfg="a")]
+    base = [{"name": "a", "metric": "toks_per_sec", "match": {"cfg": "a"},
+             "value": 100.0, "direction": "higher", "rel_tol": 0.05}]
+    assert files(rows, base, "--update") == 0
+    doc = json.load(open(files.base))
+    assert doc["baselines"][0]["value"] == 42.0
+    assert bench_gate.main(["--history", files.hist,
+                            "--baseline", files.base]) == 0
+
+
+def test_real_repo_gate_is_green(capsys):
+    """The committed baselines must pass against the committed history."""
+    assert bench_gate.main([]) == 0
+    summary = json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])["summary"]
+    assert summary["ok"] == summary["baselines"] >= 3

@@ -1,0 +1,415 @@
+"""In-program training-health telemetry + compiled-executable introspection
+(ISSUE 8 tentpole).
+
+The contract under test, in three layers:
+
+- **layout pin**: ``segment_layout`` must equal ``ravel_pytree``'s dict
+  flatten order — the whole per-parameter attribution story rests on the
+  packed stats being literal slices of grad_comm's flat buffer;
+- **HLO gates**: health stats ride the SAME compiled step (zero extra
+  dispatches, the dp8 accumulation step keeps exactly ONE fused gradient
+  all-reduce and ONE scan while-loop), the program is bit-identical when
+  health is off, and the host sees at most ONE device->host fetch per
+  ``health_interval`` steps (pinned via the ``health.fetches`` counter);
+- **attribution**: a NaN injected into ONE parameter's gradient mid-run is
+  localized BY NAME in the health record, the health.jsonl sink, the
+  metrics registry (``health.nonfinite.<param>``), and the flight-recorder
+  dump the breach triggers.
+"""
+import json
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor
+from paddle_tpu.distributed.engine import TrainStepEngine
+from paddle_tpu.distributed.mesh import (HybridCommunicateGroup,
+                                         set_hybrid_communicate_group)
+from paddle_tpu.observability import (exec_introspect, flight_recorder,
+                                      health, metrics)
+
+_ALL_REDUCE_OP = re.compile(r"^\s*%?all-reduce[.\d]*\s*=", re.MULTILINE)
+
+
+@pytest.fixture(autouse=True)
+def _observability_cleanup():
+    yield
+    metrics.reset()
+    flight_recorder.disable()
+    health.reset()
+    exec_introspect.reset()
+
+
+def _tiny_engine(microbatches=1, model=None, loss_fn="mse"):
+    """Single-device engine: health numbers must not depend on the virtual
+    8-CPU mesh the conftest forces for sharding tests."""
+    set_hybrid_communicate_group(None)
+    hcg = HybridCommunicateGroup(dp_degree=1, devices=jax.devices()[:1])
+    paddle.seed(0)
+    if model is None:
+        model = paddle.nn.Sequential(paddle.nn.Linear(8, 8), paddle.nn.ReLU(),
+                                     paddle.nn.Linear(8, 8))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    eng = TrainStepEngine(model, opt,
+                          loss_fn=paddle.nn.MSELoss() if loss_fn == "mse"
+                          else None,
+                          hcg=hcg, microbatches=microbatches)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 8).astype("float32"))
+    y = jnp.asarray(rng.randn(8, 8).astype("float32"))
+    return eng, [x, y]
+
+
+def _packed(g2, w2, u2, nf):
+    return np.asarray(list(g2) + list(w2) + list(u2) + list(nf), np.float32)
+
+
+# ---------------------------------------------------------------- layout pin
+
+def test_segment_layout_matches_ravel_pytree():
+    """segment_layout's (name, offset, size) triples must index ravel_pytree's
+    flat vector exactly — sorted-by-name IS the dict flatten order. This is
+    the load-bearing equivalence: per-parameter stats computed from the grads
+    dict are per-slice stats of grad_comm's flat buffer."""
+    from jax.flatten_util import ravel_pytree
+
+    shapes = {"b.weight": (3, 2), "a.weight": (4,), "m.scale": (),
+              "c.bias": (2, 2, 2)}
+    tree = {}
+    for i, (n, s) in enumerate(sorted(shapes.items())):
+        size = int(np.prod(s, dtype=np.int64)) if s else 1
+        tree[n] = (jnp.arange(size, dtype=jnp.float32) + 1000.0 * i).reshape(s)
+    flat, _ = ravel_pytree(tree)
+    layout = health.segment_layout(shapes)
+    assert [n for n, _, _ in layout] == sorted(shapes)
+    off_total = 0
+    for name, off, size in layout:
+        assert off == off_total
+        np.testing.assert_array_equal(np.asarray(flat[off:off + size]),
+                                      np.asarray(tree[name]).ravel())
+        off_total += size
+    assert int(flat.size) == off_total
+
+
+# ------------------------------------------------- interval gating + fan-out
+
+def test_health_interval_gates_the_single_fetch():
+    """interval=2 over 5 steps -> records (and D2H fetches) at steps 2 and 4
+    ONLY: the `health.fetches` counter IS the at-most-one-transfer-per-
+    interval gate (each ingest does exactly one np.asarray of the packed
+    buffer)."""
+    eng, arrays = _tiny_engine()
+    eng.enable_health(interval=2)
+    metrics.enable()
+    fetches0 = monitor.stat("health.fetches").get()
+    for _ in range(5):
+        eng.step(*arrays)
+    recs = eng._health.recent()
+    assert [r["step"] for r in recs] == [2, 4]
+    assert monitor.stat("health.fetches").get() - fetches0 == 2
+    for r in recs:
+        assert r["nonfinite_count"] == 0 and r["grad_norm"] > 0
+        assert set(r["per_param"]) == set(eng._param_names)
+    # registry fan-out: norm histograms + last-step gauge
+    reg = metrics.active_registry()
+    hist = reg.histogram("train.grad_norm",
+                         boundaries=health.NORM_BUCKETS).snapshot()
+    assert hist["count"] == 2
+    assert reg.gauge("health.last_step").value == 4
+    eng.disable_health()
+
+
+def test_health_jsonl_sink_and_trace_summary():
+    """enable_health(path=...) writes health.jsonl records that
+    tools/trace_summary.py renders as health telemetry."""
+    import subprocess
+    import sys
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "health.jsonl")
+        eng, arrays = _tiny_engine()
+        eng.enable_health(interval=1, path=p)
+        for _ in range(3):
+            eng.step(*arrays)
+        eng.disable_health()  # closes the sink
+        recs = [json.loads(ln) for ln in open(p) if ln.strip()]
+        assert [r["step"] for r in recs] == [1, 2, 3]
+        assert all(r["event"] == "health" for r in recs)
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "tools",
+                          "trace_summary.py"), p],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr
+        summary = json.loads(out.stdout.strip().splitlines()[-1])["summary"]
+        assert summary["kind"] == "health_telemetry"
+        assert summary["records"] == 3 and summary["anomalies"] == 0
+
+
+# --------------------------------------------------------- NaN localization
+
+class _Probe(paddle.nn.Layer):
+    """Loss = mse + sum((tail.weight * s.mean())**2): the `s` batch column is
+    a dial that drives tail.weight's gradient (2 * s.mean()^2 * w) to inf
+    WITHOUT touching any other parameter's gradient — data-driven injection,
+    so the compiled step is traced once and the breach happens mid-run."""
+
+    def __init__(self):
+        super().__init__()
+        self.body = paddle.nn.Linear(8, 8)
+        self.tail = paddle.nn.Linear(8, 8)
+
+    def forward(self, x, y, s):
+        h = self.tail(self.body(x))
+        mse = ((h - y) ** 2).mean()
+        canary = ((self.tail.weight * s.mean()) ** 2).sum()
+        return mse + canary
+
+
+def test_nan_localization_names_exact_parameter(tmp_path):
+    """Inject inf into ONE parameter's grad mid-run (step 3 of a K=2
+    microbatch engine): the health record, health.jsonl, the registry
+    counter, and the flight dump must all name tail.weight — and no other
+    parameter may report a non-finite gradient."""
+    fr = flight_recorder.enable(str(tmp_path / "flight"))
+    metrics.enable()
+    eng, arrays = _tiny_engine(microbatches=2, model=_Probe(), loss_fn=None)
+    assert "tail.weight" in eng._param_names
+    eng.enable_health(interval=1, path=str(tmp_path / "health.jsonl"))
+
+    healthy = jnp.zeros((8,), jnp.float32)
+    poisoned = jnp.full((8,), 1e25, jnp.float32)
+    eng.step(*arrays, healthy)
+    eng.step(*arrays, healthy)
+    eng.step(*arrays, poisoned)
+
+    recs = eng._health.recent()
+    assert [r["step"] for r in recs] == [1, 2, 3]
+    assert recs[0]["nonfinite_count"] == 0 and recs[1]["nonfinite_count"] == 0
+    bad = recs[2]
+    assert bad["nonfinite_count"] > 0
+    assert bad["first_nonfinite_param"] == "tail.weight"
+    assert bad["first_nonfinite_segment"] == sorted(
+        eng._param_names).index("tail.weight")
+    for name, pp in bad["per_param"].items():
+        if name != "tail.weight":
+            assert pp["nonfinite"] == 0, f"{name} wrongly flagged"
+
+    # registry: per-parameter non-finite counter by NAME
+    reg = metrics.active_registry()
+    assert reg.counter("health.nonfinite.tail.weight").value == 1
+    assert reg.counter("health.nonfinite_steps").value == 1
+
+    # flight dump: reason names the parameter; state.json carries the
+    # attribution extra AND the health ring tail
+    dumps = [d for d in fr.dumps
+             if "health_nonfinite" in os.path.basename(d)]
+    assert len(dumps) == 1
+    assert "tail_weight" in os.path.basename(dumps[0])
+    state = json.load(open(os.path.join(dumps[0], "state.json")))
+    assert state["extra"]["param"] == "tail.weight"
+    assert state["extra"]["step"] == 3
+    tail = state["health_tail"]
+    assert tail and tail[-1]["first_nonfinite_param"] == "tail.weight"
+
+    # jsonl sink got the same record
+    eng.disable_health()
+    recs = [json.loads(ln) for ln in open(tmp_path / "health.jsonl")
+            if ln.strip()]
+    assert recs[-1]["step"] == 3
+    assert recs[-1]["first_nonfinite_param"] == "tail.weight"
+
+
+# ----------------------------------------------------------- spike detection
+
+def test_spike_detection_ema_and_dump_rate_limit(tmp_path):
+    """Synthetic packed buffers straight into the host half: a grad-norm jump
+    past spike_factor x EMA flags `spike`, bumps the counters, and dumps —
+    but at most _DUMP_LIMIT dumps per reason, so a diverged run cannot flood
+    the disk."""
+    fr = flight_recorder.enable(str(tmp_path))
+    m = health.TrainingHealthMonitor({"a": (2,), "b": (3,)},
+                                     interval=1, spike_factor=10.0)
+    spikes0 = monitor.stat("health.spikes").get()
+    rec = m.on_step(1, _packed([1, 1], [4, 4], [.01, .01], [0, 0]))
+    assert rec["spike"] is False  # no EMA yet -> first sample never spikes
+    assert rec["grad_norm"] == pytest.approx(np.sqrt(2.0))
+    assert rec["update_ratio"] == pytest.approx(np.sqrt(0.02) / np.sqrt(8.0))
+    # three escalating jumps: every one is > 10x the running EMA
+    for step, g2 in ((2, 1e10), (3, 1e14), (4, 1e18)):
+        rec = m.on_step(step, _packed([g2, g2], [4, 4], [.01, .01], [0, 0]))
+        assert rec["spike"] is True, f"step {step} not flagged"
+    assert monitor.stat("health.spikes").get() - spikes0 == 3
+    spike_dumps = [d for d in fr.dumps if "health_grad_spike" in d]
+    assert len(spike_dumps) == 2  # rate-limited below the spike count
+
+
+def test_nonfinite_attribution_from_packed_buffer():
+    """Host-half decode only: the first segment with a non-finite count wins
+    the attribution, and inf norms become None in the record (JSON-safe)."""
+    m = health.TrainingHealthMonitor({"a": (2,), "b": (3,)}, interval=3)
+    assert m.on_step(1, _packed([1, 1], [1, 1], [0, 0], [0, 0])) is None
+    assert m.on_step(2, _packed([1, 1], [1, 1], [0, 0], [0, 0])) is None
+    rec = m.on_step(3, _packed([np.inf, np.nan], [1, 1], [0, 0], [0, 3]))
+    assert rec is not None
+    assert rec["nonfinite_count"] == 3
+    assert rec["first_nonfinite_param"] == "b"
+    assert rec["first_nonfinite_segment"] == 1
+    assert rec["grad_norm"] is None  # inf -> JSON-safe None
+    assert rec["per_param"]["a"]["nonfinite"] == 0
+
+
+# ------------------------------------------------------------------ HLO gates
+
+def test_health_off_is_zero_cost():
+    """Off by default means OFF: the lowered step program with health
+    disabled is byte-identical before and after an enable/disable cycle, and
+    only the enabled program contains the is_finite scan."""
+    eng, arrays = _tiny_engine()
+
+    def lowered_text():
+        jf = eng._build(arrays)
+        return jf.lower(eng.params, eng.opt_state, jnp.float32(1e-3),
+                        jnp.int32(1), jax.random.key(0), *arrays).as_text()
+
+    off = lowered_text()
+    assert "is_finite" not in off
+    eng.enable_health(interval=1)
+    on = lowered_text()
+    assert "is_finite" in on
+    eng.disable_health()
+    assert lowered_text() == off
+
+
+def test_health_adds_exactly_one_output_no_extra_dispatch():
+    """The packed stats buffer is ONE extra f32 [4P] output of the SAME
+    program — output arity grows by exactly one, nothing else changes shape."""
+    eng, arrays = _tiny_engine()
+    lr, st, key = jnp.float32(1e-3), jnp.int32(1), jax.random.key(0)
+    out_off = jax.eval_shape(eng._build(arrays), eng.params, eng.opt_state,
+                             lr, st, key, *arrays)
+    eng.enable_health(interval=1)
+    out_on = jax.eval_shape(eng._build(arrays), eng.params, eng.opt_state,
+                            lr, st, key, *arrays)
+    assert len(out_on) == len(out_off) + 1
+    packed = out_on[-1]
+    assert packed.shape == (4 * len(eng._param_names),)
+    assert packed.dtype == jnp.float32
+    eng.disable_health()
+
+
+def test_accum_health_keeps_one_allreduce_one_dispatch():
+    """ISSUE 8 acceptance: a dp-mesh K-microbatch accumulated step WITH
+    health enabled still compiles to exactly one fused gradient all-reduce
+    and one accumulation scan while-loop — the stats are pure per-segment
+    reductions of the flat grad buffer, no collectives, no extra dispatch."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet, grad_comm
+
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+    layers = []
+    for _ in range(6):
+        layers += [paddle.nn.Linear(64, 64), paddle.nn.ReLU()]
+    net = paddle.nn.Sequential(*layers[:-1])
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    eng = fleet.distributed_engine(net, opt, loss_fn=paddle.nn.MSELoss())
+    eng.microbatches = 2
+    eng.enable_health(interval=1)
+    arrays = [jnp.asarray(np.random.RandomState(0).randn(64, 64)
+                          .astype("float32")),
+              jnp.asarray(np.random.RandomState(1).randn(64, 64)
+                          .astype("float32"))]  # 64 rows: divisible by dp8*K
+    jf = eng._build_accum(arrays, 2, "f32", False, grad_comm.chunk_size())
+    lowered = jf.lower(eng.params, eng.opt_state, jnp.float32(1e-3),
+                       jnp.int32(1), jax.random.key(0), *arrays)
+    txt = lowered.compile().as_text()
+    n_ar = len(_ALL_REDUCE_OP.findall(txt))
+    assert n_ar == 1, (
+        f"{n_ar} all-reduce ops with health enabled — the stats fn must not "
+        f"change the step's collective shape")
+    n_while = len(re.findall(r"\) while\(", txt))
+    assert n_while == 1, (
+        f"expected one accumulation scan while-loop, found {n_while}")
+    # and the packed buffer rides as the LAST output of that one program
+    out = jax.eval_shape(jf, eng.params, eng.opt_state, jnp.float32(1e-3),
+                         jnp.int32(1), jax.random.key(0), *arrays)
+    assert out[-1].shape == (4 * len(eng._param_names),)
+    eng.disable_health()
+
+
+# -------------------------------------------- compiled-executable introspect
+
+def test_train_exec_introspection():
+    """introspect_executables AOT-compiles the stashed step signature and
+    returns XLA memory_analysis numbers per label (real bytes on CPU too)."""
+    eng, arrays = _tiny_engine()
+    eng.step(*arrays)
+    stats = eng.introspect_executables()
+    assert "train.step" in stats
+    s = stats["train.step"]
+    assert s.get("peak_bytes", 0) > 0
+    assert s.get("output_size_in_bytes", 0) > 0
+    rows = exec_introspect.report_rows()
+    assert any(r[0] == "train.step" for r in rows)
+
+
+def test_exec_introspect_flag_feeds_registry():
+    """FLAGS_exec_introspect auto-captures at first dispatch and publishes
+    exec.<label>.* gauges to the active metrics registry."""
+    from paddle_tpu.core import flags as _flags
+
+    metrics.enable()
+    _flags.set_flags({"exec_introspect": True})
+    eng, arrays = _tiny_engine()
+    eng.step(*arrays)
+    assert "train.step" in exec_introspect.captured()
+    gauges = metrics.active_registry().snapshot()["gauges"]
+    assert any(k.startswith("exec.train.step.") for k in gauges)
+
+
+def test_serve_exec_introspection():
+    """The serving engine stashes prefill/decode signatures the same way."""
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+    from paddle_tpu.serving import ServingEngine
+
+    set_hybrid_communicate_group(None)
+    paddle.seed(0)
+    cfg = gpt_tiny()
+    model = GPTForPretraining(cfg)
+    srv = ServingEngine(model, slot_count=2, max_new_cap=8,
+                        steps_per_dispatch=2)
+    rng = np.random.RandomState(0)
+    srv.submit(rng.randint(0, cfg.vocab_size, 12).astype(np.int64),
+               max_new_tokens=6)
+    srv.run(max_steps=8)
+    stats = srv.introspect_executables()
+    assert any(k.startswith("serve.prefill_b") for k in stats)
+    assert any(k.startswith("serve.decode_") for k in stats)
+    assert all(v.get("peak_bytes", 0) > 0 for v in stats.values())
+
+
+# --------------------------------------------------- flight-recorder counters
+
+def test_flight_dump_counter_by_reason(tmp_path):
+    """Every flight dump bumps flight.dumps and flight.dumps.<reason> in the
+    active metrics registry (ops-side visibility into crash dumps)."""
+    metrics.enable()
+    fr = flight_recorder.enable(str(tmp_path))
+    fr.dump("manual_probe")
+    fr.dump("manual_probe")
+    fr.dump("other_reason")
+    reg = metrics.active_registry()
+    assert reg.counter("flight.dumps").value == 3
+    assert reg.counter("flight.dumps.manual_probe").value == 2
+    assert reg.counter("flight.dumps.other_reason").value == 1

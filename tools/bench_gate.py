@@ -1,0 +1,174 @@
+"""Perf-trajectory gate: newest BENCH_HISTORY.jsonl rows vs pinned baselines.
+
+CI-checkable regression guard for the numbers tools/bench.py appends to
+BENCH_HISTORY.jsonl. Each baseline in tools/bench_baseline.json pins one
+configuration (a `match` dict over the row's `extra` fields — None matches
+null/absent), the value it last achieved, a direction, and a relative
+tolerance. The gate finds the NEWEST matching history row (last in file
+order — the log is append-only) and fails with a nonzero exit when it
+regressed past tolerance:
+
+  python tools/bench_gate.py                       # gate, exit 1 on regress
+  python tools/bench_gate.py --strict              # missing rows also fail
+  python tools/bench_gate.py --update              # re-pin baselines to the
+                                                   # newest matching rows
+
+--history/--baseline override the default repo-root/tools paths (the
+self-test in tests/test_bench_gate.py runs the gate over synthetic files).
+Output: one table row per baseline + the tools-convention machine-readable
+{"summary": ...} JSON line.
+"""
+import argparse
+import json
+import os
+import sys
+
+import _bootstrap  # noqa: F401  (repo-root sys.path)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_HISTORY = os.path.join(_REPO, "BENCH_HISTORY.jsonl")
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "bench_baseline.json")
+
+
+def load_history(path):
+    rows = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                rows.append(json.loads(ln))
+    return rows
+
+
+def row_matches(row, metric, match):
+    """True when the history row carries this metric and every `match` key
+    agrees with the row's extra (None matches null AND absent — bench.py
+    writes null for disabled knobs, older rows may omit the key)."""
+    if row.get("metric") != metric:
+        return False
+    extra = row.get("extra") or {}
+    for k, want in (match or {}).items():
+        if extra.get(k) != want:
+            return False
+    return True
+
+
+def newest_match(rows, metric, match):
+    """Last matching row in file order — the log is append-only, so file
+    order IS recency (the ts strings are informational)."""
+    for row in reversed(rows):
+        if row_matches(row, metric, match):
+            return row
+    return None
+
+
+def check_one(base, rows):
+    """-> result dict with status in {ok, regressed, missing}."""
+    row = newest_match(rows, base["metric"], base.get("match"))
+    out = {
+        "name": base["name"],
+        "metric": base["metric"],
+        "baseline": base["value"],
+        "direction": base.get("direction", "higher"),
+        "rel_tol": base.get("rel_tol", 0.15),
+    }
+    if row is None:
+        out.update(status="missing", value=None, ratio=None)
+        return out
+    v = float(row["value"])
+    b = float(base["value"])
+    tol = float(out["rel_tol"])
+    ratio = v / b if b else None
+    if out["direction"] == "lower":       # smaller is better (latency)
+        ok = v <= b * (1.0 + tol)
+    else:                                 # larger is better (throughput)
+        ok = v >= b * (1.0 - tol)
+    out.update(status="ok" if ok else "regressed", value=v,
+               ratio=round(ratio, 4) if ratio is not None else None)
+    return out
+
+
+def _fmt_table(header, rows):
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+
+    def line(r):
+        return "  ".join(str(c).rjust(w) if i else str(c).ljust(w)
+                         for i, (c, w) in enumerate(zip(r, widths)))
+    print(line(header))
+    for r in rows:
+        print(line(r))
+
+
+def update_baselines(doc, rows):
+    """Re-pin every baseline's value to the newest matching history row
+    (entries with no matching row keep their pinned value)."""
+    updated = 0
+    for base in doc["baselines"]:
+        row = newest_match(rows, base["metric"], base.get("match"))
+        if row is not None:
+            base["value"] = float(row["value"])
+            updated += 1
+    return updated
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--history", default=DEFAULT_HISTORY,
+                    help="BENCH_HISTORY.jsonl path")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="pinned-baseline JSON path")
+    ap.add_argument("--update", action="store_true",
+                    help="re-pin baseline values to the newest matching "
+                         "rows and rewrite the baseline file")
+    ap.add_argument("--strict", action="store_true",
+                    help="a baseline with no matching history row fails the "
+                         "gate (default: reported, not fatal)")
+    args = ap.parse_args(argv)
+
+    with open(args.baseline) as f:
+        doc = json.load(f)
+    rows = load_history(args.history)
+
+    if args.update:
+        n = update_baselines(doc, rows)
+        with open(args.baseline, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"re-pinned {n}/{len(doc['baselines'])} baselines from "
+              f"{args.history}")
+        print(json.dumps({"summary": {
+            "kind": "bench_gate_update", "updated": n,
+            "baselines": len(doc["baselines"])}}))
+        return 0
+
+    results = [check_one(b, rows) for b in doc["baselines"]]
+    table = []
+    for r in results:
+        table.append([
+            r["name"], r["status"],
+            f"{r['value']:.1f}" if r["value"] is not None else "-",
+            f"{r['baseline']:.1f}", r["direction"],
+            f"{r['rel_tol']:.0%}",
+            f"{r['ratio']:.3f}" if r["ratio"] is not None else "-",
+        ])
+    _fmt_table(["baseline", "status", "newest", "pinned", "dir", "tol",
+                "ratio"], table)
+    regressed = [r for r in results if r["status"] == "regressed"]
+    missing = [r for r in results if r["status"] == "missing"]
+    failed = bool(regressed) or (args.strict and bool(missing))
+    summary = {
+        "kind": "bench_gate",
+        "baselines": len(results),
+        "ok": len([r for r in results if r["status"] == "ok"]),
+        "regressed": [r["name"] for r in regressed],
+        "missing": [r["name"] for r in missing],
+        "failed": failed,
+    }
+    print(json.dumps({"summary": summary}))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

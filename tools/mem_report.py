@@ -1,0 +1,122 @@
+"""Compiled-executable memory/cost report: what every train (and optionally
+serve) program will cost BEFORE a chip runs it.
+
+The CLI face of observability/exec_introspect.py: builds a tiny GPT, runs
+one train step per requested path (plain / K-microbatch accumulation /
+run_steps scan), asks the engines for `introspect_executables()` (XLA
+memory_analysis + cost_analysis per label), and prints the table — the
+argument/output/temp/alias/peak bytes that make the ROADMAP's ZeRO memory
+levers measurable ahead of implementation.
+
+Run:  JAX_PLATFORMS=cpu python tools/mem_report.py
+      [--batch 8] [--seq 128] [--microbatches 2] [--serve]
+
+--serve additionally drives one ServingEngine prefill+decode and reports
+those executables (serve.prefill_b*/serve.decode_*). Ends with the
+tools-convention machine-readable {"summary": ...} JSON line.
+"""
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path)
+
+import argparse
+import json
+
+
+def _fmt_table(header, rows):
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+
+    def line(r):
+        return "  ".join(str(c).rjust(w) if i else str(c).ljust(w)
+                         for i, (c, w) in enumerate(zip(r, widths)))
+    print(line(header))
+    for r in rows:
+        print(line(r))
+
+
+def _mb(v):
+    return f"{v / 1e6:.2f}" if isinstance(v, (int, float)) else "-"
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--microbatches", type=int, default=2,
+                    help="also report the K-microbatch accumulation step "
+                         "(1 disables)")
+    ap.add_argument("--serve", action="store_true",
+                    help="also drive one ServingEngine prefill+decode and "
+                         "report those executables")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.engine import TrainStepEngine
+    from paddle_tpu.distributed.mesh import (HybridCommunicateGroup,
+                                             set_hybrid_communicate_group)
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+    from paddle_tpu.observability import exec_introspect
+
+    cfg = gpt_tiny()
+    cfg.max_seq_len = max(args.seq, 64)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size,
+                      (args.batch, args.seq)).astype(np.int64)
+    labels = np.roll(ids, -1, 1)
+
+    def build(k):
+        set_hybrid_communicate_group(None)
+        # single-device mesh: memory numbers are per-device and must not be
+        # diluted by sharding the batch over the host's virtual devices
+        hcg = HybridCommunicateGroup(dp_degree=1, devices=jax.devices()[:1])
+        paddle.seed(0)
+        model = GPTForPretraining(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        return TrainStepEngine(model, opt, hcg=hcg, microbatches=k)
+
+    eng = build(1)
+    eng.step(ids, labels)
+    eng.introspect_executables()
+    if args.microbatches > 1:
+        eng_k = build(args.microbatches)
+        eng_k.step(ids, labels)
+        eng_k.introspect_executables()
+
+    if args.serve:
+        from paddle_tpu.serving import ServingEngine
+
+        set_hybrid_communicate_group(None)
+        paddle.seed(0)
+        serve_model = GPTForPretraining(cfg)
+        srv = ServingEngine(serve_model, slot_count=2,
+                            max_new_cap=8, steps_per_dispatch=2)
+        srv.submit(rng.randint(0, cfg.vocab_size, 12).astype(np.int64),
+                   max_new_tokens=6)
+        srv.run(max_steps=8)
+        srv.introspect_executables()
+
+    rows = [[label, f"{flops:.3e}" if flops is not None else "-",
+             _mb(arg), _mb(out), _mb(temp), _mb(alias), _mb(peak)]
+            for label, flops, arg, out, temp, alias, peak
+            in exec_introspect.report_rows()]
+    _fmt_table(["executable", "flops", "arg_MB", "out_MB", "temp_MB",
+                "alias_MB", "peak_MB"], rows)
+
+    stats = exec_introspect.captured()
+    summary = {
+        "kind": "mem_report",
+        "executables": sorted(stats),
+        "peak_bytes": {k: v.get("peak_bytes") for k, v in stats.items()},
+        "temp_bytes": {k: v.get("temp_size_in_bytes")
+                       for k, v in stats.items()},
+    }
+    print(json.dumps({"summary": summary}))
+
+
+if __name__ == "__main__":
+    main()

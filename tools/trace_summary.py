@@ -104,8 +104,11 @@ def summarize_steps(path):
         return {}
     serve_reqs = [r for r in recs if r.get("event") == "serve_request"]
     serve_steps = [r for r in recs if r.get("event") == "serve_step"]
+    health = [r for r in recs if r.get("event") == "health"]
     recs = [r for r in recs if r.get("event") not in ("serve_request",
-                                                      "serve_step")]
+                                                      "serve_step", "health")]
+    if not recs and health:
+        return _summarize_health(health)
     if not recs:
         return _summarize_serve(serve_reqs, serve_steps)
     n = len(recs)
@@ -145,7 +148,52 @@ def summarize_steps(path):
     if serve_reqs or serve_steps:
         summary["serve"] = _summarize_serve(serve_reqs, serve_steps,
                                             emit_json=False)
+    if health:
+        summary["health"] = _summarize_health(health, emit_json=False)
     print(json.dumps({"summary": summary}))
+    return summary
+
+
+def _summarize_health(health, emit_json=True):
+    """health.jsonl records (observability/health.py): grad-norm/update-ratio
+    percentile table + anomaly timeline naming the offending parameter."""
+
+    def col(k):
+        return [r[k] for r in health if isinstance(r.get(k), (int, float))]
+
+    pcts = _pctl_table([
+        ("grad_norm", "l2", col("grad_norm")),
+        ("weight_norm", "l2", col("weight_norm")),
+        ("update_ratio", "frac", col("update_ratio")),
+    ])
+    anomalies = [r for r in health
+                 if r.get("nonfinite_count") or r.get("spike")]
+    if anomalies:
+        rows = []
+        for r in anomalies:
+            kind = ("nonfinite" if r.get("nonfinite_count") else "spike")
+            gn = r.get("grad_norm")
+            rows.append([r.get("step"), kind,
+                         r.get("first_nonfinite_param") or "-",
+                         r.get("nonfinite_count") or 0,
+                         f"{gn:.4g}" if gn is not None else "inf/nan"])
+        print("anomaly timeline:")
+        _fmt_table(["step", "kind", "param", "nonfinite", "grad_norm"], rows)
+    nf = [r for r in health if r.get("nonfinite_count")]
+    summary = {
+        "kind": "health_telemetry",
+        "records": len(health),
+        "first_step": health[0].get("step"),
+        "last_step": health[-1].get("step"),
+        "anomalies": len(anomalies),
+        "nonfinite_steps": len(nf),
+        "spike_steps": len([r for r in health if r.get("spike")]),
+        "first_nonfinite_param": (nf[0].get("first_nonfinite_param")
+                                  if nf else None),
+        "percentiles": pcts,
+    }
+    if emit_json:
+        print(json.dumps({"summary": summary}))
     return summary
 
 
