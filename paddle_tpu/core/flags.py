@@ -122,6 +122,17 @@ define_flag("grad_comm_chunk", 1024,
             "each chunk ships one f32 absmax scale with its int8 payload "
             "(smaller chunks track gradient dynamic range better, larger "
             "chunks amortize scale overhead)")
+define_flag("zero_update", False,
+            "ZeRO-style cross-replica weight-update sharding on the fused "
+            "gradient path (arXiv:2004.13336, distributed/grad_comm.py "
+            "make_zero_accum_step): the post-scan reduction decomposes into "
+            "reduce-scatter -> shard-local clip+optimizer update -> "
+            "all-gather of updated weights, and the optimizer state lives "
+            "as flat f32 1/N shards per data replica. Pure data-parallel "
+            "meshes with uniform elementwise optimizer rules only; "
+            "incompatible configs warn once and run the replicated (or "
+            "GSPMD) update. Also per-engine: TrainStepEngine("
+            "zero_update=True)")
 define_flag("health_monitor", False,
             "compute training-health statistics (global + per-parameter "
             "grad/weight norms, update-to-weight ratios, non-finite "

@@ -151,7 +151,7 @@ class TrainStepEngine:
                  hcg: Optional[HybridCommunicateGroup] = None, strategy=None,
                  input_specs: Optional[List[P]] = None, donate: bool = True,
                  num_model_inputs: Optional[int] = None,
-                 microbatches: int = 1):
+                 microbatches: int = 1, zero_update: bool = False):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -208,6 +208,14 @@ class TrainStepEngine:
         self._accum_fns = {}
         self._grad_residual = None     # error-feedback state, lazily built
         self._gspmd_warned = False
+        # ZeRO weight-update sharding (grad_comm.make_zero_accum_step):
+        # requested per-engine or via FLAGS_zero_update; the optimizer state
+        # converts one-way into flat f32 1/N shards on the first sharded
+        # step (self.opt_state becomes None; _gather_zero_opt reconstructs)
+        self.zero_update = bool(zero_update)
+        self._zero_opt = None          # tuple of flat [n_pad] f32 slot shards
+        self._zero_warned = False
+        self._zero_reason = "unset"    # cached fallback reason (None = ok)
         self._batch_shardings = None   # resolved lazily from the first batch
         self._pending_h2d = None       # (h2d_ms, depth) staged by prefetch()
         self.prefetcher = None         # last DevicePrefetcher built by prefetch()
@@ -605,10 +613,11 @@ class TrainStepEngine:
                    for s in self.param_specs.values())
 
     def _grad_comm_config(self):
-        """(k, dtype, use_residual, chunk) resolved from the engine +
-        flags. The accumulation path engages when K > 1 or a low-precision
-        gradient collective is requested; otherwise step() stays on the
-        original (bit-identical) fused step."""
+        """(k, dtype, use_residual, chunk, zero) resolved from the engine +
+        flags. The accumulation path engages when K > 1, a low-precision
+        gradient collective is requested, or the ZeRO weight-update
+        sharding is on; otherwise step() stays on the original
+        (bit-identical) fused step."""
         k = max(1, int(self.microbatches))
         dtype = _gc.comm_dtype()
         if not self._dp_pure():
@@ -623,7 +632,210 @@ class TrainStepEngine:
             dtype = "f32"
         use_residual = (dtype != "f32" and self._dp_pure()
                         and _gc.error_feedback())
-        return k, dtype, use_residual, _gc.chunk_size()
+        return k, dtype, use_residual, _gc.chunk_size(), self._zero_on()
+
+    # ---- ZeRO weight-update sharding (arXiv:2004.13336) ----
+    # optimizer rules whose update is a uniform elementwise function of
+    # (param, grad, state) — safe to run on an arbitrary contiguous slice
+    # of the flat buffer. lamb/lars need per-parameter trust ratios.
+    _ZERO_RULES = frozenset({"sgd", "momentum", "adam", "adamw", "adamax",
+                             "adagrad", "adadelta", "rmsprop"})
+
+    def _zero_requested(self) -> bool:
+        return bool(self.zero_update or _flags.flag("zero_update"))
+
+    def _zero_fallback_reason(self) -> Optional[str]:
+        """None when the weight-update sharding can engage; otherwise a
+        human-readable reason. Cached — every input is engine-lifetime
+        static (mesh topology, optimizer rule/kwargs/clip, offload)."""
+        if self._zero_reason != "unset":
+            return self._zero_reason
+        from ..nn.clip import ClipGradByGlobalNorm, ClipGradByValue
+
+        opt = self.optimizer
+        reason = None
+        if not self._dp_pure():
+            reason = (f"topology {self.hcg.topology()} is not pure "
+                      "data-parallel; running the GSPMD accumulation path")
+        elif not self._param_names:
+            reason = "no trainable parameters"
+        elif opt._rule not in self._ZERO_RULES:
+            reason = (f"optimizer rule {opt._rule!r} is not uniform-"
+                      "elementwise (needs per-parameter norms)")
+        elif any(opt._rule_kwargs(self._state_refs[n]) !=
+                 opt._rule_kwargs(self._state_refs[self._param_names[0]])
+                 for n in self._param_names):
+            reason = ("per-parameter rule kwargs differ (e.g. weight-decay "
+                      "exclusions): the flat shard update needs ONE "
+                      "uniform rule")
+        elif not (opt._grad_clip is None or isinstance(
+                opt._grad_clip, (ClipGradByGlobalNorm, ClipGradByValue))):
+            reason = (f"grad clip {type(opt._grad_clip).__name__} needs "
+                      "per-parameter norms")
+        elif self._opt_memory_kind:
+            reason = ("optimizer offload keeps the replicated host-"
+                      "resident state")
+        self._zero_reason = reason
+        return reason
+
+    def _zero_on(self) -> bool:
+        """True when this step runs the ZeRO weight-update-sharded program
+        (requested AND compatible). Incompatible configs warn ONCE and run
+        the replicated (or GSPMD) update."""
+        if not self._zero_requested():
+            return False
+        reason = self._zero_fallback_reason()
+        if reason is None:
+            return True
+        if not self._zero_warned:
+            import warnings
+
+            warnings.warn("zero_update requested but falling back to the "
+                          f"replicated update: {reason}")
+            self._zero_warned = True
+        return False
+
+    def _zero_n_slots(self) -> int:
+        """Optimizer-state slots per parameter for the active rule (0 for
+        sgd, 1 for momentum/adagrad, 2 for adam/adamw, ...)."""
+        return len(opt_funct.init_state(self.optimizer._rule,
+                                        np.zeros((1,), np.float32)))
+
+    def _zero_layout(self):
+        """(n, n_pad, shard, nrep) of the flat parameter/optimizer-state
+        vector: n grad elements padded to a multiple of nrep*chunk, each
+        replica owning the contiguous [r*shard, (r+1)*shard) slice."""
+        nrep = _gc.replica_count(self.mesh, self._batch_axes())
+        n = self._n_grad_elems()
+        n_pad = _gc.zero_pad_elems(n, nrep, _gc.chunk_size())
+        return n, n_pad, n_pad // max(1, nrep), nrep
+
+    def _make_flat_update(self):
+        """The ZeRO twin of opt_funct.make_tree_update: ONE uniform
+        elementwise rule over flat f32 [shard] vectors. Uniformity of the
+        per-param kwargs is guaranteed upstream by _zero_fallback_reason;
+        pad slots (zero param, zero grad, zero state) stay exactly zero
+        through every whitelisted rule."""
+        rule = opt_funct.RULES[self.optimizer._rule]
+        needs_step = self.optimizer._rule in opt_funct._NEEDS_STEP
+        kw0 = dict(self.optimizer._rule_kwargs(
+            self._state_refs[self._param_names[0]]))
+
+        def flat_update(p_shard, g_shard, opt_shards, lr, step_i):
+            kw = dict(kw0)
+            if needs_step:
+                kw["step"] = step_i
+            new_p, new_state = rule(p_shard, g_shard, tuple(opt_shards),
+                                    lr=lr, **kw)
+            return new_p, tuple(new_state)
+
+        return flat_update
+
+    def _ensure_zero_opt(self):
+        """Lazy ONE-WAY conversion of the replicated opt-state dict into
+        flat f32 1/N shards (segment_layout / sorted-name order, zero pad
+        tail). After the first sharded step self.opt_state is None — the
+        flat shards ARE the state; _gather_zero_opt() reconstructs the
+        dict form for checkpoints/debugging."""
+        n, n_pad, shard, nrep = self._zero_layout()
+        if self._zero_opt is not None:
+            if self._zero_opt and self._zero_opt[0].shape != (n_pad,):
+                raise ValueError(
+                    "the flat sharded optimizer state was built for a "
+                    f"different layout ({self._zero_opt[0].shape[0]} != "
+                    f"{n_pad} elements) — FLAGS_grad_comm_chunk or the "
+                    "mesh changed after the first ZeRO step; rebuild the "
+                    "engine")
+            return self._zero_opt
+        sh = self._residual_sharding()
+        names = sorted(self._param_names)
+        flats = []
+        for j in range(self._zero_n_slots()):
+            buf = np.zeros((n_pad,), np.float32)
+            off = 0
+            for nm in names:
+                size = int(np.prod(self._state_refs[nm].shape) or 1)
+                buf[off:off + size] = np.asarray(
+                    self.opt_state[nm][j], np.float32).reshape(-1)
+                off += size
+            flats.append(jax.device_put(buf, sh))
+        self._zero_opt = tuple(flats)
+        self.opt_state = None  # one-way: the flat shards are the state now
+        return self._zero_opt
+
+    def _gather_zero_opt(self):
+        """Reconstruct the replicated {name: (slot, ...)} opt-state dict
+        from the flat shards (host gather; checkpoint/debug convenience).
+        Returns self.opt_state unchanged when ZeRO never engaged."""
+        if self._zero_opt is None:
+            return self.opt_state
+        flats = [np.asarray(f) for f in self._zero_opt]
+        out = {}
+        off = 0
+        for nm in sorted(self._param_names):
+            shape = tuple(self._state_refs[nm].shape)
+            size = int(np.prod(shape) or 1)
+            out[nm] = tuple(f[off:off + size].reshape(shape)
+                            for f in flats)
+            off += size
+        return out
+
+    def zero_memory_model(self):
+        """Analytic optimizer-state memory of the ZeRO path: replicated
+        bytes per device vs flat-shard bytes per device (~1/N). The
+        measured counterpart is introspect_executables() argument bytes."""
+        n, n_pad, shard, nrep = self._zero_layout()
+        slots = self._zero_n_slots()
+        return {
+            "opt_slots": slots,
+            "replicas": nrep,
+            "n_grad_elems": n,
+            "n_pad": n_pad,
+            "replicated_opt_bytes": slots * n * 4,
+            "sharded_opt_bytes_per_device": slots * shard * 4,
+        }
+
+    def _build_zero_accum(self, batch_avals, k, dtype, use_residual, chunk):
+        """Jit the ZeRO weight-update-sharded accumulation step: same scan
+        as _build_accum, but the post-scan reduction is reduce-scatter ->
+        shard-local clip+update -> all-gather of updated weights, and the
+        optimizer state enters/leaves as flat [n_pad] f32 slot buffers
+        sharded 1/N over the data axes."""
+        compute = self._build_compute_loss()
+        health = self._health
+        param_templates = {
+            n: jax.ShapeDtypeStruct(tuple(self._state_refs[n].shape),
+                                    self.params[n].dtype)
+            for n in self._param_names}
+        step = _gc.make_zero_accum_step(
+            compute_loss=compute, flat_update=self._make_flat_update(),
+            clip=self.optimizer._grad_clip, mesh=self.mesh,
+            batch_axes=self._batch_axes(), k=k, dtype=dtype, chunk=chunk,
+            use_residual=use_residual, param_templates=param_templates,
+            health_partial=(health.make_sharded_stats()
+                            if health is not None else None))
+        batch_shardings = self._shardings_for(batch_avals)
+        param_shardings = {n: NamedSharding(self.mesh, s)
+                           for n, s in self.param_specs.items()}
+        shard_sh = self._residual_sharding()   # 1-D [n_pad] split over d0
+        opt_shardings = tuple(shard_sh for _ in range(self._zero_n_slots()))
+        scalar = NamedSharding(self.mesh, P())
+        in_sh = (param_shardings, opt_shardings)
+        out_sh = (scalar, param_shardings, opt_shardings)
+        donate = (0, 1)
+        if use_residual:
+            res_sh = self._residual_sharding()
+            in_sh += (res_sh,)
+            out_sh += (res_sh,)
+            donate = (0, 1, 2)
+        if health is not None:
+            out_sh += (scalar,)  # packed health buffer rides LAST
+        return jax.jit(
+            step,
+            in_shardings=in_sh + (scalar, scalar, scalar) + batch_shardings,
+            out_shardings=out_sh,
+            donate_argnums=donate if self._donate else (),
+        )
 
     def _n_grad_elems(self) -> int:
         return int(sum(int(np.prod(self._state_refs[n].shape) or 1)
@@ -700,7 +912,7 @@ class TrainStepEngine:
         """One optimizer step over K in-program microbatches: the grad_comm
         twin of step() (same plumbing contract: telemetry, compile
         accounting, donation-safe rebind of params/opt state)."""
-        k, dtype, use_residual, chunk = self._grad_comm_config()
+        k, dtype, use_residual, chunk, zero = self._grad_comm_config()
         self._check_batch(arrays)
         nrep = _gc.replica_count(self.mesh, self._batch_axes())
         for a in arrays:
@@ -712,9 +924,10 @@ class TrainStepEngine:
         from ..core import autotune
         autotune.set_step(self._step_count + 1)
         health_on = self._health is not None
-        cache_key = (k, dtype, use_residual, chunk, health_on)
+        cache_key = (k, dtype, use_residual, chunk, health_on, zero)
         if cache_key not in self._accum_fns:
-            self._accum_fns[cache_key] = self._build_accum(
+            build = self._build_zero_accum if zero else self._build_accum
+            self._accum_fns[cache_key] = build(
                 arrays, k, dtype, use_residual, chunk)
         fn = self._accum_fns[cache_key]
         staged, self._pending_h2d = self._pending_h2d, None
@@ -736,18 +949,22 @@ class TrainStepEngine:
         mreg = _obs_metrics.active_registry()
         n0 = _jit_cache_size(fn)
         p0 = _compile_cache.entries() if n0 == 0 else -1
-        label = f"train.accum_k{k}_{dtype}" + ("_res" if use_residual else "")
+        label = (f"train.zero_k{k}_{dtype}" if zero
+                 else f"train.accum_k{k}_{dtype}") + \
+            ("_res" if use_residual else "")
         t0 = time.perf_counter()
         try:
+            opt_in = (self._ensure_zero_opt() if zero
+                      else self._opt_to_hbm(self.opt_state))
             if use_residual:
-                call_args = (self.params, self._opt_to_hbm(self.opt_state),
+                call_args = (self.params, opt_in,
                              self._ensure_residual(), lr,
                              jnp.int32(self._step_count), sub) + tuple(arrays)
                 self._stash_exec(label, fn, call_args)
                 outs = fn(*call_args)
                 loss, self.params, new_opt, self._grad_residual = outs[:4]
             else:
-                call_args = (self.params, self._opt_to_hbm(self.opt_state),
+                call_args = (self.params, opt_in,
                              lr, jnp.int32(self._step_count),
                              sub) + tuple(arrays)
                 self._stash_exec(label, fn, call_args)
@@ -763,8 +980,16 @@ class TrainStepEngine:
             raise
         t1 = time.perf_counter()
         compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0, p0)
-        comm_bytes = (_gc.payload_bytes(self._n_grad_elems(), dtype, chunk)
-                      if nrep > 1 else 0)
+        if zero:
+            rs_b, ag_b = ((0, 0) if nrep <= 1 else _gc.zero_payload_bytes(
+                self._n_grad_elems(), nrep, dtype, chunk,
+                4 * len(self._param_names) if health_on else 0))
+            comm_bytes = rs_b + ag_b
+            _gc.RS_BYTES.increase(rs_b)
+            _gc.AG_BYTES.increase(ag_b)
+        else:
+            comm_bytes = (_gc.payload_bytes(self._n_grad_elems(), dtype,
+                                            chunk) if nrep > 1 else 0)
         _gc.STEPS.increase()
         _gc.MICROBATCHES.increase(k)
         _gc.BYTES_MOVED.increase(comm_bytes)
@@ -774,8 +999,12 @@ class TrainStepEngine:
         if tr.enabled:
             tr.record_complete("engine.accum_step", t0, t1,
                                {"step": self._step_count, "compiled": compiled,
-                                "microbatches": k, "grad_comm_dtype": dtype})
-        self.opt_state = self._opt_to_home(new_opt)
+                                "microbatches": k, "grad_comm_dtype": dtype,
+                                "zero_update": zero})
+        if zero:
+            self._zero_opt = tuple(new_opt)
+        else:
+            self.opt_state = self._opt_to_home(new_opt)
         if hbuf is not None:
             self._health.on_step(self._step_count, hbuf)
         self.last_loss = Tensor(loss)
@@ -787,7 +1016,8 @@ class TrainStepEngine:
                 tokens=tokens, loss=float(jax.device_get(loss)),
                 h2d_ms=h2d_ms, prefetch_depth=prefetch_depth,
                 microbatches=k, grad_comm_dtype=dtype,
-                grad_comm_bytes=comm_bytes)
+                grad_comm_bytes=comm_bytes,
+                extra=({"zero_update": True} if zero else None))
         if fr is not None or mreg is not None:
             self._obs_step_tail(fr, mreg, rec, t0, t1, h2d_ms, compiled, loss)
         return self.last_loss
@@ -869,8 +1099,22 @@ class TrainStepEngine:
         Health telemetry (enable_health) does NOT ride this path: the scan
         yields only losses, so per-step health stats would multiply the
         program's outputs by K. Use step()/_accum_step for monitored runs.
+
+        zero_update does NOT compose either — the scan carries the
+        replicated opt-state dict while the ZeRO path owns flat 1/N
+        shards; silently running the replicated update here would diverge
+        from step() semantics, so an active zero_update raises instead
+        (pinned by tests/test_zero_update.py).
         """
         arrays = self._to_arrays(batch)
+        if self._zero_on():
+            raise ValueError(
+                "run_steps (the fused K-step scan lane) does not compose "
+                "with zero_update: the scan carries the replicated "
+                "opt-state dict while the ZeRO path owns flat 1/N shards "
+                "per data replica. Use step() (one dispatch per optimizer "
+                "step, one reduce-scatter + one all-gather) or disable "
+                "zero_update for this engine.")
         fixed = steps is not None
         self._check_batch(arrays, lead_axes=0 if fixed else 1)
         k = steps if fixed else arrays[0].shape[0]
@@ -962,10 +1206,12 @@ class TrainStepEngine:
 
     def step(self, *batch) -> Tensor:
         arrays = self._to_arrays(batch)
-        if self.microbatches > 1 or _gc.comm_dtype() != "f32":
+        if (self.microbatches > 1 or _gc.comm_dtype() != "f32"
+                or self._zero_on()):
             # grad_comm path: K in-program microbatches + one deferred fused
-            # gradient all-reduce (and/or low-precision collectives). The
-            # default (K=1, f32) stays below on the original step program —
+            # gradient all-reduce (and/or low-precision collectives, and/or
+            # the ZeRO weight-update sharding). The default (K=1, f32, no
+            # zero_update) stays below on the original step program —
             # bit-identical to pre-grad_comm behavior.
             return self._accum_step(arrays)
         self._check_batch(arrays)

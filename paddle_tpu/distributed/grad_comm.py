@@ -60,6 +60,10 @@ STEPS = _monitor.stat("grad_comm.steps")
 MICROBATCHES = _monitor.stat("grad_comm.microbatches")
 BYTES_MOVED = _monitor.stat("grad_comm.bytes_moved")
 LOWP_STEPS = _monitor.stat("grad_comm.lowp_steps")
+# ZeRO weight-update-sharded steps: analytic per-device bytes handed to the
+# reduce-scatter (gradients down) and the all-gather (updated weights back)
+RS_BYTES = _monitor.stat("grad_comm.rs_bytes")
+AG_BYTES = _monitor.stat("grad_comm.ag_bytes")
 
 _CANON = {"f32": "f32", "float32": "f32", "fp32": "f32",
           "bf16": "bf16", "bfloat16": "bf16", "int8": "int8"}
@@ -96,6 +100,36 @@ def payload_bytes(n_grads: int, dtype: str, chunk: int) -> int:
         return (n_grads + 1) * 2
     n_chunks = -(-n_grads // chunk)
     return n_chunks * chunk * 1 + (n_chunks + 1) * 4
+
+
+def zero_pad_elems(n_grads: int, nrep: int, chunk: int) -> int:
+    """Padded flat-buffer length for the ZeRO update path: a multiple of
+    nrep*chunk, so every replica owns an equal contiguous shard AND the int8
+    chunk grid tiles it exactly. Always leaves at least ONE spare pad slot —
+    the f32/bf16 paths ride the loss scalar through the reduce-scatter in
+    slot n_grads (the bit-exactness trick vs the replicated psum).
+    dtype-independent on purpose — the sharded optimizer state keeps ONE
+    shape across f32/bf16/int8 steps."""
+    unit = max(1, nrep) * max(1, chunk)
+    return -(-(n_grads + 1) // unit) * unit
+
+
+def zero_payload_bytes(n_grads: int, nrep: int, dtype: str, chunk: int,
+                       health_elems: int = 0) -> Tuple[int, int]:
+    """(reduce_scatter_bytes, all_gather_bytes) per device per step for the
+    ZeRO update path — the local contribution handed to each collective,
+    the payload_bytes convention. The all-gather slab carries the updated
+    f32 weight shard + the loss scalar + the health partials (when on)."""
+    n_pad = zero_pad_elems(n_grads, nrep, chunk)
+    shard = n_pad // max(1, nrep)
+    if dtype == "f32":
+        rs = n_pad * 4
+    elif dtype == "bf16":
+        rs = n_pad * 2
+    else:  # int8 payload + one f32 scale per chunk, both via all-to-all
+        rs = n_pad * 1 + (n_pad // chunk) * 4
+    ag = (shard + 1 + health_elems) * 4
+    return rs, ag
 
 
 # ---------------------------------------------------------------- quantize --
@@ -289,6 +323,257 @@ def make_accum_step(*, compute_loss: Callable, update: Callable, clip,
         if aux is None:
             return loss, new_params, new_opt
         return loss, new_params, new_opt, aux
+
+    return step
+
+
+def _clip_shard(g, clip, axes):
+    """Grad clip on the local 1/N shard of the flat mean-grad buffer.
+    ByValue is elementwise; ByGlobalNorm needs the global sum of squares —
+    ONE scalar psum (4 bytes on the wire), not a full-buffer all-reduce
+    (note: the cross-replica summation order differs from the replicated
+    per-parameter clip, so globally-clipped runs match to fp tolerance, not
+    bit-exactly). ByNorm needs per-parameter norms and is rejected upstream
+    (the engine falls back to the replicated update)."""
+    from ..nn.clip import ClipGradByGlobalNorm, ClipGradByValue
+
+    if clip is None:
+        return g
+    if isinstance(clip, ClipGradByGlobalNorm):
+        sq = jnp.sum(jnp.square(g))
+        if axes:
+            sq = jax.lax.psum(sq, axes)
+        gn = jnp.sqrt(sq)
+        return g * (clip.clip_norm / jnp.maximum(gn, clip.clip_norm))
+    if isinstance(clip, ClipGradByValue):
+        return jnp.clip(g, clip.min, clip.max)
+    raise ValueError(f"unsupported grad clip for the ZeRO update: {clip!r}")
+
+
+def make_zero_accum_step(*, compute_loss: Callable, flat_update: Callable,
+                         clip, mesh: Mesh, batch_axes: Sequence[str], k: int,
+                         dtype: str, chunk: int, use_residual: bool,
+                         param_templates: Dict[str, jax.ShapeDtypeStruct],
+                         health_partial: Optional[Callable] = None):
+    """ZeRO-style cross-replica weight-update sharding (arXiv:2004.13336).
+
+    Same accumulation scan as make_accum_step, but the post-scan reduction
+    decomposes into **reduce-scatter -> shard-local clip + optimizer update
+    -> all-gather of updated weights**: each data replica owns the
+    contiguous 1/nrep shard of the flat f32 parameter/optimizer-state
+    vector at offset r*shard (r = row-major replica index over
+    ``batch_axes``, shard = n_pad/nrep — the same sorted-name segment order
+    as observability.health.segment_layout, pinned by tests), runs the
+    update on only its shard, and the updated weight shards gather back to
+    the replicated layout the model expects. Per optimizer step the
+    compiled HLO carries exactly ONE reduce-scatter and ONE all-gather
+    independent of K (f32/bf16; int8 replaces the reduce-scatter with two
+    all-to-alls carrying the EQuARX chunk-scaled payload + f32 scales) and
+    ZERO full-buffer all-reduces.
+
+    flat_update(p_shard, g_shard, opt_shards, lr, step_i) ->
+    (new_p_shard, new_opt_shards): ONE uniform elementwise rule over f32
+    [shard] vectors (engine._make_flat_update guarantees uniformity). The
+    loss scalar and the health partials ride the all-gather slab:
+    health_partial (health.make_sharded_stats) sees the PRE-clip gradient
+    shard plus a segment-id shard, and its [4P] partial sums are summed
+    over replicas in-program — the packed buffer the host decodes is
+    layout-identical to the replicated path's.
+
+    Error feedback (use_residual, bf16/int8 only) carries the local
+    quantization error of the SCATTERED payload: the residual is computed
+    against the local pre-collective buffer, exactly as the replicated
+    low-precision path does.
+
+    Returns step(params, opt_shards[, residual], lr, step_i, key, *batch)
+    -> (loss, new_params, new_opt_shards[, new_residual][, health])."""
+    if use_residual and dtype == "f32":
+        raise ValueError("error feedback needs a low-precision dtype")
+    ride_loss = dtype != "int8"   # f32/bf16: loss rides the scatter buffer
+    axes = tuple(a for a in batch_axes if mesh.shape[a] > 1)
+    d0 = _spec_axes(axes)
+    nrep = replica_count(mesh, axes)
+    names = sorted(param_templates)
+    shapes = {nm: tuple(param_templates[nm].shape) for nm in names}
+    dtypes = {nm: param_templates[nm].dtype for nm in names}
+    sizes = [int(np.prod(shapes[nm]) or 1) for nm in names]
+    offs = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+    n = int(offs[-1])
+    n_pad = zero_pad_elems(n, nrep, chunk)
+    shard = n_pad // nrep
+    # flat-index -> parameter-ordinal map for the sharded health partials;
+    # pad slots land in segment P and are dropped by make_sharded_stats
+    seg_ids = None
+    if health_partial is not None:
+        seg_ids = np.full((n_pad,), len(names), np.int32)
+        for i, (o, s) in enumerate(zip(offs[:-1], sizes)):
+            seg_ids[o:o + s] = i
+
+    def _flatten(params):
+        return jnp.concatenate(
+            [params[nm].astype(jnp.float32).reshape(-1) for nm in names])
+
+    def _unflatten(flat):
+        return {nm: flat[offs[i]:offs[i + 1]].reshape(shapes[nm])
+                .astype(dtypes[nm]) for i, nm in enumerate(names)}
+
+    def _scatter(buf):
+        """The ONE gradient reduce-scatter: [n_pad] local partial-mean
+        grads -> ([shard] reduced MEAN grad shard, new residual | None).
+        With no collective axes this degrades to the identity plus the
+        quantize/dequantize roundtrip, mirroring _reduce_local."""
+        if dtype == "f32":
+            g = (jax.lax.psum_scatter(buf, axes, scatter_dimension=0,
+                                      tiled=True) if axes else buf)
+            return g / nrep, None
+        if dtype == "bf16":
+            b = buf.astype(jnp.bfloat16)
+            res = ((buf - b.astype(jnp.float32))[:n]
+                   if use_residual else None)
+            g = (jax.lax.psum_scatter(b, axes, scatter_dimension=0,
+                                      tiled=True) if axes else b)
+            return g.astype(jnp.float32) / nrep, res
+        # int8: quantized reduce-scatter built from all-to-all — replica i
+        # keeps only the chunk rows of its own shard, every peer's scales
+        # survive the trip (EQuARX block scaling), dequant-sum in f32
+        q, scale = _quantize_int8(buf, chunk)      # [n_pad/chunk, chunk]
+        res = ((buf - _dequantize_int8(q, scale, n_pad))[:n]
+               if use_residual else None)
+        qs = q.reshape((nrep, shard // chunk, chunk))
+        ss = scale.reshape((nrep, shard // chunk))
+        if axes:
+            qs = jax.lax.all_to_all(qs, axes, split_axis=0, concat_axis=0)
+            ss = jax.lax.all_to_all(ss, axes, split_axis=0, concat_axis=0)
+        g = jnp.sum(qs.astype(jnp.float32) * ss[..., None], axis=0)
+        return g.reshape(shard) / nrep, res
+
+    def _local(params, lr, step_i, key, residual, opt, *lbatch):
+        mbs = tuple(b.reshape((k, b.shape[0] // k) + b.shape[1:])
+                    for b in lbatch)
+        zero_flat, _ = ravel_pytree(
+            {nm: jnp.zeros(v.shape, jnp.float32)
+             for nm, v in params.items()})
+        shard_key = key
+        for ax in axes:  # decorrelate dropout streams across data replicas
+            shard_key = jax.random.fold_in(shard_key,
+                                           jax.lax.axis_index(ax))
+
+        def body(carry, mb):
+            acc, i = carry
+            sub = jax.random.fold_in(shard_key, i)
+            loss, g = jax.value_and_grad(
+                lambda ps: compute_loss(ps, sub, *mb))(params)
+            gflat, _ = ravel_pytree(g)
+            return (acc + gflat.astype(jnp.float32), i + jnp.int32(1)), loss
+
+        (acc, _), losses = jax.lax.scan(body, (zero_flat, jnp.int32(0)), mbs)
+        flat = acc / k
+        if residual is not None:
+            flat = flat + residual[0]
+        buf = jnp.pad(flat, (0, n_pad - n))
+        if ride_loss:
+            # f32/bf16: the local mean loss rides the reduce-scatter in pad
+            # slot n (zero_pad_elems guarantees the spare) — the SAME
+            # reduction+divide the grads take, so the final loss is
+            # bit-identical to the replicated path's psum'd loss. int8 must
+            # not quantize it; there it rides the gather slab in f32.
+            buf = buf.at[n].set(losses.mean())
+        g_shard, new_res = _scatter(buf)
+        # own-shard offset: row-major replica index over the batch axes —
+        # the order psum_scatter/all_gather tile in (pinned by tests)
+        r = jnp.int32(0)
+        for ax in axes:
+            r = r * jnp.int32(mesh.shape[ax]) + jax.lax.axis_index(ax)
+        if ride_loss:
+            # extract the reduced loss from whichever replica owns slot n
+            # (zero elsewhere: the gather-slab sum stays exact) and zero it
+            # out of the grad shard before clip/update
+            loss_mask = (r * jnp.int32(shard)
+                         + jnp.arange(shard, dtype=jnp.int32)) == n
+            loss_part = jnp.sum(jnp.where(loss_mask, g_shard, 0.0))
+            g_shard = jnp.where(loss_mask, 0.0, g_shard)
+        else:
+            loss_part = losses.mean()
+        p_shard = jax.lax.dynamic_slice(
+            jnp.pad(_flatten(params), (0, n_pad - n)),
+            (r * jnp.int32(shard),), (shard,))
+        raw_g = g_shard                     # pre-clip: health attribution
+        g_shard = _clip_shard(g_shard, clip, axes)
+        new_p_shard, new_opt = flat_update(p_shard, g_shard, tuple(opt),
+                                           lr, step_i)
+        extras = [loss_part[None]]
+        if health_partial is not None:
+            ids_shard = jax.lax.dynamic_slice(
+                jnp.asarray(seg_ids), (r * jnp.int32(shard),), (shard,))
+            extras.append(health_partial(raw_g, p_shard, new_p_shard,
+                                         ids_shard))
+        # ONE all-gather: [updated weight shard | loss | health partials],
+        # decoded by reshaping to one row per replica. ride_loss rows carry
+        # the already-reduced loss on the owner replica and exact zeros
+        # elsewhere (summing is exact); int8 rows carry local mean losses.
+        slab = jnp.concatenate([new_p_shard] + extras)
+        if axes:
+            rows = jax.lax.all_gather(slab, axes, tiled=True).reshape(
+                (nrep, slab.shape[0]))
+            new_flat = rows[:, :shard].reshape(-1)[:n]
+            loss = jnp.sum(rows[:, shard])
+            if not ride_loss:
+                loss = loss / nrep
+            hbuf = (jnp.sum(rows[:, shard + 1:], axis=0)
+                    if health_partial is not None else None)
+        else:
+            new_flat = new_p_shard[:n]
+            loss = loss_part
+            hbuf = extras[1] if health_partial is not None else None
+        outs = (new_flat, loss, tuple(new_opt))
+        if use_residual:
+            outs += (new_res[None],)
+        if health_partial is not None:
+            outs += (hbuf,)
+        return outs
+
+    def _region_call(params, lr, step_i, key, residual, opt, batch):
+        if not axes:
+            return _local(params, lr, step_i, key, residual, opt, *batch)
+        in_specs = ((P(), P(), P(), P())
+                    + ((P(d0),) if use_residual else ())
+                    + (P(d0),)                 # flat opt-state shards
+                    + tuple(P(d0) for _ in batch))
+        out_specs = (P(), P(), P(d0))
+        if use_residual:
+            out_specs += (P(d0),)
+        if health_partial is not None:
+            out_specs += (P(),)
+
+        def region(params, lr, step_i, key, *rest):
+            if use_residual:
+                return _local(params, lr, step_i, key, rest[0], rest[1],
+                              *rest[2:])
+            return _local(params, lr, step_i, key, None, rest[0], *rest[1:])
+
+        fn = shard_map(region, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+        if use_residual:
+            return fn(params, lr, step_i, key, residual, tuple(opt), *batch)
+        return fn(params, lr, step_i, key, tuple(opt), *batch)
+
+    if use_residual:
+        def step(params, opt_shards, residual, lr, step_i, key, *batch):
+            outs = _region_call(params, lr, step_i, key, residual,
+                                opt_shards, batch)
+            ret = (outs[1], _unflatten(outs[0]), outs[2], outs[3])
+            if health_partial is not None:
+                ret += (outs[4],)
+            return ret
+
+        return step
+
+    def step(params, opt_shards, lr, step_i, key, *batch):
+        outs = _region_call(params, lr, step_i, key, None, opt_shards, batch)
+        ret = (outs[1], _unflatten(outs[0]), outs[2])
+        if health_partial is not None:
+            ret += (outs[3],)
+        return ret
 
     return step
 

@@ -134,6 +134,34 @@ class TrainingHealthMonitor:
 
         return packed_stats
 
+    def make_sharded_stats(self) -> Callable:
+        """ZeRO twin of make_packed_stats for the weight-update-sharded step
+        (distributed/grad_comm.make_zero_accum_step): (g_shard, p_shard,
+        new_p_shard, seg_ids) -> f32 [4P] PARTIAL sums over one 1/N shard of
+        the flat buffer. seg_ids maps each flat slot to its parameter
+        ordinal in segment_layout order; pad slots carry ordinal P and fall
+        into a dropped overflow segment. The partials ride the step's weight
+        all-gather and are summed over replicas in-program, so the packed
+        buffer the host decodes is layout-identical to the replicated
+        path's — on_step/_ingest cannot tell the two apart."""
+        p_count = len(self.segments)
+
+        def sharded_stats(g_shard, p_shard, new_p_shard, seg_ids):
+            import jax
+            import jax.numpy as jnp
+
+            d = new_p_shard - p_shard
+
+            def seg(x):
+                return jax.ops.segment_sum(
+                    x, seg_ids, num_segments=p_count + 1)[:p_count]
+
+            return jnp.concatenate([
+                seg(g_shard * g_shard), seg(p_shard * p_shard), seg(d * d),
+                seg((~jnp.isfinite(g_shard)).astype(jnp.float32))])
+
+        return sharded_stats
+
     # ---- host half --------------------------------------------------------
 
     def wants(self, step: int) -> bool:

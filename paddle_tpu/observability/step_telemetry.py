@@ -216,6 +216,10 @@ class StepTelemetry:
                             "grad_comm_microbatches"),
                            ("grad_comm.bytes_moved", "grad_comm_bytes_moved"),
                            ("grad_comm.lowp_steps", "grad_comm_lowp_steps"),
+                           # ZeRO weight-update sharding: bytes handed to
+                           # the gradient reduce-scatter / weight all-gather
+                           ("grad_comm.rs_bytes", "grad_comm_rs_bytes"),
+                           ("grad_comm.ag_bytes", "grad_comm_ag_bytes"),
                            ("dispatch.calls", "dispatch_calls"),
                            ("dispatch.nan_inf_hits", "nan_inf_hits"),
                            # decode/serving executables (models/gpt.py LRU

@@ -20,6 +20,18 @@ Run:  JAX_PLATFORMS=cpu python tools/grad_comm_bench.py
       [--batch 32] [--seq 128] [--steps 8] [--ks 1,2,4]
 
 Prints one JSON line per K plus a wire-bytes table and a summary line.
+
+--zero mode (ISSUE 9): replicated fused-all-reduce update vs the ZeRO
+weight-update-sharded step (reduce-scatter -> shard-local update ->
+all-gather) on a dp4/dp8 virtual CPU mesh. Per dp degree: steps/s for
+both variants, per-device optimizer-state bytes (engine.zero_memory_model
+analytic + exec_introspect argument bytes measured), compiled temp/peak
+bytes, and whether the final losses are bit-equal (the f32 contract
+tests/test_zero_update.py pins). --history appends BENCH_HISTORY.jsonl
+rows that tools/bench_gate.py gates against tools/bench_baseline.json:
+
+  JAX_PLATFORMS=cpu python tools/grad_comm_bench.py --zero \\
+      [--dp 4,8] [--k 2] [--steps 8] [--history]
 """
 from __future__ import annotations
 
@@ -27,7 +39,114 @@ import _bootstrap  # noqa: F401  (checkout-hermetic sys.path)
 
 import argparse
 import json
+import os
 import time
+
+
+def _force_host_devices(n=8):
+    """The dp meshes in --zero mode need virtual CPU devices; must run
+    before the first jax import (the conftest.py idiom)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def _history_path():
+    return os.environ.get("PADDLE_TPU_BENCH_HISTORY") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_HISTORY.jsonl")
+
+
+def _append_history(payload):
+    """bench.py's append idiom: provenance row with a UTC timestamp; a
+    read-only checkout must not break the measurement."""
+    import copy
+    import datetime
+
+    try:
+        entry = copy.deepcopy(payload)
+        entry["extra"]["ts"] = datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds")
+        with open(_history_path(), "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError:
+        pass
+
+
+def _run_zero(args):
+    _force_host_devices(max(int(d) for d in args.dp.split(",")))
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.engine import TrainStepEngine
+    from paddle_tpu.distributed.mesh import (HybridCommunicateGroup,
+                                             set_hybrid_communicate_group)
+
+    k = args.k
+    rng = np.random.RandomState(0)
+    xs = rng.randn(args.batch, 256).astype(np.float32)
+    ys = rng.randint(0, 4, (args.batch,)).astype(np.int64)
+
+    def build(dp, zero):
+        set_hybrid_communicate_group(None)
+        hcg = HybridCommunicateGroup(dp_degree=dp, devices=jax.devices()[:dp])
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(256, 256),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(256, 4))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=net.parameters())
+        return TrainStepEngine(net, opt,
+                               loss_fn=paddle.nn.CrossEntropyLoss(),
+                               hcg=hcg, microbatches=k, zero_update=zero)
+
+    def measure(eng):
+        x, y = paddle.to_tensor(xs), paddle.to_tensor(ys)
+        float(eng.step(x, y).item())  # warm: compile outside the window
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            loss = eng.step(x, y)
+        final = float(loss.item())
+        dt = time.perf_counter() - t0
+        # force: the capture cache is keyed by label, and both dp degrees
+        # compile the same "train.zero_k2_f32" label
+        stats, = eng.introspect_executables(force=True).values()
+        return round(args.steps / dt, 3), final, stats
+
+    for dp in (int(d) for d in args.dp.split(",")):
+        er, ez = build(dp, False), build(dp, True)
+        sps_r, loss_r, st_r = measure(er)
+        sps_z, loss_z, st_z = measure(ez)
+        mm = ez.zero_memory_model()
+        row = {
+            "dp": dp, "microbatches": k, "effective_batch": args.batch,
+            "n_grad_elems": mm["n_grad_elems"],
+            "steps_per_sec_replicated": sps_r,
+            "steps_per_sec_sharded": sps_z,
+            "opt_bytes_replicated": mm["replicated_opt_bytes"],
+            "opt_bytes_sharded_per_device":
+                mm["sharded_opt_bytes_per_device"],
+            "arg_bytes_replicated": st_r.get("argument_size_in_bytes"),
+            "arg_bytes_sharded": st_z.get("argument_size_in_bytes"),
+            "temp_bytes_replicated": st_r.get("temp_size_in_bytes"),
+            "temp_bytes_sharded": st_z.get("temp_size_in_bytes"),
+            "peak_bytes_replicated": st_r.get("peak_bytes"),
+            "peak_bytes_sharded": st_z.get("peak_bytes"),
+            "final_loss_bit_equal": loss_r == loss_z,
+        }
+        print(json.dumps(row))
+        if args.history:
+            extra = {"platform": jax.default_backend(), **row}
+            _append_history({
+                "metric": "grad_comm_zero_steps_per_sec",
+                "value": sps_z, "unit": "steps/s", "vs_baseline": None,
+                "extra": dict(extra)})
+            _append_history({
+                "metric": "grad_comm_zero_opt_bytes_per_device",
+                "value": mm["sharded_opt_bytes_per_device"],
+                "unit": "bytes", "vs_baseline": None, "extra": dict(extra)})
 
 
 def main():
@@ -37,7 +156,18 @@ def main():
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--ks", default="1,2,4")
+    ap.add_argument("--zero", action="store_true",
+                    help="replicated vs ZeRO weight-update-sharded step "
+                         "on dp virtual-device meshes")
+    ap.add_argument("--dp", default="4,8",
+                    help="--zero mode: comma list of dp degrees")
+    ap.add_argument("--k", type=int, default=2,
+                    help="--zero mode: microbatches per step")
+    ap.add_argument("--history", action="store_true",
+                    help="--zero mode: append BENCH_HISTORY.jsonl rows")
     args = ap.parse_args()
+    if args.zero:
+        return _run_zero(args)
     ks = [int(k) for k in args.ks.split(",")]
 
     import jax

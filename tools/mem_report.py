@@ -9,11 +9,16 @@ argument/output/temp/alias/peak bytes that make the ROADMAP's ZeRO memory
 levers measurable ahead of implementation.
 
 Run:  JAX_PLATFORMS=cpu python tools/mem_report.py
-      [--batch 8] [--seq 128] [--microbatches 2] [--serve]
+      [--batch 8] [--seq 128] [--microbatches 2] [--serve] [--zero]
 
 --serve additionally drives one ServingEngine prefill+decode and reports
-those executables (serve.prefill_b*/serve.decode_*). Ends with the
-tools-convention machine-readable {"summary": ...} JSON line.
+those executables (serve.prefill_b*/serve.decode_*). --zero drives the
+replicated K-microbatch step AND the ZeRO weight-update-sharded step
+(ISSUE 9) on a dp8 virtual mesh and adds the replicated-vs-sharded
+optimizer-state column: per-device opt bytes from engine.zero_memory_model
+(analytic) cross-checked against the executables' argument-byte delta
+(measured). Ends with the tools-convention machine-readable
+{"summary": ...} JSON line.
 """
 from __future__ import annotations
 
@@ -21,6 +26,7 @@ import _bootstrap  # noqa: F401  (checkout-hermetic sys.path)
 
 import argparse
 import json
+import os
 
 
 def _fmt_table(header, rows):
@@ -49,7 +55,18 @@ def main():
     ap.add_argument("--serve", action="store_true",
                     help="also drive one ServingEngine prefill+decode and "
                          "report those executables")
+    ap.add_argument("--zero", action="store_true",
+                    help="also report the ZeRO weight-update-sharded step "
+                         "on a dp8 virtual mesh: replicated vs sharded "
+                         "optimizer-state bytes per device")
     args = ap.parse_args()
+
+    if args.zero:
+        # dp8 virtual devices; must precede the first jax import
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xf:
+            os.environ["XLA_FLAGS"] = (
+                xf + " --xla_force_host_platform_device_count=8").strip()
 
     import jax
     import numpy as np
@@ -87,6 +104,81 @@ def main():
         eng_k.step(ids, labels)
         eng_k.introspect_executables()
 
+    zero_summary = None
+    if args.zero:
+        k = max(2, args.microbatches)
+
+        def build_dp8(zero):
+            # MLP, not the GPT: the ZeRO weight-update sharding needs pure
+            # dp with fully-replicated params, and the GPT's dist_attr
+            # mp specs keep it on the GSPMD path by design
+            set_hybrid_communicate_group(None)
+            hcg = HybridCommunicateGroup(dp_degree=8,
+                                         devices=jax.devices()[:8])
+            paddle.seed(0)
+            model = paddle.nn.Sequential(paddle.nn.Linear(256, 256),
+                                         paddle.nn.ReLU(),
+                                         paddle.nn.Linear(256, 4))
+            opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                         parameters=model.parameters())
+            return TrainStepEngine(model, opt,
+                                   loss_fn=paddle.nn.CrossEntropyLoss(),
+                                   hcg=hcg, microbatches=k,
+                                   zero_update=zero)
+
+        # batch must divide replicas * microbatches
+        bz = -(-args.batch // (8 * k)) * (8 * k)
+        xz = rng.randn(bz, 256).astype(np.float32)
+        yz = rng.randint(0, 4, (bz,)).astype(np.int64)
+        def aot_stats(eng):
+            # stats_for, NOT introspect_executables: the replicated dp8
+            # MLP shares the "train.accum_k*_f32" label with the GPT
+            # engine above, and the capture registry dedups by label
+            (label, (fn, avals)), = eng._exec_stash.items()
+            return exec_introspect.stats_for(label,
+                                             fn.lower(*avals).compile())
+
+        er = build_dp8(False)
+        er.step(xz, yz)
+        st_r = aot_stats(er)
+        ez = build_dp8(True)
+        ez.step(xz, yz)
+        st_z = aot_stats(ez)
+        mm = ez.zero_memory_model()
+
+        def ratio(a, b):
+            return (f"{a / b:.3f}" if isinstance(a, int)
+                    and isinstance(b, int) and b else "-")
+
+        print(f"\nZeRO weight-update sharding (dp8, K={k}) — per-device "
+              "bytes, replicated vs sharded update:")
+        _fmt_table(
+            ["quantity", "replicated_MB", "sharded_MB", "ratio"],
+            [[f"opt state, adamw x{mm['opt_slots']} slots (analytic)",
+              _mb(mm["replicated_opt_bytes"]),
+              _mb(mm["sharded_opt_bytes_per_device"]),
+              ratio(mm["sharded_opt_bytes_per_device"],
+                    mm["replicated_opt_bytes"])],
+             ["executable arguments (measured)",
+              _mb(st_r.get("argument_size_in_bytes")),
+              _mb(st_z.get("argument_size_in_bytes")),
+              ratio(st_z.get("argument_size_in_bytes"),
+                    st_r.get("argument_size_in_bytes"))],
+             ["executable peak (measured)",
+              _mb(st_r.get("peak_bytes")), _mb(st_z.get("peak_bytes")),
+              ratio(st_z.get("peak_bytes"), st_r.get("peak_bytes"))]])
+        zero_summary = {
+            "replicas": mm["replicas"], "microbatches": k,
+            "replicated_opt_bytes": mm["replicated_opt_bytes"],
+            "sharded_opt_bytes_per_device":
+                mm["sharded_opt_bytes_per_device"],
+            "arg_bytes_replicated": st_r.get("argument_size_in_bytes"),
+            "arg_bytes_sharded": st_z.get("argument_size_in_bytes"),
+            "peak_bytes_replicated": st_r.get("peak_bytes"),
+            "peak_bytes_sharded": st_z.get("peak_bytes"),
+        }
+        print()
+
     if args.serve:
         from paddle_tpu.serving import ServingEngine
 
@@ -115,6 +207,8 @@ def main():
         "temp_bytes": {k: v.get("temp_size_in_bytes")
                        for k, v in stats.items()},
     }
+    if zero_summary is not None:
+        summary["zero"] = zero_summary
     print(json.dumps({"summary": summary}))
 
 

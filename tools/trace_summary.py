@@ -145,6 +145,23 @@ def summarize_steps(path):
         "nan_inf_hits": last.get("nan_inf_hits"),
         "percentiles": pcts,
     }
+    # ZeRO weight-update sharding collectives (distributed/grad_comm.py):
+    # the records carry running byte totals for the gradient reduce-scatter
+    # and weight all-gather; the delta across the trace is what THIS run
+    # put on the wire (K-independent per optimizer step)
+    rs, ag = col("grad_comm_rs_bytes"), col("grad_comm_ag_bytes")
+    if rs or ag:
+        zsteps = sum(1 for r in recs if r.get("zero_update"))
+        summary["grad_comm_rs_bytes"] = rs[-1] if rs else None
+        summary["grad_comm_ag_bytes"] = ag[-1] if ag else None
+        summary["grad_comm_rs_bytes_delta"] = (rs[-1] - rs[0]) if rs else None
+        summary["grad_comm_ag_bytes_delta"] = (ag[-1] - ag[0]) if ag else None
+        summary["zero_update_steps"] = zsteps
+        print(f"grad_comm: rs_bytes={summary['grad_comm_rs_bytes']} "
+              f"(+{summary['grad_comm_rs_bytes_delta']}) "
+              f"ag_bytes={summary['grad_comm_ag_bytes']} "
+              f"(+{summary['grad_comm_ag_bytes_delta']}) "
+              f"zero_update_steps={zsteps}")
     if serve_reqs or serve_steps:
         summary["serve"] = _summarize_serve(serve_reqs, serve_steps,
                                             emit_json=False)
