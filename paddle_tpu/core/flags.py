@@ -158,6 +158,31 @@ define_flag("exec_introspect", False,
             "exec.<label>.* + tools/mem_report.py rows). Costs ONE extra "
             "AOT compile per program (the jit cache is not reused by the "
             "introspection lowering) — a diagnostic flag, off by default")
+define_flag("ckpt_dir", os.environ.get("PADDLE_TPU_CKPT_DIR", ""),
+            "elastic checkpoint directory (also settable as "
+            "PADDLE_TPU_CKPT_DIR). Non-empty: every TrainStepEngine attaches "
+            "a distributed/elastic.py CheckpointManager at construction — "
+            "async crash-safe snapshots every FLAGS_ckpt_interval steps, "
+            "newest-valid restore with corruption fallback. Empty = off "
+            "(engine.enable_checkpointing() still works per-engine)")
+define_flag("ckpt_interval", 100,
+            "optimizer steps between automatic checkpoints when "
+            "FLAGS_ckpt_dir / enable_checkpointing is active. An interval "
+            "that fires while the previous async save is still writing "
+            "skips (ckpt.skipped counter) rather than stalling the step")
+define_flag("ckpt_keep", 3,
+            "retention: committed checkpoints beyond the newest N are "
+            "GC'd after each successful save (ckpt.gc_removed counter)")
+define_flag("ckpt_async", True,
+            "overlap checkpoint serialization with training: capture is a "
+            "device-to-host copy on the step thread, hashing/fsync/commit "
+            "run on a background writer behind a depth-1 queue. False = "
+            "synchronous saves (step blocks until the commit rename)")
+define_flag("ckpt_rollback", False,
+            "opt-in auto-rollback: a non-finite training loss triggers a "
+            "flight-recorder dump and restores the newest valid checkpoint "
+            "in place of the diverged state (ckpt.rollbacks counter). "
+            "Costs one loss fetch per step while enabled")
 define_flag("compile_cache_dir", os.environ.get("PADDLE_TPU_COMPILE_CACHE", ""),
             "persistent XLA compilation cache directory (also settable as "
             "PADDLE_TPU_COMPILE_CACHE). Empty = off (bit-identical default); "

@@ -21,6 +21,10 @@ from .mesh import (  # noqa: F401
     set_hybrid_communicate_group,
 )
 from .engine import TrainStepEngine, parallelize  # noqa: F401
+from . import elastic  # noqa: F401
+from .elastic import (  # noqa: F401
+    CheckpointCorrupt, CheckpointManager, restore_latest, verify_checkpoint,
+)
 from .prefetcher import DevicePrefetcher  # noqa: F401
 from .store import FileStore, TCPStore  # noqa: F401
 from . import auto_parallel  # noqa: F401

@@ -22,6 +22,22 @@ from typing import Dict
 import numpy as np
 
 
+def _atomic_save(path, arr):
+    """np.save via temp-file + rename: a mid-write kill leaves either the
+    previous file or nothing — never a torn .npy a loader half-reads.
+    Returns the sha256 of the committed bytes (the manifest checksum)."""
+    from ..elastic import file_sha256
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.save(f, arr)
+        f.flush()
+        os.fsync(f.fileno())
+    digest = file_sha256(tmp)
+    os.replace(tmp, path)
+    return digest
+
+
 def _index_to_ranges(index, shape):
     """Normalize an addressable-shard index (tuple of slices) to start/stop."""
     out = []
@@ -48,8 +64,9 @@ def save_distributed_checkpoint(engine, dirname, extra_state: Dict = None,
         shards = getattr(arr, "addressable_shards", None)
         if shards is None:
             fn = f"{kind}__{key}__full.npy".replace("/", "_")
-            np.save(os.path.join(dirname, fn), np.asarray(arr))
+            digest = _atomic_save(os.path.join(dirname, fn), np.asarray(arr))
             entry["shards"].append({"file": fn,
+                                    "checksum": digest,
                                     "ranges": _index_to_ranges(
                                         tuple(slice(0, d) for d in np.shape(arr)),
                                         np.shape(arr))})
@@ -61,26 +78,48 @@ def save_distributed_checkpoint(engine, dirname, extra_state: Dict = None,
                     continue
                 seen.add(ranges)
                 fn = f"{kind}__{key}__r{rank}s{k}.npy".replace("/", "_")
-                np.save(os.path.join(dirname, fn), np.asarray(sh.data))
+                digest = _atomic_save(os.path.join(dirname, fn),
+                                      np.asarray(sh.data))
                 entry["shards"].append({"file": fn,
+                                        "checksum": digest,
                                         "ranges": [list(r) for r in ranges]})
         manifest[kind][key] = entry
 
     for n, arr in engine.params.items():
         dump("params", n, arr)
-    for n, states in engine.opt_state.items():
+    # a ZeRO engine's opt_state is None (flat 1/N shards are the state);
+    # this legacy dict-form saver gathers it back — elastic.py is the
+    # format that keeps the flat layout on disk
+    opt_state = (engine._gather_zero_opt()
+                 if getattr(engine, "opt_state", None) is None
+                 and hasattr(engine, "_gather_zero_opt")
+                 else engine.opt_state)
+    for n, states in opt_state.items():
         for ci, comp in enumerate(states):
             dump("opt", n, comp, comp=ci)
 
-    with open(os.path.join(dirname, f"manifest.rank{rank}.json"), "w") as f:
+    # manifest LAST, committed by rename: its presence implies every shard
+    # file above is complete and hashed
+    mpath = os.path.join(dirname, f"manifest.rank{rank}.json")
+    tmp = f"{mpath}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, mpath)
 
 
 def _merge_entry(dirname, entry):
+    from ..elastic import CheckpointCorrupt, file_sha256
+
     full = np.zeros(entry["shape"], dtype=np.dtype(entry["dtype"]))
     for sh in entry["shards"]:
+        path = os.path.join(dirname, sh["file"])
+        # pre-checksum checkpoints load unverified; hashed ones must match
+        if sh.get("checksum") and file_sha256(path) != sh["checksum"]:
+            raise CheckpointCorrupt(f"{path}: checksum mismatch")
         idx = tuple(slice(a, b) for a, b in sh["ranges"])
-        full[idx] = np.load(os.path.join(dirname, sh["file"]))
+        full[idx] = np.load(path)
     return full
 
 
@@ -123,9 +162,13 @@ def load_distributed_checkpoint(engine, dirname):
             state["params"][n],
             NamedSharding(engine.mesh, engine.param_specs[n]))
     new_opt = {}
-    for n, states in engine.opt_state.items():
+    for n in engine.params:
+        if engine.opt_state is not None:
+            n_slots = len(engine.opt_state[n])
+        else:  # ZeRO engine: slot count comes from the manifest keys
+            n_slots = sum(1 for k in state["opt"] if k.startswith(f"{n}."))
         comps = []
-        for ci in range(len(states)):
+        for ci in range(n_slots):
             key = f"{n}.{ci}"
             if key not in state["opt"]:
                 raise KeyError(f"checkpoint missing optimizer state {key}")
@@ -134,6 +177,8 @@ def load_distributed_checkpoint(engine, dirname):
                 NamedSharding(engine.mesh, engine.opt_specs[n])))
         new_opt[n] = tuple(comps)
     engine.opt_state = new_opt
+    if getattr(engine, "_zero_opt", None) is not None:
+        engine._zero_opt = None  # dict restore: _ensure_zero_opt reconverts
     engine._step_count = state["step"]
     return engine
 

@@ -41,6 +41,7 @@ from ..observability import metrics as _obs_metrics
 from ..observability import tracer as _obs_tracer
 from ..observability.step_telemetry import StepTelemetry
 from ..optimizer import functional as opt_funct
+from . import elastic as _elastic
 from . import grad_comm as _gc
 from . import prefetcher as _pf
 from .mesh import HybridCommunicateGroup, get_hybrid_communicate_group
@@ -242,6 +243,11 @@ class TrainStepEngine:
         # label -> (jitted fn, abstract args): what introspect_executables()
         # AOT-lowers for memory/cost analysis without holding live buffers
         self._exec_stash = {}
+        # FLAGS_ckpt_dir / PADDLE_TPU_CKPT_DIR: elastic checkpointing
+        # (distributed/elastic.py) — async crash-safe snapshots every
+        # FLAGS_ckpt_interval steps. None (the default) costs one flag read
+        # here and one None-check per step
+        self._ckpt = _elastic.from_flags()
 
     def _n_params(self) -> int:
         return int(sum(
@@ -300,6 +306,45 @@ class TrainStepEngine:
             self._health.close()
         self._health = None
         self._invalidate_step_fns()
+
+    # ---- elastic checkpointing (distributed/elastic.py) ----
+    def enable_checkpointing(self, dirname: str, interval: Optional[int] = None,
+                             keep: Optional[int] = None,
+                             async_save: Optional[bool] = None,
+                             rollback_on_nonfinite: Optional[bool] = None,
+                             resume: bool = False):
+        """Attach a CheckpointManager: async crash-safe snapshots of
+        params / optimizer state (including ZeRO flat shards) / RNG / step
+        every `interval` optimizer steps, committed by atomic rename with
+        checksummed manifests, newest `keep` retained. ``resume=True``
+        restores the newest valid checkpoint from `dirname` right now (a
+        preempted job's restart line), silently starting fresh when the
+        directory holds none. Unset kwargs fall back to the FLAGS_ckpt_*
+        defaults. Does NOT touch the compiled step (the snapshot is pure
+        host-side capture), so no recompile."""
+        if self._ckpt is not None:
+            self._ckpt.close()
+        self._ckpt = _elastic.CheckpointManager(
+            dirname,
+            interval=(_flags.flag("ckpt_interval") if interval is None
+                      else interval),
+            keep=_flags.flag("ckpt_keep") if keep is None else keep,
+            async_save=(_flags.flag("ckpt_async") if async_save is None
+                        else async_save),
+            rollback_on_nonfinite=(
+                _flags.flag("ckpt_rollback") if rollback_on_nonfinite is None
+                else rollback_on_nonfinite))
+        if resume:
+            try:
+                self._ckpt.restore(self)
+            except FileNotFoundError:
+                pass  # nothing saved yet: a fresh run, not an error
+        return self._ckpt
+
+    def disable_checkpointing(self) -> None:
+        if self._ckpt is not None:
+            self._ckpt.close()
+        self._ckpt = None
 
     def _invalidate_step_fns(self) -> None:
         """Drop cached step executables + their introspection stash — the
@@ -1020,6 +1065,8 @@ class TrainStepEngine:
                 extra=({"zero_update": True} if zero else None))
         if fr is not None or mreg is not None:
             self._obs_step_tail(fr, mreg, rec, t0, t1, h2d_ms, compiled, loss)
+        if self._ckpt is not None:
+            self._ckpt.on_step(self, self._step_count, loss)
         return self.last_loss
 
     # ---- shared step plumbing ----
@@ -1182,6 +1229,10 @@ class TrainStepEngine:
         if fr is not None or mreg is not None:
             self._obs_step_tail(fr, mreg, rec, t0, t1, h2d_ms, compiled,
                                 losses[-1], hist="train.run_steps_ms")
+        if self._ckpt is not None:
+            # K fused steps = one hook call; window makes an interval that
+            # fell INSIDE the scan still checkpoint at the scan boundary
+            self._ckpt.on_step(self, self._step_count, losses[-1], window=k)
         return Tensor(losses)
 
     def warm_scan(self, *batch, steps: int):
@@ -1282,6 +1333,8 @@ class TrainStepEngine:
                 h2d_ms=h2d_ms, prefetch_depth=prefetch_depth)
         if fr is not None or mreg is not None:
             self._obs_step_tail(fr, mreg, rec, t0, t1, h2d_ms, compiled, loss)
+        if self._ckpt is not None:
+            self._ckpt.on_step(self, self._step_count, loss)
         return self.last_loss
 
     train_batch = step

@@ -10,12 +10,60 @@ from __future__ import annotations
 
 import ctypes
 import os
+import random
 import time
 from typing import Dict, List, Optional
 
+from ..core import monitor as _monitor
 from ..core.native import load_library
 
 _DEFAULT_TIMEOUT = 900.0  # seconds, matches the reference's default store timeout
+RETRIES = _monitor.stat("store.retries")
+
+
+def _connect_with_retry(connect, host, port, timeout,
+                        max_attempts: Optional[int] = None,
+                        base_delay: float = 0.05, max_delay: float = 2.0):
+    """Bounded retry with exponential backoff + full jitter around a store
+    connect. A rank that races its master (the normal elastic-restart case)
+    sees ECONNREFUSED on the first attempts; previously that failed the job
+    hard. `connect(per_attempt_timeout)` returns a client or None/raises
+    OSError; retries are bounded by the store timeout (the rendezvous
+    contract) and optionally by PADDLE_TPU_STORE_CONNECT_ATTEMPTS. Jitter
+    decorrelates a pod of ranks hammering a just-restarted master. Every
+    retry counts in `store.retries`."""
+    if max_attempts is None:
+        max_attempts = int(os.environ.get(
+            "PADDLE_TPU_STORE_CONNECT_ATTEMPTS", "0") or 0) or None
+    deadline = time.monotonic() + timeout
+    delay = base_delay
+    attempt = 0
+    last_exc = None
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        attempt += 1
+        try:
+            client = connect(min(remaining, 5.0))
+            if client:
+                return client
+            last_exc = None
+        except OSError as e:  # includes TimeoutError / ConnectionRefused
+            last_exc = e
+        if max_attempts is not None and attempt >= max_attempts:
+            break
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        RETRIES.increase()
+        time.sleep(min(delay, max_delay, remaining)
+                   * (0.5 + random.random() * 0.5))
+        delay *= 2
+    raise TimeoutError(
+        f"TCPStore: cannot connect to {host}:{port} after {attempt} "
+        f"attempt(s) within {timeout}s"
+        + (f" (last error: {last_exc!r})" if last_exc is not None else ""))
 
 
 def _lib():
@@ -71,11 +119,10 @@ class TCPStore:
                     raise RuntimeError(f"TCPStore: cannot bind port {port}")
                 port = got.value
             self.port = port
-            self._client = lib.ts_client_connect(
-                host.encode(), port, int(timeout * 1000))
-            if not self._client:
-                raise TimeoutError(
-                    f"TCPStore: cannot connect to {host}:{port} within {timeout}s")
+            self._client = _connect_with_retry(
+                lambda t: lib.ts_client_connect(
+                    host.encode(), port, int(t * 1000)) or None,
+                host, port, timeout)
         else:
             from . import _py_store
 
@@ -83,7 +130,9 @@ class TCPStore:
                 self._py_server = _py_store.PyStoreServer(port)
                 port = self._py_server.port
             self.port = port
-            self._client = _py_store.PyStoreClient(host, port, timeout)
+            self._client = _connect_with_retry(
+                lambda t: _py_store.PyStoreClient(host, port, t),
+                host, port, timeout)
 
     # ---- API (reference tcp_store.h: set/get/wait/add) ----
     def set(self, key: str, value) -> None:

@@ -188,6 +188,70 @@ def test_store_barrier_reusable():
         assert not t.is_alive()
 
 
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_connect_retry_backoff_unit():
+    """_connect_with_retry keeps attempting through transient refusals,
+    counting each retry in store.retries."""
+    from paddle_tpu.core import monitor
+    from paddle_tpu.distributed.store import _connect_with_retry
+
+    calls = []
+
+    def flaky(per_attempt_timeout):
+        calls.append(per_attempt_timeout)
+        if len(calls) < 3:
+            raise ConnectionRefusedError("server not up yet")
+        return "client"
+
+    r0 = monitor.stat("store.retries").get()
+    assert _connect_with_retry(flaky, "h", 1, timeout=10.0) == "client"
+    assert len(calls) == 3
+    assert monitor.stat("store.retries").get() == r0 + 2
+
+
+def test_client_retries_until_master_binds():
+    """The elastic-restart race: a client rank starts BEFORE its master has
+    bound the port. Previously the first ECONNREFUSED failed the job; now
+    the client backs off and wins once the server appears."""
+    import threading
+
+    port = _free_port()
+    result = {}
+
+    def connect():
+        result["store"] = TCPStore("127.0.0.1", port, is_master=False,
+                                   world_size=1, timeout=60.0)
+
+    t = threading.Thread(target=connect)
+    t.start()
+    time.sleep(1.0)  # let the client eat refusals first
+    master = TCPStore("127.0.0.1", port, is_master=True, world_size=1,
+                      timeout=60.0)
+    t.join(timeout=60)
+    # (the native client may absorb the wait inside one connect attempt, so
+    # store.retries is asserted in the unit test above, not here)
+    assert not t.is_alive() and "store" in result
+    result["store"].set("late", b"1")
+    assert master.get("late") == b"1"
+
+
+def test_connect_attempts_bounded_by_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_STORE_CONNECT_ATTEMPTS", "2")
+    port = _free_port()  # nothing listens here
+    t0 = time.time()
+    with pytest.raises(TimeoutError, match="after 2 attempt"):
+        TCPStore("127.0.0.1", port, is_master=False, world_size=1,
+                 timeout=60.0)
+    assert time.time() - t0 < 30.0, "attempt bound did not cut the deadline"
+
+
 def test_server_stop_unblocks_waiting_get():
     """Teardown must not hang on a Serve thread parked in a blocking wait."""
     import threading

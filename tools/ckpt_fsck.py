@@ -1,0 +1,76 @@
+"""Offline checkpoint verifier (fsck for distributed/elastic.py dirs).
+
+Walks a checkpoint root (or one committed ``ckpt_<step>`` dir), re-parses
+each manifest, recomputes the manifest self-checksum and every payload
+sha256, and reports one JSON line per checkpoint. Uncommitted ``.tmp.*``
+dirs (a crashed writer's leftovers — invisible to restore by construction)
+are listed but never failed on.
+
+Exit status: 0 = every committed checkpoint verifies; 1 = at least one is
+corrupt (CI gate / pre-restore sanity check); 2 = nothing to verify.
+
+Run:  python tools/ckpt_fsck.py /path/to/ckpts [--quiet]
+      python tools/ckpt_fsck.py /path/to/ckpts/ckpt_00000100
+"""
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path)
+
+import argparse
+import json
+import os
+import sys
+
+
+def fsck_one(path, quiet=False):
+    from paddle_tpu.distributed import elastic
+
+    row = {"path": path}
+    try:
+        manifest = elastic.verify_checkpoint(path)
+        n_files = sum(len(e["shards"])
+                      for kind in ("params", "opt")
+                      for e in (manifest.get(kind) or {}).values())
+        zero = manifest.get("zero_opt")
+        if zero is not None:
+            n_files += len(zero["shards"])
+        row.update(ok=True, step=manifest["step"], payload_files=n_files,
+                   zero_opt=zero is not None)
+    except elastic.CheckpointCorrupt as e:
+        row.update(ok=False, error=str(e))
+    if not quiet:
+        print(json.dumps(row))
+    return row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("dir", help="checkpoint root, or one ckpt_<step> dir")
+    ap.add_argument("--quiet", action="store_true",
+                    help="summary line only, no per-checkpoint rows")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.distributed import elastic
+
+    root = args.dir
+    if os.path.isfile(os.path.join(root, elastic.MANIFEST)) or \
+            os.path.basename(root).startswith(elastic.CKPT_PREFIX):
+        rows = [fsck_one(root, args.quiet)]
+        tmp = []
+    else:
+        ckpts = elastic.list_checkpoints(root)
+        rows = [fsck_one(p, args.quiet) for _step, p in ckpts]
+        tmp = sorted(n for n in (os.listdir(root) if os.path.isdir(root)
+                                 else []) if n.startswith(elastic.TMP_PREFIX))
+    bad = [r for r in rows if not r["ok"]]
+    print(json.dumps({"checked": len(rows), "ok": len(rows) - len(bad),
+                      "corrupt": len(bad), "uncommitted_tmp": tmp}))
+    if bad:
+        return 1
+    if not rows:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
