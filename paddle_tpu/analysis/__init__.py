@@ -1,0 +1,46 @@
+"""Static analysis over lowered programs and paddle_tpu sources.
+
+Two analyzers live here:
+
+- **Program contracts** (:mod:`.contracts`, :mod:`.passes`,
+  :mod:`.manager`): declarative statements of what a compiled executable
+  must look like — collective counts/kinds, scan-loop survival, donation
+  coverage, grad-comm payload dtype, host-transfer and constant hygiene,
+  recompile hazards in the traced signature — checked by a pass manager
+  over the HLO text and memory analysis of any executable. Both engines
+  expose ``engine.analyze()``; ``tools/hlo_lint.py`` is the CLI.
+- **Tracing-hazard source linter** (:mod:`.source_lint`): AST rules for
+  the hazards jit hides until production — host syncs on traced values,
+  wall-clock/``random`` inside traced code, mutable default args in
+  public APIs, bare lock acquisition in the threaded subsystems — run
+  repo-wide in tier-1 against a burned-down baseline;
+  ``tools/lint_tracing.py`` is the CLI.
+"""
+from .backend import (backend_combines_collectives, backend_keeps_bf16_on_wire,
+                      collective_combining_reason,
+                      native_bf16_collective_reason)
+from .contracts import (COLLECTIVE_KINDS, AnalysisReport, CountBound,
+                        ProgramContract, Skip, Violation, check_bound)
+from .manager import PassManager, check_compiled, check_text
+from .passes import PASSES
+from .program import Program, programs_from_stash
+
+__all__ = [
+    "AnalysisReport",
+    "COLLECTIVE_KINDS",
+    "CountBound",
+    "PASSES",
+    "PassManager",
+    "Program",
+    "ProgramContract",
+    "Skip",
+    "Violation",
+    "backend_combines_collectives",
+    "backend_keeps_bf16_on_wire",
+    "check_bound",
+    "check_compiled",
+    "check_text",
+    "collective_combining_reason",
+    "native_bf16_collective_reason",
+    "programs_from_stash",
+]

@@ -1,0 +1,104 @@
+"""Backend capability probes shared by contracts, gates, and the CLI.
+
+The one that matters today: does this XLA pipeline run the
+AllReduceCombiner? Collective-SHAPE contracts (a handful of fused
+all-reduces for N params) only hold where it does — TPU/GPU. This
+container's XLA CPU keeps one all-reduce per operand and resharding
+emits device-order collective-permutes, so every contract marked
+``requires_combining`` is *skipped* (not weakened) on it. This predicate
+used to live as a private lru-cached helper inside
+tests/test_hlo_perf_gates.py; the analyzer and the 4 probe-skipped gates
+now share this single copy, so "which backends can gate collectives" has
+exactly one answer.
+"""
+from __future__ import annotations
+
+import functools
+import re
+from typing import Optional
+
+_ALL_REDUCE_OP = re.compile(r"^\s*%?all-reduce[.\d]*\s*=", re.MULTILINE)
+
+
+@functools.lru_cache(maxsize=1)
+def collective_combining_reason() -> Optional[str]:
+    """None when the backend combines collectives (contracts must run),
+    else the human-readable skip reason.
+
+    Probe: compile a tiny TWO-parameter psum program and count all-reduce
+    ops — a combining backend (TPU, GPU) folds them into one variadic
+    all-reduce; the reduced CPU pipeline keeps one per operand. Cached:
+    one ~100ms compile per process, at first use rather than import.
+    """
+    import jax
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return "single-device backend: no collectives to gate"
+    mesh = Mesh(np.array(devs), ("dp",))
+
+    def two_psums(a, b):
+        return jax.lax.psum(a, "dp"), jax.lax.psum(b, "dp")
+
+    fm = shard_map(two_psums, mesh=mesh,
+                   in_specs=(P("dp"), P("dp")), out_specs=(P(), P()))
+    z = np.zeros((len(devs), 4), np.float32)
+    txt = jax.jit(fm).lower(z, z).compile().as_text()
+    n = len(_ALL_REDUCE_OP.findall(txt))
+    if n <= 1:
+        return None
+    return (f"XLA {jax.default_backend()} backend does not run the "
+            f"AllReduceCombiner (probe: 2-param psum compiled to {n} "
+            f"all-reduce ops, a combining backend emits 1 fused) — "
+            f"collective-shape gates need a TPU/GPU pipeline")
+
+
+def backend_combines_collectives() -> bool:
+    return collective_combining_reason() is None
+
+
+@functools.lru_cache(maxsize=1)
+def native_bf16_collective_reason() -> Optional[str]:
+    """None when the backend keeps bf16 collective payloads in bf16 on the
+    wire (wire-dtype contracts must run), else the skip reason.
+
+    Probe: compile a bf16 psum and look at the all-reduce's payload dtype.
+    CPU's float-normalization pass legalizes bf16 compute to f32, turning
+    ``convert_f32(psum(convert_bf16(x)))`` into an f32 all-reduce — so on
+    such backends a declared-bf16 grad-comm region ALWAYS shows f32
+    reduction payloads and the dtype-upcast pass must skip, not fail.
+    """
+    import jax
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return "single-device backend: no collectives to gate"
+    mesh = Mesh(np.array(devs), ("dp",))
+
+    def halfwire(a):
+        return jax.lax.psum(a.astype(jax.numpy.bfloat16),
+                            "dp").astype(jax.numpy.float32)
+
+    fm = shard_map(halfwire, mesh=mesh, in_specs=(P("dp"),),
+                   out_specs=P())
+    z = np.zeros((len(devs), 4), np.float32)
+    txt = jax.jit(fm).lower(z).compile().as_text()
+    for line in txt.splitlines():
+        # result dtype sits between '=' and the 'all-reduce(' call; the
+        # metadata tail can spell any dtype in op_name, so don't scan it
+        if (_ALL_REDUCE_OP.match(line)
+                and "bf16[" in line.split("all-reduce(", 1)[0]):
+            return None
+    return (f"XLA {jax.default_backend()} backend upcasts bf16 collective "
+            f"payloads to f32 (float normalization legalizes bf16 compute) "
+            f"— wire-dtype contracts need a TPU/GPU pipeline")
+
+
+def backend_keeps_bf16_on_wire() -> bool:
+    return native_bf16_collective_reason() is None

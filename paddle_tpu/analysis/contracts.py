@@ -1,0 +1,146 @@
+"""Declarative program contracts + the analysis result model.
+
+A :class:`ProgramContract` states what a lowered program is SUPPOSED to look
+like — how many collectives of which kind, how many scan loops, how many
+bytes donation must alias, which payload dtype the gradient collectives
+carry, whether host transfers are tolerated — as plain data. The pass suite
+in ``analysis/passes.py`` turns each declared field into checks; fields left
+``None`` are simply unchecked, so a contract can be as tight (a perf gate
+pinning "exactly one reduce-scatter") or as loose (hygiene-only: no host
+callbacks, no constant bloat) as the program warrants.
+
+This replaces the hand-written ``re.findall`` gates that grew across
+tests/test_hlo_perf_gates.py, test_zero_update.py and test_health.py: the
+same counting semantics, declared once, reusable from ``engine.analyze()``,
+``tools/hlo_lint.py`` and the tests.
+"""
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+# a count bound: exact int, (lo, hi) inclusive range ((lo, None) = no upper
+# bound), or None = unchecked
+CountBound = Union[int, Tuple[int, Optional[int]], None]
+
+#: collective op kinds the contract language knows about (HLO opcode names)
+COLLECTIVE_KINDS = ("all-reduce", "reduce-scatter", "all-gather",
+                    "all-to-all", "collective-permute")
+
+
+def check_bound(n: int, bound: CountBound) -> Optional[str]:
+    """None when `n` satisfies `bound`, else a human-readable description
+    of the expectation ("exactly 1", "in [1, 4]", ">= 5"). A (lo, None)
+    tuple is open-ended above."""
+    if bound is None:
+        return None
+    if isinstance(bound, int):
+        return None if n == bound else f"exactly {bound}"
+    lo, hi = bound
+    if hi is None:
+        return None if n >= lo else f">= {lo}"
+    return None if lo <= n <= hi else f"in [{lo}, {hi}]"
+
+
+@dataclass
+class ProgramContract:
+    """What one executable (or a label family) promises.
+
+    label: fnmatch pattern over executable labels ("train.zero_*").
+    collectives: kind -> CountBound over COLLECTIVE_KINDS op definitions.
+    requires_combining: the collective counts only hold on backends that run
+        XLA's AllReduceCombiner (TPU/GPU); elsewhere the collective checks
+        are reported as skips, not violations — the shared predicate behind
+        the 4 probe-skipped perf gates (analysis/backend.py).
+    while_loops: CountBound on compiled `while(` loops (scan survival).
+    donated_bytes: bytes of input state eligible for aliasing; the
+        donation-leak pass requires alias_size >= donated_fraction * this.
+    comm_dtype: declared gradient-collective payload dtype (f32|bf16|int8);
+        bf16/int8 forbid f32 reduction collectives above comm_min_elems.
+    comm_dtype_strict: by default a declared-bf16 contract is SKIPPED on
+        backends whose float normalization legalizes bf16 collectives to
+        f32 on the wire (this CPU pipeline) — the compiled program shows
+        f32 payloads no matter what the source did, so the check cannot
+        separate a source-level upcast bug from backend legalization.
+        True forces the check regardless (seeded-violation fixtures).
+    allow_host_calls: when False, infeed/outfeed/send/recv and host-callback
+        custom-calls in the program are violations.
+    max_constant_bytes: largest literal that may be baked into the program
+        (None disables the constant-bloat check).
+    """
+
+    label: str = "*"
+    collectives: Optional[Dict[str, CountBound]] = None
+    requires_combining: bool = False
+    while_loops: CountBound = None
+    donated_bytes: Optional[int] = None
+    donated_fraction: float = 0.9
+    comm_dtype: Optional[str] = None
+    comm_dtype_strict: bool = False
+    comm_min_elems: int = 64
+    allow_host_calls: bool = False
+    max_constant_bytes: Optional[int] = 2 * 1024 * 1024
+    name: str = ""  # optional display name for reports
+
+    def matches(self, label: str) -> bool:
+        return fnmatch.fnmatchcase(label, self.label)
+
+
+@dataclass
+class Violation:
+    label: str
+    pass_name: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.pass_name}] {self.label}: {self.message}"
+
+
+@dataclass
+class Skip:
+    label: str
+    pass_name: str
+    reason: str
+
+    def __str__(self) -> str:
+        return f"[{self.pass_name}] {self.label}: skipped — {self.reason}"
+
+
+@dataclass
+class AnalysisReport:
+    """What one PassManager.run saw: which labels were checked, every
+    violation, and every backend-capability skip."""
+
+    violations: List[Violation] = field(default_factory=list)
+    skips: List[Skip] = field(default_factory=list)
+    checked: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def for_label(self, label: str) -> List[Violation]:
+        return [v for v in self.violations if v.label == label]
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "checked": sorted(set(self.checked)),
+            "violations": [
+                {"label": v.label, "pass": v.pass_name, "message": v.message}
+                for v in self.violations],
+            "skips": [
+                {"label": s.label, "pass": s.pass_name, "reason": s.reason}
+                for s in self.skips],
+        }
+
+    def format(self) -> str:
+        lines = [f"analyzed {len(set(self.checked))} executable(s): "
+                 f"{len(self.violations)} violation(s), "
+                 f"{len(self.skips)} skip(s)"]
+        lines += ["  VIOLATION " + str(v) for v in self.violations]
+        lines += ["  skip " + str(s) for s in self.skips]
+        return "\n".join(lines)
+
+    __str__ = format

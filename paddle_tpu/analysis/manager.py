@@ -1,0 +1,107 @@
+"""PassManager: run the pass suite over programs under contracts.
+
+The manager is where results meet the observability stack: every violation
+bumps ``analysis.violations`` (and ``analysis.violations.<pass>``) in both
+the lightweight monitor stats and, when enabled, the metrics registry —
+and with ``FLAGS_analysis_flight_dump`` set, a flight-recorder dump named
+``analysis_<pass>_<label>`` captures the surrounding step records.
+
+Entry points:
+
+- ``PassManager().run(programs, contracts)`` — the general form.
+- ``check_compiled(label, compiled, contract)`` — one AOT executable.
+- ``check_text(label, hlo_text, contract)`` — raw HLO text (no donation /
+  signature passes, which need the compiled object / avals).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from .contracts import AnalysisReport, ProgramContract
+from .passes import PASSES, PassFn
+from .program import Program
+
+
+class PassManager:
+    """Runs a pass suite (default: all of :data:`PASSES`) over programs."""
+
+    def __init__(self, passes: Optional[Dict[str, PassFn]] = None):
+        self.passes: Dict[str, PassFn] = dict(passes or PASSES)
+
+    def run(self, programs: Iterable[Program],
+            contracts: Sequence[ProgramContract],
+            dump: Optional[bool] = None) -> AnalysisReport:
+        """Check every program against every contract whose label pattern
+        matches it. `dump` overrides FLAGS_analysis_flight_dump."""
+        report = AnalysisReport()
+        for prog in programs:
+            matched = [c for c in contracts if c.matches(prog.label)]
+            if matched:
+                report.checked.append(prog.label)
+            for c in matched:
+                for fn in self.passes.values():
+                    vs, ss = fn(prog, c)
+                    report.violations.extend(vs)
+                    report.skips.extend(ss)
+        _publish(report, dump=dump)
+        return report
+
+
+def _publish(report: AnalysisReport, dump: Optional[bool] = None) -> None:
+    """Violation counters + optional flight dump. Never raises: analysis is
+    diagnostics, it must not take down the path it watches."""
+    if not report.violations:
+        return
+    try:
+        from ..core import monitor
+
+        monitor.stat("analysis.violations").increase(len(report.violations))
+        for v in report.violations:
+            monitor.stat(f"analysis.violations.{v.pass_name}").increase()
+    except Exception:
+        pass
+    try:
+        from ..observability import metrics
+
+        reg = metrics.active_registry()
+        if reg is not None:
+            reg.counter("analysis.violations",
+                        "program-contract violations").inc(
+                            len(report.violations))
+            for v in report.violations:
+                reg.counter(f"analysis.violations.{v.pass_name}",
+                            "violations by analysis pass").inc()
+    except Exception:
+        pass
+    try:
+        if dump is None:
+            from ..core.flags import flag
+
+            dump = bool(flag("analysis_flight_dump"))
+        if dump:
+            from ..observability import flight_recorder
+
+            rec = flight_recorder.get()
+            if rec is not None:
+                v = report.violations[0]
+                rec.dump(f"analysis_{v.pass_name}_{v.label}",
+                         extra=report.summary())
+    except Exception:
+        pass
+
+
+def check_compiled(label: str, compiled: Any,
+                   contract: ProgramContract,
+                   avals: Any = None) -> AnalysisReport:
+    """Lint one already-compiled executable against one contract."""
+    prog = Program(label, compiled=compiled,
+                   avals=list(avals) if avals is not None else None)
+    return PassManager().run([prog], [contract])
+
+
+def check_text(label: str, hlo_text: str,
+               contract: ProgramContract) -> AnalysisReport:
+    """Lint raw optimized-HLO text (donation/signature passes will skip —
+    they need the compiled object / traced avals)."""
+    prog = Program(label, hlo_text=hlo_text)
+    return PassManager().run([prog], [contract])

@@ -1,0 +1,169 @@
+"""The analyzable unit: one lowered program + its HLO text, parsed lazily.
+
+A :class:`Program` wraps whatever is available about one executable —
+a compiled object (``jax.jit(f).lower(...).compile()``), raw optimized-HLO
+text, the abstract call signature the engines stash for
+``introspect_executables()``, or a (fn, avals) pair that can produce all of
+the above on demand. Passes ask for what they need (`hlo_text`,
+`memory_analysis`, `avals`) and the expensive steps (AOT compile) happen at
+most once per program.
+
+HLO parsing here deliberately matches the counting semantics the perf-gate
+tests established (op DEFINITIONS by LHS instruction name, `) while(` for
+loop count) so migrating a hand-written gate onto a contract cannot change
+its verdict.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+# op definition lines: `%all-reduce.5 = (f32[...]) all-reduce(...)`.
+# XLA names instructions after their opcode; `-done` halves of async pairs
+# are completions of the matching `-start`, not extra collectives.
+def _op_def_re(kind: str) -> "re.Pattern[str]":
+    return re.compile(rf"^\s*%?{re.escape(kind)}(?!-done)[-.\w]*\s*=",
+                      re.MULTILINE)
+
+
+_WHILE_RE = re.compile(r"\) while\(")
+_CONST_RE = re.compile(
+    r"^\s*%?constant[-.\w]*\s*=\s*([a-z]+[0-9]*)\[([\d,]*)\]")
+_SHAPE_GROUP_RE = re.compile(r"(bf16|f16|f32|f64|s8|u8|s16|u16|s32|u32|s64|"
+                             r"u64|pred|c64|c128)\[([\d,]*)\]")
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "f16": 2, "bf16": 2, "s16": 2,
+                "u16": 2, "f32": 4, "s32": 4, "u32": 4, "c64": 8, "f64": 8,
+                "s64": 8, "u64": 8, "c128": 16}
+
+#: custom-call targets that bounce through the host (python callbacks); TPU
+#: kernel custom-calls (tpu_custom_call, Mosaic) are NOT host transfers
+_HOST_CALLBACK_MARKERS = ("callback", "host")
+_HOST_OP_KINDS = ("infeed", "outfeed", "send", "recv")
+
+
+def _elems(csv: str) -> int:
+    n = 1
+    for d in csv.split(","):
+        if d:
+            n *= int(d)
+    return n
+
+
+class Program:
+    """One executable under analysis. Construct with whichever artifacts
+    exist; the rest is derived lazily (and at most once)."""
+
+    def __init__(self, label: str, compiled: Any = None,
+                 hlo_text: Optional[str] = None, avals: Any = None,
+                 lower_thunk: Any = None):
+        self.label = label
+        self.avals = avals
+        self._compiled = compiled
+        self._hlo_text = hlo_text
+        self._lower_thunk = lower_thunk
+        self._mem = _UNSET
+
+    @classmethod
+    def from_stash(cls, label: str, fn: Any, avals: Any) -> "Program":
+        """From an engine's ``_exec_stash`` entry: AOT ``lower().compile()``
+        deferred until a pass first needs the HLO (one compile per label)."""
+        flat = _flatten(avals)
+        return cls(label, avals=flat,
+                   lower_thunk=lambda: fn.lower(*avals).compile())
+
+    @property
+    def compiled(self) -> Any:
+        if self._compiled is None and self._lower_thunk is not None:
+            self._compiled = self._lower_thunk()
+        return self._compiled
+
+    @property
+    def hlo_text(self) -> str:
+        if self._hlo_text is None:
+            comp = self.compiled
+            if comp is None:
+                raise ValueError(
+                    f"program {self.label!r} has neither HLO text nor a "
+                    f"compiled executable to read it from")
+            self._hlo_text = comp.as_text()
+        return self._hlo_text
+
+    def memory_analysis(self) -> Any:
+        """compiled.memory_analysis() or None (text-only programs, backends
+        without PJRT memory stats)."""
+        if self._mem is _UNSET:
+            try:
+                comp = self.compiled
+                self._mem = None if comp is None else comp.memory_analysis()
+            except Exception:
+                self._mem = None
+        return self._mem
+
+    # ---- HLO queries -------------------------------------------------------
+    def count_ops(self, kind: str) -> int:
+        """Op DEFINITIONS of `kind` (LHS instruction name match — the exact
+        semantics of the perf-gate regexes this layer replaces)."""
+        return len(_op_def_re(kind).findall(self.hlo_text))
+
+    def op_def_lines(self, kind: str) -> List[str]:
+        pat = _op_def_re(kind)
+        return [ln for ln in self.hlo_text.splitlines() if pat.match(ln)]
+
+    def count_while_loops(self) -> int:
+        return len(_WHILE_RE.findall(self.hlo_text))
+
+    def constants(self) -> List[Tuple[str, int, str]]:
+        """(dtype, bytes, line) per `constant` op definition."""
+        out = []
+        for ln in self.hlo_text.splitlines():
+            m = _CONST_RE.match(ln)
+            if m:
+                dt, csv = m.group(1), m.group(2)
+                out.append((dt, _elems(csv) * _DTYPE_BYTES.get(dt, 4),
+                            ln.strip()))
+        return out
+
+    def host_transfer_lines(self) -> List[str]:
+        """infeed/outfeed/send/recv op definitions plus custom-calls whose
+        target names a host (python) callback."""
+        out = []
+        kinds = [(_op_def_re(k), None) for k in _HOST_OP_KINDS]
+        cc = _op_def_re("custom-call")
+        for ln in self.hlo_text.splitlines():
+            if cc.match(ln):
+                m = re.search(r'custom_call_target="([^"]*)"', ln)
+                tgt = (m.group(1) if m else "").lower()
+                if any(mark in tgt for mark in _HOST_CALLBACK_MARKERS):
+                    out.append(ln.strip())
+                continue
+            for pat, _ in kinds:
+                if pat.match(ln):
+                    out.append(ln.strip())
+                    break
+        return out
+
+    def result_shapes(self, line: str) -> List[Tuple[str, int]]:
+        """(dtype, element-count) for every typed shape mentioned on an op
+        line (result + operands — operand dtypes equal their defs')."""
+        return [(dt, _elems(csv))
+                for dt, csv in _SHAPE_GROUP_RE.findall(line)]
+
+
+_UNSET = object()
+
+
+def _flatten(avals) -> List[Any]:
+    """Leaves of the stash's aval tree (jax optional: avals may be plain)."""
+    try:
+        import jax
+
+        return list(jax.tree_util.tree_leaves(avals))
+    except Exception:
+        return [avals]
+
+
+def programs_from_stash(stash: Dict[str, Any]) -> List[Program]:
+    """One lazy Program per engine ``_exec_stash`` entry."""
+    return [Program.from_stash(label, fn, avals)
+            for label, (fn, avals) in sorted(stash.items())]

@@ -188,3 +188,7 @@ define_flag("compile_cache_dir", os.environ.get("PADDLE_TPU_COMPILE_CACHE", ""),
             "PADDLE_TPU_COMPILE_CACHE). Empty = off (bit-identical default); "
             "set, every process reuses serialized executables so steady-state "
             "restarts skip recompilation (core/compile_cache.py)")
+define_flag("analysis_flight_dump", False,
+            "when engine.analyze()/hlo_lint finds contract violations and a "
+            "flight recorder is installed, dump the ring naming the "
+            "offending label + pass (analysis/manager.py)")

@@ -369,7 +369,11 @@ class TrainStepEngine:
                     return a
             except Exception:
                 pass
-            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+            # weak_type rides along: the recompile-hazard analysis pass reads
+            # it off the stashed signature (a weak lr would retrace per call)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                        weak_type=getattr(a, "weak_type",
+                                                          False))
 
         avals = jax.tree_util.tree_map(aval, call_args)
         self._exec_stash[label] = (fn, avals)
@@ -388,6 +392,87 @@ class TrainStepEngine:
         for label, (fn, avals) in list(self._exec_stash.items()):
             out[label] = _obs_exec.capture_jit(label, fn, avals, force=force)
         return out
+
+    # ---- static analysis (paddle_tpu.analysis) ----------------------------
+    def _analysis_state_bytes(self, include_opt: bool = True) -> int:
+        """Bytes of the donation-eligible carried state (replicated host
+        view) — the same params(+opt) accounting the donation perf gate
+        measures alias coverage against."""
+        tree = (self.params, self.opt_state) if include_opt else self.params
+        return sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+                   for a in jax.tree_util.tree_leaves(tree)
+                   if hasattr(a, "shape"))
+
+    def default_contracts(self) -> list:
+        """The contracts this engine's own executables are expected to meet,
+        derived from its configuration: hygiene (no host transfers, no
+        constant bloat, no recompile hazards) on every train label, donation
+        coverage when donation is on, and — on pure-dp meshes with real
+        replicas — the collective shapes each path promises (one fused accum
+        all-reduce, the ZeRO reduce-scatter/all-gather decomposition, the
+        quantized-gather int8 path, combining-backend GSPMD step shapes)."""
+        from .. import analysis as _an
+
+        cs = [_an.ProgramContract(label="train.*", name="train-hygiene")]
+        if self._donate:
+            full = self._analysis_state_bytes()
+            for pat in ("train.step", "train.run_steps", "train.accum_*"):
+                cs.append(_an.ProgramContract(
+                    label=pat, donated_bytes=full, name="train-donation"))
+            # ZeRO donates full params but only this shard's opt state
+            cs.append(_an.ProgramContract(
+                label="train.zero_*",
+                donated_bytes=self._analysis_state_bytes(include_opt=False),
+                name="zero-donation"))
+        ndp = self.hcg.degrees["dp"] * self.hcg.degrees["sharding"]
+        if ndp > 1 and self._dp_pure():
+            # ByGlobalNorm clip adds one scalar norm psum to the fused reduce
+            clip_hi = 2 if self.optimizer._grad_clip is not None else 1
+            cs += [
+                _an.ProgramContract(
+                    "train.accum_*_f32",
+                    collectives={"all-reduce": (1, clip_hi)},
+                    while_loops=(1, None), name="accum-fused-reduce"),
+                _an.ProgramContract(
+                    "train.accum_*_bf16*",
+                    collectives={"all-reduce": (1, clip_hi)},
+                    while_loops=(1, None), comm_dtype="bf16",
+                    name="accum-fused-reduce-bf16"),
+                _an.ProgramContract(
+                    "train.accum_*_int8*",
+                    collectives={"all-gather": (1, None),
+                                 "reduce-scatter": 0},
+                    while_loops=(1, None), comm_dtype="int8",
+                    name="accum-quantized-gather"),
+                _an.ProgramContract(
+                    "train.zero_*",
+                    collectives={"reduce-scatter": 1, "all-gather": (1, 2),
+                                 "all-reduce": (0, clip_hi - 1),
+                                 "all-to-all": 0},
+                    while_loops=(1, None), name="zero-decomposition"),
+                _an.ProgramContract(
+                    "train.step", requires_combining=True,
+                    collectives={"all-reduce": (1, 4)},
+                    name="step-fused-reduce"),
+                _an.ProgramContract(
+                    "train.run_steps", requires_combining=True,
+                    collectives={"all-reduce": (1, 4)}, while_loops=1,
+                    name="run-steps-one-loop"),
+            ]
+        return cs
+
+    def analyze(self, contracts=None, dump: Optional[bool] = None):
+        """Run the static-analysis pass suite over every executable this
+        engine has dispatched (see paddle_tpu.analysis). Dispatch-free:
+        programs are AOT-lowered from the stashed abstract signatures, never
+        executed. Returns an AnalysisReport; violations bump the
+        analysis.* counters and (FLAGS_analysis_flight_dump) flight-dump."""
+        from .. import analysis as _an
+
+        progs = _an.programs_from_stash(self._exec_stash)
+        if contracts is None:
+            contracts = self.default_contracts()
+        return _an.PassManager().run(progs, contracts, dump=dump)
 
     def _obs_step_tail(self, fr, mreg, rec, t0, t1, h2d_ms, compiled, loss,
                        hist="train.step_ms"):

@@ -302,8 +302,12 @@ class ServingEngine:
             return
         import jax
 
+        # weak_type rides along for the recompile-hazard analysis pass
         avals = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), call_args)
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           weak_type=getattr(a, "weak_type",
+                                                             False)),
+            call_args)
         self._exec_stash[label] = (fn, avals)
         if _flags.flag("exec_introspect"):
             try:
@@ -320,6 +324,44 @@ class ServingEngine:
         for label, (fn, avals) in list(self._exec_stash.items()):
             out[label] = _obs_exec.capture_jit(label, fn, avals, force=force)
         return out
+
+    # ---- static analysis (paddle_tpu.analysis) --------------------------
+    def default_contracts(self) -> list:
+        """Hygiene on every serve label (a host transfer inside prefill or
+        decode would serialize the whole fleet on one Python callback) plus
+        per-label KV-cache donation coverage: args 1/2 of every stashed
+        signature are the caches this engine donates, so their byte size IS
+        the aliasing floor."""
+        import numpy as np
+
+        from .. import analysis as _an
+
+        cs = [_an.ProgramContract(label="serve.*", name="serve-hygiene")]
+        for label, (fn, avals) in sorted(self._exec_stash.items()):
+            try:
+                import jax
+
+                caches = jax.tree_util.tree_leaves((avals[1], avals[2]))
+                donated = sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+                              for a in caches)
+            except Exception:
+                continue
+            if donated:
+                cs.append(_an.ProgramContract(
+                    label=label, donated_bytes=donated,
+                    name=f"{label}-cache-donation"))
+        return cs
+
+    def analyze(self, contracts=None, dump=None):
+        """Run the static-analysis pass suite over every prefill/decode
+        executable this engine has dispatched (see paddle_tpu.analysis).
+        Dispatch-free — programs AOT-lower from the stashed signatures."""
+        from .. import analysis as _an
+
+        progs = _an.programs_from_stash(self._exec_stash)
+        if contracts is None:
+            contracts = self.default_contracts()
+        return _an.PassManager().run(progs, contracts, dump=dump)
 
     def _note_exec_compiles(self, fn, counter: str) -> None:
         """Count executable-cache growth of a jitted fn into core.monitor —

@@ -1,0 +1,404 @@
+"""Program contract analyzer (ISSUE 11 tentpole): paddle_tpu/analysis.
+
+Three layers pinned here:
+
+- each seeded violation is caught by EXACTLY its designated pass
+  (undonated engine -> donation-leak, f32 program under a bf16 contract ->
+  dtype-upcast, host callback in a traced fn -> host-transfer, big baked
+  literal -> constant-bloat, weak-type / Python-scalar signature ->
+  recompile-hazard, broken count -> collective-contract);
+- the green path: both engines' default executables lint clean against
+  their own default_contracts(), analyze() is dispatch-free, and wiring
+  the analyzer changed nothing about lowering (byte-identical programs);
+- the observability plumbing: violation counters in monitor + metrics
+  registry, the flight-recorder dump naming label+pass, and the
+  tools/hlo_lint.py exit-code contract (0 clean / 1 violations / 2 error).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis as an
+from paddle_tpu.core import monitor
+from paddle_tpu.distributed.engine import TrainStepEngine
+from paddle_tpu.distributed.mesh import (HybridCommunicateGroup,
+                                         set_hybrid_communicate_group)
+from paddle_tpu.observability import (exec_introspect, flight_recorder,
+                                      health, metrics)
+
+
+@pytest.fixture(autouse=True)
+def _observability_cleanup():
+    yield
+    metrics.reset()
+    flight_recorder.disable()
+    health.reset()
+    exec_introspect.reset()
+
+
+def _dp8_engine(donate=True, microbatches=1, zero=False):
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+
+    strategy = dist.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(64, 64), paddle.nn.ReLU(),
+                               paddle.nn.Linear(64, 64))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    eng = fleet.distributed_engine(net, opt, loss_fn=paddle.nn.MSELoss(),
+                                   donate=donate, microbatches=microbatches,
+                                   zero_update=zero)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(64, 64).astype("float32"))
+    y = jnp.asarray(rng.randn(64, 64).astype("float32"))
+    return eng, [x, y]
+
+
+def _tiny_engine():
+    set_hybrid_communicate_group(None)
+    hcg = HybridCommunicateGroup(dp_degree=1, devices=jax.devices()[:1])
+    paddle.seed(0)
+    model = paddle.nn.Sequential(paddle.nn.Linear(8, 8), paddle.nn.ReLU(),
+                                 paddle.nn.Linear(8, 8))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    eng = TrainStepEngine(model, opt, loss_fn=paddle.nn.MSELoss(), hcg=hcg)
+    rng = np.random.RandomState(0)
+    return eng, [jnp.asarray(rng.randn(8, 8).astype("float32")),
+                 jnp.asarray(rng.randn(8, 8).astype("float32"))]
+
+
+# ------------------------------------------------------- contract language
+
+def test_check_bound_semantics():
+    assert an.check_bound(1, 1) is None
+    assert an.check_bound(2, 1) == "exactly 1"
+    assert an.check_bound(3, (1, 4)) is None
+    assert an.check_bound(0, (1, 4)) == "in [1, 4]"
+    assert an.check_bound(99, (5, None)) is None
+    assert an.check_bound(4, (5, None)) == ">= 5"
+    assert an.check_bound(7, None) is None
+
+
+def test_contract_label_matching():
+    c = an.ProgramContract(label="train.accum_*_bf16*")
+    assert c.matches("train.accum_k2_bf16")
+    assert c.matches("train.accum_k4_bf16_res")
+    assert not c.matches("train.accum_k2_f32")
+    assert an.ProgramContract().matches("anything")
+
+
+def test_program_op_counting_matches_gate_semantics():
+    """Op DEFINITIONS by LHS name; `-done` async halves excluded; while
+    counted via `) while(` — the exact semantics of the migrated gates."""
+    txt = ("  %all-reduce.1 = f32[4]{0} all-reduce(%x)\n"
+           "  %all-reduce-done.1 = f32[4]{0} all-reduce-done(%s)\n"
+           "  %y = f32[4]{0} add(%all-reduce.1, %all-reduce.1)\n"
+           "  %w = (f32[4]) while(%t), condition=%c, body=%b\n")
+    p = an.Program("t", hlo_text=txt)
+    assert p.count_ops("all-reduce") == 1
+    assert p.count_while_loops() == 1
+
+
+# ------------------------------------------------- seeded violations (sat 3)
+
+def test_seeded_undonated_engine_caught_by_donation_leak():
+    """A deliberately undonated engine: ONLY donation-leak fires."""
+    eng, arrays = _dp8_engine(donate=False)
+    eng.step(*arrays)
+    contracts = eng.default_contracts() + [an.ProgramContract(
+        label="train.*", donated_bytes=eng._analysis_state_bytes(),
+        name="seeded-donation")]
+    rep = eng.analyze(contracts)
+    assert not rep.ok
+    assert {v.pass_name for v in rep.violations} == {"donation-leak"}
+    assert rep.violations[0].label == "train.step"
+
+
+def test_seeded_f32_program_under_bf16_contract_caught_by_dtype_upcast():
+    """The engine's real f32 accumulation program declared as a bf16
+    grad-comm region: ONLY dtype-upcast fires (and it names the f32
+    all-reduce payload). comm_dtype_strict forces the check even where the
+    backend couldn't keep bf16 on the wire anyway."""
+    from paddle_tpu.distributed import grad_comm
+
+    eng, _ = _dp8_engine()
+    arrays = [jnp.asarray(np.random.RandomState(0).randn(64, 64)
+                          .astype("float32")),
+              jnp.asarray(np.random.RandomState(1).randn(64, 64)
+                          .astype("float32"))]
+    jf = eng._build_accum(arrays, 2, "f32", False, grad_comm.chunk_size())
+    comp = jf.lower(eng.params, eng.opt_state, jnp.float32(1e-3),
+                    jnp.int32(1), jax.random.key(0), *arrays).compile()
+    rep = an.check_compiled("train.accum_k2_bf16", comp, an.ProgramContract(
+        comm_dtype="bf16", comm_dtype_strict=True,
+        allow_host_calls=True, max_constant_bytes=None))
+    assert {v.pass_name for v in rep.violations} == {"dtype-upcast"}
+    assert "f32 payload" in rep.violations[0].message
+
+
+def test_bf16_contract_on_real_program_respects_backend_wire_dtype():
+    """The REAL bf16-payload program under the same (non-strict) contract:
+    clean on a native-bf16 wire; on this CPU pipeline — whose float
+    normalization legalizes the bf16 psum to an f32 all-reduce — the check
+    SKIPS with the probe's reason instead of blaming the source."""
+    from paddle_tpu.distributed import grad_comm
+
+    eng, _ = _dp8_engine()
+    arrays = [jnp.asarray(np.random.RandomState(0).randn(64, 64)
+                          .astype("float32")),
+              jnp.asarray(np.random.RandomState(1).randn(64, 64)
+                          .astype("float32"))]
+    jf = eng._build_accum(arrays, 2, "bf16", False, grad_comm.chunk_size())
+    comp = jf.lower(eng.params, eng.opt_state, jnp.float32(1e-3),
+                    jnp.int32(1), jax.random.key(0), *arrays).compile()
+    rep = an.check_compiled("train.accum_k2_bf16", comp, an.ProgramContract(
+        comm_dtype="bf16", allow_host_calls=True, max_constant_bytes=None))
+    assert rep.ok, rep.format()
+    if not an.backend_keeps_bf16_on_wire():
+        assert [s.pass_name for s in rep.skips] == ["dtype-upcast"]
+        assert rep.skips[0].reason == an.native_bf16_collective_reason()
+
+
+def test_bf16_wire_payload_passes_strict_contract():
+    """A genuinely-bf16 wire payload satisfies even the strict contract —
+    the pass flags f32 payloads, not bf16 traffic (synthetic HLO, so this
+    holds on every backend)."""
+    txt = ("  %all-reduce.1 = bf16[8320]{0} all-reduce(%g)\n"
+           "  %all-reduce.2 = f32[2]{0} all-reduce(%tiny)\n")  # < min_elems
+    rep = an.check_text("t", txt, an.ProgramContract(
+        comm_dtype="bf16", comm_dtype_strict=True,
+        allow_host_calls=True, max_constant_bytes=None))
+    assert rep.ok, rep.format()
+
+
+def test_seeded_host_callback_caught_by_host_transfer():
+    """A host (python) callback inside a jitted fn: ONLY host-transfer."""
+
+    def step(a):
+        jax.debug.callback(lambda v: None, a.sum())
+        return a * 2.0
+
+    comp = jax.jit(step).lower(jnp.zeros((8, 8), jnp.float32)).compile()
+    rep = an.check_compiled("seeded.callback", comp,
+                            an.ProgramContract(max_constant_bytes=None))
+    assert {v.pass_name for v in rep.violations} == {"host-transfer"}
+    # and tolerated when the contract says so
+    ok = an.check_compiled("seeded.callback", comp, an.ProgramContract(
+        allow_host_calls=True, max_constant_bytes=None))
+    assert ok.ok, ok.format()
+
+
+def test_seeded_constant_bloat_caught():
+    """A 4 MB non-uniform literal baked into the program (uniform arrays
+    constant-fold to broadcasts and are free): ONLY constant-bloat."""
+    big = jnp.asarray(np.random.RandomState(0).randn(512, 2048)
+                      .astype("float32"))  # 4 MB, non-uniform
+
+    def step(a):
+        return a + big
+
+    comp = jax.jit(step).lower(jnp.zeros((512, 2048), jnp.float32)).compile()
+    rep = an.check_compiled("seeded.const", comp,
+                            an.ProgramContract(allow_host_calls=True))
+    assert {v.pass_name for v in rep.violations} == {"constant-bloat"}
+    assert "4194304-byte" in rep.violations[0].message
+
+
+def test_seeded_recompile_hazards_caught():
+    """Weak-typed aval + Python scalar in a traced signature: ONLY
+    recompile-hazard, one violation each."""
+    prog = an.Program("seeded.sig", hlo_text="", avals=[
+        jax.ShapeDtypeStruct((4,), jnp.float32, weak_type=True), 0.5,
+        jax.ShapeDtypeStruct((4,), jnp.float32)])
+    rep = an.PassManager().run([prog], [an.ProgramContract(
+        allow_host_calls=True, max_constant_bytes=None)])
+    assert [v.pass_name for v in rep.violations] == ["recompile-hazard"] * 2
+    msgs = " | ".join(v.message for v in rep.violations)
+    assert "Python scalar" in msgs and "weakly typed" in msgs
+
+
+def test_collective_contract_violation_and_combining_skip():
+    txt = ("  %all-reduce.1 = f32[4]{0} all-reduce(%x)\n"
+           "  %all-reduce.2 = f32[4]{0} all-reduce(%y)\n")
+    rep = an.check_text("t", txt, an.ProgramContract(
+        collectives={"all-reduce": 1},
+        allow_host_calls=True, max_constant_bytes=None))
+    assert {v.pass_name for v in rep.violations} == {"collective-contract"}
+    # requires_combining on this CPU backend: the check SKIPS, never fails
+    rep2 = an.check_text("t", txt, an.ProgramContract(
+        collectives={"all-reduce": 1}, requires_combining=True,
+        allow_host_calls=True, max_constant_bytes=None))
+    if an.backend_combines_collectives():
+        assert not rep2.ok
+    else:
+        assert rep2.ok and len(rep2.skips) == 1
+        assert rep2.skips[0].reason == an.collective_combining_reason()
+
+
+# -------------------------------------------------------------- green path
+
+def test_train_engine_default_executables_lint_clean():
+    """Acceptance: the train engine's own step + accum executables satisfy
+    its default contracts (modulo backend-capability skips)."""
+    eng, arrays = _dp8_engine(microbatches=1)
+    eng.step(*arrays)
+    eng.microbatches = 2
+    eng.step(*arrays)
+    rep = eng.analyze()
+    assert rep.ok, rep.format()
+    assert "train.step" in rep.checked
+    assert any(lbl.startswith("train.accum_k2") for lbl in rep.checked)
+
+
+def test_zero_engine_default_executables_lint_clean():
+    eng, arrays = _dp8_engine(microbatches=2, zero=True)
+    eng.step(*arrays)
+    rep = eng.analyze()
+    assert rep.ok, rep.format()
+    assert any(lbl.startswith("train.zero_k2") for lbl in rep.checked)
+
+
+def test_serving_engine_default_executables_lint_clean():
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+    from paddle_tpu.serving import ServingEngine
+
+    set_hybrid_communicate_group(None)
+    paddle.seed(0)
+    model = GPTForPretraining(gpt_tiny())
+    model.eval()
+    eng = ServingEngine(model, slot_count=2, ladder=(8, 16), max_new_cap=8,
+                        steps_per_dispatch=4)
+    rng = np.random.RandomState(0)
+    for n in (5, 12):
+        eng.submit(rng.randint(0, 1024, (n,)).astype(np.int64),
+                   max_new_tokens=4, temperature=0.0)
+    eng.run()
+    rep = eng.analyze()
+    assert rep.ok, rep.format()
+    assert any(lbl.startswith("serve.prefill_b") for lbl in rep.checked)
+    assert any(lbl.startswith("serve.decode_") for lbl in rep.checked)
+
+
+def test_analyze_is_dispatch_free_and_lowering_is_unchanged():
+    """Bench sanity (satellite 5): analyze() AOT-lowers from the stashed
+    ABSTRACT signatures — calling the stashed fn on ShapeDtypeStructs would
+    throw, so a passing analyze() cannot have dispatched — and it leaves
+    engine state and the lowered program byte-identical."""
+    eng, arrays = _tiny_engine()
+    eng.step(*arrays)
+    step_before = eng._step_count
+    loss_before = float(eng.last_loss.item())
+
+    def lowered_text():
+        jf = eng._build(arrays)
+        return jf.lower(eng.params, eng.opt_state, jnp.float32(1e-3),
+                        jnp.int32(1), jax.random.key(0), *arrays).as_text()
+
+    before = lowered_text()
+    rep = eng.analyze()
+    assert rep.ok, rep.format()
+    assert eng._step_count == step_before
+    assert float(eng.last_loss.item()) == loss_before
+    assert lowered_text() == before, (
+        "engine.analyze() perturbed the lowered step program")
+
+
+# ------------------------------------------------------------ observability
+
+def test_violations_bump_monitor_and_metrics_counters():
+    reg = metrics.enable()
+    before = monitor.registry().report().get(
+        "analysis.violations", {}).get("value", 0)
+    rep = an.check_text("t", "  %all-reduce.1 = f32[4]{0} all-reduce(%x)\n",
+                        an.ProgramContract(
+                            collectives={"all-reduce": 0},
+                            allow_host_calls=True, max_constant_bytes=None))
+    assert not rep.ok
+    after = monitor.registry().report()["analysis.violations"]["value"]
+    assert after == before + 1
+    assert reg.counter("analysis.violations").value == 1
+    assert reg.counter(
+        "analysis.violations.collective-contract").value == 1
+
+
+def test_violation_triggers_named_flight_dump(tmp_path):
+    flight_recorder.enable(str(tmp_path), capacity=8)
+    rep = an.check_text("train.step",
+                        "  %all-reduce.1 = f32[4]{0} all-reduce(%x)\n",
+                        an.ProgramContract(
+                            collectives={"all-reduce": 0},
+                            allow_host_calls=True, max_constant_bytes=None))
+    assert not rep.ok  # dump gated off by default flag
+    assert not [d for d in os.listdir(tmp_path) if d.startswith("flight_")]
+    paddle.set_flags({"analysis_flight_dump": True})
+    try:
+        an.check_text("train.step",
+                      "  %all-reduce.1 = f32[4]{0} all-reduce(%x)\n",
+                      an.ProgramContract(
+                          collectives={"all-reduce": 0},
+                          allow_host_calls=True, max_constant_bytes=None))
+        dumps = [d for d in os.listdir(tmp_path) if d.startswith("flight_")]
+        assert len(dumps) == 1
+        assert "analysis_collective-contract_train_step" in dumps[0]
+        state = json.load(
+            open(os.path.join(tmp_path, dumps[0], "state.json")))
+        assert state["extra"]["violations"][0]["pass"] == "collective-contract"
+    finally:
+        paddle.set_flags({"analysis_flight_dump": False})
+
+
+# ------------------------------------------------------------- CLI contract
+
+_TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+def _run_hlo_lint(*extra):
+    return subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "hlo_lint.py"),
+         "--seq", "64", "--batch", "2", *extra],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_hlo_lint_cli_exit_code_2_on_bad_args():
+    """Exit 2 = error, distinct from 1 = violations. Bad arguments fail in
+    argparse before any jax work, so this pin is cheap enough for tier-1."""
+    err = _run_hlo_lint("--definitely-not-a-flag")
+    assert err.returncode == 2
+
+
+@pytest.mark.slow
+def test_hlo_lint_cli_exit_codes_clean_and_dirty():
+    """Pinned exit codes: 0 clean, 1 violations (--no-donate seeds a
+    donation-leak)."""
+    clean = _run_hlo_lint("--microbatches", "1")
+    assert clean.returncode == 0, clean.stderr[-2000:]
+    summary = json.loads(clean.stdout.strip().splitlines()[-1])["summary"]
+    assert summary["kind"] == "hlo_lint" and summary["ok"]
+    assert "train.step" in summary["checked"]
+
+    dirty = _run_hlo_lint("--microbatches", "1", "--no-donate")
+    assert dirty.returncode == 1, dirty.stderr[-2000:]
+    summary = json.loads(dirty.stdout.strip().splitlines()[-1])["summary"]
+    assert [v["pass"] for v in summary["violations"]] == ["donation-leak"]
+
+
+@pytest.mark.slow
+def test_hlo_lint_cli_serve_and_zero_paths():
+    out = _run_hlo_lint("--microbatches", "2", "--serve", "--zero")
+    assert out.returncode == 0, out.stderr[-2000:]
+    summary = json.loads(out.stdout.strip().splitlines()[-1])["summary"]
+    checked = summary["checked"]
+    assert any(c.startswith("train.zero_k2") for c in checked)
+    assert any(c.startswith("serve.prefill_b") for c in checked)

@@ -16,7 +16,6 @@ Each test pins a compiler-level property that the on-chip numbers depend on:
 
 Thresholds are pinned from measured values; regressions fail loudly.
 """
-import functools
 import os
 import re
 import sys
@@ -39,51 +38,15 @@ _FA = sys.modules["paddle_tpu.ops.pallas.flash_attention"]
 _LN = sys.modules["paddle_tpu.ops.pallas.layer_norm"]
 _LM = sys.modules["paddle_tpu.ops.pallas.lm_loss"]
 
-# matches real all-reduce OP definitions (the result type of a combined
-# gradient all-reduce is a tuple "(f32[..], ...)" which contains spaces, so
-# match on the op name token, not "= <type> all-reduce(")
-_ALL_REDUCE_OP = re.compile(r"^\s*%?all-reduce[.\d]*\s*=", re.MULTILINE)
-
-
-@functools.lru_cache(maxsize=1)
 def _collective_gate_skip_reason():
-    """Backend-capability probe for the collective-shape gates.
+    """Backend-capability probe for the collective-shape gates — now the
+    SHARED predicate in paddle_tpu/analysis/backend.py (the analyzer's
+    requires_combining contracts and these gates must agree on which
+    backends can pin collective shapes). Returns None when the backend
+    combines (gates must run), else the skip reason; cached there."""
+    from paddle_tpu.analysis.backend import collective_combining_reason
 
-    Compile a tiny TWO-parameter psum program and count the all-reduce ops:
-    a backend that runs XLA's AllReduceCombiner (TPU, GPU) folds them into
-    one variadic all-reduce; the CPU pipeline keeps one per operand. The
-    same reduced pipeline also partitions with device-order
-    collective-permute reshards (observed as identity-shuffle
-    source_target_pairs), so ALL gates pinning combined/clean collective
-    shapes are skipped — not weakened — on non-combining backends, and
-    still fail loudly on a capable one.
-
-    Returns None when the backend combines (gates must run), else the skip
-    reason. Cached: one ~100ms compile per test process, at first use
-    rather than collection (pytest --collect-only stays fast).
-    """
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import Mesh, PartitionSpec as P
-
-    devs = jax.devices()
-    if len(devs) < 2:
-        return "single-device backend: no collectives to gate"
-    mesh = Mesh(np.array(devs), ("dp",))
-
-    def two_psums(a, b):
-        return jax.lax.psum(a, "dp"), jax.lax.psum(b, "dp")
-
-    fm = shard_map(two_psums, mesh=mesh,
-                   in_specs=(P("dp"), P("dp")), out_specs=(P(), P()))
-    z = np.zeros((len(devs), 4), np.float32)
-    txt = jax.jit(fm).lower(z, z).compile().as_text()
-    n = len(_ALL_REDUCE_OP.findall(txt))
-    if n <= 1:
-        return None
-    return (f"XLA {jax.default_backend()} backend does not run the "
-            f"AllReduceCombiner (probe: 2-param psum compiled to {n} "
-            f"all-reduce ops, a combining backend emits 1 fused) — "
-            f"collective-shape gates need a TPU/GPU pipeline")
+    return collective_combining_reason()
 
 
 def _require_collective_combining():
@@ -118,16 +81,20 @@ def _compile_step(eng, arrays):
 
 
 def test_dp_allreduce_is_fused():
-    """24 params -> a handful of combined all-reduces, NOT one per param."""
+    """24 params -> a handful of combined all-reduces, NOT one per param.
+    (Declarative since ISSUE 11: the same contract rides engine.analyze().)"""
     _require_collective_combining()
+    from paddle_tpu import analysis as an
+
     eng, arrays = _dp8_engine(n_linear=12)
     comp = _compile_step(eng, arrays)
-    n_ops = len(_ALL_REDUCE_OP.findall(comp.as_text()))
-    n_params = len(eng.params)
-    assert n_params == 24
-    assert 1 <= n_ops <= 4, (
-        f"{n_ops} all-reduce ops for {n_params} params — gradient all-reduce "
-        f"combining regressed (expected one variadic fused all-reduce)")
+    assert len(eng.params) == 24
+    rep = an.check_compiled("train.step", comp, an.ProgramContract(
+        collectives={"all-reduce": (1, 4)},
+        allow_host_calls=True, max_constant_bytes=None))
+    assert rep.ok, (
+        f"gradient all-reduce combining regressed (expected one variadic "
+        f"fused all-reduce for 24 params):\n{rep.format()}")
 
 
 def _compile_accum(eng, arrays, k, dtype="f32"):
@@ -152,23 +119,21 @@ def test_microbatch_accum_exactly_one_fused_allreduce(k):
                           .astype("float32")),
               jnp.asarray(np.random.RandomState(1).randn(64, 64)
                           .astype("float32"))]  # 64 rows: divisible by dp8*K
+    from paddle_tpu import analysis as an
+
     comp = _compile_accum(eng, arrays, k)
-    txt = comp.as_text()
-    n_ar = len(_ALL_REDUCE_OP.findall(txt))
-    assert n_ar == 1, (
-        f"{n_ar} all-reduce ops in the K={k} accumulation step — expected "
-        f"the single deferred fused gradient all-reduce")
-    n_while = len(re.findall(r"\) while\(", txt))
-    assert n_while == 1, (
-        f"expected one accumulation scan while-loop, found {n_while}")
-    ma = comp.memory_analysis()
     state_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
                       for a in eng.params.values())
     state_bytes += sum(int(np.prod(s.shape)) * s.dtype.itemsize
                        for st in eng.opt_state.values() for s in st)
-    assert ma.alias_size_in_bytes >= 0.9 * state_bytes, (
-        "accumulation-step donation regressed: params/opt state would "
-        "double-buffer in HBM")
+    rep = an.check_compiled(f"train.accum_k{k}_f32", comp, an.ProgramContract(
+        collectives={"all-reduce": 1}, while_loops=1,
+        donated_bytes=state_bytes,
+        allow_host_calls=True, max_constant_bytes=None))
+    assert rep.ok, (
+        f"K={k} accumulation contract broken (expected ONE deferred fused "
+        f"gradient all-reduce, one scan while-loop, donated params+opt "
+        f"state):\n{rep.format()}")
 
 
 def test_microbatch_accum_shrinks_activation_peak():
@@ -206,17 +171,21 @@ def test_microbatch_accum_shrinks_activation_peak():
 
 def test_engine_donation_aliases_param_and_opt_buffers():
     """donate_argnums must alias params+opt state: peak = 1x state, not 2x."""
+    from paddle_tpu import analysis as an
+
     eng, arrays = _dp8_engine(n_linear=4)
     comp = _compile_step(eng, arrays)
-    ma = comp.memory_analysis()
     state_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
                       for a in eng.params.values())
     state_bytes += sum(int(np.prod(s.shape)) * s.dtype.itemsize
                        for st in eng.opt_state.values() for s in st)
     # per-device view: arguments are replicated here (dp), so full size
-    assert ma.alias_size_in_bytes >= 0.9 * state_bytes, (
-        f"alias {ma.alias_size_in_bytes} < state {state_bytes}: buffer "
-        f"donation regressed — training would double-buffer params in HBM")
+    rep = an.check_compiled("train.step", comp, an.ProgramContract(
+        donated_bytes=state_bytes,
+        allow_host_calls=True, max_constant_bytes=None))
+    assert rep.ok, (
+        f"buffer donation regressed — training would double-buffer params "
+        f"in HBM:\n{rep.format()}")
 
 
 def test_train_step_flops_accounting():
@@ -463,14 +432,17 @@ def test_default_sequence_parallel_is_ulysses_all_to_all():
     # tripping the no-ppermute assertion for reasons unrelated to the ulysses
     # routing — same reduced pipeline the probe detects
     _require_collective_combining()
+    from paddle_tpu import analysis as an
+
     eng, tr = _gpt_engine_compiled({"dp_degree": 2, "mp_degree": 2,
                                     "sep_degree": 2})
-    txt = tr.lower().compile().as_text()
-    assert "all-to-all" in txt, (
-        "no all-to-all in the default sp step — the ulysses default regressed")
-    assert "collective-permute" not in txt, (
-        "ppermute in the default sp step — ring engaged despite the ulysses "
-        "default")
+    rep = an.check_compiled("train.step", tr.lower().compile(),
+                            an.ProgramContract(
+        collectives={"all-to-all": (1, None), "collective-permute": 0},
+        allow_host_calls=True, max_constant_bytes=None))
+    assert rep.ok, (
+        f"ulysses default regressed (expected all-to-alls, no ppermute in "
+        f"the default sp step):\n{rep.format()}")
 
 
 def test_zero_sharding_gathers_params_and_keeps_fused_grad_reduce():
@@ -479,15 +451,18 @@ def test_zero_sharding_gathers_params_and_keeps_fused_grad_reduce():
     _require_collective_combining()
     eng, tr = _gpt_engine_compiled({"dp_degree": 2, "sharding_degree": 4},
                                    sharding=True)
+    from paddle_tpu import analysis as an
+
     sharded = sum(1 for s in eng.opt_specs.values()
                   if "sharding" in str(s))
     assert sharded >= 10, f"only {sharded} opt-state specs ZeRO-sharded"
-    txt = tr.lower().compile().as_text()
-    n_ag = len(re.findall(r"%all-gather[-.\w]*\s*=", txt))
-    n_ar = len(re.findall(r"%all-reduce[-.\w]*\s*=", txt))
-    assert n_ag >= 5, f"{n_ag} all-gathers: ZeRO param re-materialization gone"
-    assert 1 <= n_ar <= 8, (
-        f"{n_ar} all-reduce ops — gradient reduction no longer combined")
+    rep = an.check_compiled("train.step", tr.lower().compile(),
+                            an.ProgramContract(
+        collectives={"all-gather": (5, None), "all-reduce": (1, 8)},
+        allow_host_calls=True, max_constant_bytes=None))
+    assert rep.ok, (
+        f"ZeRO-1 signature broken (expected param all-gathers plus a "
+        f"COMBINED gradient reduction):\n{rep.format()}")
 
 
 def test_run_steps_scan_is_one_program_one_loop():
@@ -500,21 +475,20 @@ def test_run_steps_scan_is_one_program_one_loop():
     k = 5
     jf = eng._build_scan(arrays, True)
     keys = jnp.stack([jax.random.key(i) for i in range(k)])
+    from paddle_tpu import analysis as an
+
     comp = jf.lower(eng.params, eng.opt_state, jnp.full((k,), 1e-3, jnp.float32),
                     jnp.int32(1), keys, *arrays).compile()
-    txt = comp.as_text()
-    # the while op line is `%while.N = (...) while(%arg), condition=...`
-    n_while = len(re.findall(r"\) while\(", txt))
-    assert n_while == 1, f"expected one scan while-loop, found {n_while}"
-    n_ar = len(_ALL_REDUCE_OP.findall(txt))
-    assert 1 <= n_ar <= 4, (
-        f"{n_ar} all-reduce ops inside the scanned step — the fused gradient "
-        f"reduction regressed in the run_steps path")
-    ma = comp.memory_analysis()
     state_bytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
                       for a in eng.params.values())
-    assert ma.alias_size_in_bytes >= 0.9 * state_bytes, (
-        "scan carry donation regressed: params would double-buffer per step")
+    rep = an.check_compiled("train.run_steps", comp, an.ProgramContract(
+        collectives={"all-reduce": (1, 4)}, while_loops=1,
+        donated_bytes=state_bytes,
+        allow_host_calls=True, max_constant_bytes=None))
+    assert rep.ok, (
+        f"run_steps contract broken (expected ONE scan while-loop, the "
+        f"fused gradient all-reduce, donated carried params):\n"
+        f"{rep.format()}")
 
 
 def test_decode_loop_cache_in_place_no_weight_casts():

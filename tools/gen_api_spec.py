@@ -39,6 +39,7 @@ MODULES = [
     "paddle_tpu.profiler",
     "paddle_tpu.observability",
     "paddle_tpu.onnx",
+    "paddle_tpu.analysis",
 ]
 
 

@@ -1,0 +1,154 @@
+"""Lint compiled executables against their program contracts.
+
+The CLI face of paddle_tpu/analysis: builds a tiny GPT (like
+tools/mem_report.py), drives one train step per requested path so the
+engine stashes its executables, then runs `engine.analyze()` — every
+stashed label checked against the engine's default contracts
+(collective shapes, donation coverage, grad-comm payload dtype, host
+transfers, constant bloat, recompile hazards). --serve additionally
+drives one ServingEngine prefill+decode and lints those labels.
+
+Run:  JAX_PLATFORMS=cpu python tools/hlo_lint.py
+      [--batch 8] [--seq 128] [--microbatches 2] [--serve] [--zero]
+      [--no-donate] [--dump]
+
+--no-donate deliberately builds the train engine with donation off so
+the donation-leak pass fires — the seeded-violation smoke test for the
+analyzer itself (and the pinned exit-code-1 path).
+
+Exit codes: 0 = all programs clean, 1 = contract violations, 2 = error
+(bad arguments, lint crash). Ends with the tools-convention
+machine-readable {"summary": ...} JSON line.
+"""
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path)
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--microbatches", type=int, default=2,
+                    help="also lint the K-microbatch accumulation step "
+                         "(1 disables)")
+    ap.add_argument("--serve", action="store_true",
+                    help="also drive one ServingEngine prefill+decode and "
+                         "lint those executables")
+    ap.add_argument("--zero", action="store_true",
+                    help="also lint the ZeRO weight-update-sharded step on "
+                         "a dp8 virtual mesh")
+    ap.add_argument("--no-donate", action="store_true",
+                    help="build the train engine WITHOUT buffer donation — "
+                         "the donation-leak pass must fire (seeded "
+                         "violation; exits 1)")
+    ap.add_argument("--dump", action="store_true",
+                    help="flight-dump on violations (FLAGS_analysis_"
+                         "flight_dump for this run)")
+    args = ap.parse_args()
+
+    if args.zero:
+        # dp8 virtual devices; must precede the first jax import
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xf:
+            os.environ["XLA_FLAGS"] = (
+                xf + " --xla_force_host_platform_device_count=8").strip()
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu.distributed.engine import TrainStepEngine
+    from paddle_tpu.distributed.mesh import (HybridCommunicateGroup,
+                                             set_hybrid_communicate_group)
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+
+    import jax
+
+    cfg = gpt_tiny()
+    cfg.max_seq_len = max(args.seq, 64)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size,
+                      (args.batch, args.seq)).astype(np.int64)
+    labels = np.roll(ids, -1, 1)
+
+    def build(k, donate=True):
+        set_hybrid_communicate_group(None)
+        hcg = HybridCommunicateGroup(dp_degree=1, devices=jax.devices()[:1])
+        paddle.seed(0)
+        model = GPTForPretraining(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        return TrainStepEngine(model, opt, hcg=hcg, microbatches=k,
+                               donate=donate)
+
+    reports = []
+
+    eng = build(1, donate=not args.no_donate)
+    eng.step(ids, labels)
+    if args.microbatches > 1:
+        eng.microbatches = args.microbatches
+        eng.step(ids, labels)
+    contracts = eng.default_contracts()
+    if args.no_donate:
+        # donation is off, so default contracts drop the donation clause;
+        # re-impose it — the point of --no-donate is watching the pass fire
+        contracts.append(analysis.ProgramContract(
+            label="train.*", donated_bytes=eng._analysis_state_bytes(),
+            name="train-donation-seeded"))
+    reports.append(eng.analyze(contracts, dump=args.dump or None))
+
+    if args.zero:
+        set_hybrid_communicate_group(None)
+        hcg = HybridCommunicateGroup(dp_degree=8, devices=jax.devices()[:8])
+        paddle.seed(0)
+        # MLP, not the GPT: ZeRO needs pure dp with replicated params
+        model = paddle.nn.Sequential(paddle.nn.Linear(256, 256),
+                                     paddle.nn.ReLU(),
+                                     paddle.nn.Linear(256, 4))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        ez = TrainStepEngine(model, opt,
+                             loss_fn=paddle.nn.CrossEntropyLoss(),
+                             hcg=hcg, microbatches=2, zero_update=True)
+        k = 2
+        bz = -(-args.batch // (8 * k)) * (8 * k)
+        ez.step(rng.randn(bz, 256).astype(np.float32),
+                rng.randint(0, 4, (bz,)).astype(np.int64))
+        reports.append(ez.analyze(dump=args.dump or None))
+
+    if args.serve:
+        from paddle_tpu.serving import ServingEngine
+
+        set_hybrid_communicate_group(None)
+        paddle.seed(0)
+        srv = ServingEngine(GPTForPretraining(cfg), slot_count=2,
+                            max_new_cap=8, steps_per_dispatch=2)
+        srv.submit(rng.randint(0, cfg.vocab_size, 12).astype(np.int64),
+                   max_new_tokens=6)
+        srv.run(max_steps=8)
+        reports.append(srv.analyze(dump=args.dump or None))
+
+    merged = analysis.AnalysisReport()
+    for r in reports:
+        merged.violations += r.violations
+        merged.skips += r.skips
+        merged.checked += r.checked
+    print(merged.format())
+    print(json.dumps({"summary": {"kind": "hlo_lint", **merged.summary()}}))
+    return 0 if merged.ok else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except Exception as e:  # lint crash = exit 2, distinct from violations
+        print(f"hlo_lint error: {e!r}", file=sys.stderr)
+        sys.exit(2)

@@ -1,0 +1,68 @@
+"""Tracing-hazard source linter CLI (paddle_tpu/analysis/source_lint.py).
+
+Walks paddle_tpu/ and tools/ with the AST rules (host-sync, host-time,
+host-random, mutable-default, bare-lock), compares against the
+burned-down baseline, and prints every NEW finding plus every STALE
+baseline entry (debt that was paid off must be deleted from the
+baseline — it may not silently regrow).
+
+Run:  python tools/lint_tracing.py [--baseline tools/lint_tracing_baseline.txt]
+      [--all]   # print baselined findings too
+
+Exit codes: 0 = clean vs baseline, 1 = new findings or stale baseline
+entries, 2 = error. Ends with a {"summary": ...} JSON line.
+"""
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path)
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(_REPO, "tools", "lint_tracing_baseline.txt")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of accepted findings "
+                         "(key  # justification per line)")
+    ap.add_argument("--root", default=_REPO)
+    ap.add_argument("--all", action="store_true",
+                    help="also print findings covered by the baseline")
+    args = ap.parse_args()
+
+    from paddle_tpu.analysis import source_lint
+
+    findings = source_lint.lint_tree(args.root)
+    baseline = source_lint.load_baseline(args.baseline)
+    new, stale = source_lint.compare_to_baseline(findings, baseline)
+
+    if args.all:
+        for f in findings:
+            mark = "  (baselined)" if f.key in baseline else ""
+            print(f"{f}{mark}")
+    for f in new:
+        print(f"NEW {f}")
+    for k in stale:
+        print(f"STALE baseline entry (finding fixed — delete the line): {k}")
+
+    ok = not new and not stale
+    print(json.dumps({"summary": {
+        "kind": "lint_tracing", "ok": ok, "findings": len(findings),
+        "baselined": len(baseline), "new": [f.key for f in new],
+        "stale": stale}}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except Exception as e:
+        print(f"lint_tracing error: {e!r}", file=sys.stderr)
+        sys.exit(2)
