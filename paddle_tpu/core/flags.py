@@ -192,3 +192,17 @@ define_flag("analysis_flight_dump", False,
             "when engine.analyze()/hlo_lint finds contract violations and a "
             "flight recorder is installed, dump the ring naming the "
             "offending label + pass (analysis/manager.py)")
+define_flag("elastic_lease_s", 5.0,
+            "membership heartbeat lease duration in seconds "
+            "(distributed/membership.py). A worker whose lease key is older "
+            "than this is treated as departed at the next coordinator poll "
+            "(elastic.lease_expiries counter); heartbeats refresh at a third "
+            "of the lease so one missed beat never evicts")
+define_flag("elastic_check_interval", 1,
+            "optimizer steps between ElasticCoordinator membership polls "
+            "when driving through coordinator.on_step(). 1 = re-form at the "
+            "very next step boundary after a join/leave lands")
+define_flag("elastic_drain_timeout_s", 30.0,
+            "serving-replica drain bound: a SIGTERM'd ServingEngine stops "
+            "admission and runs active slots to completion for at most this "
+            "long before retiring (elastic.drain_ms histogram)")

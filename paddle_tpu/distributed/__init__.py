@@ -23,8 +23,11 @@ from .mesh import (  # noqa: F401
 from .engine import TrainStepEngine, parallelize  # noqa: F401
 from . import elastic  # noqa: F401
 from .elastic import (  # noqa: F401
-    CheckpointCorrupt, CheckpointManager, restore_latest, verify_checkpoint,
+    CheckpointCorrupt, CheckpointManager, live_reshard, restore_latest,
+    verify_checkpoint,
 )
+from . import membership  # noqa: F401
+from .membership import ElasticCoordinator, WorkerAgent  # noqa: F401
 from .prefetcher import DevicePrefetcher  # noqa: F401
 from .store import FileStore, TCPStore  # noqa: F401
 from . import auto_parallel  # noqa: F401

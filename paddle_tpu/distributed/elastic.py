@@ -523,6 +523,24 @@ def restore_latest(engine, dirname: str) -> int:
     raise FileNotFoundError(f"no checkpoint under {dirname}")
 
 
+def live_reshard(engine, new_hcg) -> float:
+    """In-memory topology change: redistribute the engine's params + flat
+    ZeRO opt shards onto ``new_hcg``'s mesh WITHOUT a disk bounce, and
+    return the pause in milliseconds.
+
+    This is the live twin of save + ``restore_latest`` onto a new
+    topology: the same host bytes land under the same target shardings
+    (``engine.reform_mesh`` reuses the segment_layout reslice math above),
+    so training continues bit-identically to the checkpoint-restore path —
+    just without serializing ~2x model size through the filesystem.
+    ``restore_latest`` stays the fallback for hard crashes; membership
+    tracking + the pause/resume protocol live in
+    distributed/membership.py (ElasticCoordinator)."""
+    t0 = time.perf_counter()
+    engine.reform_mesh(new_hcg)
+    return (time.perf_counter() - t0) * 1000.0
+
+
 # ---------------------------------------------------------------- manager
 class CheckpointManager:
     """Owns one checkpoint directory: periodic async saves, retention GC,

@@ -353,6 +353,130 @@ class TrainStepEngine:
         self._accum_fns = {}
         self._exec_stash = {}
 
+    def reform_mesh(self, new_hcg: HybridCommunicateGroup) -> None:
+        """Live in-memory mesh reformation (elastic autoscaling).
+
+        Re-forms this engine onto ``new_hcg``'s mesh without a disk bounce:
+        params and optimizer state are host-gathered from the old mesh
+        (flat ZeRO slot shards at their true ``[:n]`` prefix — the same
+        segment_layout-ordered vector elastic.py's checkpoint reslice
+        uses), every device placement is rebuilt against the new topology,
+        and only then does the engine commit. Any failure before the
+        commit point leaves the engine fully on the OLD mesh, so the
+        caller's ``restore_latest`` fallback still has a coherent engine
+        to restore into.
+
+        Bit-equality contract: the host values placed here are exactly the
+        bytes a synchronous checkpoint at this boundary would hold, and the
+        target shardings are exactly what a fresh engine + restore onto
+        ``new_hcg`` would build — so the continued loss curve is
+        bit-identical to the checkpoint-restore path on the same topology
+        change (tests/test_elastic_live.py pins this for both the
+        replicated and ZeRO optimizer layouts).
+
+        The ZeRO flat buffer re-pads to the NEW replica count: pad elements
+        are zeros by construction and stay zero through every whitelisted
+        update rule, so growing/shrinking the pad tail never perturbs real
+        state.
+        """
+        new_mesh = new_hcg.mesh
+        use_sharding = bool(self.strategy and
+                            getattr(self.strategy, "sharding", False)) or \
+            new_hcg.degrees["sharding"] > 1
+
+        # ---- host gather off the OLD mesh (owned copies) ----
+        host_params = {n: np.array(self.params[n], copy=True)
+                       for n in self._param_names}
+        host_opt = None
+        if self.opt_state is not None:
+            host_opt = {n: tuple(np.array(s, copy=True)
+                                 for s in self.opt_state[n])
+                        for n in self._param_names}
+        host_zero = None
+        if self._zero_opt is not None:
+            n_elems = self._n_grad_elems()
+            host_zero = [np.array(f, copy=True)[:n_elems]
+                         for f in self._zero_opt]
+
+        # ---- rebuild placements against the NEW mesh (temporaries) ----
+        new_param_specs = {}
+        new_params = {}
+        for n in self._param_names:
+            p = self._state_refs[n]
+            spec = _param_spec(p, p.shape, new_hcg)
+            new_param_specs[n] = spec
+            new_params[n] = jax.device_put(
+                host_params[n], NamedSharding(new_mesh, spec))
+        new_opt_specs = {
+            n: _opt_state_spec(new_param_specs[n],
+                               self._state_refs[n].shape, new_hcg,
+                               use_sharding)
+            for n in self._param_names}
+
+        def _opt_sh(spec):
+            if self._opt_memory_kind:
+                return NamedSharding(new_mesh, spec,
+                                     memory_kind=self._opt_memory_kind)
+            return NamedSharding(new_mesh, spec)
+
+        new_opt_state = None
+        if host_opt is not None:
+            new_opt_state = {
+                n: tuple(jax.device_put(s, _opt_sh(new_opt_specs[n]))
+                         for s in host_opt[n])
+                for n in self._param_names}
+
+        new_zero = None
+        if host_zero is not None:
+            batch_axes = tuple(a for a in ("dp", "sharding")
+                               if new_hcg.degrees[a] > 1)
+            nrep_new = _gc.replica_count(new_mesh, batch_axes)
+            n_elems = self._n_grad_elems()
+            n_pad_new = _gc.zero_pad_elems(n_elems, nrep_new,
+                                           _gc.chunk_size())
+            spec = P(batch_axes if len(batch_axes) > 1
+                     else (batch_axes[0] if batch_axes else None))
+            sh = NamedSharding(new_mesh, spec)
+            flats = []
+            for f in host_zero:
+                buf = np.zeros((n_pad_new,), np.float32)
+                buf[:n_elems] = f
+                flats.append(jax.device_put(buf, sh))
+            new_zero = tuple(flats)
+
+        # surface transfer failures (OOM, detached device) BEFORE commit
+        for arr in new_params.values():
+            arr.block_until_ready()
+        if new_opt_state is not None:
+            for slots in new_opt_state.values():
+                for s in slots:
+                    s.block_until_ready()
+        if new_zero is not None:
+            for f in new_zero:
+                f.block_until_ready()
+
+        # ---- commit + drop every mesh-derived cache ----
+        self.hcg = new_hcg
+        self.mesh = new_mesh
+        self.param_specs = new_param_specs
+        self.params = new_params
+        self.opt_specs = new_opt_specs
+        self.opt_state = new_opt_state
+        self._zero_opt = new_zero
+        self._invalidate_step_fns()
+        self._scan_fns = {True: None, False: None}
+        self._scan_batch_shardings = {}
+        self._batch_shardings = None
+        # error-feedback residual is per-replica accumulator state tied to
+        # the old replica count; reformation restarts it at zero (same as
+        # the checkpoint-restore path, which never persists it)
+        self._grad_residual = None
+        self._pending_h2d = None
+        self._lr_cache = (None, None)
+        self._zero_reason = "unset"
+        self._zero_warned = False
+        self._gspmd_warned = False
+
     # ---- compiled-executable introspection (observability/exec_introspect) --
     def _stash_exec(self, label: str, fn, call_args) -> None:
         """First call per label: remember (jitted fn, abstract args) so

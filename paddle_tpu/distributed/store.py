@@ -19,6 +19,54 @@ from ..core.native import load_library
 
 _DEFAULT_TIMEOUT = 900.0  # seconds, matches the reference's default store timeout
 RETRIES = _monitor.stat("store.retries")
+LEASE_EXPIRIES = _monitor.stat("store.lease_expiries")
+GC_KEYS = _monitor.stat("store.gc_keys")
+
+
+class _StoreOps:
+    """Shared high-level helpers over the primitive set/get/add/wait/
+    delete_key/list_keys surface — mixed into TCPStore AND FileStore so the
+    elastic membership coordinator runs identically on either backend.
+
+    Generation scoping: a live mesh reformation (distributed/membership.py)
+    bumps a world generation; every coordination key a generation touches
+    (barrier rounds, member leases, join/leave announcements) lives under a
+    ``gen<N>`` namespace so a re-formed world can never trip over counters
+    or done-flags a dead generation left behind. ``gc_generation`` sweeps a
+    retired generation's keys (counted in ``store.gc_keys``).
+    """
+
+    def barrier(self, name: str, world_size: Optional[int] = None,
+                timeout: Optional[float] = None,
+                generation: Optional[int] = None) -> None:
+        """All ranks arrive, then all ranks proceed. Reusable: the round is
+        derived from the arrival counter, so the same name synchronizes every
+        call (reference uses add+wait loops the same way). ``generation``
+        namespaces the round keys per world generation — barrier("resume",
+        generation=3) can never consume an arrival generation 2 banked."""
+        n = world_size or self.world_size
+        ns = (f"__barrier__/gen{int(generation)}/{name}"
+              if generation is not None else f"__barrier__/{name}")
+        arrived = self.add(f"{ns}/count", 1)
+        round_idx = (arrived - 1) // n
+        done_key = f"{ns}/round{round_idx}/done"
+        if arrived == (round_idx + 1) * n:
+            self.set(done_key, b"1")
+        self.wait([done_key], timeout)
+
+    def gc_generation(self, generation: int) -> int:
+        """Delete every key a retired world generation owned (membership
+        leases, join/leave announcements, barrier rounds). Returns the
+        number of keys removed; each removal counts in ``store.gc_keys``."""
+        removed = 0
+        for prefix in (f"__elastic__/gen{int(generation)}/",
+                       f"__barrier__/gen{int(generation)}/"):
+            for key in self.list_keys(prefix):
+                if self.delete_key(key):
+                    removed += 1
+        if removed:
+            GC_KEYS.increase(removed)
+        return removed
 
 
 def _connect_with_retry(connect, host, port, timeout,
@@ -96,7 +144,7 @@ def _lib():
     return lib
 
 
-class TCPStore:
+class TCPStore(_StoreOps):
     """paddle.distributed.TCPStore parity: TCPStore(host, port, is_master,
     world_size, timeout)."""
 
@@ -219,20 +267,6 @@ class TCPStore:
                 continue
             raise RuntimeError(f"TCPStore.list_keys({prefix!r}) failed rc={rc}")
 
-    # ---- helpers ----
-    def barrier(self, name: str, world_size: Optional[int] = None,
-                timeout: Optional[float] = None) -> None:
-        """All ranks arrive, then all ranks proceed. Reusable: the round is
-        derived from the arrival counter, so the same name synchronizes every
-        call (reference uses add+wait loops the same way)."""
-        n = world_size or self.world_size
-        arrived = self.add(f"__barrier__/{name}/count", 1)
-        round_idx = (arrived - 1) // n
-        done_key = f"__barrier__/{name}/round{round_idx}/done"
-        if arrived == (round_idx + 1) * n:
-            self.set(done_key, b"1")
-        self.wait([done_key], timeout)
-
     def __del__(self):
         try:
             if getattr(self, "_lib", None) is not None:
@@ -249,27 +283,37 @@ class TCPStore:
             pass
 
 
-class FileStore:
+class FileStore(_StoreOps):
     """Single-host fallback store over a shared directory (reference has a
-    libuv-free file store for tests)."""
+    libuv-free file store for tests). Full TCPStore API parity — bounded
+    ``wait``/``get`` timeouts, ``delete_key``/``list_keys``/``num_keys``,
+    the generation-scoped ``barrier``/``gc_generation`` helpers — so the
+    elastic membership coordinator runs on either backend, and multi-agent
+    tests can rendezvous through a tmpdir instead of a socket."""
 
-    def __init__(self, path: str, world_size: int = 1):
+    def __init__(self, path: str, world_size: int = 1,
+                 timeout: float = _DEFAULT_TIMEOUT):
         self.path = path
         self.world_size = world_size
+        self.timeout = timeout
         os.makedirs(path, exist_ok=True)
+
+    _LOCK = ".lock"
 
     def _p(self, key: str) -> str:
         return os.path.join(self.path, key.replace("/", "%2F"))
 
     def set(self, key: str, value) -> None:
         data = value if isinstance(value, bytes) else str(value).encode()
-        tmp = self._p(key) + ".tmp"
+        tmp = self._p(key) + f".tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, self._p(key))
 
-    def get(self, key: str, wait: bool = True, timeout: float = _DEFAULT_TIMEOUT):
-        deadline = time.monotonic() + timeout
+    def get(self, key: str, wait: bool = True,
+            timeout: Optional[float] = None) -> bytes:
+        tmo = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + tmo
         while True:
             try:
                 with open(self._p(key), "rb") as f:
@@ -278,13 +322,15 @@ class FileStore:
                 if not wait:
                     raise KeyError(key) from None
                 if time.monotonic() > deadline:
-                    raise TimeoutError(key) from None
+                    raise TimeoutError(
+                        f"FileStore.get({key!r}): not set within {tmo}s"
+                    ) from None
                 time.sleep(0.02)
 
     def add(self, key: str, amount: int = 1) -> int:
         import fcntl
 
-        lockp = os.path.join(self.path, ".lock")
+        lockp = os.path.join(self.path, self._LOCK)
         with open(lockp, "w") as lf:
             fcntl.flock(lf, fcntl.LOCK_EX)
             try:
@@ -296,7 +342,39 @@ class FileStore:
             return new
 
     def wait(self, keys, timeout: Optional[float] = None) -> None:
+        """Block until every key exists; raises TimeoutError past the bound
+        (the store timeout by default) instead of wedging the caller — the
+        same contract as TCPStore.wait."""
         if isinstance(keys, str):
             keys = [keys]
+        tmo = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + tmo
         for k in keys:
-            self.get(k, wait=True, timeout=timeout or _DEFAULT_TIMEOUT)
+            self.get(k, wait=True,
+                     timeout=max(0.0, deadline - time.monotonic()))
+
+    def delete_key(self, key: str) -> bool:
+        try:
+            os.remove(self._p(key))
+            return True
+        except FileNotFoundError:
+            return False
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        """Keys with the given prefix (used by the elastic membership
+        registry). Internal lock/tmp files are invisible by construction."""
+        out = []
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return out
+        for name in names:
+            if name == self._LOCK or ".tmp." in name:
+                continue
+            key = name.replace("%2F", "/")
+            if key.startswith(prefix):
+                out.append(key)
+        return sorted(out)
+
+    def num_keys(self) -> int:
+        return len(self.list_keys())

@@ -30,6 +30,7 @@ a TPU deployment keeps resident.
 from __future__ import annotations
 
 import itertools
+import signal
 import threading
 import time
 from collections import deque
@@ -180,6 +181,12 @@ class ServingEngine:
         self._queue: deque[Request] = deque()
         self._completed: List[Request] = []
         self._steps = 0
+        # elastic drain state (distributed/membership.py protocol): once
+        # draining, submit() refuses and _admit() stops pulling the queue —
+        # active slots run to completion, then the replica retires
+        self._draining = False
+        self._replica_agent = None
+        self._prev_sigterm = None
 
         self.refresh_params()
 
@@ -242,6 +249,10 @@ class ServingEngine:
         """Enqueue a request; returns the live Request handle (tokens fill
         in as the engine runs). max_new_tokens is clamped to the engine cap
         and to the cache room left after the prompt's bucket."""
+        if self._draining:
+            raise RuntimeError(
+                "ServingEngine is draining (SIGTERM/begin_drain): admission "
+                "is closed; submit to a live replica")
         req = Request(prompt_ids, max_new_tokens, temperature, top_k, top_p,
                       eos_token_id, seed)
         plen = len(req.prompt_ids)
@@ -273,12 +284,86 @@ class ServingEngine:
         dispatches); returns the requests completed during this call."""
         done0 = len(self._completed)
         steps = 0
-        while self._queue or self._active.any():
+        while (self._queue and not self._draining) or self._active.any():
             self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
         return self._completed[done0:]
+
+    # ---------------------------------------------------- elastic replica
+    def register_replica(self, store, replica_id: str,
+                         lease_s: Optional[float] = None):
+        """Join the serving fleet: heartbeat a ``replica/<rid>`` lease under
+        the current membership generation (distributed/membership.py) and
+        arm nothing else — call install_sigterm_handler() to make SIGTERM
+        drain this replica gracefully. Returns the WorkerAgent."""
+        from ..distributed.membership import WorkerAgent
+
+        agent = WorkerAgent(store, replica_id, lease_s=lease_s,
+                            kind="replica")
+        agent.register()
+        agent.start_heartbeat()
+        self._replica_agent = agent
+        return agent
+
+    def begin_drain(self, reason: str = "drain") -> None:
+        """Stop admission NOW (submit() refuses, queued requests stay
+        queued for a live replica); active slots keep decoding. Idempotent."""
+        if self._draining:
+            return
+        self._draining = True
+        if reason == "sigterm":
+            from ..distributed import membership as _membership
+
+            _membership.PREEMPTIONS.increase()
+            mreg = _obs_metrics.active_registry()
+            if mreg is not None:
+                mreg.counter("elastic.preemptions").inc()
+
+    def drain(self, timeout_s: Optional[float] = None) -> List[Request]:
+        """Run active slots to completion (admission closed), deregister
+        the replica lease, and return the requests completed during the
+        drain. Bounded by FLAGS_elastic_drain_timeout_s — a wedged decode
+        retires the replica anyway rather than hanging the SIGTERM path.
+        Records ``elastic.drain_ms`` in the metrics registry."""
+        self.begin_drain()
+        tmo = float(timeout_s if timeout_s is not None
+                    else _flags.flag("elastic_drain_timeout_s"))
+        t0 = time.perf_counter()
+        done0 = len(self._completed)
+        while self._active.any():
+            if time.perf_counter() - t0 > tmo:
+                break
+            self._decode_step()
+        drain_ms = (time.perf_counter() - t0) * 1000.0
+        mreg = _obs_metrics.active_registry()
+        if mreg is not None:
+            mreg.histogram("elastic.drain_ms").observe(drain_ms)
+        self.retire()
+        return self._completed[done0:]
+
+    def retire(self) -> None:
+        """Deregister the replica lease (graceful leave). Idempotent; a
+        no-op when register_replica was never called."""
+        if self._replica_agent is not None:
+            self._replica_agent.announce_leave(
+                "sigterm" if self._draining else "leave")
+            self._replica_agent = None
+
+    def install_sigterm_handler(self) -> None:
+        """SIGTERM → close admission (drain flag) and chain the previous
+        handler. The actual drain runs on the driver thread: run() exits
+        its loop once active slots empty (queue is no longer admitted), or
+        the owner calls drain() explicitly. Signal-handler work is kept to
+        a flag flip — no jax dispatch from an async context."""
+        def _on_sigterm(signum, frame):
+            self.begin_drain("sigterm")
+            prev = self._prev_sigterm
+            if callable(prev):
+                prev(signum, frame)
+
+        self._prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
 
     def stats(self) -> Dict[str, Any]:
         return {
@@ -286,6 +371,7 @@ class ServingEngine:
             "completed": len(self._completed),
             "queued": len(self._queue),
             "active_slots": int(self._active.sum()),
+            "draining": self._draining,
             "slot_count": self.slot_count,
             "ladder": self.ladder,
             "prefill_executables": len(self._prefill_fns),
@@ -439,6 +525,8 @@ class ServingEngine:
         import jax.numpy as jnp
         import numpy as np
 
+        if self._draining:
+            return
         while True:
             with self._lock:
                 if not self._queue:

@@ -1,0 +1,399 @@
+"""Live elastic autoscaling (distributed/membership.py + engine.reform_mesh).
+
+The contract under test: an in-memory mesh reformation (dp4→dp2→dp4) is
+bit-identical — params, optimizer state, and the continued loss curve — to
+the checkpoint-restore path onto the same topology change, for both the
+replicated and ZeRO optimizer layouts. Plus the membership protocol itself
+(leases, expiry eviction, generation bumps + GC), the failure path (flight
+dump + restore_latest fallback instead of a hang), and the serving-replica
+drain. The full SIGTERM dp8→dp6→dp8 drill with real worker processes lives
+in tools/elastic_drill.py / __graft_entry__ phase 12; these tests pin every
+branch on cheap engines.
+"""
+import json
+import os
+import signal
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor
+from paddle_tpu.distributed import membership
+from paddle_tpu.distributed.elastic import (CheckpointManager, live_reshard,
+                                            restore_latest)
+from paddle_tpu.distributed.engine import TrainStepEngine
+from paddle_tpu.distributed.membership import (ElasticCoordinator,
+                                               WorkerAgent,
+                                               bump_generation,
+                                               current_generation)
+from paddle_tpu.distributed.mesh import (HybridCommunicateGroup,
+                                         set_hybrid_communicate_group)
+from paddle_tpu.distributed.store import FileStore
+
+
+def _hcg(dp):
+    set_hybrid_communicate_group(None)
+    return HybridCommunicateGroup(dp_degree=dp, devices=jax.devices()[:dp])
+
+
+def _make(dp=4, zero=False, seed=0):
+    hcg = _hcg(dp)
+    paddle.seed(seed)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    return TrainStepEngine(net, opt, loss_fn=paddle.nn.CrossEntropyLoss(),
+                           hcg=hcg, zero_update=zero)
+
+
+def _batch(n=32):
+    rng = np.random.RandomState(0)
+    return (paddle.to_tensor(rng.randn(n, 16).astype(np.float32)),
+            paddle.to_tensor(rng.randint(0, 4, (n,)).astype(np.int64)))
+
+
+def _losses(eng, x, y, steps):
+    return [float(eng.step(x, y).item()) for _ in range(steps)]
+
+
+def _param_bytes(eng):
+    return {n: np.asarray(eng.params[n]).tobytes()
+            for n in eng._param_names}
+
+
+def _opt_bytes(eng):
+    if eng._zero_opt is not None:
+        n = eng._n_grad_elems()
+        return tuple(np.asarray(f)[:n].tobytes() for f in eng._zero_opt)
+    return {n: tuple(np.asarray(s).tobytes() for s in eng.opt_state[n])
+            for n in eng._param_names}
+
+
+def _stat(name):
+    return monitor.stat(name).get()
+
+
+# --------------------------------------------- live reshard bit-equality
+
+@pytest.mark.parametrize("zero", [False, True], ids=["replicated", "zero"])
+def test_live_reshard_bit_identical_to_restore(tmp_path, zero):
+    """dp4→dp2→dp4: at each boundary the live in-memory reshard must land
+    exactly where checkpoint-restore onto the same topology lands —
+    params, opt state, and every continued loss bit-for-bit."""
+    x, y = _batch()
+    live = _make(dp=4, zero=zero)
+    _losses(live, x, y, 3)
+
+    for leg, dp in enumerate((2, 4)):
+        ckdir = str(tmp_path / f"leg{leg}")
+        mgr = CheckpointManager(ckdir, async_save=False)
+        mgr.save(live, block=True)
+        mgr.close()
+        ctrl = _make(dp=dp, zero=zero, seed=7)  # different init on purpose
+        if zero:
+            _losses(ctrl, x, y, 1)  # engage ZeRO so the target layout exists
+        restore_latest(ctrl, ckdir)
+
+        pause_ms = live_reshard(live, _hcg(dp))
+        assert pause_ms >= 0.0
+        assert live.hcg.degrees["dp"] == dp
+        assert live.mesh.devices.size == dp
+
+        assert _param_bytes(live) == _param_bytes(ctrl)
+        assert _opt_bytes(live) == _opt_bytes(ctrl)
+        assert _losses(live, x, y, 3) == _losses(ctrl, x, y, 3)
+
+
+def test_reform_mesh_drops_compiled_state():
+    eng = _make(dp=4)
+    x, y = _batch()
+    _losses(eng, x, y, 1)
+    assert eng._step_fn is not None
+    eng.reform_mesh(_hcg(2))
+    assert eng._step_fn is None
+    assert eng._batch_shardings is None
+    assert eng._lr_cache == (None, None)
+    assert eng._zero_reason == "unset"
+    # and it still trains at the new world size
+    _losses(eng, x, y, 1)
+
+
+def test_reform_mesh_zero_repads_flat_shards():
+    """The ZeRO flat buffer re-pads to the new replica count; real elements
+    survive exactly, the pad tail is zeros."""
+    eng = _make(dp=4, zero=True)
+    x, y = _batch()
+    _losses(eng, x, y, 2)
+    n = eng._n_grad_elems()
+    before = [np.asarray(f)[:n].copy() for f in eng._zero_opt]
+    eng.reform_mesh(_hcg(2))
+    for f, b in zip(eng._zero_opt, before):
+        host = np.asarray(f)
+        assert host[:n].tobytes() == b.tobytes()
+        assert not host[n:].any()
+
+
+# ----------------------------------------------------- membership protocol
+
+def test_worker_agent_lease_lifecycle(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    coord = ElasticCoordinator(store, lease_s=5.0)
+    a = WorkerAgent(store, "w0", lease_s=5.0)
+    b = WorkerAgent(store, "w1", lease_s=5.0)
+    a.register()
+    b.register()
+    assert sorted(coord.live_members()) == ["w0", "w1"]
+
+    joins0 = _stat("elastic.leaves")
+    b.announce_leave("sigterm")
+    assert sorted(coord.live_members()) == ["w0"]
+    assert _stat("elastic.leaves") == joins0 + 1
+    raw = store.get(membership.member_key(0, "w1", "leave"), wait=False)
+    assert json.loads(raw.decode())["reason"] == "sigterm"
+
+
+def test_lease_expiry_evicts_and_counts(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    coord = ElasticCoordinator(store, lease_s=0.05)
+    a = WorkerAgent(store, "w0", lease_s=0.05)
+    a.register()
+    exp0 = _stat("elastic.lease_expiries")
+    sexp0 = _stat("store.lease_expiries")
+    time.sleep(0.1)  # no heartbeat: the lease lapses
+    assert coord.live_members() == {}
+    assert _stat("elastic.lease_expiries") == exp0 + 1
+    assert _stat("store.lease_expiries") == sexp0 + 1
+    # the expired key was evicted, not just skipped
+    assert store.list_keys("__elastic__/gen0/member/") == []
+
+
+def test_heartbeat_follows_generation_bump(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    a = WorkerAgent(store, "w0", lease_s=5.0)
+    a.register()
+    g1 = bump_generation(store)
+    assert current_generation(store) == g1
+    a.heartbeat()  # re-registers under the new generation
+    assert store.list_keys(f"__elastic__/gen{g1}/member/") == [
+        f"__elastic__/gen{g1}/member/w0"]
+
+
+def test_generation_scoped_barrier_and_gc(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    # same name, different generations: fully independent namespaces
+    store.barrier("sync", world_size=1, generation=1)
+    store.barrier("sync", world_size=1, generation=2)
+    assert store.list_keys("__barrier__/gen1/") != []
+    gc0 = _stat("store.gc_keys")
+    removed = store.gc_generation(1)
+    assert removed >= 1
+    assert store.list_keys("__barrier__/gen1/") == []
+    assert store.list_keys("__barrier__/gen2/") != []
+    assert _stat("store.gc_keys") == gc0 + removed
+
+
+def test_coordinator_reforms_on_membership_change(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    coord = ElasticCoordinator(store, lease_s=5.0)
+    agents = [WorkerAgent(store, f"w{i}", lease_s=5.0) for i in range(4)]
+    for a in agents:
+        a.register()
+
+    eng = _make(dp=4)
+    x, y = _batch()
+    _losses(eng, x, y, 2)
+    assert coord.maybe_reform(eng) is False  # 4 live == dp4: no change
+
+    ref0 = _stat("elastic.reformations")
+    agents[3].announce_leave("sigterm")
+    agents[2].announce_leave("sigterm")
+    gen_before = coord.generation()
+    assert coord.maybe_reform(eng) is True
+    assert eng.hcg.degrees["dp"] == 2
+    assert coord.generation() == gen_before + 1
+    assert _stat("elastic.reformations") == ref0 + 1
+    assert coord.last_pause_ms is not None and coord.last_pause_ms >= 0.0
+    # dead generation's keys are GC'd; survivors carried into the new one
+    assert store.list_keys(f"__elastic__/gen{gen_before}/") == []
+    assert sorted(coord.live_members()) == ["w0", "w1"]
+    _losses(eng, x, y, 1)  # trains at the new world size
+
+    # grow back: two new workers join
+    for i in (2, 3):
+        WorkerAgent(store, f"w{i}", lease_s=5.0).register()
+    assert coord.maybe_reform(eng) is True
+    assert eng.hcg.degrees["dp"] == 4
+    _losses(eng, x, y, 1)
+
+
+def test_on_step_counts_resumed_steps(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    coord = ElasticCoordinator(store, lease_s=5.0, check_interval=1)
+    for i in range(2):
+        WorkerAgent(store, f"w{i}", lease_s=5.0).register()
+    eng = _make(dp=4)
+    x, y = _batch()
+    _losses(eng, x, y, 1)
+    r0 = _stat("elastic.resumed_steps")
+    assert coord.on_step(eng) is True  # 2 live members -> dp2
+    _losses(eng, x, y, 2)
+    coord.on_step(eng)
+    coord.on_step(eng)
+    assert _stat("elastic.resumed_steps") == r0 + 2
+
+
+# ------------------------------------------------------------ failure path
+
+def test_failed_reform_dumps_flight_and_falls_back(tmp_path, monkeypatch):
+    """Lease timeout mid-reshard: the coordinator must dump an
+    elastic_reform_<gen> ring and restore_latest instead of hanging —
+    and the engine must still be usable."""
+    from paddle_tpu.observability import flight_recorder as fl
+
+    flight_dir = tmp_path / "flight"
+    fl.enable(str(flight_dir))
+    try:
+        store = FileStore(str(tmp_path / "store"), timeout=2.0)
+        ckdir = str(tmp_path / "ckpt")
+        eng = _make(dp=4)
+        x, y = _batch()
+        _losses(eng, x, y, 3)
+        mgr = CheckpointManager(ckdir, async_save=False)
+        mgr.save(eng, block=True)
+        mgr.close()
+
+        coord = ElasticCoordinator(store, lease_s=5.0, ckpt_dir=ckdir)
+        for i in range(2):
+            WorkerAgent(store, f"w{i}", lease_s=5.0).register()
+
+        def _boom():
+            raise TimeoutError("lease expired mid-reshard")
+
+        coord._fault_hook = _boom
+        fails0 = _stat("elastic.reform_failures")
+        assert coord.maybe_reform(eng) is False  # fell back, no reform
+        assert _stat("elastic.reform_failures") == fails0 + 1
+        assert eng.hcg.degrees["dp"] == 4        # still on the old mesh
+        assert eng._step_count == 3              # restored, not lost
+        dumps = [p for p in os.listdir(flight_dir)
+                 if "elastic_reform_" in p]
+        assert dumps, os.listdir(flight_dir)
+        payload = json.loads(
+            (flight_dir / dumps[0] / "state.json").read_text())
+        extra = payload["extra"]
+        assert "lease expired" in extra["error"]
+        assert extra["membership"]["members"]
+        _losses(eng, x, y, 1)
+    finally:
+        fl.disable()
+
+
+def test_failed_reform_without_ckpt_raises(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    coord = ElasticCoordinator(store, lease_s=5.0)
+    for i in range(2):
+        WorkerAgent(store, f"w{i}", lease_s=5.0).register()
+    eng = _make(dp=4)
+    x, y = _batch()
+    _losses(eng, x, y, 1)
+
+    def _boom():
+        raise TimeoutError("lease expired mid-reshard")
+
+    coord._fault_hook = _boom
+    with pytest.raises(TimeoutError):
+        coord.maybe_reform(eng)
+    assert eng.hcg.degrees["dp"] == 4  # atomic: old mesh intact
+
+
+def test_mismatched_generation_fails_reform(tmp_path):
+    """A second generation bump landing mid-reshard (another coordinator,
+    a racing join) must fail the reformation loudly, not silently commit."""
+    store = FileStore(str(tmp_path), timeout=2.0)
+    coord = ElasticCoordinator(store, lease_s=5.0)
+    for i in range(2):
+        WorkerAgent(store, f"w{i}", lease_s=5.0).register()
+    eng = _make(dp=4)
+    x, y = _batch()
+    _losses(eng, x, y, 1)
+    coord._fault_hook = lambda: bump_generation(store)
+    with pytest.raises(RuntimeError, match="generation moved"):
+        coord.maybe_reform(eng)
+
+
+# ------------------------------------------------------------ serving drain
+
+def _tiny_serving():
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+    from paddle_tpu.serving.engine import ServingEngine
+
+    set_hybrid_communicate_group(None)
+    paddle.seed(0)
+    model = GPTForPretraining(gpt_tiny()).eval()
+    return ServingEngine(model, slot_count=2, ladder=(8,), max_new_cap=8,
+                         steps_per_dispatch=2)
+
+
+def test_serving_drain_completes_active_refuses_new(tmp_path):
+    eng = _tiny_serving()
+    store = FileStore(str(tmp_path), timeout=2.0)
+    eng.register_replica(store, "r0", lease_s=5.0)
+    coord = ElasticCoordinator(store, lease_s=5.0)
+    assert sorted(coord.live_members(kind="replica")) == ["r0"]
+
+    r1 = eng.submit([1, 2, 3], max_new_tokens=4)
+    eng.step()  # admit + first decode chunk
+    eng.begin_drain()
+    with pytest.raises(RuntimeError, match="draining"):
+        eng.submit([4, 5], max_new_tokens=2)
+    done = eng.drain(timeout_s=30.0)
+    assert r1 in done and r1.done
+    assert not eng._active.any()
+    assert eng.stats()["draining"] is True
+    # the replica lease is gone and the leave announcement is a preemption-
+    # style record the coordinator can read
+    assert coord.live_members(kind="replica") == {}
+
+
+def test_serving_sigterm_sets_drain_flag(tmp_path):
+    eng = _tiny_serving()
+    eng.install_sigterm_handler()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.05)
+        assert eng._draining is True
+        with pytest.raises(RuntimeError, match="draining"):
+            eng.submit([1, 2], max_new_tokens=2)
+    finally:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+
+# ------------------------------------------------------- FileStore parity
+
+def test_filestore_bounded_get_and_wait(tmp_path):
+    store = FileStore(str(tmp_path), timeout=0.2)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        store.get("nope")          # store-level default bound
+    with pytest.raises(TimeoutError):
+        store.wait(["nope"], timeout=0.1)
+    assert time.monotonic() - t0 < 5.0
+    with pytest.raises(KeyError):
+        store.get("nope", wait=False)
+
+
+def test_filestore_delete_and_list(tmp_path):
+    store = FileStore(str(tmp_path))
+    store.set("a/b", b"1")
+    store.set("a/c", b"2")
+    store.set("z", b"3")
+    store.add("ctr", 1)  # exercises the .lock file: must stay invisible
+    assert store.list_keys("a/") == ["a/b", "a/c"]
+    assert store.num_keys() == 4
+    assert store.delete_key("a/b") is True
+    assert store.delete_key("a/b") is False
+    assert store.list_keys("a/") == ["a/c"]

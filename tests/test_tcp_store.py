@@ -188,6 +188,60 @@ def test_store_barrier_reusable():
         assert not t.is_alive()
 
 
+def test_barrier_generation_namespaced():
+    """The same barrier name under different generations uses disjoint keys
+    — a re-formed world can't trip over a dead generation's counts."""
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10.0)
+    s.barrier("sync", 1, generation=1)
+    s.barrier("sync", 1, generation=2)
+    keys = s.list_keys("__barrier__/")
+    assert any(k.startswith("__barrier__/gen1/sync/") for k in keys)
+    assert any(k.startswith("__barrier__/gen2/sync/") for k in keys)
+
+
+def test_gc_generation_tcp():
+    """gc_generation sweeps one generation's elastic + barrier keys and
+    counts them, leaving every other namespace alone."""
+    from paddle_tpu.core import monitor
+
+    s = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10.0)
+    s.set("__elastic__/gen5/member/w0", b"{}")
+    s.set("__elastic__/gen5/leave/w1", b"{}")
+    s.set("__elastic__/gen6/member/w0", b"{}")
+    s.barrier("sync", 1, generation=5)
+    before = monitor.stat("store.gc_keys").get()
+    removed = s.gc_generation(5)
+    assert removed >= 3
+    assert monitor.stat("store.gc_keys").get() == before + removed
+    assert s.list_keys("__elastic__/gen5/") == []
+    assert s.list_keys("__barrier__/gen5/") == []
+    assert s.list_keys("__elastic__/gen6/") == ["__elastic__/gen6/member/w0"]
+
+
+def test_file_store_backend_parity_for_coordinator(tmp_path):
+    """The membership coordinator's whole store surface behaves the same on
+    FileStore as on TCPStore: bounded get/wait, delete_key, list_keys,
+    num_keys, generation barrier, gc."""
+    for make in (lambda: TCPStore("127.0.0.1", 0, is_master=True,
+                                  world_size=1, timeout=1.0),
+                 lambda: FileStore(str(tmp_path / "fs"), world_size=1,
+                                   timeout=1.0)):
+        s = make()
+        s.set("__elastic__/gen0/member/a", b"x")
+        s.set("__elastic__/gen0/member/b", b"y")
+        assert s.list_keys("__elastic__/gen0/member/") == [
+            "__elastic__/gen0/member/a", "__elastic__/gen0/member/b"]
+        assert s.delete_key("__elastic__/gen0/member/a") is True
+        assert s.delete_key("__elastic__/gen0/member/a") is False
+        with pytest.raises(KeyError):
+            s.get("missing", wait=False)
+        with pytest.raises(TimeoutError):
+            s.wait(["missing"], timeout=0.2)
+        assert s.num_keys() >= 1
+        s.barrier("go", 1, generation=0)
+        assert s.gc_generation(0) >= 1
+
+
 def _free_port():
     import socket
 

@@ -1,0 +1,328 @@
+"""SIGTERM preemption drill: dp8 → dp6 → dp8 live, zero committed steps lost.
+
+The standalone proof behind distributed/membership.py (__graft_entry__
+phase 12 runs this as a subprocess): eight real worker processes hold
+heartbeat leases in a FileStore, a ZeRO (flat weight-update-sharded) MLP
+engine trains at dp8 — pure-dp so the flat-shard layout actually engages;
+GPT's mp dist_attrs take the replicated path, which the unit tests cover —
+and the drill
+
+  1. SIGTERMs two workers — their handlers announce a preemption-leave —
+     and the ElasticCoordinator re-forms the mesh to dp6 IN MEMORY
+     (engine.reform_mesh: device_put redistribution of params + flat ZeRO
+     opt shards), with the committed step count intact;
+  2. proves bit-continuity: the post-reform loss curve (and params/opt
+     state at the boundary) is bit-identical to a control engine restored
+     from a synchronous checkpoint onto the same dp6 topology;
+  3. starts two fresh workers (join) and re-forms back to dp8, with the
+     same bit-equality check against a dp8 restore control;
+  4. injects a lease-timeout fault into the next reformation: the
+     coordinator must dump an elastic_reform_<gen> flight ring and fall
+     back to restore_latest (the hard-crash path) instead of hanging —
+     and the engine must keep training afterwards.
+
+Prints one JSON verdict row per check plus a summary row; exit 0 iff every
+verdict passed. Compile cache stays off (multi-device bit-equality, same
+debt as the dryrun phases). --history appends an `elastic_reform_pause_ms`
+row to BENCH_HISTORY.jsonl for tools/bench_gate.py.
+
+Run:  JAX_PLATFORMS=cpu python tools/elastic_drill.py
+      [--steps-per-leg 3] [--lease 5.0] [--history]
+"""
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (checkout-hermetic sys.path)
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER_SRC = textwrap.dedent('''\
+    import signal
+    import sys
+    import time
+
+    from paddle_tpu.distributed.membership import WorkerAgent
+    from paddle_tpu.distributed.store import FileStore
+
+    store = FileStore(sys.argv[1], timeout=20.0)
+    agent = WorkerAgent(store, sys.argv[2], lease_s=float(sys.argv[3]))
+    # exit AFTER the agent's chained announce_leave("sigterm") runs
+    signal.signal(signal.SIGTERM, lambda s, f: sys.exit(0))
+    agent.install_sigterm_handler()
+    agent.register()
+    agent.start_heartbeat()
+    print("READY", flush=True)
+    while True:
+        time.sleep(0.1)
+''')
+
+
+def _history_path():
+    return os.environ.get("PADDLE_TPU_BENCH_HISTORY") or os.path.join(
+        _REPO, "BENCH_HISTORY.jsonl")
+
+
+def _append_history(payload):
+    import copy
+    import datetime
+
+    try:
+        entry = copy.deepcopy(payload)
+        entry["extra"]["ts"] = datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds")
+        with open(_history_path(), "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError:
+        pass
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps-per-leg", type=int, default=3)
+    ap.add_argument("--lease", type=float, default=5.0)
+    ap.add_argument("--history", action="store_true",
+                    help="append BENCH_HISTORY.jsonl rows")
+    args = ap.parse_args()
+
+    from paddle_tpu.device.probe import force_cpu_platform
+    force_cpu_platform(virtual_devices=8)
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import monitor
+    from paddle_tpu.distributed import membership
+    from paddle_tpu.distributed.elastic import (CheckpointManager,
+                                                restore_latest)
+    from paddle_tpu.distributed.engine import TrainStepEngine
+    from paddle_tpu.distributed.membership import ElasticCoordinator
+    from paddle_tpu.distributed.mesh import HybridCommunicateGroup
+    from paddle_tpu.distributed.store import FileStore
+    from paddle_tpu.observability import flight_recorder as fl
+
+    # bit-equality across reformations is the whole drill; the compile
+    # cache keeps its known multi-device nondeterminism out of the picture
+    paddle.set_flags({"compile_cache_dir": ""})
+
+    work = tempfile.mkdtemp(prefix="elastic_drill_")
+    store_dir = os.path.join(work, "store")
+    flight_dir = os.path.join(work, "flight")
+    worker_py = os.path.join(work, "worker.py")
+    with open(worker_py, "w") as f:
+        f.write(_WORKER_SRC)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PADDLE_TPU_CKPT_DIR", None)
+    env.pop("PADDLE_TPU_FLIGHT_DIR", None)
+
+    verdicts = []
+
+    def verdict(check, ok, **extra):
+        row = {"check": check, "ok": bool(ok), **extra}
+        verdicts.append(row)
+        print(json.dumps(row), flush=True)
+
+    procs = {}
+
+    def spawn_worker(wid):
+        procs[wid] = subprocess.Popen(
+            [sys.executable, worker_py, store_dir, wid, str(args.lease)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env)
+
+    def await_members(store, wids, timeout=60.0):
+        gen = membership.current_generation(store)
+        store.wait([membership.member_key(gen, w) for w in wids],
+                   timeout=timeout)
+
+    def await_leaves(store, wids, timeout=30.0):
+        gen = membership.current_generation(store)
+        store.wait([membership.member_key(gen, w, "leave") for w in wids],
+                   timeout=timeout)
+
+    def hcg(dp):
+        return HybridCommunicateGroup(dp_degree=dp,
+                                      devices=jax.devices()[:dp])
+
+    def topo(n):
+        live_dp = max((d for d in (8, 6, 4, 2, 1) if d <= n), default=1)
+        return hcg(live_dp)
+
+    rng = np.random.RandomState(7)
+    xb = paddle.to_tensor(rng.randn(24, 64).astype(np.float32))
+    yb = paddle.to_tensor(rng.randint(0, 8, (24,)).astype(np.int64))
+
+    def drill_engine(dp, seed):
+        paddle.seed(seed)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(64, 256), paddle.nn.ReLU(),
+            paddle.nn.Linear(256, 64), paddle.nn.ReLU(),
+            paddle.nn.Linear(64, 8))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        return TrainStepEngine(model, opt,
+                               loss_fn=paddle.nn.CrossEntropyLoss(),
+                               hcg=hcg(dp), zero_update=True)
+
+    def steps(eng, k):
+        return [float(eng.step(xb, yb).item()) for _ in range(k)]
+
+    def state_bit_equal(a, b):
+        for nm in a._param_names:
+            if np.asarray(a.params[nm]).tobytes() != \
+                    np.asarray(b.params[nm]).tobytes():
+                return False
+        n = a._n_grad_elems()
+        return all(np.asarray(fa)[:n].tobytes() ==
+                   np.asarray(fb)[:n].tobytes()
+                   for fa, fb in zip(a._zero_opt, b._zero_opt))
+
+    def checkpoint(eng, name):
+        d = os.path.join(work, name)
+        mgr = CheckpointManager(d, async_save=False)
+        mgr.save(eng, block=True)
+        mgr.close()
+        return d
+
+    def restore_control(dp, ckdir, seed):
+        ctrl = drill_engine(dp, seed=seed)
+        steps(ctrl, 1)  # engage the ZeRO flat layout before restoring
+        restore_latest(ctrl, ckdir)
+        return ctrl
+
+    fl.enable(flight_dir)
+    pause = {}
+    exit_code = 1
+    try:
+        store = FileStore(store_dir, timeout=20.0)
+        coord = ElasticCoordinator(store, topology_for=topo,
+                                   lease_s=args.lease)
+        for i in range(8):
+            spawn_worker(f"w{i}")
+        await_members(store, [f"w{i}" for i in range(8)])
+        verdict("fleet_up", len(coord.live_members()) == 8, world=8)
+
+        eng = drill_engine(8, seed=0)
+        assert eng._zero_fallback_reason() is None, (
+            "drill engine must run the flat ZeRO path: "
+            + str(eng._zero_fallback_reason()))
+        losses8 = steps(eng, args.steps_per_leg)
+        committed = eng._step_count
+        verdict("dp8_warm", committed == args.steps_per_leg,
+                losses=losses8)
+
+        def sigterm_leaves(gen):
+            out = []
+            prefix = f"__elastic__/gen{gen}/leave/"
+            for key in store.list_keys(prefix):
+                rec = json.loads(store.get(key, wait=False).decode())
+                if rec.get("reason") == "sigterm":
+                    out.append(rec["wid"])
+            return out
+
+        # ---- leg 1: SIGTERM-preemption dp8 -> dp6 ----
+        ck1 = checkpoint(eng, "ck_leg1")
+        for wid in ("w6", "w7"):
+            procs[wid].send_signal(signal.SIGTERM)
+        await_leaves(store, ["w6", "w7"])
+        preempted = sigterm_leaves(membership.current_generation(store))
+        for wid in ("w6", "w7"):
+            procs.pop(wid).wait(timeout=10)
+        reformed = coord.maybe_reform(eng)
+        pause["8to6"] = coord.last_pause_ms
+        verdict("reform_8to6", reformed and eng.hcg.degrees["dp"] == 6
+                and eng._step_count == committed
+                and sorted(preempted) == ["w6", "w7"],
+                pause_ms=round(coord.last_pause_ms, 2),
+                committed_steps=eng._step_count,
+                preempted=sorted(preempted))
+        ctrl6 = restore_control(6, ck1, seed=1)
+        verdict("state_bit_equal_dp6", state_bit_equal(eng, ctrl6))
+        live6, ctl6 = steps(eng, args.steps_per_leg), \
+            steps(ctrl6, args.steps_per_leg)
+        verdict("loss_bit_continuous_8to6", live6 == ctl6,
+                live=live6, control=ctl6)
+
+        # ---- leg 2: capacity returns, dp6 -> dp8 ----
+        ck2 = checkpoint(eng, "ck_leg2")
+        for wid in ("w8", "w9"):
+            spawn_worker(wid)
+        await_members(store, ["w8", "w9"])
+        reformed = coord.maybe_reform(eng)
+        pause["6to8"] = coord.last_pause_ms
+        verdict("reform_6to8", reformed and eng.hcg.degrees["dp"] == 8
+                and eng._step_count == committed + args.steps_per_leg,
+                pause_ms=round(coord.last_pause_ms, 2))
+        ctrl8 = restore_control(8, ck2, seed=2)
+        verdict("state_bit_equal_dp8", state_bit_equal(eng, ctrl8))
+        live8, ctl8 = steps(eng, args.steps_per_leg), \
+            steps(ctrl8, args.steps_per_leg)
+        verdict("loss_bit_continuous_6to8", live8 == ctl8,
+                live=live8, control=ctl8)
+
+        # ---- hard-crash fallback: fault mid-reshard -> flight + restore
+        ck3 = checkpoint(eng, "ck_fault")
+        coord.ckpt_dir = ck3
+        coord._fault_hook = lambda: (_ for _ in ()).throw(
+            TimeoutError("injected lease expiry mid-reshard"))
+        procs.pop("w5").send_signal(signal.SIGTERM)  # world 8 -> 7 -> dp6
+        await_leaves(store, ["w5"])
+        fails0 = monitor.stat("elastic.reform_failures").get()
+        step_before = eng._step_count
+        fell_back = coord.maybe_reform(eng) is False
+        coord._fault_hook = None
+        dumps = [d for d in os.listdir(flight_dir)
+                 if "elastic_reform_" in d]
+        verdict("fault_falls_back_to_restore",
+                fell_back and eng._step_count == step_before
+                and monitor.stat("elastic.reform_failures").get()
+                == fails0 + 1,
+                flight_dumps=dumps)
+        verdict("flight_dump_written", bool(dumps))
+        post = steps(eng, 1)  # the fallback engine still trains
+        verdict("post_fallback_step", all(np.isfinite(post)), loss=post)
+
+        ok = all(v["ok"] for v in verdicts)
+        print(json.dumps({
+            "summary": "elastic_drill", "ok": ok,
+            "reformations": coord.reformations,
+            "pause_ms_8to6": round(pause["8to6"], 2),
+            "pause_ms_6to8": round(pause["6to8"], 2),
+            "committed_steps_lost": 0 if ok else None,
+        }), flush=True)
+        if args.history and ok:
+            base = {"platform": jax.default_backend(), "model": "mlp_zero",
+                    "zero": True, "steps_per_leg": args.steps_per_leg}
+            _append_history({
+                "metric": "elastic_reform_pause_ms",
+                "value": round(pause["8to6"], 2), "unit": "ms",
+                "vs_baseline": None,
+                "extra": {**base, "world_from": 8, "world_to": 6}})
+            _append_history({
+                "metric": "elastic_reform_pause_ms",
+                "value": round(pause["6to8"], 2), "unit": "ms",
+                "vs_baseline": None,
+                "extra": {**base, "world_from": 6, "world_to": 8}})
+        exit_code = 0 if ok else 1
+    finally:
+        fl.disable()
+        for p in procs.values():
+            p.kill()
+            p.wait()
+        shutil.rmtree(work, ignore_errors=True)
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())
