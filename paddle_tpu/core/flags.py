@@ -206,3 +206,16 @@ define_flag("elastic_drain_timeout_s", 30.0,
             "serving-replica drain bound: a SIGTERM'd ServingEngine stops "
             "admission and runs active slots to completion for at most this "
             "long before retiring (elastic.drain_ms histogram)")
+define_flag("kv_page_tokens", 64,
+            "tokens per KV-cache page for the paged serving layout "
+            "(serving/kv_pages.py). Smaller pages waste fewer bytes on the "
+            "last partial page per sequence and share finer-grained "
+            "prefixes; larger pages shrink the page table and the gather. "
+            "Must divide nothing — any positive value works; prefix reuse "
+            "only shares whole pages")
+define_flag("kv_cache_dtype", "auto",
+            "paged KV-cache storage dtype: 'auto' stores pages in the "
+            "attention compute dtype, 'bf16' casts pages to bfloat16, "
+            "'int8' stores EQuARX-style chunk-scaled int8 pages (one f32 "
+            "absmax/127 scale per (page, token, head), dequantized inside "
+            "the attention read). Only the paged layout honors this")

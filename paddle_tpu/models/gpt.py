@@ -96,6 +96,27 @@ class GPTAttention(nn.Layer):
 
         from ..core.tensor import Tensor
 
+        if hasattr(cache, "page_table"):
+            # paged serving cache (serving/kv_pages.py): scatter this
+            # chunk's K/V through the slot page table, gather the logical
+            # cache back (dequantizing int8 pages), and mask exactly like
+            # the per-row dense path — unallocated table entries alias the
+            # zero page, so the gathered values match a zero-initialized
+            # contiguous cache bit for bit.
+            from ..serving import kv_pages as _kvp
+
+            kc, vc, new_cache = _kvp.update_and_read(cache, k._data, v._data)
+            total = kc.shape[1]
+            off = cache.offset
+            qpos = off[:, None] + jnp.arange(s)[None, :]      # [b, s]
+            mask = (jnp.arange(total)[None, None, :]
+                    <= qpos[:, :, None])[:, None]             # [b, 1, s, T]
+            out = F.scaled_dot_product_attention(
+                q, Tensor(kc), Tensor(vc), attn_mask=Tensor(mask),
+                dropout_p=0.0, training=False)
+            out = P.reshape(out, (b, s, self.hidden_size))
+            return self.out_proj(out), new_cache
+
         k_cache, v_cache, offset = cache
         kc, vc = k_cache._data, v_cache._data
         off = offset._data if isinstance(offset, Tensor) else offset

@@ -14,20 +14,26 @@ Quick start::
 
 core.monitor counters: serving.prefill_compiles (bounded by the bucket
 ladder), serving.decode_compiles (one executable), serving.steps,
-serving.tokens, serving.requests; legacy generate() adds
-decode.jit_compiles / decode.cache_evictions (LRU-bounded executable
-cache).
+serving.tokens, serving.requests, serving.prefill_dispatches; the paged
+layout (kv_pages.py / prefix_cache.py / router.py) adds
+serving.prefix_lookups, serving.prefix_hits, serving.prefill_skips;
+legacy generate() adds decode.jit_compiles / decode.cache_evictions
+(LRU-bounded executable cache).
 """
 from .bucketing import (  # noqa: F401
     DEFAULT_LADDER, bucket_for, clip_ladder, resolve_bucket,
 )
 from .engine import Request, ServingEngine  # noqa: F401
+from .kv_pages import PagePool, PoolExhausted  # noqa: F401
+from .prefix_cache import RadixPrefixCache  # noqa: F401
+from .router import ReplicaRouter  # noqa: F401
 from .sampling import (  # noqa: F401
     filter_topk_topp, request_key, sample_tokens,
 )
 
 __all__ = [
-    "ServingEngine", "Request",
+    "ServingEngine", "Request", "ReplicaRouter",
+    "PagePool", "PoolExhausted", "RadixPrefixCache",
     "DEFAULT_LADDER", "bucket_for", "clip_ladder", "resolve_bucket",
     "sample_tokens", "filter_topk_topp", "request_key",
 ]

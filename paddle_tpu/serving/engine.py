@@ -77,6 +77,9 @@ class Request:
                              else None)
         self.seed = int(seed)
         self.tokens: List[int] = []      # generated tokens (incl. eos if hit)
+        self.prefix_hit = False          # paged: >= 1 page matched the trie
+        self.shared_tokens = 0           # paged: prompt tokens served from
+        self.tail_bucket: Optional[int] = None  # shared pages (no prefill)
         self.bucket: Optional[int] = None
         self.slot: Optional[int] = None
         self.queue_depth_at_submit = 0
@@ -147,7 +150,10 @@ class ServingEngine:
                  ladder: Sequence[int] = DEFAULT_LADDER,
                  max_seq_len: Optional[int] = None,
                  max_new_cap: int = 64, steps_per_dispatch: int = 8,
-                 sink=None):
+                 sink=None, kv_layout: str = "contiguous",
+                 kv_page_tokens: Optional[int] = None,
+                 kv_num_pages: Optional[int] = None,
+                 kv_cache_dtype: Optional[str] = None):
         import jax.numpy as jnp
         import numpy as np
 
@@ -193,10 +199,49 @@ class ServingEngine:
         nh = cfg.num_heads
         hd = cfg.hidden_size // cfg.num_heads
         S, T = self.slot_count, self.max_seq_len
-        self._kcs = [jnp.zeros((S, T, nh, hd), self._cache_dtype)
-                     for _ in range(cfg.num_layers)]
-        self._vcs = [jnp.zeros((S, T, nh, hd), self._cache_dtype)
-                     for _ in range(cfg.num_layers)]
+        if kv_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'contiguous' or 'paged', got {kv_layout!r}")
+        self.kv_layout = kv_layout
+        if kv_layout == "paged":
+            # paged KV: per-layer page pools + ONE [slots, max_pages] page
+            # table traced into prefill/decode as a gather index
+            # (kv_pages.py). Shapes stay static so the two-executable
+            # design and donation survive; the radix prefix cache
+            # (prefix_cache.py) shares whole prompt pages across requests.
+            from . import kv_pages as _kvp
+            from .prefix_cache import RadixPrefixCache
+
+            pt = int(kv_page_tokens if kv_page_tokens is not None
+                     else _flags.flag("kv_page_tokens"))
+            if pt < 1:
+                raise ValueError(f"kv_page_tokens must be >= 1, got {pt}")
+            self.page_tokens = pt
+            self.max_pages = -(-T // pt)                  # ceil(T / pt)
+            self._t_eff = self.max_pages * pt
+            mode = (kv_cache_dtype if kv_cache_dtype is not None
+                    else _flags.flag("kv_cache_dtype"))
+            self._store_dtype, self._kv_quantized = _kvp.resolve_store_dtype(
+                mode, self._cache_dtype)
+            # default pool covers the contiguous worst case (every slot at
+            # max_seq_len) so it can never exhaust; pass kv_num_pages to
+            # trade bytes for admission-time eviction pressure
+            self.num_pages = int(kv_num_pages if kv_num_pages is not None
+                                 else S * self.max_pages + _kvp.RESERVED_PAGES)
+            self._pool = _kvp.PagePool(self.num_pages)
+            self._prefix = RadixPrefixCache(self._pool, pt)
+            self._pool_state = _kvp.make_pool_state(
+                cfg.num_layers, self.num_pages, pt, nh, hd, S,
+                self.max_pages, self._store_dtype, self._kv_quantized)
+            self._tables = np.zeros((S, self.max_pages), np.int32)
+            self._slot_pages: List[List[int]] = [[] for _ in range(S)]
+            self._replay = np.zeros(S, bool)
+            self._kcs = self._vcs = None
+        else:
+            self._kcs = [jnp.zeros((S, T, nh, hd), self._cache_dtype)
+                         for _ in range(cfg.num_layers)]
+            self._vcs = [jnp.zeros((S, T, nh, hd), self._cache_dtype)
+                         for _ in range(cfg.num_layers)]
 
         # host-side per-slot state (tiny arrays, re-staged every step)
         self._offsets = np.zeros(S, np.int32)
@@ -219,6 +264,9 @@ class ServingEngine:
         self._fn_cache_sizes: Dict[int, int] = {}  # id(fn) -> last size
         # label -> (jitted fn, abstract args) for introspect_executables()
         self._exec_stash: Dict[str, Any] = {}
+        # label -> donate_argnums of the stashed fn (default_contracts
+        # derives each label's donation floor from these positions)
+        self._exec_donated: Dict[str, tuple] = {}
 
     # ------------------------------------------------------------- params
     def refresh_params(self) -> None:
@@ -366,7 +414,7 @@ class ServingEngine:
         self._prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "steps": self._steps,
             "completed": len(self._completed),
             "queued": len(self._queue),
@@ -376,16 +424,65 @@ class ServingEngine:
             "ladder": self.ladder,
             "prefill_executables": len(self._prefill_fns),
             "decode_executables": len(self._decode_fns),
+            "kv_layout": self.kv_layout,
+            "kv_cache_bytes": self.kv_cache_bytes(),
         }
+        if self.kv_layout == "paged":
+            out.update({
+                "page_tokens": self.page_tokens,
+                "num_pages": self.num_pages,
+                "pages_in_use": self._pool.in_use,
+                "pages_cached": self._pool.cached,
+                "prefix": self._prefix.stats(),
+            })
+        return out
+
+    # ------------------------------------------------------ paged public
+    def kv_cache_bytes(self) -> int:
+        """Device bytes held by the KV cache: per-slot rows (contiguous)
+        or pools + scales + page tables (paged) — the denominator of
+        serve_bench's concurrent-requests-per-MB datum."""
+        if self.kv_layout == "paged":
+            from . import kv_pages as _kvp
+
+            return _kvp.pool_state_bytes(self._pool_state)
+        return sum(int(a.size) * a.dtype.itemsize
+                   for a in (*self._kcs, *self._vcs))
+
+    def prefix_match_len(self, prompt_ids) -> int:
+        """Tokens of this prompt already cached as shared pages (0 on the
+        contiguous layout) — the router's prefix-locality probe; no
+        refcount side effects."""
+        if self.kv_layout != "paged":
+            return 0
+        return self._prefix.peek(
+            [int(t) for t in prompt_ids])
+
+    def flush_prefix_cache(self) -> int:
+        """Evict every refcount-zero cached prefix page; returns the count
+        freed. Bench hygiene: measure cold-trie TTFT against warm
+        executables."""
+        if self.kv_layout != "paged":
+            return 0
+        return self._prefix.flush()
+
+    def occupancy(self) -> float:
+        return float(self._active.sum()) / self.slot_count
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
 
     # ---------------------------------------------------------- internals
-    def _stash_exec(self, label: str, fn, call_args) -> None:
+    def _stash_exec(self, label: str, fn, call_args,
+                    donate: tuple = (1, 2)) -> None:
         """First call per label: remember (jitted fn, abstract args) so
         introspect_executables() can AOT-lower the same program later, and
         auto-capture now when FLAGS_exec_introspect is on. ShapeDtypeStructs
-        replace the arrays — no live (or donated) buffer is retained."""
+        replace the arrays — no live (or donated) buffer is retained.
+        donate records the fn's donate_argnums for default_contracts()."""
         if label in self._exec_stash:
             return
+        self._exec_donated[label] = tuple(donate)
         import jax
 
         # weak_type rides along for the recompile-hazard analysis pass
@@ -427,7 +524,13 @@ class ServingEngine:
             try:
                 import jax
 
-                caches = jax.tree_util.tree_leaves((avals[1], avals[2]))
+                # contiguous: args 1/2 are the K/V caches; paged: arg 1 is
+                # the whole pool state (pools + scales + page tables) — the
+                # recorded donate_argnums say which, and their byte size IS
+                # the aliasing floor either way
+                dargs = self._exec_donated.get(label, (1, 2))
+                caches = jax.tree_util.tree_leaves(
+                    tuple(avals[i] for i in dargs))
                 donated = sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
                               for a in caches)
             except Exception:
@@ -521,6 +624,57 @@ class ServingEngine:
 
         return jax.jit(prefill, donate_argnums=(1, 2))
 
+    def _build_prefill_paged(self, bucket: int):
+        """Paged tail-prefill, one executable per TAIL rung: the unshared
+        suffix of the prompt (the whole prompt on a trie miss) runs with a
+        traced base offset and writes K/V through this slot's page-table
+        row. base/tail_len/slot/sampling/seed are all traced, so prefix
+        hits of any depth share the same rung executables."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..jit import functional_call
+        from . import kv_pages as _kvp
+        from .sampling import request_key, sample_tokens
+
+        gpt = self.model.gpt
+        pt = self.page_tokens
+        quant = self._kv_quantized
+        compute_dtype = self._cache_dtype
+
+        def prefill(params, state, ids, tail_len, base, slot, temp, top_k,
+                    top_p, seed):
+            gpt_params = {k[len("gpt."):]: v for k, v in params.items()
+                          if k.startswith("gpt.")}
+            table_row = jax.lax.dynamic_slice_in_dim(
+                state["tables"], slot, 1, 0)                 # [1, max_pages]
+            # pad positions past the tail redirect to the scratch page:
+            # their page-table entries may be unallocated (the zero page
+            # must never be written)
+            wmask = (jnp.arange(bucket, dtype=jnp.int32)[None, :]
+                     < tail_len)                             # [1, bucket]
+            caches = _kvp.layer_views(state, table_row, base[None], wmask,
+                                      pt, compute_dtype)
+            h, caches = functional_call(gpt, gpt_params, Tensor(ids),
+                                        caches=caches)
+            last_h = jax.lax.dynamic_index_in_dim(h._data, tail_len - 1, 1,
+                                                  keepdims=False)
+            logits = self._head_traced(params, last_h)       # [1, V]
+            key = request_key(seed, base + tail_len)  # abs first-token pos
+            tok = sample_tokens(logits, key[None], temp[None], top_k[None],
+                                top_p[None])[0]
+            new_state = {
+                "k": [c.k_pool for c in caches],
+                "v": [c.v_pool for c in caches],
+                "ks": [c.k_scale for c in caches] if quant else [],
+                "vs": [c.v_scale for c in caches] if quant else [],
+                "tables": state["tables"],
+            }
+            return new_state, tok
+
+        return jax.jit(prefill, donate_argnums=(1,))
+
     def _admit(self) -> None:
         import jax.numpy as jnp
         import numpy as np
@@ -537,6 +691,10 @@ class ServingEngine:
                     return
                 req = self._queue.popleft()
             slot = free[0]
+            if self.kv_layout == "paged":
+                if not self._admit_paged(req, slot):
+                    return
+                continue
             bucket = req.bucket
             plen = len(req.prompt_ids)
             req.admit_ts = time.perf_counter()    # queue wait ends here
@@ -551,6 +709,9 @@ class ServingEngine:
                          jnp.int32(req.top_k), jnp.float32(req.top_p),
                          jnp.int32(req.seed))
             self._stash_exec(f"serve.prefill_b{bucket}", fn, call_args)
+            from ..core import monitor
+
+            monitor.stat("serving.prefill_dispatches").increase()
             try:
                 self._kcs, self._vcs, tok = fn(*call_args)
                 self._note_exec_compiles(fn, "serving.prefill_compiles")
@@ -596,6 +757,193 @@ class ServingEngine:
             self._remaining[slot] = req.max_new_tokens - 1
             self._seeds[slot] = req.seed
             self._slot_req[slot] = req
+
+    # ---- paged admission ----------------------------------------------
+    def _pages_reserved_inflight(self) -> int:
+        """Worst-case pages still to be allocated by active slots (each
+        slot's final offset is offsets + remaining; shared and own pages
+        already in its table row don't count)."""
+        import numpy as np
+
+        pt = self.page_tokens
+        total = 0
+        for i in np.nonzero(self._active)[0]:
+            end = min(int(self._offsets[i]) + int(self._remaining[i]),
+                      self.max_seq_len)
+            need = -(-end // pt) - int((self._tables[i] != 0).sum())
+            total += max(0, need)
+        return total
+
+    def _release_slot(self, slot: int) -> None:
+        """Drop the slot's page references (shared pages decref; own pages
+        free or park for prefix reuse) and reset its table row to the zero
+        page."""
+        for p in self._slot_pages[slot]:
+            self._prefix.release(int(p))
+        self._slot_pages[slot] = []
+        self._tables[slot, :] = 0
+        self._replay[slot] = False
+
+    def _admit_paged(self, req: Request, slot: int) -> bool:
+        """Seat a request on the paged cache. Three admission shapes:
+
+        - trie miss: allocate prompt pages, prefill the whole prompt
+          (base 0) — the contiguous flow, just scattered through pages.
+        - partial hit: copy the matched pages into the table row and
+          prefill only the unshared tail rung at base = matched tokens.
+        - full hit (prompt length is page-aligned and fully cached): NO
+          prefill dispatch at all — the slot seats directly into decode at
+          offset plen-1 feeding prompt[-1], with a per-row replay flag
+          that redirects that first step's (already-cached) K/V write to
+          the scratch page. The first token then falls out of the decode
+          chunk, sampled with the same request_key(seed, plen) the prefill
+          program would have used.
+
+        Returns False (request requeued) when the pool can't cover this
+        request's worst case plus in-flight reservations — admission
+        retries once decode retires a slot and frees pages."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..core import monitor
+        from . import kv_pages as _kvp
+
+        pt = self.page_tokens
+        plen = len(req.prompt_ids)
+        req.admit_ts = time.perf_counter()    # queue wait ends here
+        shared = self._prefix.match(req.prompt_ids)
+        k_shared = len(shared)
+        monitor.stat("serving.prefix_lookups").increase()
+        # reservation check: this request's unshared worst case on top of
+        # what active slots may still allocate must fit free + evictable
+        need_new = -(-(plen + req.max_new_tokens) // pt) - k_shared
+        avail = self._pool.available
+        if avail < self._pages_reserved_inflight() + need_new:
+            for p in shared:
+                self._prefix.release(int(p))
+            if not self._active.any():
+                raise _kvp.PoolExhausted(
+                    f"pool of {self.num_pages} pages cannot fit one request "
+                    f"needing {need_new} fresh pages ({avail} available) — "
+                    "raise kv_num_pages or lower max_new_cap")
+            req.admit_ts = None
+            with self._lock:
+                self._queue.appendleft(req)
+            return False
+        if shared:
+            monitor.stat("serving.prefix_hits").increase()
+            req.prefix_hit = True
+            req.shared_tokens = k_shared * pt
+        self._tables[slot, :] = 0
+        self._tables[slot, :k_shared] = shared
+        self._slot_pages[slot] = [int(p) for p in shared]
+        eos = req.eos_token_id if req.eos_token_id is not None else _NO_EOS
+        tr = _obs_tracer.get_tracer()
+        mreg = _obs_metrics.active_registry()
+        if tr.enabled:
+            tr.record_complete("serve.queue_wait", req.submit_ts,
+                               req.admit_ts, {"request": req.id})
+        if mreg is not None:
+            mreg.histogram("serve.queue_wait_ms").observe(
+                req.queue_wait_s * 1e3)
+
+        if k_shared * pt >= plen:
+            # full hit: replay seat, zero prefill dispatches
+            monitor.stat("serving.prefill_skips").increase()
+            req.tail_bucket = 0
+            req.slot = slot
+            if tr.enabled:
+                tr.instant("serve.prefix_replay", request=req.id, slot=slot,
+                           shared_tokens=req.shared_tokens)
+            self._offsets[slot] = plen - 1
+            self._last_tok[slot] = int(req.prompt_ids[-1])
+            self._active[slot] = True
+            self._replay[slot] = True
+            self._temps[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._topp[slot] = req.top_p
+            self._eos[slot] = eos
+            self._remaining[slot] = req.max_new_tokens
+            self._seeds[slot] = req.seed
+            self._slot_req[slot] = req
+            return True
+
+        # partial hit / miss: allocate the prompt's unshared pages and
+        # prefill the tail rung at base = shared tokens
+        base = k_shared * pt
+        tail = plen - base
+        tbucket = bucket_for(tail, self.ladder)
+        req.tail_bucket = tbucket
+        npages_prompt = -(-plen // pt)
+        if not self._prefix.ensure_free(npages_prompt - k_shared):
+            raise _kvp.PoolExhausted(     # reservation check above makes
+                "page reservation accounting violated")  # this unreachable
+        for pi in range(k_shared, npages_prompt):
+            page = self._pool.alloc()
+            self._tables[slot, pi] = page
+            self._slot_pages[slot].append(page)
+        fn = self._prefill_fns.get(tbucket)
+        if fn is None:
+            fn = self._prefill_fns[tbucket] = self._build_prefill_paged(
+                tbucket)
+        padded = np.zeros((1, tbucket), np.int64)
+        padded[0, :tail] = req.prompt_ids[base:]
+        state = dict(self._pool_state, tables=jnp.asarray(self._tables))
+        call_args = (self._params, state, jnp.asarray(padded),
+                     jnp.int32(tail), jnp.int32(base), jnp.int32(slot),
+                     jnp.float32(req.temperature), jnp.int32(req.top_k),
+                     jnp.float32(req.top_p), jnp.int32(req.seed))
+        self._stash_exec(f"serve.prefill_b{tbucket}", fn, call_args,
+                         donate=(1,))
+        monitor.stat("serving.prefill_dispatches").increase()
+        try:
+            new_state, tok = fn(*call_args)
+            self._note_exec_compiles(fn, "serving.prefill_compiles")
+            first = int(tok)                  # device sync = first token
+        except Exception as e:
+            fr = _obs_flight.get()
+            if fr is not None:
+                fr.dump("serve_prefill_exception",
+                        {"request": req.id, "bucket": tbucket,
+                         "base": base, "error": repr(e)})
+            raise
+        self._pool_state = new_state
+        req.first_token_ts = time.perf_counter()
+        if tr.enabled:
+            tr.record_complete("serve.prefill", req.admit_ts,
+                               req.first_token_ts,
+                               {"request": req.id, "bucket": tbucket,
+                                "base": base, "slot": slot})
+        if mreg is not None:
+            mreg.histogram("serve.prefill_ms").observe(
+                (req.first_token_ts - req.admit_ts) * 1e3)
+        # publish this prompt's fully-written pages for future sharers
+        full_pages = plen // pt
+        if full_pages > k_shared:
+            self._prefix.insert(
+                req.prompt_ids[:full_pages * pt],
+                [int(p) for p in self._tables[slot, :full_pages]])
+        req.slot = slot
+        req.tokens.append(first)
+        self._count_tokens(1)
+        if (eos != _NO_EOS and first == eos) or req.max_new_tokens <= 1:
+            req.finish_reason = ("eos" if eos != _NO_EOS and first == eos
+                                 else "length")
+            self._release_slot(slot)
+            self._finish(req)
+            return True
+        self._offsets[slot] = plen
+        self._last_tok[slot] = first
+        self._active[slot] = True
+        self._replay[slot] = False
+        self._temps[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+        self._eos[slot] = eos
+        self._remaining[slot] = req.max_new_tokens - 1
+        self._seeds[slot] = req.seed
+        self._slot_req[slot] = req
+        return True
 
     # ---- decode --------------------------------------------------------
     def _build_decode(self, family: str):
@@ -654,6 +1002,107 @@ class ServingEngine:
 
         return jax.jit(step_chunk, donate_argnums=(1, 2))
 
+    def _build_decode_paged(self, family: str):
+        """Paged decode chunk: same continuous-batching scan as the dense
+        decode, but K/V flows through the donated pool state (per-layer
+        pools + scales + the page table). Extra per-row ``replay`` flag:
+        a full-prefix-hit slot's first step re-derives a position whose
+        K/V already sits in a shared page, so its write is redirected to
+        the scratch page; the flag clears after the row's first active
+        step and the row behaves like any other from then on."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..jit import functional_call
+        from . import kv_pages as _kvp
+        from .sampling import request_key, sample_tokens
+
+        gpt = self.model.gpt
+        T = self.max_seq_len
+        t_eff = self._t_eff
+        n_inner = self.steps_per_dispatch
+        greedy_only = family == "greedy"
+        pt = self.page_tokens
+        quant = self._kv_quantized
+        compute_dtype = self._cache_dtype
+
+        def step_chunk(params, state, off, tok, active, replay, temps,
+                       top_k, top_p, eos, remaining, seeds):
+            gpt_params = {k[len("gpt."):]: v for k, v in params.items()
+                          if k.startswith("gpt.")}
+            tables = state["tables"]
+
+            def one(carry, _):
+                ks, vs, kss, vss, off, tok, active, replay, remaining = carry
+                off_m = jnp.clip(off, 0, jnp.int32(t_eff - 1))
+                st = {"k": ks, "v": vs, "ks": kss, "vs": vss}
+                # idle rows and replaying rows write to the scratch page
+                caches = _kvp.layer_views(st, tables, off_m,
+                                          active & ~replay, pt,
+                                          compute_dtype)
+                h, caches = functional_call(
+                    gpt, gpt_params, Tensor(tok[:, None].astype(jnp.int64)),
+                    caches=caches)
+                logits = self._head_traced(params, h._data[:, 0])  # [S, V]
+                act = active.astype(jnp.int32)
+                new_off = off + act         # the sampled token's position
+                if greedy_only:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    keys = jax.vmap(request_key)(seeds, new_off)
+                    nxt = sample_tokens(logits, keys, temps, top_k, top_p)
+                nxt = jnp.where(active, nxt, tok)
+                new_remaining = remaining - act
+                hit_eos = active & (eos != _NO_EOS) & (nxt == eos)
+                new_active = (active & ~hit_eos & (new_remaining > 0)
+                              & (new_off < T))
+                new_replay = replay & ~active
+                new_ks = [c.k_pool for c in caches]
+                new_vs = [c.v_pool for c in caches]
+                new_kss = [c.k_scale for c in caches] if quant else []
+                new_vss = [c.v_scale for c in caches] if quant else []
+                return ((new_ks, new_vs, new_kss, new_vss, new_off, nxt,
+                         new_active, new_replay, new_remaining),
+                        (nxt, active, hit_eos))
+
+            carry = (state["k"], state["v"], state["ks"], state["vs"], off,
+                     tok, active, replay, remaining)
+            ((ks, vs, kss, vss, off, tok, active, replay, remaining),
+             (toks, was_active, hits)) = jax.lax.scan(
+                one, carry, None, length=n_inner)
+            new_state = {"k": ks, "v": vs, "ks": kss, "vs": vss,
+                         "tables": tables}
+            return (new_state, off, tok, active, replay, remaining, toks,
+                    was_active, hits)
+
+        return jax.jit(step_chunk, donate_argnums=(1,))
+
+    def _prealloc_decode_pages(self) -> None:
+        """Host-side, between dispatches: make sure every active slot's
+        table row covers the positions the next chunk may write (the
+        table is static within a dispatch). Evicts LRU cached prefixes
+        under pressure; admission reservations guarantee success."""
+        import numpy as np
+
+        from . import kv_pages as _kvp
+
+        pt = self.page_tokens
+        for i in np.nonzero(self._active)[0]:
+            first = int(self._offsets[i]) + (1 if self._replay[i] else 0)
+            last = min(int(self._offsets[i]) + self.steps_per_dispatch,
+                       self.max_seq_len) - 1
+            for pi in range(first // pt, last // pt + 1):
+                if self._tables[i, pi] == 0:
+                    if not self._prefix.ensure_free(1):
+                        raise _kvp.PoolExhausted(
+                            f"decode needs a page for slot {i} and none is "
+                            "free or evictable (reservation accounting "
+                            "violated)")
+                    page = self._pool.alloc()
+                    self._tables[i, pi] = page
+                    self._slot_pages[i].append(page)
+
     def _decode_step(self) -> None:
         import jax.numpy as jnp
         import numpy as np
@@ -663,20 +1112,45 @@ class ServingEngine:
         # executables max, regardless of traffic mix.
         family = ("greedy"
                   if not self._temps[self._active].any() else "sample")
+        paged = self.kv_layout == "paged"
         fn = self._decode_fns.get(family)
         if fn is None:
-            fn = self._decode_fns[family] = self._build_decode(family)
-        call_args = (self._params, self._kcs, self._vcs,
-                     jnp.asarray(self._offsets), jnp.asarray(self._last_tok),
-                     jnp.asarray(self._active), jnp.asarray(self._temps),
-                     jnp.asarray(self._topk), jnp.asarray(self._topp),
-                     jnp.asarray(self._eos), jnp.asarray(self._remaining),
-                     jnp.asarray(self._seeds))
-        self._stash_exec(f"serve.decode_{family}", fn, call_args)
+            fn = self._decode_fns[family] = (
+                self._build_decode_paged(family) if paged
+                else self._build_decode(family))
+        if paged:
+            self._prealloc_decode_pages()
+            state = dict(self._pool_state,
+                         tables=jnp.asarray(self._tables))
+            call_args = (self._params, state, jnp.asarray(self._offsets),
+                         jnp.asarray(self._last_tok),
+                         jnp.asarray(self._active),
+                         jnp.asarray(self._replay),
+                         jnp.asarray(self._temps), jnp.asarray(self._topk),
+                         jnp.asarray(self._topp), jnp.asarray(self._eos),
+                         jnp.asarray(self._remaining),
+                         jnp.asarray(self._seeds))
+            self._stash_exec(f"serve.decode_{family}", fn, call_args,
+                             donate=(1,))
+        else:
+            call_args = (self._params, self._kcs, self._vcs,
+                         jnp.asarray(self._offsets),
+                         jnp.asarray(self._last_tok),
+                         jnp.asarray(self._active),
+                         jnp.asarray(self._temps), jnp.asarray(self._topk),
+                         jnp.asarray(self._topp), jnp.asarray(self._eos),
+                         jnp.asarray(self._remaining),
+                         jnp.asarray(self._seeds))
+            self._stash_exec(f"serve.decode_{family}", fn, call_args)
         t0 = time.perf_counter()
         try:
-            (self._kcs, self._vcs, off, tok, active, remaining, toks,
-             was_active, hits) = fn(*call_args)
+            if paged:
+                (self._pool_state, off, tok, active, replay, remaining,
+                 toks, was_active, hits) = fn(*call_args)
+                self._replay = np.array(replay)
+            else:
+                (self._kcs, self._vcs, off, tok, active, remaining, toks,
+                 was_active, hits) = fn(*call_args)
             self._note_exec_compiles(fn, "serving.decode_compiles")
             # np.array (copy): zero-copy views of jax buffers are read-only,
             # and _admit mutates these in place when it seats the next request
@@ -708,9 +1182,13 @@ class ServingEngine:
             for slot in np.nonzero(was_active[j])[0]:
                 req = self._slot_req[slot]
                 req.tokens.append(int(toks[j, slot]))
+                if req.first_token_ts is None:   # prefix-replay first token
+                    req.first_token_ts = now
                 if not alive_after[slot]:     # retired at this inner step
                     req.finish_reason = "eos" if hits[j, slot] else "length"
                     self._slot_req[slot] = None
+                    if paged:
+                        self._release_slot(slot)
                     self._finish(req, now)
         emitted = int(was_active.sum())
         self._count_tokens(emitted)
@@ -725,6 +1203,11 @@ class ServingEngine:
                            boundaries=_OCCUPANCY_BUCKETS).observe(occupancy)
             mreg.gauge("serve.queue_depth").set(len(self._queue))
             mreg.gauge("serve.active_slots").set(int(self._active.sum()))
+            if paged:
+                mreg.gauge("serve.pages_in_use").set(self._pool.in_use)
+                mreg.gauge("serve.pages_cached").set(self._pool.cached)
+                mreg.gauge("serve.prefix_hit_rate").set(
+                    self._prefix.hit_rate)
         fr = _obs_flight.get()
         if self.sink is not None or fr is not None:
             rec = {
@@ -738,6 +1221,10 @@ class ServingEngine:
                 "queue_depth": len(self._queue),
                 "tokens": emitted,
             }
+            if paged:
+                rec["pages_in_use"] = self._pool.in_use
+                rec["pages_cached"] = self._pool.cached
+                rec["prefix_hit_rate"] = round(self._prefix.hit_rate, 4)
             if self.sink is not None:
                 self.sink.write(rec)
             if fr is not None:
@@ -794,6 +1281,9 @@ class ServingEngine:
                 "wall_s": round(wall, 6),
                 "tokens_per_sec": round(len(req.tokens) / wall, 2),
                 "queue_depth_at_submit": req.queue_depth_at_submit,
+                "layout": self.kv_layout,
+                "prefix_hit": req.prefix_hit,
+                "shared_tokens": req.shared_tokens,
             }
             if self.sink is not None:
                 self.sink.write(rec)

@@ -1,0 +1,302 @@
+"""Paged KV cache: fixed-size pages + a block allocator + a per-slot page
+table traced into the serving executables as an integer gather index.
+
+The PR 4 engine pins one slot-contiguous ``[slots, max_seq_len, nh, hd]``
+cache row per slot, so every request reserves worst-case bytes and no two
+requests can share anything. The paged layout (vLLM's PagedAttention block
+table, arXiv 2309.06180) breaks each sequence into ``page_tokens``-sized
+pages drawn from one shared pool:
+
+- **device state** (per layer): a page pool ``[num_pages, page_tokens, nh,
+  hd]`` plus, for all layers at once, ONE page table ``[slots, max_pages]``
+  of int32 pool indices. Both shapes are static, so the two-executable
+  (bucketed prefill + single decode) design and buffer donation survive
+  unchanged — the page table is just another traced integer operand.
+- **read** = gather: ``pool[table]`` reassembles each slot's logical
+  ``[max_pages * page_tokens, nh, hd]`` K/V, and the existing causal mask
+  (``col <= query_pos``) makes everything past a slot's offset inert.
+- **write** = scatter: token position ``p`` lands in page ``table[slot,
+  p // page_tokens]`` at row ``p % page_tokens``.
+
+Two pool pages are reserved:
+
+- page 0 is the **zero page**: every unallocated page-table entry points
+  here and it is never written, so gathering an unallocated region reads
+  exact zeros — the same values a freshly zero-initialized contiguous
+  cache holds, which is what makes paged attention bit-identical to the
+  contiguous engine (masked columns contribute exp(-1e9) == 0.0 either
+  way).
+- page 1 is the **scratch page**: rows that must not write (idle slots,
+  prefix-replay steps re-deriving an already-cached position) have their
+  scatter redirected here. It is never read through any table.
+
+Quantized pages (``FLAGS_kv_cache_dtype``): 'bf16' casts the pool;
+'int8' stores EQuARX-style chunk-scaled int8 (grad_comm's absmax/127
+scheme, PAPERS.md 2506.17615) with one f32 scale per (page, token, head),
+dequantized inside the attention read.
+
+Host side, :class:`PagePool` is a refcounting block allocator (free list +
+LRU-evictable set of refcount-zero pages still referenced by the radix
+prefix cache — see prefix_cache.py).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Sequence
+
+ZERO_PAGE = 0
+SCRATCH_PAGE = 1
+RESERVED_PAGES = 2
+
+
+class PoolExhausted(RuntimeError):
+    """No free page and nothing evictable — the pool is undersized for the
+    admitted load (raise kv_num_pages or lower slot_count/max_new_cap)."""
+
+
+class PagePool:
+    """Host-side page accounting: a free list plus per-page refcounts.
+
+    The pool tracks *references held by live slots* only — the prefix
+    cache holds pages weakly (a refcount-0 page with a trie node parks in
+    the LRU ``evictable`` set, still allocated, content preserved, until
+    either re-matched or evicted to satisfy an allocation).
+    """
+
+    def __init__(self, num_pages: int):
+        import numpy as np
+
+        if num_pages < RESERVED_PAGES + 1:
+            raise ValueError(f"num_pages must be > {RESERVED_PAGES}, "
+                             f"got {num_pages}")
+        self.num_pages = int(num_pages)
+        self.free: deque = deque(range(RESERVED_PAGES, self.num_pages))
+        self.ref = np.zeros(self.num_pages, np.int32)
+        # page -> monotonic clock at last release (LRU eviction order);
+        # maintained by the prefix cache via park()/unpark()
+        self.evictable: "OrderedDict[int, int]" = OrderedDict()
+        self.allocs = 0
+        self.evictions = 0
+
+    # -- capacity -------------------------------------------------------
+    @property
+    def free_count(self) -> int:
+        return len(self.free)
+
+    @property
+    def available(self) -> int:
+        """Pages an allocation could obtain (free + evictable-cached)."""
+        return len(self.free) + len(self.evictable)
+
+    @property
+    def in_use(self) -> int:
+        """Pages referenced by at least one live slot."""
+        return int((self.ref > 0).sum())
+
+    @property
+    def cached(self) -> int:
+        """Refcount-zero pages parked for prefix reuse."""
+        return len(self.evictable)
+
+    # -- alloc / refs ---------------------------------------------------
+    def alloc(self) -> int:
+        """Pop a free page with refcount 1. Caller must have ensured a
+        free page exists (evicting through the prefix cache if needed)."""
+        if not self.free:
+            raise PoolExhausted(
+                f"KV page pool exhausted: {self.num_pages} pages, "
+                f"{self.in_use} in use, {self.cached} cached (nothing "
+                "evictable was freed) — raise kv_num_pages")
+        p = self.free.popleft()
+        self.ref[p] = 1
+        self.allocs += 1
+        return p
+
+    def incref(self, page: int) -> int:
+        self.ref[page] += 1
+        if page in self.evictable:      # back in use: no longer evictable
+            del self.evictable[page]
+        return int(self.ref[page])
+
+    def decref(self, page: int) -> int:
+        if self.ref[page] <= 0:
+            raise RuntimeError(f"decref of unreferenced page {page}")
+        self.ref[page] -= 1
+        return int(self.ref[page])
+
+    def release(self, page: int) -> None:
+        """Return a refcount-zero page to the free list."""
+        if self.ref[page] != 0:
+            raise RuntimeError(
+                f"release of page {page} with refcount {self.ref[page]}")
+        self.evictable.pop(page, None)
+        self.free.append(page)
+
+    def park(self, page: int, clock: int) -> None:
+        """Park a refcount-zero page as evictable (prefix-cached)."""
+        self.evictable[page] = clock
+        self.evictable.move_to_end(page)
+
+
+def resolve_store_dtype(mode: str, compute_dtype):
+    """Map FLAGS_kv_cache_dtype to (storage dtype, quantized?)."""
+    import jax.numpy as jnp
+
+    if mode in (None, "", "auto"):
+        return compute_dtype, False
+    if mode == "bf16":
+        return jnp.bfloat16, False
+    if mode == "int8":
+        return jnp.int8, True
+    raise ValueError(f"kv_cache_dtype must be auto|bf16|int8, got {mode!r}")
+
+
+def quantize_kv_int8(x):
+    """[..., hd] -> (int8 [..., hd], f32 scale [...]) — grad_comm's
+    EQuARX absmax/127 chunk scaling with the head_dim as the chunk."""
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+    safe = jnp.maximum(scale, 1e-30)
+    q = jnp.clip(jnp.round(xf / safe[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+class PagedLayerCache:
+    """Traced per-layer view of the paged KV state, duck-compatible with
+    the dense ``(k_cache, v_cache, offset)`` cache tuple GPTModel indexes
+    (``cache[2]`` -> per-row offsets). Built fresh inside each traced
+    prefill/decode step from the donated pool-state operands.
+
+    offset: int32 [b] — count of already-cached positions per row (the
+    write position of this step's token), pre-clamped by the engine.
+    write_mask: bool [b] or [b, s] — rows/positions whose scatter goes to
+    a real page; everything else is redirected to the scratch page.
+    """
+
+    def __init__(self, k_pool, v_pool, page_table, offset, write_mask,
+                 page_tokens: int, compute_dtype, k_scale=None, v_scale=None):
+        self.k_pool = k_pool            # [P, pt, nh, hd] storage dtype
+        self.v_pool = v_pool
+        self.page_table = page_table    # [b, max_pages] int32
+        self.offset = offset            # [b] int32
+        self.write_mask = write_mask    # [b] or [b, s] bool
+        self.page_tokens = int(page_tokens)
+        self.compute_dtype = compute_dtype
+        self.k_scale = k_scale          # [P, pt, nh] f32 (int8 mode only)
+        self.v_scale = v_scale
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    def __getitem__(self, i):
+        # GPTModel reads caches[0][2] for position embeddings
+        if i == 2:
+            from ..core.tensor import Tensor
+
+            return Tensor(self.offset)
+        raise IndexError(f"PagedLayerCache exposes only [2] (offset), "
+                         f"got [{i}]")
+
+
+def update_and_read(cache: PagedLayerCache, k, v):
+    """Scatter this step's K/V into the pools through the page table, then
+    gather the full logical cache back out in compute dtype.
+
+    k, v: [b, s, nh, hd]. Returns (kc, vc, new_cache) where kc/vc are the
+    dense [b, max_pages * page_tokens, nh, hd] views attention consumes
+    and new_cache carries the updated pools with offset advanced by s.
+    """
+    import jax.numpy as jnp
+
+    b, s = k.shape[0], k.shape[1]
+    pt = cache.page_tokens
+    table = cache.page_table
+    max_pages = table.shape[1]
+    t_eff = max_pages * pt
+
+    pos = cache.offset[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    pos_c = jnp.clip(pos, 0, t_eff - 1)                       # [b, s]
+    pidx = pos_c // pt
+    within = pos_c % pt
+    gpage = jnp.take_along_axis(table, pidx, axis=1)          # [b, s]
+    wm = cache.write_mask
+    if wm.ndim == 1:
+        wm = wm[:, None]
+    # out-of-range positions (idle slot at the cache tip) always redirect
+    wm = wm & (pos < t_eff)
+    target = jnp.where(wm, gpage, jnp.int32(SCRATCH_PAGE))    # [b, s]
+
+    k_pool, v_pool = cache.k_pool, cache.v_pool
+    k_scale, v_scale = cache.k_scale, cache.v_scale
+    if cache.quantized:
+        qk, sk = quantize_kv_int8(k)                          # [b,s,nh,hd]/[b,s,nh]
+        qv, sv = quantize_kv_int8(v)
+        k_pool = k_pool.at[target, within].set(qk)
+        v_pool = v_pool.at[target, within].set(qv)
+        k_scale = k_scale.at[target, within].set(sk)
+        v_scale = v_scale.at[target, within].set(sv)
+    else:
+        k_pool = k_pool.at[target, within].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[target, within].set(v.astype(v_pool.dtype))
+
+    # gather: [b, max_pages, pt, nh, hd] -> [b, t_eff, nh, hd]
+    def _gather(pool, scale):
+        g = pool[table]
+        if scale is not None:
+            g = g.astype(jnp.float32) * scale[table][..., None]
+        g = g.reshape((b, t_eff) + g.shape[3:])
+        return g.astype(cache.compute_dtype)
+
+    kc = _gather(k_pool, k_scale)
+    vc = _gather(v_pool, v_scale)
+    new_cache = PagedLayerCache(
+        k_pool, v_pool, table, cache.offset + jnp.int32(s), cache.write_mask,
+        pt, cache.compute_dtype, k_scale, v_scale)
+    return kc, vc, new_cache
+
+
+def make_pool_state(num_layers: int, num_pages: int, page_tokens: int,
+                    num_heads: int, head_dim: int, slots: int,
+                    max_pages: int, store_dtype, quantized: bool) -> Dict:
+    """Device-side paged state as one donated pytree: per-layer K/V pools,
+    optional per-layer scale pools, and the shared page table."""
+    import jax.numpy as jnp
+
+    shape = (num_pages, page_tokens, num_heads, head_dim)
+    state = {
+        "k": [jnp.zeros(shape, store_dtype) for _ in range(num_layers)],
+        "v": [jnp.zeros(shape, store_dtype) for _ in range(num_layers)],
+        "ks": [], "vs": [],
+        "tables": jnp.zeros((slots, max_pages), jnp.int32),
+    }
+    if quantized:
+        sshape = (num_pages, page_tokens, num_heads)
+        state["ks"] = [jnp.zeros(sshape, jnp.float32)
+                       for _ in range(num_layers)]
+        state["vs"] = [jnp.zeros(sshape, jnp.float32)
+                       for _ in range(num_layers)]
+    return state
+
+
+def pool_state_bytes(state: Dict) -> int:
+    """Total device bytes of pools + scales + tables (the paged engine's
+    KV-cache footprint, what serve_bench's per-MB concurrency divides by)."""
+    import jax
+
+    return sum(a.size * a.dtype.itemsize
+               for a in jax.tree_util.tree_leaves(state))
+
+
+def layer_views(state: Dict, table, offset, write_mask, page_tokens: int,
+                compute_dtype) -> List[PagedLayerCache]:
+    """One PagedLayerCache per layer over a (possibly sliced) table."""
+    n = len(state["k"])
+    ks = state["ks"] or [None] * n
+    vs = state["vs"] or [None] * n
+    return [PagedLayerCache(state["k"][i], state["v"][i], table, offset,
+                            write_mask, page_tokens, compute_dtype,
+                            ks[i], vs[i])
+            for i in range(n)]

@@ -1,0 +1,174 @@
+"""Replica router: queue-depth / occupancy / prefix-locality-aware
+admission over K ServingEngine replicas.
+
+One engine replica saturates at slot_count concurrent decodes; the
+"millions of users" tier is K replicas behind a router. Placement uses
+the telemetry the engines already export (PR 6) plus the paged engines'
+prefix trie (kv_pages/prefix_cache):
+
+    score = w_queue * queue_depth / slots
+          + w_occupancy * occupancy
+          - w_prefix * (matched prefix tokens / prompt tokens)
+
+Lowest score wins (ties break deterministically by replica name), so an
+idle replica that already holds this prompt's prefix pages beats an
+equally idle cold one — prefix locality is worth real TTFT (the replica
+skips straight to decode on a full hit). The prefix probe is
+``engine.prefix_match_len`` (a refcount-free trie peek; contiguous
+replicas score 0).
+
+Drain integration (PR 12): a replica whose ``_draining`` flag is set —
+by ``begin_drain()``, ``drain()``, or the SIGTERM handler — stops
+receiving admissions immediately but keeps being stepped so its active
+slots run to completion. ``submit()`` raises only when NO live replica
+remains.
+
+Metrics (route.*, PR 6 registry when active): ``route.requests``,
+``route.prefix_routed`` counters, ``route.replicas_live`` gauge, and a
+``route.queue_depth`` histogram of the chosen replica's depth at
+admission. The sink (if any) gets one ``route`` record per placement.
+
+Host-side only — the router never touches device state.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Union
+
+from ..observability import metrics as _obs_metrics
+from .engine import Request, ServingEngine
+
+
+class ReplicaRouter:
+    """Front K in-process ServingEngine replicas with placement-aware
+    admission and a shared drive loop.
+
+    replicas: list (auto-named r0..rK-1) or dict name -> engine.
+    """
+
+    def __init__(self, replicas: Union[Sequence[ServingEngine],
+                                       Dict[str, ServingEngine]],
+                 sink=None, w_queue: float = 1.0, w_occupancy: float = 1.0,
+                 w_prefix: float = 2.0):
+        if not isinstance(replicas, dict):
+            replicas = {f"r{i}": e for i, e in enumerate(replicas)}
+        if not replicas:
+            raise ValueError("ReplicaRouter needs at least one replica")
+        self.replicas: Dict[str, ServingEngine] = dict(replicas)
+        self.sink = sink
+        self.w_queue = float(w_queue)
+        self.w_occupancy = float(w_occupancy)
+        self.w_prefix = float(w_prefix)
+        self.routed: Dict[str, int] = {name: 0 for name in self.replicas}
+        self.prefix_routed = 0
+
+    # ---------------------------------------------------------- placement
+    def live_replicas(self) -> Dict[str, ServingEngine]:
+        """Replicas currently accepting admissions (not draining)."""
+        return {n: e for n, e in self.replicas.items() if not e._draining}
+
+    def _score(self, name: str, eng: ServingEngine, prompt_ids) -> Dict:
+        qd = eng.queue_depth()
+        occ = eng.occupancy()
+        plen = max(1, len(prompt_ids))
+        matched = min(eng.prefix_match_len(prompt_ids), plen)
+        frac = matched / plen
+        return {
+            "replica": name,
+            "queue_depth": qd,
+            "occupancy": round(occ, 4),
+            "prefix_tokens": matched,
+            "score": (self.w_queue * qd / eng.slot_count
+                      + self.w_occupancy * occ
+                      - self.w_prefix * frac),
+        }
+
+    def submit(self, prompt_ids, **kwargs) -> Request:
+        """Place one request on the best live replica (see module doc for
+        the score). Raises RuntimeError when every replica is draining."""
+        live = self.live_replicas()
+        if not live:
+            raise RuntimeError(
+                "ReplicaRouter: all replicas are draining; no admission "
+                "target remains")
+        scored = [self._score(n, e, prompt_ids)
+                  for n, e in sorted(live.items())]
+        best = min(scored, key=lambda s: (s["score"], s["replica"]))
+        name = best["replica"]
+        req = live[name].submit(prompt_ids, **kwargs)
+        self.routed[name] += 1
+        if best["prefix_tokens"] > 0:
+            self.prefix_routed += 1
+        mreg = _obs_metrics.active_registry()
+        if mreg is not None:
+            mreg.counter("route.requests").inc()
+            if best["prefix_tokens"] > 0:
+                mreg.counter("route.prefix_routed").inc()
+            mreg.gauge("route.replicas_live").set(len(live))
+            mreg.histogram("route.queue_depth").observe(best["queue_depth"])
+        if self.sink is not None:
+            self.sink.write({
+                "event": "route", "ts": time.time(), "request_id": req.id,
+                "replica": name, "score": round(best["score"], 4),
+                "queue_depth": best["queue_depth"],
+                "occupancy": best["occupancy"],
+                "prefix_tokens": best["prefix_tokens"],
+                "replicas_live": len(live),
+                "candidates": len(scored),
+            })
+        return req
+
+    # -------------------------------------------------------------- drive
+    def step(self) -> int:
+        """One engine step on every replica (draining ones included — their
+        active slots must finish). Returns total live slots after."""
+        return sum(e.step() for e in self.replicas.values())
+
+    def pending(self) -> int:
+        return sum(len(e._queue) + int(e._active.sum())
+                   for e in self.replicas.values())
+
+    def run(self, max_steps: Optional[int] = None) -> None:
+        """Drive all replicas until queues and slots drain everywhere."""
+        steps = 0
+        while self.pending():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                return
+
+    # -------------------------------------------------------------- drain
+    def begin_drain(self, name: str, reason: str = "drain") -> List[Request]:
+        """Close admission on one replica. Its active slots keep decoding
+        to completion under step()/run(), but queued-not-yet-admitted work
+        would strand (a draining engine stops pulling its queue), so it is
+        re-placed on the remaining live replicas. Returns the re-placed
+        Request handles (the stranded originals never produce tokens)."""
+        eng = self.replicas[name]
+        requeue = []
+        with eng._lock:
+            while eng._queue:
+                requeue.append(eng._queue.popleft())
+        eng.begin_drain(reason)
+        return [self.submit(req.prompt_ids,
+                            max_new_tokens=req.max_new_tokens,
+                            temperature=req.temperature, top_k=req.top_k,
+                            top_p=req.top_p, eos_token_id=req.eos_token_id,
+                            seed=req.seed)
+                for req in requeue]
+
+    def drained(self, name: str) -> bool:
+        eng = self.replicas[name]
+        return bool(eng._draining) and not eng._active.any()
+
+    def stats(self) -> Dict:
+        return {
+            "replicas": {n: {"draining": e._draining,
+                             "queued": e.queue_depth(),
+                             "active": int(e._active.sum()),
+                             "routed": self.routed[n],
+                             "completed": len(e._completed)}
+                         for n, e in self.replicas.items()},
+            "prefix_routed": self.prefix_routed,
+            "total_routed": sum(self.routed.values()),
+        }

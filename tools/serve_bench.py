@@ -16,8 +16,18 @@ the warmed executables — the steady-state serving number). Useful tokens =
 tokens up to and including the first EOS; legacy's post-EOS padding steps
 produce no useful tokens but still cost scan time.
 
+A third claim rides on the paged KV subsystem (ISSUE 13): under
+shared-prefix traffic (N requests over M distinct system prompts) the
+radix prefix cache turns repeat prefills into page-table copies —
+``--shared-prefix`` measures TTFT on prefix-hit vs prefix-miss requests
+(>5x target) and concurrent requests per MB of KV cache for the paged vs
+contiguous layout (strictly higher target). ``--history`` appends
+``serve_prefix_ttft_speedup`` / ``serve_max_concurrent_per_mb`` rows to
+BENCH_HISTORY.jsonl for tools/bench_gate.py.
+
 Usage: python tools/serve_bench.py [--slots 4] [--ladder 8,16,32]
        [--requests 12] [--max-new 16] [--json out.json]
+       [--shared-prefix] [--history]
 """
 from __future__ import annotations
 
@@ -25,9 +35,32 @@ import _bootstrap  # noqa: F401  (checkout-hermetic sys.path, tools/_bootstrap.p
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
+
+
+def _history_path():
+    return os.environ.get("PADDLE_TPU_BENCH_HISTORY") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_HISTORY.jsonl")
+
+
+def _append_history(payload):
+    """bench.py's append idiom: provenance row with a UTC timestamp; a
+    read-only checkout must not break the measurement."""
+    import copy
+    import datetime
+
+    try:
+        entry = copy.deepcopy(payload)
+        entry["extra"]["ts"] = datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds")
+        with open(_history_path(), "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError:
+        pass
 
 
 def _useful_len(row, eos):
@@ -86,6 +119,167 @@ def run_engine(model, work, slots, ladder, max_new, max_seq_len,
     return wall, useful, reqs, eng
 
 
+def run_shared_prefix(args, model, paddle, monitor, metrics):
+    """Shared-prefix scenario: M distinct long system prompts, each hit by
+    several requests with short unique suffixes.
+
+    Phase 1 (TTFT): requests run one at a time on a paged engine so TTFT is
+    pure prefill cost. The first request per prefix misses the radix cache
+    and prefills the full prompt at the big rung; repeats match the cached
+    prefix pages and prefill only the suffix tail at the small rung.
+
+    Phase 2 (density): a paged engine with a pool sized for *shared* prefix
+    residency vs a contiguous engine with the same slot count; both run the
+    same hit-heavy workload to peak concurrency, and concurrency is divided
+    by the KV bytes each layout had to allocate.
+    """
+    from paddle_tpu.serving import ServingEngine
+
+    pt = args.page_tokens
+    prefix_len = args.prefix_len
+    if prefix_len % pt:
+        raise SystemExit(f"--prefix-len {prefix_len} must be a multiple of "
+                         f"--page-tokens {pt} (radix chunks are page-sized)")
+    suffix_len, max_new = 4, 6
+    plen = prefix_len + suffix_len
+    tail_rung = 8
+    big_rung = -(-plen // 16) * 16          # round up to a 16 multiple
+    ladder = (tail_rung, big_rung)
+    max_seq_len = big_rung + 16             # room for max_new_cap reserve
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(args.seed + 13)
+    prefixes = [rng.randint(0, vocab, (prefix_len,)).astype(np.int64)
+                for _ in range(args.prefixes)]
+
+    def counter(name):
+        return monitor.registry().report().get(name, {}).get("value", 0)
+
+    # ---- phase 1: TTFT, prefix miss vs hit, one request at a time ---------
+    eng = ServingEngine(model, slot_count=2, ladder=ladder,
+                        max_new_cap=8, max_seq_len=max_seq_len,
+                        steps_per_dispatch=2, kv_layout="paged",
+                        kv_page_tokens=pt)
+    # warm all three executables (big-rung prefill, tail-rung prefill,
+    # decode) on throwaway prompts, then drop their cached pages so the
+    # measured first occurrence of each prefix is a genuine miss
+    for wl in (plen, 5):
+        eng.submit(rng.randint(0, vocab, (wl,)).astype(np.int64),
+                   max_new_tokens=max_new, temperature=0.0)
+        eng.run()
+    eng.flush_prefix_cache()
+
+    hits0 = counter("serving.prefix_hits")
+    miss_ttft, hit_ttft = [], []
+    for rep in range(args.repeats):
+        for pre in prefixes:
+            suffix = rng.randint(0, vocab, (suffix_len,)).astype(np.int64)
+            req = eng.submit(np.concatenate([pre, suffix]),
+                             max_new_tokens=max_new, temperature=0.0)
+            eng.run()
+            (miss_ttft if rep == 0 else hit_ttft).append(req.ttft_s * 1e3)
+    hits = counter("serving.prefix_hits") - hits0
+    expect_hits = args.prefixes * (args.repeats - 1)
+    miss_ms = float(np.median(miss_ttft))
+    hit_ms = float(np.median(hit_ttft))
+    speedup = miss_ms / max(hit_ms, 1e-9)
+
+    # ---- phase 2: peak concurrent requests per MB of KV cache -------------
+    slots = args.slots
+    prefix_pages = prefix_len // pt
+    tail_pages = -(-(plen + max_new) // pt) - prefix_pages
+    from paddle_tpu.serving.kv_pages import RESERVED_PAGES
+    num_pages = (RESERVED_PAGES + args.prefixes * prefix_pages
+                 + slots * tail_pages + 2)
+
+    def drive_peak(e, reqs):
+        peak = 0
+        while e.queue_depth() or e._active.any():
+            peak = max(peak, e.step())
+        assert all(r.done for r in reqs)
+        return peak
+
+    dense = ServingEngine(model, slot_count=slots, ladder=ladder,
+                          max_new_cap=8, max_seq_len=max_seq_len,
+                          steps_per_dispatch=2)
+    paged = ServingEngine(model, slot_count=slots, ladder=ladder,
+                          max_new_cap=8, max_seq_len=max_seq_len,
+                          steps_per_dispatch=2, kv_layout="paged",
+                          kv_page_tokens=pt, kv_num_pages=num_pages)
+    # seed the radix cache one prefix at a time (misses reserve
+    # conservatively: sequential seeding keeps the tight pool sufficient)
+    for pre in prefixes:
+        paged.submit(np.concatenate(
+            [pre, rng.randint(0, vocab, (suffix_len,)).astype(np.int64)]),
+            max_new_tokens=max_new, temperature=0.0)
+        paged.run()
+    work = []
+    for i in range(3 * slots):
+        pre = prefixes[i % len(prefixes)]
+        work.append(np.concatenate(
+            [pre, rng.randint(0, vocab, (suffix_len,)).astype(np.int64)]))
+    paged_reqs = [paged.submit(w, max_new_tokens=max_new, temperature=0.0)
+                  for w in work]
+    paged_peak = drive_peak(paged, paged_reqs)
+    dense_reqs = [dense.submit(w, max_new_tokens=max_new, temperature=0.0)
+                  for w in work]
+    dense_peak = drive_peak(dense, dense_reqs)
+    mismatches = sum(list(a.output_ids()) != list(b.output_ids())
+                     for a, b in zip(paged_reqs, dense_reqs))
+    mb_paged = paged.kv_cache_bytes() / 2**20
+    mb_dense = dense.kv_cache_bytes() / 2**20
+    paged_per_mb = paged_peak / mb_paged
+    dense_per_mb = dense_peak / mb_dense
+
+    import jax
+    platform = jax.default_backend()
+    summary = {
+        "scenario": "shared_prefix",
+        "prefix_len": prefix_len, "suffix_len": suffix_len,
+        "page_tokens": pt, "prefixes": args.prefixes,
+        "repeats": args.repeats, "ladder": list(ladder),
+        "prefix_hits": hits, "expected_hits": expect_hits,
+        "ttft_miss_ms": round(miss_ms, 3), "ttft_hit_ms": round(hit_ms, 3),
+        "ttft_speedup": round(speedup, 2),
+        "slots": slots, "num_pages": num_pages,
+        "paged_peak_concurrent": paged_peak,
+        "dense_peak_concurrent": dense_peak,
+        "paged_kv_mb": round(mb_paged, 3), "dense_kv_mb": round(mb_dense, 3),
+        "paged_concurrent_per_mb": round(paged_per_mb, 3),
+        "dense_concurrent_per_mb": round(dense_per_mb, 3),
+        "token_mismatches": mismatches,
+        "prefix_stats": eng.stats().get("prefix"),
+        "ttft_ok": speedup > 5.0 and hits == expect_hits,
+        "per_mb_ok": paged_per_mb > dense_per_mb and mismatches == 0,
+    }
+    print(json.dumps(summary, indent=2), flush=True)
+    if args.history:
+        _append_history({
+            "metric": "serve_prefix_ttft_speedup", "value": round(speedup, 2),
+            "unit": "x", "vs_baseline": None,
+            "extra": {"scenario": "shared_prefix", "platform": platform,
+                      "prefix_len": prefix_len, "page_tokens": pt,
+                      "prefixes": args.prefixes, "repeats": args.repeats,
+                      "ttft_miss_ms": round(miss_ms, 3),
+                      "ttft_hit_ms": round(hit_ms, 3)}})
+        _append_history({
+            "metric": "serve_max_concurrent_per_mb",
+            "value": round(paged_per_mb, 3), "unit": "req/MB",
+            "vs_baseline": None,
+            "extra": {"scenario": "shared_prefix", "platform": platform,
+                      "prefix_len": prefix_len, "page_tokens": pt,
+                      "slots": slots,
+                      "contiguous_per_mb": round(dense_per_mb, 3),
+                      "ratio": round(paged_per_mb / dense_per_mb, 2),
+                      "token_mismatches": mismatches}})
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2)
+    if not (summary["ttft_ok"] and summary["per_mb_ok"]):
+        raise SystemExit("shared-prefix acceptance failed: "
+                         + json.dumps({k: summary[k]
+                                       for k in ("ttft_ok", "per_mb_ok")}))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--slots", type=int, default=4)
@@ -98,6 +292,19 @@ def main():
     ap.add_argument("--max-new", type=int, default=16)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", default=None, help="also write summary here")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="run the paged-KV shared-prefix scenario instead "
+                         "of the mixed-length legacy-vs-engine comparison")
+    ap.add_argument("--prefix-len", type=int, default=512,
+                    help="shared system-prompt tokens (multiple of "
+                         "--page-tokens)")
+    ap.add_argument("--page-tokens", type=int, default=16)
+    ap.add_argument("--prefixes", type=int, default=2,
+                    help="distinct shared prefixes in the workload")
+    ap.add_argument("--repeats", type=int, default=4,
+                    help="requests per prefix (first is the miss)")
+    ap.add_argument("--history", action="store_true",
+                    help="append BENCH_HISTORY.jsonl rows (bench_gate pins)")
     args = ap.parse_args()
 
     import paddle_tpu as paddle
@@ -111,9 +318,18 @@ def main():
 
     ladder = tuple(int(x) for x in args.ladder.split(","))
     paddle.seed(args.seed)
-    model = GPTForPretraining(gpt_tiny())
+    # the shared-prefix scenario needs positional room for a long system
+    # prompt; gpt_tiny defaults to max_seq_len=128
+    cfg = gpt_tiny()
+    if args.shared_prefix:
+        cfg.max_seq_len = args.prefix_len + 64
+    model = GPTForPretraining(cfg)
     model.eval()
     rng = np.random.RandomState(args.seed)
+
+    if args.shared_prefix:
+        run_shared_prefix(args, model, paddle, monitor, metrics)
+        return
 
     # >= 8 distinct prompt lengths spread over the ladder
     base_lengths = [3, 5, 6, 7, 9, 11, 13, 15, 18, 21, 25, 28]

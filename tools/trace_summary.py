@@ -104,13 +104,15 @@ def summarize_steps(path):
         return {}
     serve_reqs = [r for r in recs if r.get("event") == "serve_request"]
     serve_steps = [r for r in recs if r.get("event") == "serve_step"]
+    routes = [r for r in recs if r.get("event") == "route"]
     health = [r for r in recs if r.get("event") == "health"]
     recs = [r for r in recs if r.get("event") not in ("serve_request",
-                                                      "serve_step", "health")]
+                                                      "serve_step", "health",
+                                                      "route")]
     if not recs and health:
         return _summarize_health(health)
     if not recs:
-        return _summarize_serve(serve_reqs, serve_steps)
+        return _summarize_serve(serve_reqs, serve_steps, routes)
     n = len(recs)
 
     def col(k):
@@ -162,8 +164,8 @@ def summarize_steps(path):
               f"ag_bytes={summary['grad_comm_ag_bytes']} "
               f"(+{summary['grad_comm_ag_bytes_delta']}) "
               f"zero_update_steps={zsteps}")
-    if serve_reqs or serve_steps:
-        summary["serve"] = _summarize_serve(serve_reqs, serve_steps,
+    if serve_reqs or serve_steps or routes:
+        summary["serve"] = _summarize_serve(serve_reqs, serve_steps, routes,
                                             emit_json=False)
     if health:
         summary["health"] = _summarize_health(health, emit_json=False)
@@ -214,9 +216,11 @@ def _summarize_health(health, emit_json=True):
     return summary
 
 
-def _summarize_serve(serve_reqs, serve_steps, emit_json=True):
-    """Percentile table over serve_request/serve_step records (ServingEngine
-    sink stream): TTFT/TPOT/queue-wait/request-wall + occupancy."""
+def _summarize_serve(serve_reqs, serve_steps, routes=(), emit_json=True):
+    """Percentile table over serve_request/serve_step/route records
+    (ServingEngine + ReplicaRouter sink streams): TTFT/TPOT/queue-wait/
+    request-wall + occupancy, plus the paged-KV gauges (pages in use,
+    prefix hit rate) and router placement breakdown when present."""
 
     def col(recs, k, scale=1.0):
         return [r[k] * scale for r in recs
@@ -228,6 +232,8 @@ def _summarize_serve(serve_reqs, serve_steps, emit_json=True):
         ("queue_wait", "ms", col(serve_reqs, "queue_wait_s", 1e3)),
         ("request_wall", "ms", col(serve_reqs, "wall_s", 1e3)),
         ("occupancy", "frac", col(serve_steps, "occupancy")),
+        ("pages_in_use", "pages", col(serve_steps, "pages_in_use")),
+        ("route_queue_depth", "n", col(routes, "queue_depth")),
     ])
     toks = col(serve_reqs, "new_tokens")
     summary = {
@@ -237,6 +243,35 @@ def _summarize_serve(serve_reqs, serve_steps, emit_json=True):
         "total_new_tokens": int(sum(toks)) if toks else 0,
         "percentiles": pcts,
     }
+    # paged-KV gauges ride on serve_step records (engine.py emits them only
+    # on the paged layout); report the final sample — the steady state
+    hit_rates = col(serve_steps, "prefix_hit_rate")
+    if hit_rates:
+        summary["prefix_hit_rate"] = round(hit_rates[-1], 4)
+        summary["pages_in_use_last"] = (col(serve_steps, "pages_in_use")
+                                        or [None])[-1]
+        summary["pages_cached_last"] = (col(serve_steps, "pages_cached")
+                                        or [None])[-1]
+        summary["prefix_hit_requests"] = sum(
+            1 for r in serve_reqs if r.get("prefix_hit"))
+        print(f"paged kv: prefix_hit_rate={summary['prefix_hit_rate']} "
+              f"pages_in_use={summary['pages_in_use_last']} "
+              f"pages_cached={summary['pages_cached_last']} "
+              f"prefix_hit_requests={summary['prefix_hit_requests']}")
+    if routes:
+        per_replica = {}
+        for r in routes:
+            per_replica[r.get("replica")] = \
+                per_replica.get(r.get("replica"), 0) + 1
+        summary["route"] = {
+            "placements": len(routes),
+            "per_replica": per_replica,
+            "prefix_routed": sum(1 for r in routes
+                                 if r.get("prefix_tokens")),
+        }
+        rows = [[name, n] for name, n in sorted(per_replica.items())]
+        print("router placements:")
+        _fmt_table(["replica", "requests"], rows)
     if emit_json:
         print(json.dumps({"summary": summary}))
     return summary
