@@ -44,6 +44,7 @@ from ..core import flags as _flags
 from ..core import monitor as _monitor
 from ..observability import flight_recorder as _obs_flight
 from ..observability import metrics as _obs_metrics
+from ..observability import tracer as _obs_tracer
 from .mesh import HybridCommunicateGroup
 
 GEN_KEY = "__elastic__/gen"
@@ -317,7 +318,14 @@ class ElasticCoordinator:
             return False
 
         t0 = time.perf_counter()
+        tr = _obs_tracer.get_tracer()
         new_gen = bump_generation(self.store)
+        if tr.enabled:
+            # reformation lifecycle as first-class spans: bump (instant) ->
+            # pause (whole stopped-world window) -> reshard (redistribution
+            # only) -> commit (instant) — one per-generation fleet timeline
+            tr.instant("elastic.generation_bump", generation=new_gen,
+                       from_generation=old_gen, n_live=n_live)
         # carry live leases into the new namespace so the first
         # coordinator poll after the reshard doesn't see an empty world;
         # workers' own heartbeats take over the new keys at the next beat
@@ -332,7 +340,13 @@ class ElasticCoordinator:
                 self._fault_hook()
             from .elastic import live_reshard
 
+            t_rs = time.perf_counter()
             live_reshard(engine, new_hcg)
+            if tr.enabled:
+                tr.record_complete(
+                    "elastic.reshard", t_rs, time.perf_counter(),
+                    {"generation": new_gen,
+                     "to_topology": dict(new_hcg.degrees)})
             g_now = self.generation()
             if g_now != new_gen:
                 raise RuntimeError(
@@ -341,6 +355,9 @@ class ElasticCoordinator:
         except Exception as exc:
             REFORM_FAILURES.increase()
             _reg_inc("elastic.reform_failures")
+            if tr.enabled:
+                tr.instant("elastic.reform_failed", generation=new_gen,
+                           error=f"{type(exc).__name__}: {exc}")
             fr = _obs_flight.get()
             if fr is not None:
                 fr.dump(f"elastic_reform_{new_gen}", {
@@ -357,7 +374,16 @@ class ElasticCoordinator:
             raise
         self.store.gc_generation(old_gen)
 
-        self.last_pause_ms = (time.perf_counter() - t0) * 1000.0
+        t_end = time.perf_counter()
+        self.last_pause_ms = (t_end - t0) * 1000.0
+        if tr.enabled:
+            tr.record_complete("elastic.pause", t0, t_end,
+                               {"generation": new_gen,
+                                "from_generation": old_gen,
+                                "world_size": new_hcg.nranks})
+            tr.instant("elastic.commit", generation=new_gen,
+                       world_size=new_hcg.nranks,
+                       pause_ms=round(self.last_pause_ms, 3))
         self.reformations += 1
         REFORMATIONS.increase()
         reg = _obs_metrics.active_registry()

@@ -56,11 +56,13 @@ class _StoreOps:
 
     def gc_generation(self, generation: int) -> int:
         """Delete every key a retired world generation owned (membership
-        leases, join/leave announcements, barrier rounds). Returns the
-        number of keys removed; each removal counts in ``store.gc_keys``."""
+        leases, join/leave announcements, barrier rounds, fleet metric
+        snapshots). Returns the number of keys removed; each removal
+        counts in ``store.gc_keys``."""
         removed = 0
         for prefix in (f"__elastic__/gen{int(generation)}/",
-                       f"__barrier__/gen{int(generation)}/"):
+                       f"__barrier__/gen{int(generation)}/",
+                       f"__fleet__/gen{int(generation)}/"):
             for key in self.list_keys(prefix):
                 if self.delete_key(key):
                     removed += 1

@@ -17,6 +17,11 @@ Layers, one subsystem:
   enabled via PADDLE_TPU_METRICS_PORT.
 - ``flight_recorder``: bounded ring of recent step/serve records dumped to
   disk on NaN/exception/explicit trigger (PADDLE_TPU_FLIGHT_DIR).
+- ``fleet``: cross-process federation — per-worker registry snapshots
+  published into generation-scoped store keys, a collector merging
+  log-bucket histograms losslessly (``/fleet/metrics``), and
+  ``TraceContext`` carrying request id + parent span across the
+  router -> engine boundary (PADDLE_TPU_FLEET_*).
 - ``health``: in-program training-health stats (grad/weight/update norms,
   non-finite localization by parameter name) riding the compiled step as
   ONE packed aux output, fetched every FLAGS_health_interval steps
@@ -30,10 +35,15 @@ one env var (PADDLE_TPU_TELEMETRY_DIR / PADDLE_TPU_METRICS_PORT /
 PADDLE_TPU_FLIGHT_DIR) or one method call; disabled, no jax import, no I/O,
 no spans, no per-step work beyond a None check.
 """
-from . import exec_introspect, exporter, flight_recorder, health, metrics  # noqa: F401
+from . import exec_introspect, exporter, fleet, flight_recorder, health, metrics  # noqa: F401,E501
 from .exporter import (  # noqa: F401
     MetricsExporter, ensure_started_from_env, get_exporter, start_exporter,
     stop_exporter,
+)
+from .fleet import (  # noqa: F401
+    FleetCollector, FleetPublisher, TraceContext, active_collector,
+    fleet_to_prometheus, install_collector, merge_registry_snapshots,
+    register_router, uninstall_collector,
 )
 from .flight_recorder import FlightRecorder  # noqa: F401
 from .health import TrainingHealthMonitor, segment_layout  # noqa: F401
@@ -43,6 +53,7 @@ from .flops import (  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricRegistry, active_registry,
     default_registry, estimate_percentile, log_buckets,
+    merge_histogram_snapshots,
 )
 from .step_telemetry import (  # noqa: F401
     InMemorySink, JsonlSink, StepTelemetry,
@@ -57,7 +68,10 @@ __all__ = [
     "transformer_flops_per_token", "peak_flops_per_sec", "PEAK_TFLOPS",
     "Counter", "Gauge", "Histogram", "MetricRegistry",
     "default_registry", "active_registry", "estimate_percentile",
-    "log_buckets",
+    "log_buckets", "merge_histogram_snapshots",
+    "FleetCollector", "FleetPublisher", "TraceContext", "fleet",
+    "install_collector", "uninstall_collector", "active_collector",
+    "register_router", "merge_registry_snapshots", "fleet_to_prometheus",
     "MetricsExporter", "start_exporter", "stop_exporter", "get_exporter",
     "ensure_started_from_env",
     "FlightRecorder", "metrics", "exporter", "flight_recorder",

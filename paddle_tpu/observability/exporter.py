@@ -3,9 +3,13 @@
 A daemon-thread ``http.server`` serving the process-global
 `metrics.MetricRegistry`:
 
-    GET /metrics        Prometheus text format 0.0.4
-    GET /metrics.json   full registry snapshot as JSON
-    GET /healthz        liveness probe ("ok")
+    GET /metrics             Prometheus text format 0.0.4
+    GET /metrics.json        full registry snapshot as JSON
+    GET /fleet/metrics       merged fleet registry (Prometheus, per-worker
+                             labels) when a fleet.FleetCollector is active
+    GET /fleet/metrics.json  collected fleet snapshot as JSON
+    GET /fleet/trace         merged cross-worker chrome-trace JSON
+    GET /healthz             liveness probe ("ok")
 
 Enabled via ``PADDLE_TPU_METRICS_PORT`` (the engines call
 `ensure_started_from_env()` at construction — one getenv when unset, so
@@ -48,8 +52,33 @@ class _Handler(BaseHTTPRequestHandler):
         elif path in ("/metrics.json", "/snapshot"):
             self._send(200, json.dumps(reg.snapshot(), sort_keys=True),
                        "application/json")
+        elif path.startswith("/fleet/"):
+            self._do_fleet(path)
         elif path == "/healthz":
             self._send(200, "ok\n", "text/plain")
+        else:
+            self._send(404, "not found\n", "text/plain")
+
+    def _do_fleet(self, path):
+        from . import fleet as _fleet
+        coll = _fleet.active_collector()
+        if coll is None:
+            self._send(404, "no fleet collector installed\n", "text/plain")
+            return
+        try:
+            fleet_snap = coll.collect()  # a scrape is a federation pass
+        except Exception as exc:  # dead store mid-scrape: 503, not a crash
+            self._send(503, f"fleet collect failed: {exc}\n", "text/plain")
+            return
+        if path == "/fleet/metrics":
+            self._send(200, _fleet.fleet_to_prometheus(fleet_snap),
+                       PROM_CONTENT_TYPE)
+        elif path == "/fleet/metrics.json":
+            self._send(200, json.dumps(fleet_snap, sort_keys=True,
+                                       default=str), "application/json")
+        elif path == "/fleet/trace":
+            self._send(200, json.dumps(coll.merged_chrome_trace()),
+                       "application/json")
         else:
             self._send(404, "not found\n", "text/plain")
 

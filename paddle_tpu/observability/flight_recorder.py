@@ -142,6 +142,16 @@ class FlightRecorder:
         except ImportError:
             pass
         try:
+            # fleet context: the last collected fleet snapshot + router
+            # placement tail when a collector/router is live — a crash
+            # dump then shows the fleet, not just the dying process
+            from . import fleet as _fleet
+            fc = _fleet.flight_context()
+            if fc:
+                state.update(fc)  # "fleet" + "router_placements" keys
+        except Exception:
+            pass
+        try:
             # training-health tail: the last decoded health records (grad
             # norms, nonfinite attribution) when a monitor is live — the
             # post-mortem context a health-triggered dump points at

@@ -66,6 +66,13 @@ class Counter:
         with self._lock:
             return self._value
 
+    def merge(self, other: "Counter") -> None:
+        """Absorb another counter's value (fleet federation: merged total
+        equals the sum of the per-worker totals)."""
+        n = other.value
+        with self._lock:
+            self._value += n
+
     def snapshot(self) -> dict:
         return {"kind": self.kind, "value": self.value}
 
@@ -146,6 +153,32 @@ class Histogram:
         with self._lock:
             return self._count
 
+    def merge(self, other: "Histogram") -> None:
+        """Absorb another histogram with identical boundaries, losslessly.
+
+        Bucket counts and the exact moments (count/sum/min/max) add
+        elementwise — exactly what one histogram observing the pooled
+        samples would hold — so percentile estimates recomputed from the
+        merged buckets stay within one bucket width of the pooled truth.
+        """
+        if tuple(other.boundaries) != self.boundaries:
+            raise ValueError(
+                f"histogram {self.name!r}: cannot merge boundaries "
+                f"{list(other.boundaries)} into {list(self.boundaries)}")
+        with other._lock:
+            counts = list(other._counts)
+            count, total = other._count, other._sum
+            mn, mx = other._min, other._max
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._count += count
+            self._sum += total
+            if mn < self._min:
+                self._min = mn
+            if mx > self._max:
+                self._max = mx
+
     def snapshot(self) -> dict:
         with self._lock:
             counts = list(self._counts)
@@ -195,6 +228,50 @@ def estimate_percentile(snap: dict, q: float) -> Optional[float]:
             return float(min(max(est, mn), mx))
         cum += c
     return float(mx)
+
+
+def merge_histogram_snapshots(snaps: Sequence[Optional[dict]]
+                              ) -> Optional[dict]:
+    """Lossless merge of histogram snapshot dicts sharing one boundary set.
+
+    Bucket counts, ``count`` and ``sum`` add elementwise; ``min``/``max``
+    combine (None-aware for empty inputs); p50/p90/p99 are recomputed from
+    the merged buckets — the same estimate a single histogram observing
+    the pooled samples would report, so merged percentiles sit within one
+    bucket width of the pooled recompute. Usable offline (fleet collector,
+    tools/trace_summary.py) on JSON snapshots without a live registry.
+    Returns None when no snapshot is present at all.
+    """
+    merged: Optional[dict] = None
+    for snap in snaps:
+        if snap is None:
+            continue
+        if merged is None:
+            merged = {
+                "kind": "histogram",
+                "boundaries": list(snap["boundaries"]),
+                "counts": list(snap["counts"]),
+                "count": int(snap["count"]),
+                "sum": float(snap["sum"]),
+                "min": snap["min"],
+                "max": snap["max"],
+            }
+            continue
+        if list(snap["boundaries"]) != merged["boundaries"]:
+            raise ValueError(
+                "cannot merge histogram snapshots with different boundaries")
+        merged["counts"] = [a + b for a, b in
+                            zip(merged["counts"], snap["counts"])]
+        merged["count"] += int(snap["count"])
+        merged["sum"] += float(snap["sum"])
+        mns = [v for v in (merged["min"], snap["min"]) if v is not None]
+        mxs = [v for v in (merged["max"], snap["max"]) if v is not None]
+        merged["min"] = min(mns) if mns else None
+        merged["max"] = max(mxs) if mxs else None
+    if merged is not None:
+        for q in (0.5, 0.9, 0.99):
+            merged["p%g" % (q * 100)] = estimate_percentile(merged, q)
+    return merged
 
 
 class MetricRegistry:

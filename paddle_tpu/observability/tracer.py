@@ -26,6 +26,7 @@ threads land on separate chrome-trace rows.
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import os
 import threading
@@ -198,6 +199,16 @@ class Tracer:
 
 
 _global_tracer = Tracer()
+
+_span_ids = itertools.count(1)
+
+
+def new_span_id() -> int:
+    """Process-unique id for cross-component span parentage (fleet trace
+    context): the router mints one per placement span; engine-side child
+    spans carry it as ``parent_span`` so one chrome trace links routing
+    decision -> queue wait -> prefill/decode for a single request."""
+    return next(_span_ids)
 
 
 def get_tracer() -> Tracer:

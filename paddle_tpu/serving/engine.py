@@ -64,10 +64,14 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, prompt_ids, max_new_tokens, temperature, top_k, top_p,
-                 eos_token_id, seed):
+                 eos_token_id, seed, trace_ctx=None):
         import numpy as np
 
         self.id = next(Request._ids)
+        # fleet trace identity (observability.fleet.TraceContext or any
+        # object with span_args()): set by the ReplicaRouter so engine-side
+        # spans carry the request id + the placement span as parent_span
+        self.trace_ctx = trace_ctx
         self.prompt_ids = np.asarray(prompt_ids, np.int64).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -113,6 +117,15 @@ class Request:
                 or len(self.tokens) < 2):
             return None
         return (self.done_ts - self.first_token_ts) / (len(self.tokens) - 1)
+
+    def trace_args(self, **kw) -> dict:
+        """Span-args dict for this request's trace events: local id plus
+        the propagated fleet request id / parent placement span (if any)."""
+        out = {"request": self.id}
+        if self.trace_ctx is not None:
+            out.update(self.trace_ctx.span_args())
+        out.update(kw)
+        return out
 
     def output_ids(self):
         """[prompt + generated] (no post-EOS padding; pad with eos to
@@ -293,16 +306,18 @@ class ServingEngine:
     # ------------------------------------------------------------- public
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-               eos_token_id=None, seed: int = 0) -> Request:
+               eos_token_id=None, seed: int = 0, trace_ctx=None) -> Request:
         """Enqueue a request; returns the live Request handle (tokens fill
         in as the engine runs). max_new_tokens is clamped to the engine cap
-        and to the cache room left after the prompt's bucket."""
+        and to the cache room left after the prompt's bucket. trace_ctx
+        (fleet.TraceContext) threads a fleet request id + parent span
+        through every span this request records."""
         if self._draining:
             raise RuntimeError(
                 "ServingEngine is draining (SIGTERM/begin_drain): admission "
                 "is closed; submit to a live replica")
         req = Request(prompt_ids, max_new_tokens, temperature, top_k, top_p,
-                      eos_token_id, seed)
+                      eos_token_id, seed, trace_ctx=trace_ctx)
         plen = len(req.prompt_ids)
         req.bucket = bucket_for(plen, self.ladder)  # raises if oversize
         room = self.max_seq_len - req.bucket
@@ -314,8 +329,8 @@ class ServingEngine:
             self._queue.append(req)
         tr = _obs_tracer.get_tracer()
         if tr.enabled:
-            tr.instant("serve.enqueue", request=req.id,
-                       queue_depth=req.queue_depth_at_submit)
+            tr.instant("serve.enqueue", **req.trace_args(
+                queue_depth=req.queue_depth_at_submit))
         return req
 
     def step(self) -> int:
@@ -727,11 +742,10 @@ class ServingEngine:
             tr = _obs_tracer.get_tracer()
             if tr.enabled:
                 tr.record_complete("serve.queue_wait", req.submit_ts,
-                                   req.admit_ts, {"request": req.id})
+                                   req.admit_ts, req.trace_args())
                 tr.record_complete("serve.prefill", req.admit_ts,
                                    req.first_token_ts,
-                                   {"request": req.id, "bucket": bucket,
-                                    "slot": slot})
+                                   req.trace_args(bucket=bucket, slot=slot))
             mreg = _obs_metrics.active_registry()
             if mreg is not None:
                 mreg.histogram("serve.queue_wait_ms").observe(
@@ -842,7 +856,7 @@ class ServingEngine:
         mreg = _obs_metrics.active_registry()
         if tr.enabled:
             tr.record_complete("serve.queue_wait", req.submit_ts,
-                               req.admit_ts, {"request": req.id})
+                               req.admit_ts, req.trace_args())
         if mreg is not None:
             mreg.histogram("serve.queue_wait_ms").observe(
                 req.queue_wait_s * 1e3)
@@ -853,8 +867,8 @@ class ServingEngine:
             req.tail_bucket = 0
             req.slot = slot
             if tr.enabled:
-                tr.instant("serve.prefix_replay", request=req.id, slot=slot,
-                           shared_tokens=req.shared_tokens)
+                tr.instant("serve.prefix_replay", **req.trace_args(
+                    slot=slot, shared_tokens=req.shared_tokens))
             self._offsets[slot] = plen - 1
             self._last_tok[slot] = int(req.prompt_ids[-1])
             self._active[slot] = True
@@ -912,8 +926,8 @@ class ServingEngine:
         if tr.enabled:
             tr.record_complete("serve.prefill", req.admit_ts,
                                req.first_token_ts,
-                               {"request": req.id, "bucket": tbucket,
-                                "base": base, "slot": slot})
+                               req.trace_args(bucket=tbucket, base=base,
+                                              slot=slot))
         if mreg is not None:
             mreg.histogram("serve.prefill_ms").observe(
                 (req.first_token_ts - req.admit_ts) * 1e3)
@@ -1251,12 +1265,10 @@ class ServingEngine:
             if req.first_token_ts is not None:
                 tr.record_complete("serve.decode", req.first_token_ts,
                                    req.done_ts,
-                                   {"request": req.id,
-                                    "tokens": len(req.tokens)})
+                                   req.trace_args(tokens=len(req.tokens)))
             tr.record_complete("serve.request", req.submit_ts, req.done_ts,
-                               {"request": req.id,
-                                "finish": req.finish_reason})
-            tr.instant("serve.retire", request=req.id, slot=req.slot)
+                               req.trace_args(finish=req.finish_reason))
+            tr.instant("serve.retire", **req.trace_args(slot=req.slot))
         mreg = _obs_metrics.active_registry()
         if mreg is not None:
             if req.ttft_s is not None:
@@ -1285,6 +1297,8 @@ class ServingEngine:
                 "prefix_hit": req.prefix_hit,
                 "shared_tokens": req.shared_tokens,
             }
+            if req.trace_ctx is not None:
+                rec["fleet_request_id"] = req.trace_ctx.request_id
             if self.sink is not None:
                 self.sink.write(rec)
             if fr is not None:

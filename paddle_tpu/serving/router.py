@@ -28,14 +28,26 @@ Metrics (route.*, PR 6 registry when active): ``route.requests``,
 ``route.queue_depth`` histogram of the chosen replica's depth at
 admission. The sink (if any) gets one ``route`` record per placement.
 
+Distributed tracing (ISSUE 14): when the tracer is enabled, each
+placement records a ``route.place`` span carrying a minted span id and a
+fleet request id, and hands the engine a ``fleet.TraceContext`` so every
+engine-side span of that request (queue wait, prefill, decode, retire)
+is tagged ``request_id=...`` with ``parent_span`` pointing back at the
+placement — one chrome trace then renders routing decision + replica
+execution as a single parented timeline. Dark path unchanged: tracer
+off means no context allocation, no extra span args.
+
 Host-side only — the router never touches device state.
 """
 from __future__ import annotations
 
+import collections
 import time
 from typing import Dict, List, Optional, Sequence, Union
 
+from ..observability import fleet as _obs_fleet
 from ..observability import metrics as _obs_metrics
+from ..observability import tracer as _obs_tracer
 from .engine import Request, ServingEngine
 
 
@@ -61,6 +73,10 @@ class ReplicaRouter:
         self.w_prefix = float(w_prefix)
         self.routed: Dict[str, int] = {name: 0 for name in self.replicas}
         self.prefix_routed = 0
+        # bounded tail of placement decisions: flight dumps embed it via
+        # fleet.flight_context() so a crash shows where traffic was going
+        self._placements: collections.deque = collections.deque(maxlen=64)
+        _obs_fleet.register_router(self)
 
     # ---------------------------------------------------------- placement
     def live_replicas(self) -> Dict[str, ServingEngine]:
@@ -83,9 +99,17 @@ class ReplicaRouter:
                       - self.w_prefix * frac),
         }
 
-    def submit(self, prompt_ids, **kwargs) -> Request:
+    def submit(self, prompt_ids, trace_ctx=None, **kwargs) -> Request:
         """Place one request on the best live replica (see module doc for
-        the score). Raises RuntimeError when every replica is draining."""
+        the score). Raises RuntimeError when every replica is draining.
+
+        With the tracer enabled, the placement itself becomes a
+        ``route.place`` span whose minted span id is the ``parent_span``
+        of every engine-side span this request records; ``trace_ctx``
+        lets a re-placement (begin_drain) keep the original request id.
+        """
+        tr = _obs_tracer.get_tracer()
+        t0 = time.perf_counter() if tr.enabled else None
         live = self.live_replicas()
         if not live:
             raise RuntimeError(
@@ -95,10 +119,32 @@ class ReplicaRouter:
                   for n, e in sorted(live.items())]
         best = min(scored, key=lambda s: (s["score"], s["replica"]))
         name = best["replica"]
-        req = live[name].submit(prompt_ids, **kwargs)
+        ctx = trace_ctx
+        if tr.enabled:
+            if ctx is None:
+                ctx = _obs_fleet.TraceContext()
+            ctx.parent_span = _obs_tracer.new_span_id()
+        req = live[name].submit(prompt_ids, trace_ctx=ctx, **kwargs)
         self.routed[name] += 1
         if best["prefix_tokens"] > 0:
             self.prefix_routed += 1
+        if tr.enabled:
+            # span_id (not parent_span): the placement IS the parent the
+            # engine-side children point back at
+            tr.record_complete("route.place", t0, time.perf_counter(), {
+                "request": req.id, "request_id": ctx.request_id,
+                "span_id": ctx.parent_span, "replica": name,
+                "score": round(best["score"], 4),
+                "prefix_tokens": best["prefix_tokens"],
+            })
+        self._placements.append({
+            "ts": time.time(), "request": req.id, "replica": name,
+            "score": round(best["score"], 4),
+            "queue_depth": best["queue_depth"],
+            "occupancy": best["occupancy"],
+            "prefix_tokens": best["prefix_tokens"],
+            **({"request_id": ctx.request_id} if ctx is not None else {}),
+        })
         mreg = _obs_metrics.active_registry()
         if mreg is not None:
             mreg.counter("route.requests").inc()
@@ -107,7 +153,7 @@ class ReplicaRouter:
             mreg.gauge("route.replicas_live").set(len(live))
             mreg.histogram("route.queue_depth").observe(best["queue_depth"])
         if self.sink is not None:
-            self.sink.write({
+            rec = {
                 "event": "route", "ts": time.time(), "request_id": req.id,
                 "replica": name, "score": round(best["score"], 4),
                 "queue_depth": best["queue_depth"],
@@ -115,8 +161,16 @@ class ReplicaRouter:
                 "prefix_tokens": best["prefix_tokens"],
                 "replicas_live": len(live),
                 "candidates": len(scored),
-            })
+            }
+            if ctx is not None:
+                rec["fleet_request_id"] = ctx.request_id
+            self.sink.write(rec)
         return req
+
+    def recent_placements(self) -> List[Dict]:
+        """Bounded tail of placement decisions, oldest first (embedded in
+        flight-recorder state.json via fleet.flight_context())."""
+        return list(self._placements)
 
     # -------------------------------------------------------------- drive
     def step(self) -> int:
@@ -150,7 +204,7 @@ class ReplicaRouter:
             while eng._queue:
                 requeue.append(eng._queue.popleft())
         eng.begin_drain(reason)
-        return [self.submit(req.prompt_ids,
+        return [self.submit(req.prompt_ids, trace_ctx=req.trace_ctx,
                             max_new_tokens=req.max_new_tokens,
                             temperature=req.temperature, top_k=req.top_k,
                             top_p=req.top_p, eos_token_id=req.eos_token_id,

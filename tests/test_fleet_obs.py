@@ -1,0 +1,510 @@
+"""Fleet observability (ISSUE 14 tentpole): cross-process metrics
+federation + distributed trace propagation.
+
+Pinned contracts:
+- histogram/registry merge is lossless over the log-bucket representation:
+  merged count == sum of per-worker counts exactly, merged min/max exact,
+  merged percentiles recomputed from merged buckets land within one bucket
+  width of a pooled-sample recompute;
+- the publisher/collector pair federates over the same store the elastic
+  membership layer uses: generation-scoped keys, wall-clock deadlines (a
+  dead publisher is evicted by the collector's read), gc_generation sweeps
+  fleet keys with the rest of a retired generation;
+- trace context threads router -> engine: the route.place span's minted
+  span id is the parent_span of every engine-side span of that request,
+  and the request_id tags them end to end;
+- dark by default: no active registry -> publish_once() is a no-op that
+  never touches the store.
+"""
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.store import FileStore
+from paddle_tpu.observability import (exporter, fleet, flight_recorder,
+                                      metrics, tracer)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    """Fleet wiring rides the same process-globals as the rest of
+    observability: start dark, leave dark."""
+    def _reset():
+        exporter.stop_exporter()
+        metrics.reset()
+        flight_recorder.disable()
+        fleet.uninstall_collector()
+        tr = tracer.get_tracer()
+        tr.disable()
+        tr.clear()
+        tr.clear_stats()
+
+    _reset()
+    yield
+    _reset()
+
+
+def _fill(h, values):
+    for v in values:
+        h.observe(v)
+    return h
+
+
+# -------------------------------------------------------------- merge math
+
+def test_counter_and_histogram_merge_match_pooled():
+    """a.merge(b) must equal one histogram that observed both streams:
+    bucket counts / count / min / max exactly, sum up to float summation
+    order, percentiles identical (same buckets + same clamps)."""
+    rng = np.random.RandomState(3)
+    xs = list(np.exp(rng.randn(400)) * 5.0)
+    ys = list(np.exp(rng.randn(300)) * 40.0)
+
+    a = _fill(metrics.Histogram("m"), xs)
+    b = _fill(metrics.Histogram("m"), ys)
+    pooled = _fill(metrics.Histogram("m"), xs + ys)
+    a.merge(b)
+    sa, sp = a.snapshot(), pooled.snapshot()
+    assert sa["counts"] == sp["counts"]
+    assert sa["count"] == sp["count"] == 700
+    assert sa["min"] == sp["min"] and sa["max"] == sp["max"]
+    assert math.isclose(sa["sum"], sp["sum"], rel_tol=1e-12)
+    for q in (0.5, 0.9, 0.99):
+        assert metrics.estimate_percentile(sa, q) == \
+            metrics.estimate_percentile(sp, q)
+
+    ca, cb = metrics.Counter("c"), metrics.Counter("c")
+    ca.inc(3), cb.inc(4.5)
+    ca.merge(cb)
+    assert ca.value == 7.5
+
+
+def test_histogram_merge_boundary_mismatch_raises():
+    a = metrics.Histogram("m", boundaries=(1.0, 2.0))
+    b = metrics.Histogram("m", boundaries=(1.0, 4.0))
+    with pytest.raises(ValueError):
+        a.merge(b)
+    with pytest.raises(ValueError):
+        metrics.merge_histogram_snapshots([a.snapshot(), b.snapshot()])
+
+
+def test_merge_histogram_snapshots_edges():
+    """Empty input / all-None -> None; a single snapshot round-trips; fully
+    disjoint ranges merge with exact global min/max."""
+    assert metrics.merge_histogram_snapshots([]) is None
+    assert metrics.merge_histogram_snapshots([None, None]) is None
+
+    solo = _fill(metrics.Histogram("m"), [3.0]).snapshot()
+    m = metrics.merge_histogram_snapshots([None, solo])
+    assert m["count"] == 1 and m["min"] == m["max"] == 3.0
+    assert m["counts"] == solo["counts"]
+
+    lo = _fill(metrics.Histogram("m"), [0.2, 0.4]).snapshot()
+    hi = _fill(metrics.Histogram("m"), [5000.0, 9000.0]).snapshot()
+    m = metrics.merge_histogram_snapshots([lo, hi])
+    assert m["count"] == 4
+    assert m["min"] == 0.2 and m["max"] == 9000.0
+    assert sum(m["counts"]) == 4
+
+
+def test_merged_percentiles_within_one_bucket_of_pooled_numpy():
+    """The federation acceptance bound: split a lognormal stream over 4
+    'workers', merge the snapshots, and the merged p50/p90/p99 must land
+    within the containing bucket's width of numpy's pooled answer."""
+    rng = np.random.RandomState(11)
+    pooled = np.exp(rng.randn(4000)) * 12.0
+    parts = np.array_split(pooled, 4)
+    snaps = [_fill(metrics.Histogram("m"), p).snapshot() for p in parts]
+    m = metrics.merge_histogram_snapshots(snaps)
+    assert m["count"] == 4000 == sum(s["count"] for s in snaps)
+    import bisect
+    bs = m["boundaries"]
+    for q in (50, 90, 99):
+        est = m[f"p{q}"]
+        truth = float(np.percentile(pooled, q))
+        i = bisect.bisect_left(bs, truth)
+        lo = bs[i - 1] if i > 0 else m["min"]
+        hi = bs[i] if i < len(bs) else m["max"]
+        assert abs(est - truth) <= (hi - lo), (q, est, truth)
+
+
+def test_merge_registry_snapshots_sums_and_merges():
+    reg_a = {"counters": {"c": 2.0}, "gauges": {"g": 1.5},
+             "histograms": {"h": _fill(metrics.Histogram("h"),
+                                       [1.0, 2.0]).snapshot()},
+             "monitor": {"s": {"value": 3.0, "peak": 5.0}}}
+    reg_b = {"counters": {"c": 5.0, "d": 1.0}, "gauges": {"g": 0.5},
+             "histograms": {"h": _fill(metrics.Histogram("h"),
+                                       [4.0]).snapshot()},
+             "monitor": {"s": {"value": 2.0, "peak": 9.0}}}
+    m = fleet.merge_registry_snapshots([reg_a, None, reg_b])
+    assert m["counters"] == {"c": 7.0, "d": 1.0}
+    assert m["gauges"] == {"g": 2.0}
+    assert m["histograms"]["h"]["count"] == 3
+    assert m["monitor"]["s"] == {"value": 5.0, "peak": 9.0}
+
+
+# ------------------------------------------------- publisher / collector
+
+def test_publisher_collector_roundtrip_filestore(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    reg = metrics.enable()
+    _fill(reg.histogram("train.step_ms"), [10.0, 20.0, 30.0])
+    reg.counter("train.steps").inc(3)
+    pub = fleet.FleetPublisher(store, "w0", interval_s=0.1, deadline_s=5.0)
+    assert pub.publish_once() is True
+    coll = fleet.FleetCollector(store)
+    snap = coll.collect()
+    assert list(snap["workers"]) == ["w0"]
+    assert snap["workers"]["w0"]["age_s"] < 5.0
+    assert snap["merged"]["counters"]["train.steps"] == 3.0
+    assert snap["merged"]["histograms"]["train.step_ms"]["count"] == 3
+    assert snap["per_worker"]["w0"]["histograms"]["train.step_ms"][
+        "count"] == 3
+    assert snap["evicted"] == []
+
+
+def test_dark_by_default_no_store_writes(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    pub = fleet.FleetPublisher(store, "w0", interval_s=0.1)
+    assert metrics.active_registry() is None
+    assert pub.payload() is None
+    assert pub.publish_once() is False
+    assert store.list_keys(fleet.FLEET_PREFIX) == []
+    assert pub.publishes == 0
+
+
+def test_oversized_publish_sheds_spans_then_drops(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    reg = metrics.enable()
+    tr = tracer.get_tracer()
+    tr.enable()
+    for i in range(50):
+        tr.instant("noise", i=i, blob="x" * 64)
+    _fill(reg.histogram("h"), [1.0])
+    # bound fits the snapshot alone, not snapshot+spans: tail is shed
+    base = len(fleet.FleetPublisher(store, "w0", span_tail=0).payload())
+    pub = fleet.FleetPublisher(store, "w0", interval_s=0.1,
+                               max_bytes=base + 8)
+    assert pub.publish_once() is True
+    doc = fleet._decode(store.get(fleet.snap_key(0, "w0"), wait=False))
+    assert doc["spans"] == [] and doc["snapshot"]["histograms"]
+    # bound below even the span-less payload: drop + counter
+    pub2 = fleet.FleetPublisher(store, "w1", interval_s=0.1, max_bytes=16)
+    assert pub2.publish_once() is False
+    assert pub2.drops == 1
+    assert reg.snapshot()["counters"]["fleet.publish_drops"] == 1.0
+    assert store.list_keys(fleet.snap_key(0, "w1")) == []
+
+
+def test_collector_evicts_dead_publisher(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    metrics.enable()
+    live = fleet.FleetPublisher(store, "alive", interval_s=0.1,
+                                deadline_s=30.0)
+    dead = fleet.FleetPublisher(store, "dead", interval_s=0.1,
+                                deadline_s=0.05)
+    assert live.publish_once() and dead.publish_once()
+    time.sleep(0.1)  # the dead worker's deadline lapses, no re-publish
+    coll = fleet.FleetCollector(store)
+    snap = coll.collect()
+    assert snap["evicted"] == ["dead"]
+    assert list(snap["workers"]) == ["alive"]
+    # evicted means deleted from the store, not just skipped
+    assert store.list_keys(fleet.snap_key(0, "dead")) == []
+    assert coll.evictions == 1
+
+
+def test_gc_generation_sweeps_fleet_keys(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    store.set(fleet.snap_key(1, "w0"), b"old")
+    store.set(fleet.snap_key(2, "w0"), b"new")
+    removed = store.gc_generation(1)
+    assert removed >= 1
+    assert store.list_keys("__fleet__/gen1/") == []
+    assert store.list_keys("__fleet__/gen2/") == [fleet.snap_key(2, "w0")]
+
+
+def test_two_process_federation_roundtrip(tmp_path):
+    """A real second process publishes over the FileStore; the driver's
+    collector merges its registry with the local one exactly."""
+    child = (
+        "import sys\n"
+        "from paddle_tpu.distributed.store import FileStore\n"
+        "from paddle_tpu.observability import fleet, metrics\n"
+        "store = FileStore(sys.argv[1], timeout=5.0)\n"
+        "reg = metrics.enable()\n"
+        "h = reg.histogram('train.step_ms')\n"
+        "for v in (100.0, 200.0, 300.0): h.observe(v)\n"
+        "reg.counter('train.steps').inc(3)\n"
+        "pub = fleet.FleetPublisher(store, 'remote', interval_s=0.1,\n"
+        "                           deadline_s=30.0)\n"
+        "assert pub.publish_once()\n"
+        "print('PUBLISHED')\n")
+    store = FileStore(str(tmp_path), timeout=5.0)
+    env = {**os.environ, "PYTHONPATH": _REPO, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run([sys.executable, "-c", child, str(tmp_path)],
+                         env=env, capture_output=True, text=True,
+                         timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "PUBLISHED" in out.stdout
+
+    reg = metrics.enable()
+    _fill(reg.histogram("train.step_ms"), [10.0, 20.0])
+    reg.counter("train.steps").inc(2)
+    fleet.FleetPublisher(store, "local", interval_s=0.1,
+                         deadline_s=30.0).publish_once()
+    snap = fleet.FleetCollector(store).collect()
+    assert sorted(snap["workers"]) == ["local", "remote"]
+    assert snap["workers"]["remote"]["pid"] != os.getpid()
+    merged = snap["merged"]
+    assert merged["counters"]["train.steps"] == 5.0
+    h = merged["histograms"]["train.step_ms"]
+    assert h["count"] == 5
+    assert h["min"] == 10.0 and h["max"] == 300.0
+
+
+# ---------------------------------------------------- trace propagation
+
+@pytest.fixture(scope="module")
+def model():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+
+    set_hybrid_communicate_group(None)
+    paddle.seed(0)
+    m = GPTForPretraining(gpt_tiny())
+    m.eval()
+    return m
+
+
+def test_router_placement_span_parents_engine_spans(model):
+    """ISSUE 14 acceptance: a routed request produces route.place whose
+    span_id is the parent_span of that request's queue-wait/prefill/decode
+    spans, all tagged with one request_id, in causal order."""
+    from paddle_tpu.serving import ReplicaRouter, ServingEngine
+
+    tr = tracer.get_tracer()
+    tr.enable()
+    tr.clear()
+    rng = np.random.RandomState(5)
+    engines = [ServingEngine(model, slot_count=2, ladder=(8, 16),
+                             max_new_cap=8, steps_per_dispatch=2)
+               for _ in range(2)]
+    router = ReplicaRouter(engines)
+    reqs = [router.submit(rng.randint(0, 1024, (4 + i,)).astype(np.int64),
+                          max_new_tokens=3, temperature=0.0)
+            for i in range(4)]
+    router.run()
+    events = tr.events()
+
+    places = [e for e in events if e["name"] == "route.place"]
+    assert len(places) == 4
+    for req in reqs:
+        assert req.done
+        ctx = req.trace_ctx
+        assert ctx is not None and ctx.parent_span is not None
+        place = next(p for p in places
+                     if p["args"]["request_id"] == ctx.request_id)
+        assert place["args"]["span_id"] == ctx.parent_span
+        children = [e for e in events if e["name"].startswith("serve.")
+                    and (e.get("args") or {}).get("request") == req.id]
+        assert {e["name"] for e in children} >= {
+            "serve.queue_wait", "serve.prefill", "serve.decode",
+            "serve.request", "serve.retire"}
+        for ev in children:
+            assert ev["args"]["request_id"] == ctx.request_id
+            assert ev["args"]["parent_span"] == ctx.parent_span
+        qw = next(e for e in children if e["name"] == "serve.queue_wait")
+        assert place["ts"] <= qw["ts"]  # placement precedes admission
+    # distinct requests got distinct parents (no span-id reuse)
+    assert len({p["args"]["span_id"] for p in places}) == 4
+    # placement tail recorded for flight dumps, request ids included
+    tail = router.recent_placements()
+    assert len(tail) == 4 and all("request_id" in p for p in tail)
+
+
+def test_merged_chrome_trace_single_timeline(tmp_path):
+    """Two publishers' span tails stitch into one chrome trace with one
+    pid row per worker and the request id preserved in span args."""
+    store = FileStore(str(tmp_path), timeout=2.0)
+    metrics.enable()
+    tr = tracer.get_tracer()
+    tr.enable()
+    rid = fleet.new_request_id()
+    tr.instant("route.place", request_id=rid)
+    with tr.span("serve.prefill", request_id=rid):
+        pass
+    fleet.FleetPublisher(store, "w0", interval_s=0.1,
+                         deadline_s=30.0).publish_once()
+    fleet.FleetPublisher(store, "w1", interval_s=0.1,
+                         deadline_s=30.0).publish_once()
+    coll = fleet.FleetCollector(store)
+    coll.collect()
+    doc = coll.merged_chrome_trace()
+    names = [e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("name") == "process_name"]
+    assert sorted(names) == ["fleet:w0", "fleet:w1"]
+    tagged = [e for e in doc["traceEvents"]
+              if (e.get("args") or {}).get("request_id") == rid]
+    # both workers republished the same process tail here; what matters is
+    # the id survives the roundtrip and X/i phases are well-formed
+    assert tagged and all(e["ph"] in ("X", "i") for e in tagged)
+
+
+def test_reformation_events_become_spans(tmp_path):
+    """generation_bump / pause / reshard / commit land as first-class
+    spans with the new generation in their args, in causal order."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.engine import TrainStepEngine
+    from paddle_tpu.distributed.membership import (ElasticCoordinator,
+                                                   WorkerAgent)
+    from paddle_tpu.distributed.mesh import (HybridCommunicateGroup,
+                                             set_hybrid_communicate_group)
+
+    store = FileStore(str(tmp_path), timeout=2.0)
+    coord = ElasticCoordinator(store, lease_s=5.0)
+    agents = [WorkerAgent(store, f"w{i}", lease_s=5.0) for i in range(4)]
+    for a in agents:
+        a.register()
+
+    set_hybrid_communicate_group(None)
+    hcg = HybridCommunicateGroup(dp_degree=4, devices=jax.devices()[:4])
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    eng = TrainStepEngine(net, opt, loss_fn=paddle.nn.CrossEntropyLoss(),
+                          hcg=hcg)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(32, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 4, (32,)).astype(np.int64))
+    eng.step(x, y)
+
+    tr = tracer.get_tracer()
+    tr.enable()
+    tr.clear()
+    agents[3].announce_leave("sigterm")
+    agents[2].announce_leave("sigterm")
+    assert coord.maybe_reform(eng) is True
+    events = {e["name"]: e for e in tr.events()}
+    assert {"elastic.generation_bump", "elastic.pause", "elastic.reshard",
+            "elastic.commit"} <= set(events)
+    gen = coord.generation()
+    for name in ("elastic.generation_bump", "elastic.reshard",
+                 "elastic.commit"):
+        assert events[name]["args"]["generation"] == gen
+    bump, pause, rs, commit = (events["elastic.generation_bump"],
+                               events["elastic.pause"],
+                               events["elastic.reshard"],
+                               events["elastic.commit"])
+    assert pause["ts"] <= bump["ts"] <= rs["ts"] <= commit["ts"]
+    assert pause["ts"] + pause["dur"] <= commit["ts"] + 1e-6
+    assert commit["args"]["world_size"] == 2
+    assert pause["dur"] * 1000.0 == pytest.approx(coord.last_pause_ms,
+                                                  rel=0.5)
+
+
+# -------------------------------------------- exporter / flight / tools
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+def test_exporter_fleet_routes(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    reg = metrics.enable()
+    _fill(reg.histogram("train.step_ms"), [10.0, 20.0])
+    fleet.FleetPublisher(store, "w0", interval_s=0.1,
+                         deadline_s=30.0).publish_once()
+    ex = exporter.start_exporter(port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(ex.url + "/fleet/metrics")
+        assert ei.value.code == 404  # no collector installed yet
+
+        fleet.install_collector(fleet.FleetCollector(store))
+        status, body = _get(ex.url + "/fleet/metrics")
+        assert status == 200
+        assert "paddle_tpu_fleet_workers 1" in body
+        assert "paddle_tpu_fleet_train_step_ms_count 2" in body
+        assert 'paddle_tpu_fleet_train_step_ms_count{worker="w0"} 2' in body
+        assert "paddle_tpu_fleet_train_step_ms_p99" in body
+
+        status, body = _get(ex.url + "/fleet/metrics.json")
+        doc = json.loads(body)
+        assert doc["merged"]["histograms"]["train.step_ms"]["count"] == 2
+        assert list(doc["workers"]) == ["w0"]
+
+        status, body = _get(ex.url + "/fleet/trace")
+        trace = json.loads(body)
+        assert any(e.get("name") == "process_name"
+                   for e in trace["traceEvents"])
+    finally:
+        exporter.stop_exporter()
+
+
+def test_flight_state_embeds_fleet_context(tmp_path):
+    store = FileStore(str(tmp_path / "store"), timeout=2.0)
+    reg = metrics.enable()
+    _fill(reg.histogram("train.step_ms"), [10.0])
+    fleet.FleetPublisher(store, "w0", interval_s=0.1,
+                         deadline_s=30.0).publish_once()
+    coll = fleet.install_collector(fleet.FleetCollector(store))
+    coll.collect()
+    fr = flight_recorder.enable(str(tmp_path / "flight"))
+    fr.record({"step": 1, "loss": 0.5})
+    out = fr.dump("unit")
+    state = json.loads(
+        (open(os.path.join(out, "state.json"))).read())
+    assert state["fleet"]["generation"] == 0
+    assert list(state["fleet"]["workers"]) == ["w0"]
+    merged = state["fleet"]["merged"]["histograms"]["train.step_ms"]
+    assert merged["count"] == 1
+    assert "counts" not in merged  # compact form, not raw buckets
+
+
+def test_trace_summary_fleet_mode(tmp_path):
+    """Two worker dirs -> one merged report: per-worker rows + merged step
+    stats + merged snapshot, as a single fleet_merged summary line."""
+    for wid, n in (("wa", 3), ("wb", 2)):
+        d = tmp_path / wid
+        d.mkdir()
+        with open(d / "steps.jsonl", "w") as f:
+            for i in range(n):
+                f.write(json.dumps({
+                    "event": "train_step", "step": i, "loss": 1.0,
+                    "step_ms": 10.0 + i, "tokens_per_sec": 100.0}) + "\n")
+        reg = metrics.enable()
+        _fill(reg.histogram("train.step_ms"), [10.0 + i for i in range(n)])
+        with open(d / "metrics.json", "w") as f:
+            f.write(json.dumps(reg.snapshot(include_monitor=True)))
+        metrics.reset()
+    env = {**os.environ, "PYTHONPATH": _REPO, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_summary.py"),
+         str(tmp_path / "wa"), str(tmp_path / "wb")],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    summary = json.loads(out.stdout.strip().splitlines()[-1])["summary"]
+    assert summary["kind"] == "fleet_merged"
+    assert summary["sources"] == 2
+    assert set(summary["workers"]) == {"wa", "wb"}
+    assert summary["merged"]["steps"] == 5
+    assert summary["merged_snapshot"]["percentiles"][
+        "train.step_ms"]["n"] == 5

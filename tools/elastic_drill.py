@@ -21,10 +21,20 @@ and the drill
      back to restore_latest (the hard-crash path) instead of hanging —
      and the engine must keep training afterwards.
 
+Fleet-federation leg (ISSUE 14): every worker also enables the metrics
+registry, observes a deterministic synthetic `train.step_ms` stream, and
+runs a FleetPublisher on a short deadline; the driver's FleetCollector
+must see the merged histogram count equal the sum of per-worker counts,
+the merged p99 within one log-bucket width of the percentile recomputed
+from the pooled samples (the driver regenerates the same streams), the
+SIGTERMed workers' snapshots evicted after their deadline, and the fleet
+namespace follow the generation bump (old `__fleet__/gen<g>/` swept).
+
 Prints one JSON verdict row per check plus a summary row; exit 0 iff every
 verdict passed. Compile cache stays off (multi-device bit-equality, same
-debt as the dryrun phases). --history appends an `elastic_reform_pause_ms`
-row to BENCH_HISTORY.jsonl for tools/bench_gate.py.
+debt as the dryrun phases). --history appends `elastic_reform_pause_ms`,
+`fleet_collect_ms` and `fleet_snapshot_age_ms` rows to BENCH_HISTORY.jsonl
+for tools/bench_gate.py.
 
 Run:  JAX_PLATFORMS=cpu python tools/elastic_drill.py
       [--steps-per-leg 3] [--lease 5.0] [--history]
@@ -34,8 +44,10 @@ from __future__ import annotations
 import _bootstrap  # noqa: F401  (checkout-hermetic sys.path)
 
 import argparse
+import bisect
 import json
 import os
+import random
 import shutil
 import signal
 import subprocess
@@ -47,24 +59,45 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _WORKER_SRC = textwrap.dedent('''\
+    import random
     import signal
     import sys
     import time
 
     from paddle_tpu.distributed.membership import WorkerAgent
     from paddle_tpu.distributed.store import FileStore
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.observability.fleet import FleetPublisher
 
     store = FileStore(sys.argv[1], timeout=20.0)
-    agent = WorkerAgent(store, sys.argv[2], lease_s=float(sys.argv[3]))
+    wid = sys.argv[2]
+    agent = WorkerAgent(store, wid, lease_s=float(sys.argv[3]))
     # exit AFTER the agent's chained announce_leave("sigterm") runs
     signal.signal(signal.SIGTERM, lambda s, f: sys.exit(0))
     agent.install_sigterm_handler()
     agent.register()
     agent.start_heartbeat()
+    # fleet-federation leg: a deterministic synthetic step-time stream
+    # (the driver regenerates the identical stream per wid to compute the
+    # pooled-sample truth) published on a short staleness deadline
+    reg = obs_metrics.enable()
+    rnd = random.Random(1234 + int(wid[1:]))
+    h = reg.histogram("train.step_ms")
+    for _ in range(int(sys.argv[4])):
+        h.observe(rnd.lognormvariate(2.5, 0.6))
+    pub = FleetPublisher(store, wid, interval_s=float(sys.argv[5]),
+                         deadline_s=float(sys.argv[6]))
+    pub.publish_once()
+    pub.start()
     print("READY", flush=True)
     while True:
         time.sleep(0.1)
 ''')
+
+# fleet-federation leg parameters (worker argv 4..6)
+FLEET_SAMPLES = 200
+FLEET_PUBLISH_S = 0.25
+FLEET_DEADLINE_S = 1.5
 
 
 def _history_path():
@@ -137,7 +170,9 @@ def main():
 
     def spawn_worker(wid):
         procs[wid] = subprocess.Popen(
-            [sys.executable, worker_py, store_dir, wid, str(args.lease)],
+            [sys.executable, worker_py, store_dir, wid, str(args.lease),
+             str(FLEET_SAMPLES), str(FLEET_PUBLISH_S),
+             str(FLEET_DEADLINE_S)],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
             text=True, env=env)
 
@@ -213,6 +248,56 @@ def main():
         await_members(store, [f"w{i}" for i in range(8)])
         verdict("fleet_up", len(coord.live_members()) == 8, world=8)
 
+        # ---- fleet-federation leg: merged registry over 8 publishers ----
+        from paddle_tpu.observability import fleet as obs_fleet
+
+        collector = obs_fleet.FleetCollector(store)
+
+        def collect_until(n_workers, timeout=20.0):
+            deadline = time.time() + timeout
+            snap = collector.collect()
+            while len(snap["workers"]) != n_workers \
+                    and time.time() < deadline:
+                time.sleep(0.2)
+                snap = collector.collect()
+            return snap
+
+        fsnap = collect_until(8)
+        per_counts = [
+            fsnap["per_worker"][w]["histograms"]["train.step_ms"]["count"]
+            for w in sorted(fsnap["workers"])]
+        merged_h = fsnap["merged"]["histograms"]["train.step_ms"]
+        pooled = []
+        for i in range(8):  # the workers' exact streams, regenerated
+            rnd = random.Random(1234 + i)
+            pooled.extend(rnd.lognormvariate(2.5, 0.6)
+                          for _ in range(FLEET_SAMPLES))
+        p99_pool = float(np.percentile(pooled, 99))
+        bs = merged_h["boundaries"]
+        bi = bisect.bisect_left(bs, p99_pool)
+        b_lo = bs[bi - 1] if bi > 0 else merged_h["min"]
+        b_hi = bs[bi] if bi < len(bs) else merged_h["max"]
+        bucket_width = b_hi - b_lo
+        verdict("fleet_merge_exact",
+                merged_h["count"] == sum(per_counts) == 8 * FLEET_SAMPLES
+                and abs(merged_h["p99"] - p99_pool) <= bucket_width,
+                merged_count=merged_h["count"],
+                per_worker_counts=per_counts,
+                merged_p99=round(merged_h["p99"], 3),
+                pooled_p99=round(p99_pool, 3),
+                bucket_width=round(bucket_width, 3))
+        collect_times = []
+        for _ in range(5):
+            t0c = time.perf_counter()
+            fsnap = collector.collect()
+            collect_times.append((time.perf_counter() - t0c) * 1000.0)
+        fleet_collect_ms = sorted(collect_times)[len(collect_times) // 2]
+        fleet_age_ms = max(
+            w["age_s"] for w in fsnap["workers"].values()) * 1000.0
+        verdict("fleet_collect", len(fsnap["workers"]) == 8,
+                collect_ms=round(fleet_collect_ms, 3),
+                snapshot_age_ms=round(fleet_age_ms, 1))
+
         eng = drill_engine(8, seed=0)
         assert eng._zero_fallback_reason() is None, (
             "drill engine must run the flat ZeRO path: "
@@ -239,6 +324,24 @@ def main():
         preempted = sigterm_leaves(membership.current_generation(store))
         for wid in ("w6", "w7"):
             procs.pop(wid).wait(timeout=10)
+        # dead publishers must age out of the merged view (deadline-based
+        # eviction, checked BEFORE the reform so generation gc can't make
+        # this vacuous)
+        evicted = set()
+        ev_deadline = time.time() + 10.0
+        while time.time() < ev_deadline:
+            fsnap = collector.collect()
+            evicted.update(fsnap["evicted"])
+            if {"w6", "w7"} <= evicted \
+                    and not ({"w6", "w7"} & set(fsnap["workers"])):
+                break
+            time.sleep(0.25)
+        verdict("fleet_evicts_dead",
+                {"w6", "w7"} <= evicted
+                and not ({"w6", "w7"} & set(fsnap["workers"])),
+                evicted=sorted(evicted),
+                workers=sorted(fsnap["workers"]))
+        gen_before_reform = membership.current_generation(store)
         reformed = coord.maybe_reform(eng)
         pause["8to6"] = coord.last_pause_ms
         verdict("reform_8to6", reformed and eng.hcg.degrees["dp"] == 6
@@ -247,6 +350,18 @@ def main():
                 pause_ms=round(coord.last_pause_ms, 2),
                 committed_steps=eng._step_count,
                 preempted=sorted(preempted))
+        # snapshots re-home under the bumped generation; the old
+        # generation's fleet keys are swept by gc_generation
+        fsnap = collect_until(6)
+        verdict("fleet_follows_generation",
+                len(fsnap["workers"]) == 6
+                and fsnap["generation"]
+                == membership.current_generation(store)
+                and fsnap["generation"] > gen_before_reform
+                and not store.list_keys(
+                    f"__fleet__/gen{gen_before_reform}/"),
+                generation=fsnap["generation"],
+                workers=sorted(fsnap["workers"]))
         ctrl6 = restore_control(6, ck1, seed=1)
         verdict("state_bit_equal_dp6", state_bit_equal(eng, ctrl6))
         live6, ctl6 = steps(eng, args.steps_per_leg), \
@@ -299,6 +414,8 @@ def main():
             "reformations": coord.reformations,
             "pause_ms_8to6": round(pause["8to6"], 2),
             "pause_ms_6to8": round(pause["6to8"], 2),
+            "fleet_collect_ms": round(fleet_collect_ms, 3),
+            "fleet_snapshot_age_ms": round(fleet_age_ms, 1),
             "committed_steps_lost": 0 if ok else None,
         }), flush=True)
         if args.history and ok:
@@ -314,6 +431,17 @@ def main():
                 "value": round(pause["6to8"], 2), "unit": "ms",
                 "vs_baseline": None,
                 "extra": {**base, "world_from": 6, "world_to": 8}})
+            fbase = {"platform": jax.default_backend(),
+                     "workers": 8, "samples": FLEET_SAMPLES,
+                     "publish_s": FLEET_PUBLISH_S}
+            _append_history({
+                "metric": "fleet_collect_ms",
+                "value": round(fleet_collect_ms, 3), "unit": "ms",
+                "vs_baseline": None, "extra": fbase})
+            _append_history({
+                "metric": "fleet_snapshot_age_ms",
+                "value": round(fleet_age_ms, 1), "unit": "ms",
+                "vs_baseline": None, "extra": fbase})
         exit_code = 0 if ok else 1
     finally:
         fl.disable()

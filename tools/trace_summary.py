@@ -11,6 +11,15 @@ can be compared region by region.
   python tools/trace_summary.py /tmp/paddle_tpu_profile/host_1234.json
   python tools/trace_summary.py /tmp/paddle_tpu_profile/   # merged dir
   python tools/trace_summary.py snapshot.json  # exporter /metrics.json dump
+  python tools/trace_summary.py /tmp/w0 /tmp/w1     # fleet: merged report
+  python tools/trace_summary.py '/tmp/workers/w*'   # fleet: glob of dirs
+
+Fleet mode (ISSUE 14): more than one path — or a glob matching more than
+one — pools every worker's JSONL records into ONE merged report (per-
+worker record counts + pooled percentile tables) and merges any metrics
+snapshots losslessly via the fleet histogram-merge (bucket counts add,
+percentiles recomputed), mirroring what the live FleetCollector serves
+at /fleet/metrics.
 
 Format is auto-detected: a JSONL stream of step records gets the per-step
 throughput table (plus a TTFT/TPOT/step-time p50/p90/p99 percentile table
@@ -92,13 +101,21 @@ def _pctl_table(series):
     return out
 
 
-def summarize_steps(path):
+def _load_jsonl(path):
     recs = []
     with open(path) as f:
         for ln in f:
             ln = ln.strip()
             if ln:
                 recs.append(json.loads(ln))
+    return recs
+
+
+def summarize_steps(path):
+    return summarize_records(_load_jsonl(path))
+
+
+def summarize_records(recs, emit_json=True):
     if not recs:
         print("no records")
         return {}
@@ -110,9 +127,10 @@ def summarize_steps(path):
                                                       "serve_step", "health",
                                                       "route")]
     if not recs and health:
-        return _summarize_health(health)
+        return _summarize_health(health, emit_json=emit_json)
     if not recs:
-        return _summarize_serve(serve_reqs, serve_steps, routes)
+        return _summarize_serve(serve_reqs, serve_steps, routes,
+                                emit_json=emit_json)
     n = len(recs)
 
     def col(k):
@@ -169,7 +187,8 @@ def summarize_steps(path):
                                             emit_json=False)
     if health:
         summary["health"] = _summarize_health(health, emit_json=False)
-    print(json.dumps({"summary": summary}))
+    if emit_json:
+        print(json.dumps({"summary": summary}))
     return summary
 
 
@@ -280,10 +299,14 @@ def _summarize_serve(serve_reqs, serve_steps, routes=(), emit_json=True):
 def summarize_snapshot(path):
     """Percentile table from a metrics-registry snapshot (the exporter's
     /metrics.json document or a flight-recorder state.json)."""
-    from paddle_tpu.observability.metrics import estimate_percentile
-
     with open(path) as f:
         doc = json.load(f)
+    return summarize_snapshot_doc(doc)
+
+
+def summarize_snapshot_doc(doc, emit_json=True):
+    from paddle_tpu.observability.metrics import estimate_percentile
+
     hists = doc.get("histograms") or doc.get("metrics", {}).get("histograms",
                                                                 {})
     rows = []
@@ -311,7 +334,8 @@ def summarize_snapshot(path):
         "gauges": len(doc.get("gauges", {})),
         "percentiles": pcts,
     }
-    print(json.dumps({"summary": summary}))
+    if emit_json:
+        print(json.dumps({"summary": summary}))
     return summary
 
 
@@ -342,21 +366,125 @@ def summarize_trace(path):
     return summary
 
 
+# ---- fleet mode: merge many per-worker telemetry dirs into one report ------
+
+def _expand_paths(raw_paths):
+    """Glob-expand each argument (quoted globs work from any shell); keep
+    literal paths as-is so a missing file still errors loudly."""
+    import glob
+
+    out = []
+    for p in raw_paths:
+        hits = sorted(glob.glob(p))
+        out.extend(hits if hits else [p])
+    return out
+
+
+def _worker_label(path, root_common):
+    """Stable per-source label for merged tables: the path relative to the
+    common prefix of all sources (usually the per-worker dir name)."""
+    rel = os.path.relpath(path, root_common) if root_common else path
+    return rel if rel != "." else os.path.basename(path.rstrip("/"))
+
+
+def _collect_source_files(path):
+    """(jsonl_files, snapshot_files) under one source path. A directory
+    contributes its top-level *.jsonl streams and snapshot-shaped *.json
+    files; a file contributes itself."""
+    jsonls, snaps = [], []
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            p = os.path.join(path, name)
+            if not os.path.isfile(p):
+                continue
+            if name.endswith(".jsonl") and _is_jsonl(p):
+                jsonls.append(p)
+            elif name.endswith(".json") and _is_snapshot(p):
+                snaps.append(p)
+    elif _is_snapshot(path):
+        snaps.append(path)
+    elif _is_jsonl(path):
+        jsonls.append(path)
+    return jsonls, snaps
+
+
+def summarize_fleet(paths):
+    """One merged report over many per-worker telemetry dirs/files: pooled
+    JSONL records (per-worker counts + pooled percentiles — the exact
+    pooled-sample truth the fleet collector's histogram merge estimates)
+    plus a losslessly merged view of any metrics snapshots."""
+    from paddle_tpu.observability import fleet as _fleet
+
+    try:
+        common = os.path.commonpath([os.path.abspath(p) for p in paths])
+    except ValueError:
+        common = ""
+    per_worker_counts = {}
+    pooled = []
+    snapshot_docs = {}
+    for p in paths:
+        if not os.path.exists(p):
+            sys.exit(f"no such path: {p}")
+        label = _worker_label(os.path.abspath(p), common)
+        jsonls, snaps = _collect_source_files(p)
+        n = 0
+        for jf in jsonls:
+            recs = _load_jsonl(jf)
+            for r in recs:
+                r.setdefault("worker", label)
+            pooled.extend(recs)
+            n += len(recs)
+        if n:
+            per_worker_counts[label] = per_worker_counts.get(label, 0) + n
+        for sf in snaps:
+            with open(sf) as f:
+                doc = json.load(f)
+            if "histograms" not in doc:    # flight state.json nests it
+                doc = doc.get("metrics", {})
+            snapshot_docs[label] = doc
+    if per_worker_counts:
+        print("fleet sources:")
+        _fmt_table(["worker", "records"],
+                   [[w, n] for w, n in sorted(per_worker_counts.items())])
+    summary = {"kind": "fleet_merged", "sources": len(paths),
+               "workers": per_worker_counts}
+    if pooled:
+        summary["merged"] = summarize_records(pooled, emit_json=False)
+    if snapshot_docs:
+        merged_snap = _fleet.merge_registry_snapshots(
+            list(snapshot_docs.values()))
+        print(f"merged metrics snapshots from {len(snapshot_docs)} "
+              "worker(s):")
+        summary["merged_snapshot"] = summarize_snapshot_doc(
+            merged_snap, emit_json=False)
+    if not pooled and not snapshot_docs:
+        print("no mergeable telemetry under the given paths")
+    print(json.dumps({"summary": summary}))
+    return summary
+
+
 def main():
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="StepTelemetry .jsonl, chrome-trace .json, "
-                                 "or a directory of traces")
+    ap.add_argument("paths", nargs="+",
+                    help="StepTelemetry .jsonl, chrome-trace .json, a "
+                         "directory of traces, or several of these (or a "
+                         "quoted glob) for one merged fleet report")
     args = ap.parse_args()
-    if not os.path.exists(args.path):
-        sys.exit(f"no such path: {args.path}")
-    if os.path.isfile(args.path) and _is_snapshot(args.path):
-        summarize_snapshot(args.path)
-    elif os.path.isfile(args.path) and _is_jsonl(args.path):
-        summarize_steps(args.path)
+    paths = _expand_paths(args.paths)
+    if len(paths) > 1:
+        summarize_fleet(paths)
+        return
+    path = paths[0]
+    if not os.path.exists(path):
+        sys.exit(f"no such path: {path}")
+    if os.path.isfile(path) and _is_snapshot(path):
+        summarize_snapshot(path)
+    elif os.path.isfile(path) and _is_jsonl(path):
+        summarize_steps(path)
     else:
-        summarize_trace(args.path)
+        summarize_trace(path)
 
 
 if __name__ == "__main__":
