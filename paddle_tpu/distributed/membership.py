@@ -34,6 +34,7 @@ step records — instead of hanging.
 """
 from __future__ import annotations
 
+import collections
 import json
 import signal
 import threading
@@ -247,6 +248,19 @@ class ElasticCoordinator:
         self.last_pause_ms: Optional[float] = None
         self.reformations = 0
         self._fault_hook: Optional[Callable[[], None]] = None
+        # bounded tail of SLO alert transitions (note_alert, typically
+        # wired as slo_engine.add_hook(coordinator.note_alert)): a failed
+        # reformation's flight dump then shows what the SLO layer was
+        # screaming about when the world changed
+        self._alert_tail: collections.deque = collections.deque(maxlen=16)
+
+    def note_alert(self, event: dict) -> None:
+        """SLO-engine hook target: remember recent alert transitions for
+        reformation postmortems (observability.slo.SloEngine.add_hook)."""
+        self._alert_tail.append(dict(event))
+
+    def recent_alerts(self) -> List[dict]:
+        return list(self._alert_tail)
 
     @staticmethod
     def _default_topology(n: int) -> Optional[HybridCommunicateGroup]:
@@ -301,6 +315,8 @@ class ElasticCoordinator:
                 except KeyError:
                     pass
             snap[kind + "s"] = recs
+        if self._alert_tail:
+            snap["slo_alerts"] = list(self._alert_tail)
         return snap
 
     # ---- reformation ----

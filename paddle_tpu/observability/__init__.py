@@ -35,7 +35,7 @@ one env var (PADDLE_TPU_TELEMETRY_DIR / PADDLE_TPU_METRICS_PORT /
 PADDLE_TPU_FLIGHT_DIR) or one method call; disabled, no jax import, no I/O,
 no spans, no per-step work beyond a None check.
 """
-from . import exec_introspect, exporter, fleet, flight_recorder, health, metrics  # noqa: F401,E501
+from . import exec_introspect, exporter, fleet, flight_recorder, health, metrics, slo  # noqa: F401,E501
 from .exporter import (  # noqa: F401
     MetricsExporter, ensure_started_from_env, get_exporter, start_exporter,
     stop_exporter,
@@ -53,7 +53,14 @@ from .flops import (  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricRegistry, active_registry,
     default_registry, estimate_percentile, log_buckets,
-    merge_histogram_snapshots,
+    merge_histogram_snapshots, subtract_histogram_snapshots,
+    subtract_registry_snapshots,
+)
+from .slo import (  # noqa: F401
+    AlertManager, BurnWindow, SloEngine, SloSpec, SnapshotRing,
+    active_engine, default_serving_slos, default_slos, default_train_slos,
+    default_windows, install_engine, latency_slo, ratio_slo,
+    uninstall_engine,
 )
 from .step_telemetry import (  # noqa: F401
     InMemorySink, JsonlSink, StepTelemetry,
@@ -69,6 +76,11 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry",
     "default_registry", "active_registry", "estimate_percentile",
     "log_buckets", "merge_histogram_snapshots",
+    "subtract_histogram_snapshots", "subtract_registry_snapshots",
+    "SloSpec", "SloEngine", "SnapshotRing", "AlertManager", "BurnWindow",
+    "ratio_slo", "latency_slo", "default_windows", "default_slos",
+    "default_serving_slos", "default_train_slos", "install_engine",
+    "uninstall_engine", "active_engine", "slo",
     "FleetCollector", "FleetPublisher", "TraceContext", "fleet",
     "install_collector", "uninstall_collector", "active_collector",
     "register_router", "merge_registry_snapshots", "fleet_to_prometheus",

@@ -9,7 +9,13 @@ A daemon-thread ``http.server`` serving the process-global
                              labels) when a fleet.FleetCollector is active
     GET /fleet/metrics.json  collected fleet snapshot as JSON
     GET /fleet/trace         merged cross-worker chrome-trace JSON
-    GET /healthz             liveness probe ("ok")
+    GET /alerts              SLO engine state (specs, burn rates, firing
+                             alerts) when a slo.SloEngine is installed
+    GET /healthz             liveness probe: plain 200 "ok" until an SLO
+                             engine is installed, then a JSON
+                             {status, firing, ...} body that turns
+                             503/degraded while a page-severity alert
+                             fires (each probe ticks the engine)
 
 Enabled via ``PADDLE_TPU_METRICS_PORT`` (the engines call
 `ensure_started_from_env()` at construction — one getenv when unset, so
@@ -55,9 +61,43 @@ class _Handler(BaseHTTPRequestHandler):
         elif path.startswith("/fleet/"):
             self._do_fleet(path)
         elif path == "/healthz":
-            self._send(200, "ok\n", "text/plain")
+            self._do_healthz()
+        elif path == "/alerts":
+            self._do_alerts()
         else:
             self._send(404, "not found\n", "text/plain")
+
+    def _do_healthz(self):
+        from . import slo as _slo
+        eng = _slo.active_engine()
+        if eng is None:
+            # no SLO engine installed: the original plain liveness
+            # contract (200 "ok") — probes written against it keep working
+            self._send(200, "ok\n", "text/plain")
+            return
+        try:
+            st = eng.poll()  # scrape-driven evaluation, like /fleet/*
+        except Exception as exc:
+            self._send(503, f"slo evaluation failed: {exc}\n", "text/plain")
+            return
+        code = 503 if st["status"] == "degraded" else 200
+        self._send(code, json.dumps(st, sort_keys=True, default=str),
+                   "application/json")
+
+    def _do_alerts(self):
+        from . import slo as _slo
+        eng = _slo.active_engine()
+        if eng is None:
+            self._send(404, "no slo engine installed\n", "text/plain")
+            return
+        try:
+            eng.tick()
+            doc = eng.doc()
+        except Exception as exc:
+            self._send(503, f"slo evaluation failed: {exc}\n", "text/plain")
+            return
+        self._send(200, json.dumps(doc, sort_keys=True, default=str),
+                   "application/json")
 
     def _do_fleet(self, path):
         from . import fleet as _fleet

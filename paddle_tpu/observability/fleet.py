@@ -327,7 +327,16 @@ class FleetCollector:
         self.evictions = 0
         self.last: Optional[dict] = None
         self._docs: Dict[str, dict] = {}
+        self._slo = None  # optional slo.SloEngine judging merged snapshots
         self._lock = threading.Lock()
+
+    def attach_slo(self, slo_engine) -> None:
+        """Evaluate fleet-level SLOs on every collect(): the engine's ring
+        is fed the *merged* snapshot, so burn rates and alerts reflect the
+        whole fleet (works with no local registry — merged counts are the
+        evaluation input, gauges are skipped when metrics are dark). The
+        collected document gains a ``slo`` section."""
+        self._slo = slo_engine
 
     def generation(self) -> int:
         return current_generation(self.store)
@@ -372,6 +381,14 @@ class FleetCollector:
             "per_worker": {wid: d.get("snapshot") or {}
                            for wid, d in sorted(docs.items())},
         }
+        if self._slo is not None:
+            try:
+                events = self._slo.tick(now=now, snapshot=merged)
+                result["slo"] = self._slo.status()
+                if events:
+                    result["slo"]["events"] = events
+            except Exception as exc:  # judgement must not break federation
+                result["slo"] = {"status": "error", "error": repr(exc)}
         with self._lock:
             self.last = result
             self._docs = docs

@@ -274,6 +274,109 @@ def merge_histogram_snapshots(snaps: Sequence[Optional[dict]]
     return merged
 
 
+def subtract_histogram_snapshots(curr: Optional[dict], prev: Optional[dict]
+                                 ) -> Optional[dict]:
+    """Exact window delta of two histogram snapshots of ONE histogram.
+
+    The dual of :func:`merge_histogram_snapshots`: given a later (``curr``)
+    and an earlier (``prev``) snapshot of the same monotonically-observing
+    histogram, returns the snapshot the histogram would hold had it only
+    observed the samples between the two — bucket counts, ``count`` and
+    ``sum`` subtract exactly (boundary mismatch raises, and so does a
+    bucket going backwards: that means ``prev`` is not an earlier view of
+    ``curr``). The window ``min``/``max`` are not recoverable from
+    cumulative state, so they are re-derived from the delta buckets
+    (first/last non-empty bucket bounds, tightened by the lifetime
+    min/max) — which keeps p50/p90/p99 recomputed from the delta within
+    one bucket width of a pooled recompute over the window's samples, the
+    same guarantee the merge direction gives. This is the primitive the
+    SLO snapshot ring uses for sliding-window percentiles; ``prev=None``
+    treats the window as starting from empty.
+    """
+    if curr is None:
+        return None
+    if prev is None:
+        prev = {"boundaries": curr["boundaries"],
+                "counts": [0] * len(curr["counts"]),
+                "count": 0, "sum": 0.0, "min": None, "max": None}
+    if list(curr["boundaries"]) != list(prev["boundaries"]):
+        raise ValueError(
+            "cannot subtract histogram snapshots with different boundaries")
+    counts = [int(a) - int(b) for a, b in zip(curr["counts"], prev["counts"])]
+    if any(c < 0 for c in counts) or curr["count"] < prev["count"]:
+        raise ValueError(
+            "histogram delta went backwards: prev is not an earlier "
+            "snapshot of curr (registry reset mid-window?)")
+    boundaries = list(curr["boundaries"])
+    delta = {
+        "kind": "histogram",
+        "boundaries": boundaries,
+        "counts": counts,
+        "count": int(curr["count"]) - int(prev["count"]),
+        "sum": float(curr["sum"]) - float(prev["sum"]),
+        "min": None,
+        "max": None,
+    }
+    if delta["count"]:
+        nz = [i for i, c in enumerate(counts) if c]
+        lo_i, hi_i = nz[0], nz[-1]
+        # window min lies inside bucket lo_i: bound it by the bucket's
+        # lower edge (or the lifetime min for the first bucket), window
+        # max by the bucket's upper edge (lifetime max for overflow)
+        delta["min"] = boundaries[lo_i - 1] if lo_i > 0 else curr["min"]
+        delta["max"] = (boundaries[hi_i] if hi_i < len(boundaries)
+                        else curr["max"])
+        for q in (0.5, 0.9, 0.99):
+            delta["p%g" % (q * 100)] = estimate_percentile(delta, q)
+    else:
+        for q in (0.5, 0.9, 0.99):
+            delta["p%g" % (q * 100)] = None
+    return delta
+
+
+def subtract_counter_values(curr: float, prev: float) -> float:
+    """Window delta of a monotonic counter; raises if it went backwards."""
+    d = float(curr) - float(prev)
+    if d < 0:
+        raise ValueError(
+            f"counter delta went backwards ({curr} < {prev}): prev is not "
+            "an earlier snapshot of curr")
+    return d
+
+
+def subtract_registry_snapshots(curr: dict, prev: Optional[dict]) -> dict:
+    """Window delta of two full ``MetricRegistry.snapshot()`` documents.
+
+    Counters, monitor values and histogram buckets subtract exactly
+    (:func:`subtract_counter_values` / :func:`subtract_histogram_snapshots`
+    semantics); gauges are level- not event-valued, so the delta carries
+    the *current* gauge reading. A counter/histogram present only in
+    ``curr`` deltas from zero (it was created inside the window); one that
+    went backwards raises. ``prev=None`` returns the full current view.
+    """
+    prev = prev or {}
+    out: dict = {"counters": {}, "gauges": dict(curr.get("gauges", {})),
+                 "histograms": {}}
+    pc = prev.get("counters", {})
+    for name, v in curr.get("counters", {}).items():
+        out["counters"][name] = subtract_counter_values(v, pc.get(name, 0.0))
+    ph = prev.get("histograms", {})
+    for name, h in curr.get("histograms", {}).items():
+        out["histograms"][name] = subtract_histogram_snapshots(
+            h, ph.get(name))
+    if "monitor" in curr:
+        pm = prev.get("monitor", {})
+        out["monitor"] = {}
+        for name, rep in curr["monitor"].items():
+            pv = float(pm.get(name, {}).get("value", 0.0))
+            out["monitor"][name] = {
+                "value": subtract_counter_values(
+                    float(rep.get("value", 0.0)), pv),
+                "peak": float(rep.get("peak", 0.0)),
+            }
+    return out
+
+
 class MetricRegistry:
     """Thread-safe name -> metric map with get-or-create accessors."""
 

@@ -92,6 +92,11 @@ class Request:
         self.first_token_ts: Optional[float] = None
         self.done_ts: Optional[float] = None
         self.finish_reason: Optional[str] = None  # "eos" | "length"
+        # terminal disposition, set when the request leaves the engine:
+        # "ok" | "eos" | "length" (normal), "drained" (drain timeout cut
+        # it short), "error" (prefill/decode raised) — the error-rate
+        # SLI's numerator/denominator
+        self.outcome: Optional[str] = None
 
     @property
     def done(self) -> bool:
@@ -206,6 +211,10 @@ class ServingEngine:
         self._draining = False
         self._replica_agent = None
         self._prev_sigterm = None
+        # set by ReplicaRouter (or the owner): when non-None, _finish
+        # additionally publishes serve.replica.<name>.* metrics — the
+        # per-replica namespace the SLO self-healing hooks key on
+        self.replica_name: Optional[str] = None
 
         self.refresh_params()
 
@@ -397,6 +406,19 @@ class ServingEngine:
         done0 = len(self._completed)
         while self._active.any():
             if time.perf_counter() - t0 > tmo:
+                # timeout cut the drain short: whatever is still decoding
+                # terminates with outcome="drained" (counted, recorded,
+                # but not a completion) and its slot is reclaimed
+                import numpy as np
+
+                for slot in np.nonzero(self._active)[0]:
+                    req = self._slot_req[slot]
+                    self._active[slot] = False
+                    self._slot_req[slot] = None
+                    if self.kv_layout == "paged":
+                        self._release_slot(slot)
+                    if req is not None and req.done_ts is None:
+                        self._finish(req, outcome="drained")
                 break
             self._decode_step()
         drain_ms = (time.perf_counter() - t0) * 1000.0
@@ -737,6 +759,7 @@ class ServingEngine:
                     fr.dump("serve_prefill_exception",
                             {"request": req.id, "bucket": bucket,
                              "error": repr(e)})
+                self._finish(req, outcome="error")
                 raise
             req.first_token_ts = time.perf_counter()
             tr = _obs_tracer.get_tracer()
@@ -920,6 +943,7 @@ class ServingEngine:
                 fr.dump("serve_prefill_exception",
                         {"request": req.id, "bucket": tbucket,
                          "base": base, "error": repr(e)})
+            self._finish(req, outcome="error")
             raise
         self._pool_state = new_state
         req.first_token_ts = time.perf_counter()
@@ -1181,6 +1205,13 @@ class ServingEngine:
                 fr.dump("serve_decode_exception",
                         {"step": self._steps, "family": family,
                          "error": repr(e)})
+            # a failed decode dispatch takes every in-flight request with
+            # it: record each as a terminal error before re-raising so the
+            # availability SLI sees the blast radius
+            for slot in np.nonzero(self._active)[0]:
+                req = self._slot_req[slot]
+                if req is not None and req.done_ts is None:
+                    self._finish(req, outcome="error")
             raise
         t1 = time.perf_counter()
         tr = _obs_tracer.get_tracer()
@@ -1251,12 +1282,19 @@ class ServingEngine:
 
             monitor.stat("serving.tokens").increase(n)
 
-    def _finish(self, req: Request, now: Optional[float] = None) -> None:
+    def _finish(self, req: Request, now: Optional[float] = None,
+                outcome: Optional[str] = None) -> None:
         from ..core import monitor
 
         req.done_ts = now if now is not None else time.perf_counter()
-        self._completed.append(req)
+        # terminal disposition: normal completions inherit finish_reason
+        # ("eos"/"length", "ok" as the fallback); abnormal exits pass
+        # outcome="error"/"drained" explicitly and stay out of _completed
+        req.outcome = outcome or req.outcome or req.finish_reason or "ok"
+        if req.outcome not in ("error", "drained"):
+            self._completed.append(req)
         monitor.stat("serving.requests").increase()
+        monitor.stat("serving.outcome." + req.outcome).increase()
         tr = _obs_tracer.get_tracer()
         if tr.enabled:
             # the request's full span lifecycle: enqueue (instant at submit)
@@ -1271,10 +1309,20 @@ class ServingEngine:
             tr.instant("serve.retire", **req.trace_args(slot=req.slot))
         mreg = _obs_metrics.active_registry()
         if mreg is not None:
+            mreg.counter("serve.requests").inc()
+            if req.outcome == "error":
+                mreg.counter("serve.errors").inc()
             if req.ttft_s is not None:
                 mreg.histogram("serve.ttft_ms").observe(req.ttft_s * 1e3)
             if req.tpot_s is not None:
                 mreg.histogram("serve.tpot_ms").observe(req.tpot_s * 1e3)
+            if self.replica_name:
+                pfx = f"serve.replica.{self.replica_name}."
+                mreg.counter(pfx + "requests").inc()
+                if req.outcome == "error":
+                    mreg.counter(pfx + "errors").inc()
+                if req.ttft_s is not None:
+                    mreg.histogram(pfx + "ttft_ms").observe(req.ttft_s * 1e3)
         fr = _obs_flight.get()
         if self.sink is not None or fr is not None:
             wall = max(req.done_ts - req.submit_ts, 1e-9)
@@ -1285,7 +1333,9 @@ class ServingEngine:
                 "bucket": req.bucket, "slot": req.slot,
                 "new_tokens": len(req.tokens),
                 "finish_reason": req.finish_reason,
-                "ttft_s": round(req.ttft_s, 6),
+                "outcome": req.outcome,
+                "ttft_s": (round(req.ttft_s, 6)
+                           if req.ttft_s is not None else None),
                 "queue_wait_s": (round(req.queue_wait_s, 6)
                                  if req.queue_wait_s is not None else None),
                 "tpot_s": (round(req.tpot_s, 6)

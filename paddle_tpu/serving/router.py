@@ -73,6 +73,13 @@ class ReplicaRouter:
         self.w_prefix = float(w_prefix)
         self.routed: Dict[str, int] = {name: 0 for name in self.replicas}
         self.prefix_routed = 0
+        # SLO self-healing (observability.slo): firing per-replica alerts
+        # add a score penalty here so traffic flows away from the sick
+        # replica; resolution removes it. See attach_slo().
+        self._shed: Dict[str, float] = {}
+        for name, eng in self.replicas.items():
+            if eng.replica_name is None:
+                eng.replica_name = name
         # bounded tail of placement decisions: flight dumps embed it via
         # fleet.flight_context() so a crash shows where traffic was going
         self._placements: collections.deque = collections.deque(maxlen=64)
@@ -96,7 +103,8 @@ class ReplicaRouter:
             "prefix_tokens": matched,
             "score": (self.w_queue * qd / eng.slot_count
                       + self.w_occupancy * occ
-                      - self.w_prefix * frac),
+                      - self.w_prefix * frac
+                      + self._shed.get(name, 0.0)),
         }
 
     def submit(self, prompt_ids, trace_ctx=None, **kwargs) -> Request:
@@ -172,6 +180,53 @@ class ReplicaRouter:
         flight-recorder state.json via fleet.flight_context())."""
         return list(self._placements)
 
+    # ------------------------------------------------------ SLO shedding
+    def shed(self, name: str, penalty: float = 10.0) -> None:
+        """Deprioritize one replica: add a flat score penalty so every
+        other live replica wins placement while it recovers. Idempotent;
+        the replica still serves (it is not draining) if every other
+        replica is worse by more than the penalty."""
+        if name not in self.replicas:
+            raise KeyError(f"unknown replica {name!r}")
+        self._shed[name] = float(penalty)
+        mreg = _obs_metrics.active_registry()
+        if mreg is not None:
+            mreg.counter("route.sheds").inc()
+            mreg.gauge("route.shedding").set(float(len(self._shed)))
+
+    def unshed(self, name: str) -> None:
+        if self._shed.pop(name, None) is not None:
+            mreg = _obs_metrics.active_registry()
+            if mreg is not None:
+                mreg.gauge("route.shedding").set(float(len(self._shed)))
+
+    def shedding(self) -> List[str]:
+        return sorted(self._shed)
+
+    def attach_slo(self, slo_engine, penalty: float = 10.0,
+                   drain: bool = False) -> None:
+        """Close the loop from per-replica SLOs to placement: register a
+        hook on ``slo_engine`` (observability.slo.SloEngine) that sheds a
+        replica while an alert labeled ``{"replica": <name>}`` is firing
+        and unsheds it on resolve. With ``drain=True``, a *page*-severity
+        fire also begins draining the replica (its queued work re-places
+        on healthy replicas) — only while at least one other live replica
+        remains, so healing never closes the last admission target."""
+        def _hook(ev: Dict) -> None:
+            name = (ev.get("labels") or {}).get("replica")
+            if name is None or name not in self.replicas:
+                return
+            if ev.get("state") == "firing":
+                self.shed(name, penalty)
+                if (drain and ev.get("severity") == "page"
+                        and not self.replicas[name]._draining
+                        and len(self.live_replicas()) > 1):
+                    self.begin_drain(name, reason="slo")
+            elif ev.get("state") == "resolved":
+                self.unshed(name)
+
+        slo_engine.add_hook(_hook)
+
     # -------------------------------------------------------------- drive
     def step(self) -> int:
         """One engine step on every replica (draining ones included — their
@@ -225,4 +280,5 @@ class ReplicaRouter:
                          for n, e in self.replicas.items()},
             "prefix_routed": self.prefix_routed,
             "total_routed": sum(self.routed.values()),
+            "shedding": sorted(self._shed),
         }

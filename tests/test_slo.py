@@ -1,0 +1,561 @@
+"""SLO engine (ISSUE 15 tentpole): declarative objectives, multi-window
+burn-rate alerting, and self-healing hooks.
+
+Pinned contracts:
+- snapshot subtraction: counter/histogram window deltas are exact in
+  count/sum/buckets, window percentiles within one bucket width of a
+  pooled numpy recompute, and going backwards raises;
+- burn-rate math against closed-form values (bad-fraction / budget) for
+  both SLI forms, latency thresholds snapping down to bucket granularity;
+- multi-window evaluation: an alert needs burn >= factor in BOTH the long
+  and the short window; the severity is the worst firing pair's;
+- AlertManager: pending -> firing (after for_s) -> resolved with
+  duration, dedup while firing, severity escalation, silent pending drop;
+- dark by default: with no active registry, tick() is a no-op — no ring
+  growth, no gauges, no alerts file;
+- exporter: /healthz keeps the legacy plain-200 contract with no engine,
+  flips 200 -> 503 -> 200 around a page-severity fire; /alerts 404s with
+  no engine and serves the full doc with one;
+- self-healing: ReplicaRouter.attach_slo sheds the firing replica's
+  placements and unsheds on resolve; FleetCollector evaluates attached
+  SLOs over the merged fleet snapshot;
+- serving outcome: every finished request carries a terminal outcome
+  threaded through handles, sink records and the serving counters.
+"""
+import bisect
+import collections
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.store import FileStore
+from paddle_tpu.models import GPTForPretraining, gpt_tiny
+from paddle_tpu.observability import exporter, fleet, metrics, slo
+from paddle_tpu.serving.engine import ServingEngine
+from paddle_tpu.serving.router import ReplicaRouter
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+approx = pytest.approx
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    """Metrics/exporter/SLO engine are process-globals the shared conftest
+    doesn't know about: start every test dark, leave it dark."""
+    exporter.stop_exporter()
+    metrics.reset()
+    slo.uninstall_engine()
+    yield
+    exporter.stop_exporter()
+    metrics.reset()
+    slo.uninstall_engine()
+
+
+def _reg_snap(counters=None, histograms=None):
+    return {"counters": dict(counters or {}), "gauges": {},
+            "histograms": dict(histograms or {})}
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode("utf-8")
+
+
+# ------------------------------------------------- snapshot subtraction
+
+def test_subtract_histogram_exact_vs_pooled_recompute():
+    reg = metrics.enable()
+    h = reg.histogram("lat")
+    rnd = np.random.RandomState(3)
+    first = rnd.lognormal(1.0, 0.5, 300).tolist()
+    for v in first:
+        h.observe(v)
+    prev = reg.snapshot()["histograms"]["lat"]
+    second = rnd.lognormal(2.0, 0.7, 500).tolist()
+    for v in second:
+        h.observe(v)
+    curr = reg.snapshot()["histograms"]["lat"]
+
+    d = metrics.subtract_histogram_snapshots(curr, prev)
+    assert d["count"] == 500
+    assert sum(d["counts"]) == 500
+    assert d["sum"] == approx(sum(second))
+    # window min/max bracket the true window extremes
+    assert d["min"] <= min(second) and d["max"] >= max(second)
+    # percentiles within one bucket width of the pooled numpy recompute
+    bs = d["boundaries"]
+    for q in (50, 90, 99):
+        exact = float(np.percentile(second, q))
+        i = bisect.bisect_left(bs, exact)
+        lo = bs[i - 1] if i > 0 else d["min"]
+        hi = bs[i] if i < len(bs) else d["max"]
+        assert abs(d["p%g" % q] - exact) <= (hi - lo) + 1e-9
+
+    # prev=None: window-from-empty equals the full current view
+    full = metrics.subtract_histogram_snapshots(curr, None)
+    assert full["count"] == 800 and full["counts"] == list(curr["counts"])
+
+
+def test_subtract_histogram_rejects_bad_pairs():
+    reg = metrics.enable()
+    h = reg.histogram("lat")
+    h.observe(3.0)
+    prev = reg.snapshot()["histograms"]["lat"]
+    h.observe(5.0)
+    curr = reg.snapshot()["histograms"]["lat"]
+    with pytest.raises(ValueError, match="went backwards"):
+        metrics.subtract_histogram_snapshots(prev, curr)
+    mangled = dict(prev)
+    mangled["boundaries"] = [1.0, 2.0]
+    mangled["counts"] = [0, 0]
+    with pytest.raises(ValueError, match="boundaries"):
+        metrics.subtract_histogram_snapshots(curr, mangled)
+    assert metrics.subtract_histogram_snapshots(None, prev) is None
+
+
+def test_subtract_registry_snapshots_semantics():
+    curr = {"counters": {"a": 10.0, "born": 3.0}, "gauges": {"g": 7.0},
+            "histograms": {},
+            "monitor": {"m": {"value": 5.0, "peak": 9.0}}}
+    prev = {"counters": {"a": 4.0}, "gauges": {"g": 2.0}, "histograms": {},
+            "monitor": {"m": {"value": 2.0, "peak": 4.0}}}
+    d = metrics.subtract_registry_snapshots(curr, prev)
+    assert d["counters"] == {"a": 6.0, "born": 3.0}
+    assert d["gauges"] == {"g": 7.0}          # level, not event, valued
+    assert d["monitor"]["m"] == {"value": 3.0, "peak": 9.0}
+    full = metrics.subtract_registry_snapshots(curr, None)
+    assert full["counters"] == curr["counters"]
+    with pytest.raises(ValueError, match="backwards"):
+        metrics.subtract_registry_snapshots(prev, curr)
+
+
+# ------------------------------------------------------- burn-rate math
+
+def test_ratio_burn_rate_closed_form():
+    spec = slo.ratio_slo("avail", "err", "req", 0.999)
+    assert spec.budget == approx(0.001)
+    delta = _reg_snap(counters={"err": 3.0, "req": 1000.0})
+    # burn = (3/1000) / 0.001 = 3.0
+    assert slo.burn_rate(spec, delta) == approx(3.0)
+    # idle window spends nothing
+    assert slo.burn_rate(spec, _reg_snap()) == 0.0
+    # all-bad window burns the full 1/budget
+    worst = _reg_snap(counters={"err": 10.0, "req": 10.0})
+    assert slo.burn_rate(spec, worst) == approx(1000.0)
+
+
+def test_latency_burn_rate_threshold_snaps_to_bucket():
+    h = {"boundaries": [1.0, 2.0, 4.0, 8.0], "counts": [5, 3, 2, 0],
+         "count": 10, "sum": 20.0, "min": 0.5, "max": 3.9}
+    spec = slo.latency_slo("lat", "m", 2.0, 0.9)
+    delta = _reg_snap(histograms={"m": h})
+    # threshold on a boundary: buckets <= 2.0 are good -> 8 good, 2 bad
+    assert slo.burn_rate(spec, delta) == approx((2 / 10) / 0.1)
+    # threshold inside (2, 4]: snaps DOWN, the straddling bucket is bad
+    spec3 = slo.latency_slo("lat", "m", 3.0, 0.9)
+    assert slo.burn_rate(spec3, delta) == approx((2 / 10) / 0.1)
+    # threshold at the top boundary: everything is good
+    spec8 = slo.latency_slo("lat", "m", 8.0, 0.9)
+    assert slo.burn_rate(spec8, delta) == 0.0
+    # missing metric / empty histogram: no traffic, no burn
+    assert slo.burn_rate(spec, _reg_snap()) == 0.0
+
+
+def test_events_resolution_order_counters_monitor_histogram():
+    snap = {"counters": {"x": 7.0},
+            "monitor": {"y": {"value": 3.0, "peak": 5.0}},
+            "histograms": {"z": {"count": 11}}}
+    assert slo._events(snap, "x") == 7.0
+    assert slo._events(snap, "y") == 3.0
+    assert slo._events(snap, "z") == 11.0
+    assert slo._events(snap, "absent") == 0.0
+
+
+# -------------------------------------------------------- snapshot ring
+
+def test_snapshot_ring_window_semantics():
+    ring = slo.SnapshotRing(retention_s=10.0)
+    assert ring.delta(5.0) is None and ring.at(0.0) is None
+    ring.push(0.0, _reg_snap(counters={"c": 5.0}))
+    # single entry: the window predates the ring -> delta from empty
+    d = ring.delta(5.0, now=0.0)
+    assert d["counters"]["c"] == 5.0 and d["_window_s"] == 0.0
+    ring.push(4.0, _reg_snap(counters={"c": 9.0}))
+    d = ring.delta(2.0, now=4.0)  # baseline at(2.0) -> the t=0 entry
+    assert d["counters"]["c"] == 4.0 and d["_window_s"] == 4.0
+    # window longer than history: oldest entry serves as baseline
+    d = ring.delta(100.0, now=4.0)
+    assert d["counters"]["c"] == 4.0
+    # retention trim keeps at least two entries, drops expired ones
+    ring.push(20.0, _reg_snap(counters={"c": 9.0}))
+    assert len(ring) == 2 and ring.at(1.0) is None
+
+
+def test_snapshot_ring_max_entries():
+    ring = slo.SnapshotRing(retention_s=1e9, max_entries=3)
+    for i in range(6):
+        ring.push(float(i), _reg_snap(counters={"c": float(i)}))
+    assert len(ring) == 3
+    assert ring.latest()[0] == 5.0
+
+
+# ------------------------------------------------ multi-window evaluate
+
+def test_evaluate_requires_both_windows_and_ranks_severity():
+    spec = slo.ratio_slo(
+        "avail", "err", "req", 0.99,
+        windows=[slo.BurnWindow(50.0, 2.0, 5.0, "page"),
+                 slo.BurnWindow(100.0, 2.0, 0.5, "warn")])
+    ring = slo.SnapshotRing(retention_s=200.0)
+    ring.push(0.0, _reg_snap(counters={"err": 0.0, "req": 0.0}))
+    ring.push(98.0, _reg_snap(counters={"err": 0.0, "req": 900.0}))
+    ring.push(100.0, _reg_snap(counters={"err": 10.0, "req": 1000.0}))
+    res = slo.evaluate(spec, ring, now=100.0)
+    # long-50 burn: 10 bad / 1000 total / 0.01 budget = 1.0
+    # short-2 burn: 10 bad / 100 total / 0.01 budget = 10.0
+    fast, slow = res["windows"]
+    assert fast["burn_long"] == approx(1.0)
+    assert fast["burn_short"] == approx(10.0)
+    # the page pair does NOT fire: long burn 1.0 < factor 5 even though
+    # the short window is way over — BOTH windows must exceed
+    assert not fast["firing"]
+    assert slow["firing"]  # 1.0 >= 0.5 and 10.0 >= 0.5
+    assert res["breach"] and res["severity"] == "warn"
+    assert res["burn"] == approx(1.0)  # the fast pair's long burn
+    assert res["budget_remaining"] == approx(0.0)
+
+
+def test_burn_window_validation():
+    with pytest.raises(ValueError, match="severity"):
+        slo.BurnWindow(10.0, 1.0, 2.0, "sev1")
+    with pytest.raises(ValueError, match="short_s"):
+        slo.BurnWindow(1.0, 10.0, 2.0)
+    w = slo.default_windows(scale=1.0 / 3600.0)
+    assert w[0].long_s == approx(1.0) and w[0].short_s == approx(300 / 3600)
+    assert (w[0].factor, w[0].severity) == (14.4, "page")
+    assert (w[1].factor, w[1].severity) == (1.0, "warn")
+
+
+# --------------------------------------------------- alert state machine
+
+def _result(breach, burn=5.0, sev="page", name="s"):
+    return {"slo": name, "labels": {}, "burn": burn,
+            "budget_remaining": 0.5, "breach": breach,
+            "severity": sev if breach else None, "windows": []}
+
+
+def test_alert_manager_pending_firing_resolved():
+    am = slo.AlertManager(for_s=1.0)
+    ev = am.update([_result(True)], now=0.0)
+    assert [e["state"] for e in ev] == ["pending"]
+    assert am.update([_result(True, burn=9.0)], now=0.5) == []  # not yet
+    ev = am.update([_result(True)], now=1.5)
+    assert [e["state"] for e in ev] == ["firing"]
+    assert am.update([_result(True)], now=2.0) == []  # dedup while firing
+    assert am.firing()[0]["peak_burn"] == approx(9.0)
+    ev = am.update([_result(False)], now=3.0)
+    assert ev[0]["state"] == "resolved"
+    assert ev[0]["duration_s"] == approx(1.5)
+    assert am.firing() == [] and am.resolved_count == 1
+
+
+def test_alert_manager_for_s_zero_and_silent_pending_drop():
+    am = slo.AlertManager(for_s=0.0)
+    ev = am.update([_result(True)], now=0.0)
+    assert [e["state"] for e in ev] == ["pending", "firing"]
+    am2 = slo.AlertManager(for_s=10.0)
+    am2.update([_result(True)], now=0.0)
+    # a pending alert that clears before for_s elapses drops silently
+    assert am2.update([_result(False)], now=1.0) == []
+    assert am2.active == {}
+
+
+def test_alert_manager_severity_escalation():
+    am = slo.AlertManager(for_s=0.0)
+    am.update([_result(True, sev="warn")], now=0.0)
+    assert am.firing()[0]["severity"] == "warn"
+    assert am.update([_result(True, sev="page")], now=1.0) == []
+    assert am.firing()[0]["severity"] == "page"
+
+
+# ------------------------------------------------------------ SloEngine
+
+def test_engine_dark_by_default(tmp_path):
+    alerts = tmp_path / "alerts.jsonl"
+    eng = slo.SloEngine(specs=slo.default_slos(),
+                        alerts_path=str(alerts))
+    assert metrics.active_registry() is None
+    assert eng.tick() == []
+    assert len(eng.ring) == 0 and eng.ticks == 0
+    assert not alerts.exists()
+    assert eng.status()["status"] == "ok"
+
+
+def test_engine_tick_fires_gauges_jsonl_and_hooks(tmp_path):
+    reg = metrics.enable()
+    alerts = tmp_path / "alerts.jsonl"
+    spec = slo.ratio_slo("avail", "err", "req", 0.999,
+                         windows=[slo.BurnWindow(60.0, 10.0, 1.0, "page")])
+    eng = slo.SloEngine(specs=[spec], alerts_path=str(alerts))
+    seen = []
+    eng.add_hook(lambda ev: (_ for _ in ()).throw(RuntimeError("boom")))
+    eng.add_hook(seen.append)  # a broken hook must not starve the next
+
+    reg.counter("req").inc(1000)
+    assert eng.tick(now=0.0) == []
+    reg.counter("err").inc(10)
+    reg.counter("req").inc(1000)
+    ev = eng.tick(now=1.0)
+    assert [e["state"] for e in ev] == ["pending", "firing"]
+    # window delta: 10 bad / 1000 total -> burn (0.01)/0.001 = 10
+    assert ev[0]["burn"] == approx(10.0)
+    snap = reg.snapshot()
+    assert snap["gauges"]["slo.avail.burn_rate"] == approx(10.0)
+    assert snap["gauges"]["slo.avail.firing"] == 2.0  # page rank
+    assert snap["gauges"]["slo.avail.error_budget_remaining"] == 0.0
+    assert eng.status()["status"] == "degraded"
+    assert [e["state"] for e in seen] == ["pending", "firing"]
+
+    # no new traffic: the window drains empty and the alert resolves
+    ev = eng.tick(now=100.0)
+    assert [e["state"] for e in ev] == ["resolved"]
+    assert ev[0]["duration_s"] == approx(99.0)
+    assert eng.status()["status"] == "ok"
+    assert reg.snapshot()["gauges"]["slo.avail.firing"] == 0.0
+    lines = [json.loads(ln) for ln in alerts.read_text().splitlines()]
+    assert [ln["state"] for ln in lines] == ["pending", "firing",
+                                             "resolved"]
+    doc = eng.doc()
+    assert doc["specs"][0]["name"] == "avail"
+    assert doc["results"][0]["slo"] == "avail"
+
+
+def test_install_uninstall_engine_globals():
+    assert slo.active_engine() is None
+    eng = slo.install_engine(specs=[slo.ratio_slo("a", "e", "t", 0.9)])
+    assert slo.active_engine() is eng
+    slo.uninstall_engine()
+    assert slo.active_engine() is None
+
+
+def test_default_packs_shapes():
+    serving = slo.default_serving_slos()
+    assert [s.name for s in serving] == [
+        "serve.availability", "serve.ttft", "serve.tpot",
+        "serve.queue_wait"]
+    per = slo.default_serving_slos(replica="r0")
+    assert [s.name for s in per] == ["serve.availability.r0",
+                                     "serve.ttft.r0"]
+    assert per[0].bad == "serve.replica.r0.errors"
+    assert per[1].metric == "serve.replica.r0.ttft_ms"
+    assert all(s.labels == {"replica": "r0"} for s in per)
+    train = slo.default_train_slos()
+    assert [s.name for s in train] == ["train.step_time",
+                                       "train.finite_loss"]
+    assert len(slo.default_slos()) == 6
+
+
+# ----------------------------------------------------- exporter routes
+
+def test_healthz_flips_and_alerts_route(tmp_path):
+    ex = exporter.start_exporter(0)
+    # legacy contract with no engine installed
+    code, body = _get(ex.url + "/healthz")
+    assert (code, body) == (200, "ok\n")
+    code, _ = _get(ex.url + "/alerts")
+    assert code == 404
+
+    reg = metrics.default_registry()
+    spec = slo.ratio_slo("avail", "err", "req", 0.99,
+                         windows=[slo.BurnWindow(0.5, 0.1, 1.0, "page")])
+    slo.install_engine(specs=[spec],
+                       alerts_path=str(tmp_path / "alerts.jsonl"))
+    reg.counter("req").inc(100)
+    code, body = _get(ex.url + "/healthz")
+    assert code == 200 and json.loads(body)["status"] == "ok"
+
+    reg.counter("err").inc(5)
+    code, body = _get(ex.url + "/healthz")
+    assert code == 503 and json.loads(body)["status"] == "degraded"
+    assert json.loads(body)["firing"][0]["slo"] == "avail"
+    code, body = _get(ex.url + "/alerts")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["status"] == "degraded" and doc["specs"]
+
+    time.sleep(0.6)  # both windows slide past the burst
+    code, body = _get(ex.url + "/healthz")
+    assert code == 200 and json.loads(body)["status"] == "ok"
+
+
+# ------------------------------------------------- router self-healing
+
+class _FakeEngine:
+    """The ServingEngine surface ReplicaRouter actually touches."""
+
+    def __init__(self):
+        self.replica_name = None
+        self._draining = False
+        self._queue = collections.deque()
+        self._active = np.zeros(1, bool)
+        self._lock = threading.Lock()
+        self._completed = []
+        self.slot_count = 1
+        self.submitted = []
+
+    def queue_depth(self):
+        return len(self._queue)
+
+    def occupancy(self):
+        return 0.0
+
+    def prefix_match_len(self, prompt_ids):
+        return 0
+
+    def submit(self, prompt_ids, trace_ctx=None, **kw):
+        self.submitted.append(list(prompt_ids))
+        return types.SimpleNamespace(id=len(self.submitted))
+
+    def step(self):
+        return 0
+
+    def begin_drain(self, reason="drain"):
+        self._draining = True
+
+
+def test_router_shed_unshed_moves_placement():
+    a, b = _FakeEngine(), _FakeEngine()
+    router = ReplicaRouter({"a": a, "b": b})
+    assert (a.replica_name, b.replica_name) == ("a", "b")
+    with pytest.raises(KeyError):
+        router.shed("nope")
+    router.shed("a", penalty=50.0)
+    assert router.shedding() == ["a"]
+    assert router.stats()["shedding"] == ["a"]
+    for _ in range(4):
+        router.submit([1, 2, 3])
+    assert router.routed == {"a": 0, "b": 4}
+    router.unshed("a")
+    router.unshed("a")  # idempotent
+    assert router.shedding() == []
+
+
+def test_router_attach_slo_sheds_on_fire_unsheds_on_resolve():
+    a, b = _FakeEngine(), _FakeEngine()
+    router = ReplicaRouter({"a": a, "b": b})
+    spec = slo.ratio_slo("avail.b", "r.b.err", "r.b.req", 0.99,
+                         windows=[slo.BurnWindow(10.0, 10.0, 2.0, "page")],
+                         labels={"replica": "b"})
+    eng = slo.SloEngine(specs=[spec])
+    router.attach_slo(eng, penalty=9.0, drain=True)
+    eng.tick(now=0.0,
+             snapshot=_reg_snap(counters={"r.b.err": 0.0, "r.b.req": 0.0}))
+    bad = _reg_snap(counters={"r.b.err": 5.0, "r.b.req": 10.0})
+    ev = eng.tick(now=1.0, snapshot=bad)
+    assert [e["state"] for e in ev] == ["pending", "firing"]
+    assert router.shedding() == ["b"]
+    assert b._draining  # page fire + drain=True + another live replica
+    ev = eng.tick(now=20.0, snapshot=bad)  # window slid past the burst
+    assert [e["state"] for e in ev] == ["resolved"]
+    assert router.shedding() == []
+
+
+# --------------------------------------------- fleet-level evaluation
+
+def test_fleet_collector_evaluates_slos_over_merged(tmp_path):
+    store = FileStore(str(tmp_path), timeout=2.0)
+    reg = metrics.enable()
+    reg.counter("serve.requests").inc(100)
+    reg.counter("serve.errors").inc(50)
+    fleet.FleetPublisher(store, "w0", interval_s=0.1).publish_once()
+    coll = fleet.FleetCollector(store)
+    eng = slo.SloEngine(specs=[slo.ratio_slo(
+        "fleet.avail", "serve.errors", "serve.requests", 0.99,
+        windows=[slo.BurnWindow(5.0, 1.0, 1.0, "page")])])
+    coll.attach_slo(eng)
+    snap = coll.collect()
+    # first collect: window-from-empty already holds the bad counters
+    assert snap["slo"]["status"] == "degraded"
+    assert snap["slo"]["firing"][0]["slo"] == "fleet.avail"
+    assert [e["state"] for e in snap["slo"]["events"]] == ["pending",
+                                                           "firing"]
+
+
+# --------------------------------------------------- serving outcomes
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+
+    set_hybrid_communicate_group(None)
+    paddle.seed(0)
+    m = GPTForPretraining(gpt_tiny())
+    m.eval()
+    return m
+
+
+def test_serve_request_outcome_and_replica_metrics(model):
+    reg = metrics.enable()
+    sink = _ListSink()
+    eng = ServingEngine(model, slot_count=2, ladder=(8,), max_new_cap=4,
+                        max_seq_len=32, steps_per_dispatch=2, sink=sink)
+    eng.replica_name = "r0"
+    h = eng.submit([1, 2, 3], max_new_tokens=3)
+    eng.run()
+    assert h.done and h.outcome in ("ok", "eos", "length")
+    recs = [r for r in sink.records if r["event"] == "serve_request"]
+    assert recs and recs[-1]["outcome"] == h.outcome
+    snap = reg.snapshot()
+    assert snap["counters"]["serve.requests"] == 1.0
+    assert "serve.errors" not in snap["counters"]
+    assert snap["counters"]["serve.replica.r0.requests"] == 1.0
+    assert snap["histograms"]["serve.replica.r0.ttft_ms"]["count"] == 1
+
+
+# ------------------------------------------------- trace_summary render
+
+def test_trace_summary_renders_alert_timeline(tmp_path):
+    base = {"event": "alert", "slo": "serve.ttft", "severity": "page",
+            "labels": {"replica": "r1"}, "budget_remaining": 0.4}
+    rows = [
+        dict(base, ts=100.0, state="pending", burn=20.0, peak_burn=20.0),
+        dict(base, ts=100.0, state="firing", burn=20.0, peak_burn=20.0),
+        dict(base, ts=103.0, state="resolved", burn=0.0, peak_burn=25.0,
+             duration_s=3.0),
+    ]
+    p = tmp_path / "alerts.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    env = {**os.environ, "PYTHONPATH": _REPO, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_summary.py"),
+         str(p)], env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    summary = json.loads(out.stdout.strip().splitlines()[-1])["summary"]
+    assert summary["kind"] == "alert_timeline"
+    assert summary["events"] == 3 and summary["span_s"] == 3.0
+    s = summary["slos"]["serve.ttft"]
+    assert (s["fires"], s["resolves"]) == (1, 1)
+    assert s["peak_burn"] == 25.0 and s["total_firing_s"] == 3.0
+    assert summary["still_firing"] == []

@@ -30,11 +30,19 @@ from the pooled samples (the driver regenerates the same streams), the
 SIGTERMed workers' snapshots evicted after their deadline, and the fleet
 namespace follow the generation bump (old `__fleet__/gen<g>/` swept).
 
+SLO self-healing leg (ISSUE 15): two live tiny-GPT serving replicas
+behind a ReplicaRouter, per-replica burn-rate SLOs on a test-scaled
+window. Latency injected into one replica must fire its TTFT page alert,
+flip the exporter's /healthz 200 -> 503, shed the replica (all new
+placements land on the healthy one), and — because the shed replica's
+window then drains empty — resolve the alert and flip /healthz back to
+200, with every submitted request completing normally (zero lost).
+
 Prints one JSON verdict row per check plus a summary row; exit 0 iff every
 verdict passed. Compile cache stays off (multi-device bit-equality, same
 debt as the dryrun phases). --history appends `elastic_reform_pause_ms`,
-`fleet_collect_ms` and `fleet_snapshot_age_ms` rows to BENCH_HISTORY.jsonl
-for tools/bench_gate.py.
+`fleet_collect_ms`, `fleet_snapshot_age_ms` and `slo_eval_ms` rows to
+BENCH_HISTORY.jsonl for tools/bench_gate.py.
 
 Run:  JAX_PLATFORMS=cpu python tools/elastic_drill.py
       [--steps-per-leg 3] [--lease 5.0] [--history]
@@ -117,6 +125,156 @@ def _append_history(payload):
             f.write(json.dumps(entry) + "\n")
     except OSError:
         pass
+
+
+def _slo_leg(verdict, work):
+    """Serving SLO episode: fire -> shed -> resolve, zero requests lost.
+
+    Self-contained (installs its own exporter + SLO engine, resets the
+    driver-process metrics state on the way out) so the fleet/elastic legs
+    see the same world they did before this leg existed. Returns
+    (median tick ms, spec count) for the bench row.
+    """
+    import urllib.error
+    import urllib.request
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+    from paddle_tpu.observability import exporter as obs_exporter
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.observability import slo as obs_slo
+    from paddle_tpu.serving.engine import ServingEngine
+    from paddle_tpu.serving.router import ReplicaRouter
+
+    set_hybrid_communicate_group(None)
+    paddle.seed(0)
+    model = GPTForPretraining(gpt_tiny())
+    model.eval()
+
+    def mk():
+        return ServingEngine(model, slot_count=1, ladder=(8, 16),
+                             max_new_cap=4, max_seq_len=32,
+                             steps_per_dispatch=1)
+
+    engines = {"fast": mk(), "slow": mk()}
+    router = ReplicaRouter(engines)
+    prompts = [[11, 12, 13], [21, 22, 23, 24], [31, 32], [41, 42, 43]]
+
+    def burst(n=4):
+        hs = [router.submit(prompts[i % len(prompts)], max_new_tokens=3)
+              for i in range(n)]
+        router.run()
+        return hs
+
+    burst()  # compile both replicas dark — XLA stays out of the TTFT SLI
+
+    exp = obs_exporter.start_exporter(0)  # also enables the registry
+    alerts_path = os.path.join(work, "alerts.jsonl")
+    # production pairs scaled to drill time: one page pair, 2s/0.4s, x2
+    win = [obs_slo.BurnWindow(2.0, 0.4, 2.0, "page")]
+    specs = (obs_slo.default_serving_slos(windows=win, replica="fast",
+                                          ttft_ms=100.0)
+             + obs_slo.default_serving_slos(windows=win, replica="slow",
+                                            ttft_ms=100.0))
+    slo_eng = obs_slo.install_engine(specs=specs, alerts_path=alerts_path)
+    router.attach_slo(slo_eng, penalty=50.0)
+    events = []
+    slo_eng.add_hook(events.append)
+    tick_ms = []
+
+    def tick():
+        t0 = time.perf_counter()
+        slo_eng.tick()
+        tick_ms.append((time.perf_counter() - t0) * 1000.0)
+
+    def healthz():
+        try:
+            with urllib.request.urlopen(exp.url + "/healthz",
+                                        timeout=10) as resp:
+                return resp.status
+        except urllib.error.HTTPError as err:
+            return err.code
+
+    def ev_for(state):
+        return next((ev for ev in events if ev["state"] == state
+                     and ev["labels"].get("replica") == "slow"), None)
+
+    try:
+        handles = burst()
+        tick()
+        code_healthy = healthz()
+
+        # inject: the slow replica holds every queued request until it has
+        # aged 250ms — TTFT blows through the 100ms objective but the
+        # requests themselves still complete correctly. Admission is held,
+        # not slept through, so the shared drive loop (and the healthy
+        # replica's TTFT) keeps moving
+        slow = engines["slow"]
+        orig_admit = slow._admit
+
+        def laggy_admit():
+            if slow._queue and not slow._draining:
+                head = slow._queue[0]
+                if time.perf_counter() - head.submit_ts < 0.25:
+                    time.sleep(0.005)
+                    return
+            orig_admit()
+
+        slow._admit = laggy_admit
+        deadline = time.time() + 60.0
+        while ev_for("firing") is None and time.time() < deadline:
+            handles += burst()
+            tick()
+        fired = ev_for("firing")
+        verdict("slo_alert_fires", fired is not None,
+                slo=fired["slo"] if fired else None,
+                burn=round(fired["burn"], 2) if fired else None)
+        code_firing = healthz()
+        verdict("slo_healthz_degraded", code_firing == 503,
+                code=code_firing)
+        shed = router.shedding()
+        verdict("slo_router_sheds", shed == ["slow"], shedding=shed)
+
+        # shed replica gets no traffic -> its windows drain empty -> the
+        # alert resolves on its own; meanwhile every burst lands on fast
+        slow._admit = orig_admit
+        placed_before = dict(router.routed)
+        deadline = time.time() + 60.0
+        while ev_for("resolved") is None and time.time() < deadline:
+            handles += burst()
+            tick()
+            time.sleep(0.05)
+        resolved = ev_for("resolved")
+        moved = {n: router.routed[n] - placed_before[n] for n in engines}
+        verdict("slo_traffic_moves",
+                moved["slow"] == 0 and moved["fast"] > 0,
+                placements=moved)
+        verdict("slo_alert_resolves", resolved is not None,
+                fire_to_resolve_s=round(resolved["duration_s"], 3)
+                if resolved else None,
+                shedding_after=router.shedding())
+        code_after = healthz()
+        verdict("slo_healthz_flips",
+                (code_healthy, code_firing, code_after) == (200, 503, 200),
+                codes=[code_healthy, code_firing, code_after])
+        lost = [h.id for h in handles
+                if not h.done or (h.outcome or "ok") in ("error", "drained")]
+        verdict("slo_zero_lost", not lost, submitted=len(handles),
+                lost=lost)
+        slo_eval_ms = sorted(tick_ms)[len(tick_ms) // 2]
+        alert_lines = 0
+        if os.path.exists(alerts_path):
+            with open(alerts_path) as f:
+                alert_lines = sum(1 for _ in f)
+        verdict("slo_eval_timed", bool(tick_ms) and alert_lines >= 3,
+                eval_ms=round(slo_eval_ms, 3), ticks=len(tick_ms),
+                alert_events=alert_lines, specs=len(specs))
+        return slo_eval_ms, len(specs)
+    finally:
+        obs_slo.uninstall_engine()
+        obs_exporter.stop_exporter()
+        obs_metrics.reset()
 
 
 def main():
@@ -240,6 +398,9 @@ def main():
     pause = {}
     exit_code = 1
     try:
+        # ---- SLO self-healing leg: fire -> shed -> resolve, zero lost ----
+        slo_eval_ms, slo_spec_count = _slo_leg(verdict, work)
+
         store = FileStore(store_dir, timeout=20.0)
         coord = ElasticCoordinator(store, topology_for=topo,
                                    lease_s=args.lease)
@@ -416,6 +577,7 @@ def main():
             "pause_ms_6to8": round(pause["6to8"], 2),
             "fleet_collect_ms": round(fleet_collect_ms, 3),
             "fleet_snapshot_age_ms": round(fleet_age_ms, 1),
+            "slo_eval_ms": round(slo_eval_ms, 3),
             "committed_steps_lost": 0 if ok else None,
         }), flush=True)
         if args.history and ok:
@@ -442,6 +604,12 @@ def main():
                 "metric": "fleet_snapshot_age_ms",
                 "value": round(fleet_age_ms, 1), "unit": "ms",
                 "vs_baseline": None, "extra": fbase})
+            _append_history({
+                "metric": "slo_eval_ms",
+                "value": round(slo_eval_ms, 3), "unit": "ms",
+                "vs_baseline": None,
+                "extra": {"platform": jax.default_backend(),
+                          "replicas": 2, "specs": slo_spec_count}})
         exit_code = 0 if ok else 1
     finally:
         fl.disable()

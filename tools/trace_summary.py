@@ -8,6 +8,7 @@ can be compared region by region.
 
   python tools/trace_summary.py /tmp/tele/step_telemetry.jsonl
   python tools/trace_summary.py /tmp/serve/serve.jsonl      # serve_request
+  python tools/trace_summary.py /tmp/slo/alerts.jsonl       # alert timeline
   python tools/trace_summary.py /tmp/paddle_tpu_profile/host_1234.json
   python tools/trace_summary.py /tmp/paddle_tpu_profile/   # merged dir
   python tools/trace_summary.py snapshot.json  # exporter /metrics.json dump
@@ -123,14 +124,28 @@ def summarize_records(recs, emit_json=True):
     serve_steps = [r for r in recs if r.get("event") == "serve_step"]
     routes = [r for r in recs if r.get("event") == "route"]
     health = [r for r in recs if r.get("event") == "health"]
+    alerts = [r for r in recs if r.get("event") == "alert"]
     recs = [r for r in recs if r.get("event") not in ("serve_request",
                                                       "serve_step", "health",
-                                                      "route")]
+                                                      "route", "alert")]
+    if not recs and alerts and not (serve_reqs or serve_steps or routes
+                                    or health):
+        return _summarize_alerts(alerts, emit_json=emit_json)
     if not recs and health:
-        return _summarize_health(health, emit_json=emit_json)
+        out = _summarize_health(health, emit_json=False)
+        if alerts:
+            out["alerts"] = _summarize_alerts(alerts, emit_json=False)
+        if emit_json:
+            print(json.dumps({"summary": out}))
+        return out
     if not recs:
-        return _summarize_serve(serve_reqs, serve_steps, routes,
-                                emit_json=emit_json)
+        out = _summarize_serve(serve_reqs, serve_steps, routes,
+                               emit_json=False)
+        if alerts:
+            out["alerts"] = _summarize_alerts(alerts, emit_json=False)
+        if emit_json:
+            print(json.dumps({"summary": out}))
+        return out
     n = len(recs)
 
     def col(k):
@@ -187,6 +202,8 @@ def summarize_records(recs, emit_json=True):
                                             emit_json=False)
     if health:
         summary["health"] = _summarize_health(health, emit_json=False)
+    if alerts:
+        summary["alerts"] = _summarize_alerts(alerts, emit_json=False)
     if emit_json:
         print(json.dumps({"summary": summary}))
     return summary
@@ -235,6 +252,58 @@ def _summarize_health(health, emit_json=True):
     return summary
 
 
+def _summarize_alerts(alerts, emit_json=True):
+    """alerts.jsonl (observability/slo.py transition events): the alert
+    timeline — every pending/firing/resolved transition in ts order, then
+    one per-SLO roll-up with fire->resolve durations and peak burn."""
+    alerts = sorted(alerts, key=lambda r: r.get("ts", 0))
+    t0 = alerts[0].get("ts", 0)
+    rows = [[f"{r.get('ts', 0) - t0:+.3f}s", r.get("slo"), r.get("state"),
+             r.get("severity"),
+             f"{r.get('burn', 0):.2f}x",
+             (f"{r['duration_s']:.3f}s" if "duration_s" in r else "-")]
+            for r in alerts]
+    print("alert timeline:")
+    _fmt_table(["t", "slo", "state", "severity", "burn", "fire->resolve"],
+               rows)
+    per = {}
+    for r in alerts:
+        s = per.setdefault(r.get("slo"), {
+            "fires": 0, "resolves": 0, "peak_burn": 0.0,
+            "severity": r.get("severity"), "total_firing_s": 0.0,
+            "unresolved": False})
+        if r.get("state") == "firing":
+            s["fires"] += 1
+            s["unresolved"] = True
+            s["severity"] = r.get("severity") or s["severity"]
+        elif r.get("state") == "resolved":
+            s["resolves"] += 1
+            s["unresolved"] = False
+            s["total_firing_s"] += float(r.get("duration_s", 0.0))
+        s["peak_burn"] = max(s["peak_burn"],
+                             float(r.get("peak_burn", r.get("burn", 0.0))))
+    rows = [[name, s["severity"], s["fires"], s["resolves"],
+             f"{s['peak_burn']:.2f}x", f"{s['total_firing_s']:.3f}s",
+             "yes" if s["unresolved"] else "no"]
+            for name, s in sorted(per.items())]
+    print("per-SLO:")
+    _fmt_table(["slo", "severity", "fires", "resolves", "peak_burn",
+                "firing_s", "still_firing"], rows)
+    summary = {
+        "kind": "alert_timeline",
+        "events": len(alerts),
+        "span_s": round(alerts[-1].get("ts", 0) - t0, 3),
+        "slos": {name: {k: (round(v, 4) if isinstance(v, float) else v)
+                        for k, v in s.items()}
+                 for name, s in per.items()},
+        "still_firing": sorted(n for n, s in per.items()
+                               if s["unresolved"]),
+    }
+    if emit_json:
+        print(json.dumps({"summary": summary}))
+    return summary
+
+
 def _summarize_serve(serve_reqs, serve_steps, routes=(), emit_json=True):
     """Percentile table over serve_request/serve_step/route records
     (ServingEngine + ReplicaRouter sink streams): TTFT/TPOT/queue-wait/
@@ -255,13 +324,24 @@ def _summarize_serve(serve_reqs, serve_steps, routes=(), emit_json=True):
         ("route_queue_depth", "n", col(routes, "queue_depth")),
     ])
     toks = col(serve_reqs, "new_tokens")
+    # terminal-outcome breakdown (ok|eos|length|drained|error) — older
+    # streams without the field fall back to finish_reason
+    outcomes = {}
+    for r in serve_reqs:
+        o = r.get("outcome") or r.get("finish_reason") or "ok"
+        outcomes[o] = outcomes.get(o, 0) + 1
     summary = {
         "kind": "serve_telemetry",
         "requests": len(serve_reqs),
         "decode_dispatches": len(serve_steps),
         "total_new_tokens": int(sum(toks)) if toks else 0,
+        "outcomes": outcomes,
+        "errors": outcomes.get("error", 0),
         "percentiles": pcts,
     }
+    if outcomes:
+        print("outcomes: " + "  ".join(f"{k}={v}" for k, v in
+                                       sorted(outcomes.items())))
     # paged-KV gauges ride on serve_step records (engine.py emits them only
     # on the paged layout); report the final sample — the steady state
     hit_rates = col(serve_steps, "prefix_hit_rate")
@@ -327,6 +407,22 @@ def summarize_snapshot_doc(doc, emit_json=True):
         _fmt_table(["histogram", "n", "p50", "p90", "p99"], rows)
     else:
         print("no populated histograms in snapshot")
+    # SLO gauges (observability/slo.py writes slo.<name>.burn_rate /
+    # .error_budget_remaining / .firing): surface the judgement layer
+    # next to the raw percentiles — in fleet mode this is the merged view
+    slo_gauges = {k: v for k, v in (doc.get("gauges") or {}).items()
+                  if k.startswith("slo.")}
+    if slo_gauges:
+        slos = {}
+        for k, v in slo_gauges.items():
+            name, _, field = k[len("slo."):].rpartition(".")
+            slos.setdefault(name, {})[field] = v
+        rows = [[name, f"{g.get('burn_rate', 0):.2f}x",
+                 f"{g.get('error_budget_remaining', 1):.4f}",
+                 "yes" if g.get("firing") else "no"]
+                for name, g in sorted(slos.items())]
+        print("slo state:")
+        _fmt_table(["slo", "burn", "budget_left", "firing"], rows)
     summary = {
         "kind": "metrics_snapshot",
         "histograms": len(pcts),
@@ -334,6 +430,11 @@ def summarize_snapshot_doc(doc, emit_json=True):
         "gauges": len(doc.get("gauges", {})),
         "percentiles": pcts,
     }
+    if slo_gauges:
+        summary["slo_gauges"] = slo_gauges
+        summary["slo_firing"] = sorted(
+            k[len("slo."):-len(".firing")] for k, v in slo_gauges.items()
+            if k.endswith(".firing") and v)
     if emit_json:
         print(json.dumps({"summary": summary}))
     return summary
