@@ -22,6 +22,10 @@ Layers, one subsystem:
   log-bucket histograms losslessly (``/fleet/metrics``), and
   ``TraceContext`` carrying request id + parent span across the
   router -> engine boundary (PADDLE_TPU_FLEET_*).
+- ``capacity``: closed-loop SLO-driven autoscaling — a CapacityController
+  polling firing burn-rate alerts + occupancy/queue gauges into a target
+  replica count, acting through the router's spawn/drain machinery, every
+  decision a traced span + capacity.jsonl record (``/capacity`` route).
 - ``health``: in-program training-health stats (grad/weight/update norms,
   non-finite localization by parameter name) riding the compiled step as
   ONE packed aux output, fetched every FLAGS_health_interval steps
@@ -35,7 +39,11 @@ one env var (PADDLE_TPU_TELEMETRY_DIR / PADDLE_TPU_METRICS_PORT /
 PADDLE_TPU_FLIGHT_DIR) or one method call; disabled, no jax import, no I/O,
 no spans, no per-step work beyond a None check.
 """
-from . import exec_introspect, exporter, fleet, flight_recorder, health, metrics, slo  # noqa: F401,E501
+from . import capacity, exec_introspect, exporter, fleet, flight_recorder, health, metrics, slo  # noqa: F401,E501
+from .capacity import (  # noqa: F401
+    CapacityController, CapacityPolicy, active_controller,
+    install_controller, uninstall_controller,
+)
 from .exporter import (  # noqa: F401
     MetricsExporter, ensure_started_from_env, get_exporter, start_exporter,
     stop_exporter,
@@ -71,6 +79,8 @@ from .tracer import (  # noqa: F401
 
 __all__ = [
     "Tracer", "get_tracer", "span", "enabled",
+    "CapacityController", "CapacityPolicy", "capacity",
+    "install_controller", "uninstall_controller", "active_controller",
     "StepTelemetry", "JsonlSink", "InMemorySink",
     "transformer_flops_per_token", "peak_flops_per_sec", "PEAK_TFLOPS",
     "Counter", "Gauge", "Histogram", "MetricRegistry",

@@ -16,6 +16,9 @@ A daemon-thread ``http.server`` serving the process-global
                              {status, firing, ...} body that turns
                              503/degraded while a page-severity alert
                              fires (each probe ticks the engine)
+    GET /capacity            autoscaling state (policy, live/retiring
+                             replicas, recent scale decisions) when a
+                             capacity.CapacityController is installed
 
 Enabled via ``PADDLE_TPU_METRICS_PORT`` (the engines call
 `ensure_started_from_env()` at construction — one getenv when unset, so
@@ -64,6 +67,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._do_healthz()
         elif path == "/alerts":
             self._do_alerts()
+        elif path == "/capacity":
+            self._do_capacity()
         else:
             self._send(404, "not found\n", "text/plain")
 
@@ -95,6 +100,21 @@ class _Handler(BaseHTTPRequestHandler):
             doc = eng.doc()
         except Exception as exc:
             self._send(503, f"slo evaluation failed: {exc}\n", "text/plain")
+            return
+        self._send(200, json.dumps(doc, sort_keys=True, default=str),
+                   "application/json")
+
+    def _do_capacity(self):
+        from . import capacity as _capacity
+        ctl = _capacity.active_controller()
+        if ctl is None:
+            self._send(404, "no capacity controller installed\n",
+                       "text/plain")
+            return
+        try:
+            doc = ctl.doc()  # state only — scrapes must not drive scaling
+        except Exception as exc:
+            self._send(503, f"capacity state failed: {exc}\n", "text/plain")
             return
         self._send(200, json.dumps(doc, sort_keys=True, default=str),
                    "application/json")

@@ -25,6 +25,9 @@ from .bucketing import (  # noqa: F401
 )
 from .engine import Request, ServingEngine  # noqa: F401
 from .kv_pages import PagePool, PoolExhausted  # noqa: F401
+from .loadgen import (  # noqa: F401
+    LoadGenerator, Scenario, spike_scenario, zipf_tenants,
+)
 from .prefix_cache import RadixPrefixCache  # noqa: F401
 from .router import ReplicaRouter  # noqa: F401
 from .sampling import (  # noqa: F401
@@ -33,6 +36,7 @@ from .sampling import (  # noqa: F401
 
 __all__ = [
     "ServingEngine", "Request", "ReplicaRouter",
+    "Scenario", "LoadGenerator", "spike_scenario", "zipf_tenants",
     "PagePool", "PoolExhausted", "RadixPrefixCache",
     "DEFAULT_LADDER", "bucket_for", "clip_ladder", "resolve_bucket",
     "sample_tokens", "filter_topk_topp", "request_key",

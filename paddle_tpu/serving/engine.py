@@ -64,10 +64,14 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, prompt_ids, max_new_tokens, temperature, top_k, top_p,
-                 eos_token_id, seed, trace_ctx=None):
+                 eos_token_id, seed, trace_ctx=None, tenant=None):
         import numpy as np
 
         self.id = next(Request._ids)
+        # multi-tenant attribution (serving/loadgen.py scenarios): carried
+        # into the serve_request sink record so per-tenant latency/goodput
+        # can be cut offline; None = untagged, zero extra cost
+        self.tenant = tenant if tenant is None else str(tenant)
         # fleet trace identity (observability.fleet.TraceContext or any
         # object with span_args()): set by the ReplicaRouter so engine-side
         # spans carry the request id + the placement span as parent_span
@@ -315,7 +319,8 @@ class ServingEngine:
     # ------------------------------------------------------------- public
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-               eos_token_id=None, seed: int = 0, trace_ctx=None) -> Request:
+               eos_token_id=None, seed: int = 0, trace_ctx=None,
+               tenant=None) -> Request:
         """Enqueue a request; returns the live Request handle (tokens fill
         in as the engine runs). max_new_tokens is clamped to the engine cap
         and to the cache room left after the prompt's bucket. trace_ctx
@@ -326,7 +331,7 @@ class ServingEngine:
                 "ServingEngine is draining (SIGTERM/begin_drain): admission "
                 "is closed; submit to a live replica")
         req = Request(prompt_ids, max_new_tokens, temperature, top_k, top_p,
-                      eos_token_id, seed, trace_ctx=trace_ctx)
+                      eos_token_id, seed, trace_ctx=trace_ctx, tenant=tenant)
         plen = len(req.prompt_ids)
         req.bucket = bucket_for(plen, self.ladder)  # raises if oversize
         room = self.max_seq_len - req.bucket
@@ -1347,6 +1352,8 @@ class ServingEngine:
                 "prefix_hit": req.prefix_hit,
                 "shared_tokens": req.shared_tokens,
             }
+            if req.tenant is not None:
+                rec["tenant"] = req.tenant
             if req.trace_ctx is not None:
                 rec["fleet_request_id"] = req.trace_ctx.request_id
             if self.sink is not None:

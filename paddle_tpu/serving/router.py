@@ -107,7 +107,8 @@ class ReplicaRouter:
                       + self._shed.get(name, 0.0)),
         }
 
-    def submit(self, prompt_ids, trace_ctx=None, **kwargs) -> Request:
+    def submit(self, prompt_ids, trace_ctx=None, _replaced=False,
+               **kwargs) -> Request:
         """Place one request on the best live replica (see module doc for
         the score). Raises RuntimeError when every replica is draining.
 
@@ -115,6 +116,11 @@ class ReplicaRouter:
         ``route.place`` span whose minted span id is the ``parent_span``
         of every engine-side span this request records; ``trace_ctx``
         lets a re-placement (begin_drain) keep the original request id.
+        ``_replaced`` marks a begin_drain re-placement: the same logical
+        request, already counted at first submission — it must not
+        re-increment ``route.requests`` (the capacity controller's
+        scale-in signal reads that counter; double counting would read as
+        phantom load). It counts under ``route.replaced`` instead.
         """
         tr = _obs_tracer.get_tracer()
         t0 = time.perf_counter() if tr.enabled else None
@@ -155,7 +161,10 @@ class ReplicaRouter:
         })
         mreg = _obs_metrics.active_registry()
         if mreg is not None:
-            mreg.counter("route.requests").inc()
+            if _replaced:
+                mreg.counter("route.replaced").inc()
+            else:
+                mreg.counter("route.requests").inc()
             if best["prefix_tokens"] > 0:
                 mreg.counter("route.prefix_routed").inc()
             mreg.gauge("route.replicas_live").set(len(live))
@@ -170,6 +179,8 @@ class ReplicaRouter:
                 "replicas_live": len(live),
                 "candidates": len(scored),
             }
+            if _replaced:
+                rec["replaced"] = True
             if ctx is not None:
                 rec["fleet_request_id"] = ctx.request_id
             self.sink.write(rec)
@@ -252,23 +263,66 @@ class ReplicaRouter:
         to completion under step()/run(), but queued-not-yet-admitted work
         would strand (a draining engine stops pulling its queue), so it is
         re-placed on the remaining live replicas. Returns the re-placed
-        Request handles (the stranded originals never produce tokens)."""
+        Request handles (the stranded originals never produce tokens).
+
+        Counter audit (capacity controller reads these): the drained
+        replica's ``routed`` credit for never-admitted requests moves with
+        them, and the re-submission goes through the ``_replaced`` path —
+        ``route.requests`` counts each logical request exactly once, and
+        ``serve.replica.<name>.requests`` (finish-time) only ever counts
+        the replica that actually served it."""
         eng = self.replicas[name]
         requeue = []
         with eng._lock:
             while eng._queue:
                 requeue.append(eng._queue.popleft())
+        self.routed[name] -= len(requeue)
         eng.begin_drain(reason)
         return [self.submit(req.prompt_ids, trace_ctx=req.trace_ctx,
+                            _replaced=True,
                             max_new_tokens=req.max_new_tokens,
                             temperature=req.temperature, top_k=req.top_k,
                             top_p=req.top_p, eos_token_id=req.eos_token_id,
-                            seed=req.seed)
+                            seed=req.seed, tenant=req.tenant)
                 for req in requeue]
 
     def drained(self, name: str) -> bool:
         eng = self.replicas[name]
         return bool(eng._draining) and not eng._active.any()
+
+    # ------------------------------------------------- elastic replica set
+    def add_replica(self, name: str, engine: ServingEngine) -> None:
+        """Grow the fleet in place (capacity controller scale-out): the new
+        replica is eligible for placement on the very next submit()."""
+        if name in self.replicas:
+            raise ValueError(f"replica {name!r} already exists")
+        if engine.replica_name is None:
+            engine.replica_name = name
+        self.replicas[name] = engine
+        self.routed.setdefault(name, 0)
+        mreg = _obs_metrics.active_registry()
+        if mreg is not None:
+            mreg.gauge("route.replicas_live").set(len(self.live_replicas()))
+
+    def remove_replica(self, name: str) -> ServingEngine:
+        """Retire a fully drained replica (capacity controller scale-in):
+        refuses while it still holds queued or active work — drain first
+        (begin_drain + step until drained()). Calls engine.retire() so a
+        registered membership lease is released (graceful leave)."""
+        eng = self.replicas[name]
+        if not eng._draining or eng._active.any() or eng._queue:
+            raise RuntimeError(
+                f"replica {name!r} is not drained (draining="
+                f"{eng._draining}, active={int(eng._active.sum())}, "
+                f"queued={len(eng._queue)}); begin_drain and step first")
+        del self.replicas[name]
+        self.routed.pop(name, None)
+        self._shed.pop(name, None)
+        eng.retire()
+        mreg = _obs_metrics.active_registry()
+        if mreg is not None:
+            mreg.gauge("route.replicas_live").set(len(self.live_replicas()))
+        return eng
 
     def stats(self) -> Dict:
         return {

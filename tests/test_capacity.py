@@ -1,0 +1,434 @@
+"""Closed-loop capacity controller (ISSUE 16 tentpole b).
+
+Pinned contracts:
+- scale out on a firing alert (target = ceil(cur * factor) clamped to
+  max_replicas, spawned replicas named past the existing index) and on
+  occupancy/queue sustained above the high-water marks;
+- scale in only when nothing fires, every SLO keeps >= budget_min error
+  budget, the fleet idles for idle_sustain_s, and nothing is retiring —
+  newest replicas drain first, reaped only once their drain completes;
+- hysteresis/flap damping: cooldown_s dead time after every action, the
+  sustain clocks reset on action;
+- every decision is one capacity.jsonl record carrying the full signal
+  snapshot (holds elidable via log_holds=False) and doc() serves the
+  policy + decision tail;
+- counter audit (the begin_drain double-count regression): a drain
+  re-placement moves the routed credit and counts under route.replaced —
+  route.requests counts each logical request exactly once;
+- dark by default: nothing installed at import, /capacity 404s until
+  install_controller, polls run fine with no metrics registry;
+- the pinned spike episode (the drill's autoscale leg, run in-process):
+  spike -> page alert -> 2->4 -> resolve -> 4->2, zero requests lost.
+"""
+import collections
+import json
+import os
+import subprocess
+import sys
+import threading
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import capacity, exporter, metrics, slo
+from paddle_tpu.serving.router import ReplicaRouter
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    """Controller/exporter/registry are process-globals the shared
+    conftest doesn't know about: start dark, leave dark."""
+    capacity.uninstall_controller()
+    exporter.stop_exporter()
+    metrics.reset()
+    slo.uninstall_engine()
+    yield
+    capacity.uninstall_controller()
+    exporter.stop_exporter()
+    metrics.reset()
+    slo.uninstall_engine()
+
+
+class _Engine:
+    """The ServingEngine surface ReplicaRouter + CapacityController touch.
+
+    Queued requests carry the full re-placement field set so the real
+    begin_drain path can re-submit them; drain() semantics are modeled by
+    the _draining flag + step() admitting one queued request per call."""
+
+    def __init__(self, occupancy=0.0):
+        self.replica_name = None
+        self.slot_count = 1
+        self._draining = False
+        self._queue = collections.deque()
+        self._active = np.zeros(1, bool)
+        self._lock = threading.Lock()
+        self._completed = []
+        self._occ = occupancy
+        self.retired = False
+
+    def queue_depth(self):
+        return len(self._queue)
+
+    def occupancy(self):
+        return self._occ
+
+    def prefix_match_len(self, prompt_ids):
+        return 0
+
+    def submit(self, prompt_ids, trace_ctx=None, max_new_tokens=None,
+               temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+               seed=None, tenant=None):
+        if self._draining:
+            raise RuntimeError("draining")
+        req = types.SimpleNamespace(
+            id=f"q{id(self)}-{len(self._completed) + len(self._queue)}",
+            prompt_ids=list(prompt_ids), trace_ctx=trace_ctx,
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
+            seed=seed, tenant=tenant, done=False, outcome=None)
+        self._queue.append(req)
+        return req
+
+    def step(self):
+        if self._queue:
+            req = self._queue.popleft()
+            req.done, req.outcome = True, "length"
+            self._completed.append(req)
+        return 0
+
+    def begin_drain(self, reason="drain"):
+        self._draining = True
+
+    def retire(self):
+        self.retired = True
+
+    def register_replica(self, store, replica_id, lease_s=None):
+        raise AssertionError("no store attached in these tests")
+
+
+class _FakeSlo:
+    """The SloEngine surface the controller reads."""
+
+    def __init__(self):
+        self._firing = []
+        self.last_results = []
+
+    def firing(self, severity=None):
+        return list(self._firing)
+
+    def fire(self, name="serve.ttft", severity="page"):
+        self._firing = [{"slo": name, "severity": severity, "labels": {}}]
+
+    def calm(self, budget_remaining=1.0):
+        self._firing = []
+        self.last_results = [{"budget_remaining": budget_remaining}]
+
+
+def _fleet(n=2, occupancy=0.0):
+    router = ReplicaRouter({f"r{i}": _Engine(occupancy=occupancy)
+                            for i in range(n)})
+    return router, (lambda name: _Engine(occupancy=occupancy))
+
+
+def _controller(router, spawn, slo_engine=None, **pol):
+    defaults = dict(min_replicas=1, max_replicas=4, cooldown_s=5.0,
+                    idle_sustain_s=1.0, occupancy_low=0.2, queue_low=0.5)
+    defaults.update(pol)
+    return capacity.CapacityController(
+        router, spawn, policy=capacity.CapacityPolicy(**defaults),
+        slo_engine=slo_engine)
+
+
+# ----------------------------------------------------------------- policy
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="min_replicas"):
+        capacity.CapacityPolicy(min_replicas=0)
+    with pytest.raises(ValueError, match="min_replicas"):
+        capacity.CapacityPolicy(min_replicas=4, max_replicas=2)
+    with pytest.raises(ValueError, match="factors"):
+        capacity.CapacityPolicy(scale_out_factor=1.0)
+    d = capacity.CapacityPolicy().as_dict()
+    assert d["max_replicas"] == 8 and "cooldown_s" in d
+
+
+# ------------------------------------------------------------- scale out
+
+def test_scale_out_on_firing_alert_names_past_existing():
+    router, spawn = _fleet(2)
+    eng = _FakeSlo()
+    eng.fire()
+    ctl = _controller(router, spawn, slo_engine=eng)
+    rec = ctl.poll(now=100.0)
+    assert rec["action"] == "scale_out" and rec["reason"] == "slo_burn"
+    assert (rec["replicas"], rec["target"]) == (2, 4)
+    assert rec["added"] == ["r2", "r3"]          # index seeded past r0/r1
+    assert sorted(router.replicas) == ["r0", "r1", "r2", "r3"]
+    assert rec["signals"]["firing"][0]["slo"] == "serve.ttft"
+    assert ctl.scale_outs == 1
+    # max_replicas clamps: still firing, but the fleet is at the ceiling
+    rec = ctl.poll(now=200.0)
+    assert rec["action"] == "hold"
+
+
+def test_scale_out_on_sustained_occupancy_only():
+    router, spawn = _fleet(2, occupancy=0.95)
+    ctl = _controller(router, spawn, occupancy_high=0.9,
+                      high_sustain_s=1.0)
+    assert ctl.poll(now=10.0)["action"] == "hold"   # hot, not yet sustained
+    assert ctl.poll(now=10.5)["action"] == "hold"
+    rec = ctl.poll(now=11.1)
+    assert rec["action"] == "scale_out" and rec["reason"] == "occupancy"
+    assert len(router.replicas) == 4
+
+
+# -------------------------------------------------------------- scale in
+
+def test_scale_in_waits_for_idle_sustain_budget_and_cooldown():
+    router, spawn = _fleet(4)
+    eng = _FakeSlo()
+    eng.calm(budget_remaining=0.1)
+    ctl = _controller(router, spawn, slo_engine=eng, budget_min=0.25,
+                      cooldown_s=5.0, idle_sustain_s=1.0)
+    # idle but budget-starved: no shrink (a recent burn ate the budget)
+    ctl.poll(now=0.0)
+    assert ctl.poll(now=2.0)["action"] == "hold"
+    # budget back: idle clock already satisfied -> shrink 4 -> 2
+    eng.calm(budget_remaining=0.9)
+    rec = ctl.poll(now=3.0)
+    assert rec["action"] == "scale_in" and rec["reason"] == "idle_budget"
+    assert (rec["replicas"], rec["target"]) == (4, 2)
+    assert rec["draining"] == ["r3", "r2"]       # newest drain first
+    assert router.replicas["r3"]._draining
+    assert ctl.scale_ins == 1
+    # the action reset the idle clock; this poll also reaps the drained
+    # pair and restarts the clock at 4.0
+    assert ctl.poll(now=4.0)["action"] == "hold"
+    # idle sustained again, but the cooldown dead time blocks the flap
+    rec = ctl.poll(now=5.5)
+    assert rec["action"] == "hold" and rec["reason"] == "cooldown"
+
+
+def test_retiring_replicas_reaped_after_drain_completes():
+    router, spawn = _fleet(2)
+    ctl = _controller(router, spawn, min_replicas=1, cooldown_s=0.5,
+                      idle_sustain_s=0.5)
+    router.submit([1, 2])  # lands on r0 (deterministic tie-break)
+    ctl.poll(now=0.0)
+    rec = ctl.poll(now=1.0)
+    assert rec["action"] == "scale_in" and rec["draining"] == ["r1"]
+    # r1 is drained (no queue, no active) -> the next poll reaps it
+    assert "r1" in router.replicas
+    rec = ctl.poll(now=2.0)
+    assert "r1" not in router.replicas
+    assert rec["signals"]["retiring"] == []
+    assert ctl.doc()["retiring"] == []
+
+
+def test_scale_in_blocked_while_firing_or_retiring():
+    router, spawn = _fleet(4)
+    eng = _FakeSlo()
+    eng.fire()
+    ctl = _controller(router, spawn, slo_engine=eng, max_replicas=4,
+                      cooldown_s=0.0, idle_sustain_s=0.5)
+    assert ctl.poll(now=0.0)["action"] == "hold"  # firing + at ceiling
+    assert ctl.poll(now=5.0)["action"] == "hold"  # firing blocks shrink
+    eng.calm()
+    rec = ctl.poll(now=6.0)                       # idle sustained since 0.0
+    assert rec["action"] == "scale_in" and rec["draining"] == ["r3", "r2"]
+    # an unfinished drain blocks further shrink: r3 keeps an active slot
+    router.replicas["r3"]._active[0] = True
+    rec = ctl.poll(now=7.0)                       # reaps r2, r3 lingers
+    assert rec["action"] == "hold"
+    assert rec["signals"]["retiring"] == ["r3"]
+    assert "r2" not in router.replicas
+    router.replicas["r3"]._active[0] = False      # slot finishes
+    ctl.poll(now=8.0)
+    assert "r3" not in router.replicas
+    assert router.replicas["r0"].retired is False  # survivors untouched
+
+
+# ------------------------------------------------------ evidence surfaces
+
+def test_jsonl_records_and_log_holds(tmp_path):
+    path = str(tmp_path / "capacity.jsonl")
+    router, spawn = _fleet(1)
+    eng = _FakeSlo()
+    ctl = capacity.CapacityController(
+        router, spawn, policy=capacity.CapacityPolicy(max_replicas=2),
+        slo_engine=eng, jsonl_path=path, log_holds=False)
+    ctl.poll(now=0.0)                 # hold: not logged
+    eng.fire()
+    ctl.poll(now=1.0)                 # scale_out: logged
+    with open(path) as f:
+        recs = [json.loads(ln) for ln in f]
+    assert [r["action"] for r in recs] == ["scale_out"]
+    assert recs[0]["event"] == "capacity"
+    assert set(recs[0]["signals"]) >= {"replicas", "occupancy", "queued",
+                                       "queue_per_slot", "firing",
+                                       "budget_remaining"}
+    doc = ctl.doc()
+    assert doc["policy"]["max_replicas"] == 2
+    assert doc["scale_outs"] == 1 and doc["polls"] == 2
+    assert doc["last"]["action"] == "scale_out"
+    assert doc["decisions"][-1] == doc["last"]
+
+
+def test_metrics_gauges_and_counters():
+    metrics.enable()
+    router, spawn = _fleet(1)
+    eng = _FakeSlo()
+    eng.fire()
+    ctl = _controller(router, spawn, slo_engine=eng)
+    ctl.poll(now=0.0)
+    snap = metrics.default_registry().snapshot()
+    assert snap["counters"]["capacity.scale_outs"] == 1
+    assert snap["gauges"]["capacity.target_replicas"] == 2.0
+    assert snap["gauges"]["capacity.replicas"] == 1.0
+
+
+def test_capacity_route_dark_until_installed():
+    ex = exporter.start_exporter(0)
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(ex.url + path, timeout=10) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as err:
+            return err.code, err.read().decode()
+
+    code, body = get("/capacity")
+    assert code == 404 and "no capacity controller" in body
+    router, spawn = _fleet(2)
+    ctl = capacity.install_controller(_controller(router, spawn))
+    assert capacity.active_controller() is ctl
+    ctl.poll(now=0.0)
+    code, body = get("/capacity")
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["replicas"] == ["r0", "r1"] and doc["polls"] == 1
+    capacity.uninstall_controller()
+    assert capacity.active_controller() is None
+    assert get("/capacity")[0] == 404
+
+
+def test_poll_runs_dark_with_no_registry_tracer_or_jsonl():
+    assert metrics.active_registry() is None
+    router, spawn = _fleet(1)
+    ctl = _controller(router, spawn)
+    rec = ctl.poll(now=0.0)
+    assert rec["action"] == "hold" and ctl.last_decision is rec
+
+
+# ------------------------------------------- counter audit (satellite 5)
+
+def test_begin_drain_replacement_counts_each_request_once():
+    """The regression the drill's autoscale leg relies on: re-placing a
+    drained replica's queued work must not double-count route.requests
+    (the controller's scale-in signal) nor credit the drained replica's
+    routed tally for work it never served."""
+    metrics.enable()
+    router = ReplicaRouter({"a": _Engine(), "b": _Engine()})
+    reqs = [router.submit([i, i + 1]) for i in range(6)]
+    placed_a = router.routed["a"]
+    assert placed_a > 0 and router.routed["b"] > 0  # queue-balanced spread
+    replaced = router.begin_drain("a")
+    assert len(replaced) == placed_a  # nothing was admitted yet
+    snap = metrics.default_registry().snapshot()["counters"]
+    assert snap["route.requests"] == 6          # once per logical request
+    assert snap["route.replaced"] == len(replaced)
+    assert router.routed["a"] == 0              # credit moved with the work
+    assert router.routed["b"] == 6
+    router.run()
+    assert router.drained("a")
+    done = [r for r in reqs if r.done] + replaced
+    assert {tuple(r.prompt_ids) for r in done} == \
+        {(i, i + 1) for i in range(6)}
+    # the sink-visible flag: replaced records are distinguishable
+    assert all(r.outcome == "length" for r in replaced)
+
+
+# ------------------------------------------ trace_summary scaling story
+
+def test_trace_summary_renders_capacity_timeline(tmp_path):
+    caps = [
+        {"event": "capacity", "ts": 100.0, "action": "hold",
+         "reason": "steady", "replicas": 2, "target": 2,
+         "signals": {"occupancy": 0.1, "queued": 0, "firing": []}},
+        {"event": "capacity", "ts": 101.5, "action": "scale_out",
+         "reason": "slo_burn", "replicas": 2, "target": 4,
+         "signals": {"occupancy": 0.9, "queued": 6,
+                     "firing": [{"slo": "serve.ttft"}]},
+         "added": ["r2", "r3"]},
+        {"event": "capacity", "ts": 106.0, "action": "scale_in",
+         "reason": "idle_budget", "replicas": 4, "target": 2,
+         "signals": {"occupancy": 0.0, "queued": 0, "firing": []},
+         "draining": ["r3", "r2"]},
+    ]
+    alerts = [
+        {"event": "alert", "ts": 101.0, "slo": "serve.ttft",
+         "state": "firing", "severity": "page", "burn": 4.0},
+        {"event": "alert", "ts": 103.0, "slo": "serve.ttft",
+         "state": "resolved", "severity": "page", "burn": 0.2,
+         "duration_s": 2.0, "peak_burn": 4.0},
+    ]
+    p = tmp_path / "merged.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in caps + alerts))
+    env = {**os.environ, "PYTHONPATH": _REPO, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_summary.py"),
+         str(p)], env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "scaling timeline:" in out.stdout
+    assert "steady holds elided" in out.stdout
+    summary = json.loads(out.stdout.strip().splitlines()[-1])["summary"]
+    assert summary["kind"] == "capacity_timeline"
+    assert summary["scale_outs"] == 1 and summary["scale_ins"] == 1
+    assert (summary["replicas_initial"], summary["replicas_peak"],
+            summary["replicas_final"]) == (2, 4, 2)
+    assert summary["reaction_s"] == 0.5    # firing -> scale_out
+    assert summary["recovery_s"] == 2.0    # firing -> last resolve
+    assert summary["alerts"]["kind"] == "alert_timeline"
+
+
+# ----------------------------------- the pinned spike episode (dryrun)
+
+def test_autoscale_spike_episode_dryrun(tmp_path):
+    """The drill's autoscale leg, in-process: the SAME code path
+    __graft_entry__'s dryrun asserts on, so tier-1 catches a broken loop
+    without the 8-worker drill. spike -> page alert -> 2->4 -> resolve
+    -> 4->2 after cooldown, zero lost, route.requests counted once."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "elastic_drill_for_test",
+            os.path.join(_REPO, "tools", "elastic_drill.py"))
+        drill = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(drill)
+    finally:
+        sys.path.pop(0)
+    verdicts = []
+
+    def verdict(check, ok, **extra):
+        verdicts.append({"check": check, "ok": bool(ok), **extra})
+
+    recovery_s, schedule_ms, n = drill._autoscale_leg(
+        verdict, str(tmp_path))
+    failed = [v for v in verdicts if not v["ok"]]
+    assert not failed, failed
+    names = {v["check"] for v in verdicts}
+    assert {"autoscale_scenario_replayable", "autoscale_alert_fires",
+            "autoscale_scales_out", "autoscale_alert_resolves",
+            "autoscale_scales_back", "autoscale_membership_follows",
+            "autoscale_zero_lost", "autoscale_route_counts_once",
+            "autoscale_decisions_logged",
+            "autoscale_recovery_timed"} <= names
+    assert recovery_s > 0 and schedule_ms > 0 and n > 0
+    assert os.path.exists(os.path.join(str(tmp_path), "capacity.jsonl"))

@@ -1,0 +1,264 @@
+"""Replayable load scenarios (ISSUE 16 tentpole a).
+
+Pinned contracts:
+- byte-identity: the same scenario + seed compiles to the same
+  schedule_doc() bytes across runs, across a dumps/loads round-trip,
+  across a scenario-file save/load round-trip, and across interpreter
+  hash seeds (string-seeded random.Random uses sha512, not hash());
+- the seed is the only entropy source: changing it changes the schedule,
+  changing nothing keeps every row;
+- length dists: fixed is constant, cycle is values[i % n] exactly (and
+  consumes no randomness — swapping it for fixed leaves every other draw
+  untouched), lognormal respects min/max clamps, choice draws only from
+  its value set;
+- arrival processes: batch puts count rows at t=0, spike labels the
+  window "spike" and raises its arrival density, diurnal labels
+  peak/trough, rates beyond MAX_EVENTS fail loudly;
+- zipf tenant skew shows up in the schedule (first tenant dominates);
+- LoadGenerator drives a schedule open-loop in arrival order, threads
+  tenants through submit, and reduces the episode to a summary doc.
+"""
+import json
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+from paddle_tpu.serving.loadgen import (
+    LoadGenerator, Scenario, spike_scenario, zipf_tenants,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mixed_scenario(seed=3):
+    return Scenario(
+        name="mixed", seed=seed, duration_s=8.0,
+        arrival={"process": "poisson", "rate_rps": 5.0},
+        prompt_len={"dist": "lognormal", "median": 8, "sigma": 0.6,
+                    "min": 2, "max": 32},
+        max_new={"dist": "choice", "values": [2, 4, 8],
+                 "weights": [4, 2, 1]},
+        tenants=zipf_tenants(4))
+
+
+# ----------------------------------------------------------- byte-identity
+
+def test_schedule_byte_identical_across_runs_and_round_trips(tmp_path):
+    scn = _mixed_scenario()
+    doc = scn.schedule_doc()
+    assert doc == _mixed_scenario().schedule_doc()          # fresh object
+    assert doc == Scenario.loads(scn.dumps()).schedule_doc()  # json twin
+    p = scn.save(str(tmp_path / "mixed.json"))
+    assert doc == Scenario.load(p).schedule_doc()           # file twin
+    # canonical JSON: compact separators, sorted keys, parseable
+    parsed = json.loads(doc)
+    assert parsed["scenario"] == "mixed" and parsed["seed"] == 3
+    assert doc == json.dumps(parsed, sort_keys=True,
+                             separators=(",", ":"))
+
+
+def test_schedule_survives_interpreter_hash_seed(tmp_path):
+    """String-seeded random.Random hashes via sha512 — PYTHONHASHSEED
+    must not leak into the schedule. loadgen is stdlib-only, so the
+    subprocess loads the module file directly (no jax import)."""
+    prog = (
+        "import importlib.util, sys\n"
+        "spec = importlib.util.spec_from_file_location('lg', sys.argv[1])\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(m)\n"
+        "print(m.spike_scenario().schedule_doc())\n")
+    path = os.path.join(_REPO, "paddle_tpu", "serving", "loadgen.py")
+    docs = []
+    for hash_seed in ("0", "12345"):
+        env = {**os.environ, "PYTHONHASHSEED": hash_seed}
+        out = subprocess.run([sys.executable, "-c", prog, path], env=env,
+                             capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        docs.append(out.stdout.strip())
+    assert docs[0] == docs[1]
+    assert docs[0] == spike_scenario().schedule_doc()
+
+
+def test_seed_is_the_only_entropy_source():
+    a, b = _mixed_scenario(seed=3), _mixed_scenario(seed=4)
+    assert a.schedule_doc() != b.schedule_doc()
+    rows = a.schedule()
+    assert rows == _mixed_scenario(seed=3).schedule()
+    assert [r["i"] for r in rows] == list(range(len(rows)))
+    assert all(0.0 <= r["t"] < a.duration_s for r in rows)
+    assert rows == sorted(rows, key=lambda r: r["t"])
+
+
+# ------------------------------------------------------------ length dists
+
+def test_cycle_dist_is_positional_and_draws_nothing():
+    values = [3, 5, 7]
+    cyc = Scenario(name="c", seed=1, arrival={"process": "batch",
+                                              "count": 9},
+                   prompt_len={"dist": "cycle", "values": values})
+    lens = [r["prompt_len"] for r in cyc.schedule()]
+    assert lens == [values[i % 3] for i in range(9)]
+    # cycle consumes no randomness: swapping it for fixed leaves the
+    # other draws (tenant, max_new) bit-identical
+    fix = Scenario(name="c", seed=1, arrival={"process": "batch",
+                                              "count": 9},
+                   prompt_len={"dist": "fixed", "value": 3})
+    strip = [{k: r[k] for k in ("tenant", "max_new")}
+             for r in cyc.schedule()]
+    assert strip == [{k: r[k] for k in ("tenant", "max_new")}
+                     for r in fix.schedule()]
+
+
+def test_lognormal_clamps_and_choice_stays_in_set():
+    scn = _mixed_scenario()
+    rows = scn.schedule()
+    assert rows, "expected arrivals at 5 rps over 8s"
+    assert all(2 <= r["prompt_len"] <= 32 for r in rows)
+    assert all(r["max_new"] in (2, 4, 8) for r in rows)
+    assert len({r["prompt_len"] for r in rows}) > 3  # actually heavy-tailed
+
+
+def test_unknown_dist_and_process_fail_loudly():
+    with pytest.raises(ValueError, match="arrival process"):
+        Scenario(name="x", arrival={"process": "warp"})
+    bad = Scenario(name="x", arrival={"process": "batch", "count": 2},
+                   prompt_len={"dist": "zeta", "value": 1})
+    with pytest.raises(ValueError, match="length dist"):
+        bad.schedule()
+    # an empty tenant table falls back to the single default tenant
+    assert Scenario(name="x", tenants=[]).tenants == \
+        [{"name": "default", "weight": 1.0}]
+    with pytest.raises(ValueError):
+        Scenario(name="x", tenants=[{"name": "t0", "weight": 0.0}])
+
+
+# ------------------------------------------------------- arrival processes
+
+def test_batch_arrivals_all_at_zero():
+    scn = Scenario(name="b", arrival={"process": "batch", "count": 12})
+    rows = scn.schedule()
+    assert len(rows) == 12
+    assert all(r["t"] == 0.0 and r["phase"] == "base" for r in rows)
+
+
+def test_spike_window_is_denser_and_labeled():
+    scn = spike_scenario(duration_s=9.0, rate_rps=4.0, spike_factor=10.0)
+    rows = scn.schedule()
+    spike = [r for r in rows if r["phase"] == "spike"]
+    base = [r for r in rows if r["phase"] == "base"]
+    assert spike and base
+    assert all(3.0 <= r["t"] < 6.0 for r in spike)  # the middle third
+    # 10x the rate over a third of the horizon ≫ the other two thirds
+    assert len(spike) > 2 * len(base)
+
+
+def test_diurnal_phases_and_rate_modulation():
+    scn = Scenario(name="d", seed=5, duration_s=10.0,
+                   arrival={"process": "diurnal", "rate_rps": 8.0,
+                            "period_s": 10.0, "amplitude": 0.9})
+    rows = scn.schedule()
+    phases = {r["phase"] for r in rows}
+    assert phases == {"peak", "trough"}
+    peak = sum(r["phase"] == "peak" for r in rows)
+    assert peak > (len(rows) - peak)  # sin>0 half carries more arrivals
+
+
+def test_runaway_rate_raises_instead_of_oom():
+    scn = Scenario(name="oops", duration_s=1e9,
+                   arrival={"process": "poisson", "rate_rps": 1e6})
+    with pytest.raises(ValueError, match="exceeds"):
+        scn.schedule()
+
+
+# ------------------------------------------------------------ tenant skew
+
+def test_zipf_tenants_skew_the_schedule():
+    table = zipf_tenants(4, s=1.5)
+    assert [t["name"] for t in table] == ["t0", "t1", "t2", "t3"]
+    assert table[0]["weight"] > table[1]["weight"] > table[3]["weight"]
+    scn = Scenario(name="z", seed=9,
+                   arrival={"process": "batch", "count": 400},
+                   tenants=table)
+    counts = {}
+    for r in scn.schedule():
+        counts[r["tenant"]] = counts.get(r["tenant"], 0) + 1
+    assert counts["t0"] > counts.get("t3", 0)
+    assert counts["t0"] > 400 / 4  # above the uniform share
+
+
+def test_prompt_tokens_deterministic_and_bounded():
+    scn = spike_scenario()
+    toks = scn.prompt_tokens(5, 12, vocab=64)
+    assert toks == scn.prompt_tokens(5, 12, vocab=64)
+    assert toks != scn.prompt_tokens(6, 12, vocab=64)
+    assert len(toks) == 12 and all(0 <= t < 64 for t in toks)
+
+
+# ---------------------------------------------------------- LoadGenerator
+
+class _FakeTarget:
+    """The submit/step/pending surface LoadGenerator drives; completes
+    one request per step (so the drive loop terminates)."""
+
+    def __init__(self):
+        self.reqs = []
+
+    def submit(self, prompt_ids, max_new_tokens=None, tenant=None):
+        req = types.SimpleNamespace(
+            prompt_ids=list(prompt_ids), max_new=max_new_tokens,
+            tenant=tenant, done=False, outcome=None,
+            ttft_s=0.01, tpot_s=0.002)
+        self.reqs.append(req)
+        return req
+
+    def step(self):
+        for r in self.reqs:
+            if not r.done:
+                r.done, r.outcome = True, "length"
+                return 1
+        return 0
+
+    def pending(self):
+        return sum(not r.done for r in self.reqs)
+
+
+def test_loadgen_drives_schedule_in_order_with_tenants():
+    scn = spike_scenario(duration_s=4.0, rate_rps=3.0)
+    rows = scn.schedule()
+    target = _FakeTarget()
+    gen = LoadGenerator(scn, target, vocab=64, time_scale=0.0)
+    ticks = [0]
+
+    def on_tick():
+        ticks[0] += 1
+
+    handles = gen.run(on_tick=on_tick)
+    assert len(handles) == len(rows) == len(target.reqs)
+    assert [r.tenant for r in target.reqs] == [r["tenant"] for r in rows]
+    assert [r.max_new for r in target.reqs] == [r["max_new"] for r in rows]
+    assert [len(r.prompt_ids) for r in target.reqs] == \
+        [r["prompt_len"] for r in rows]
+    assert gen.schedule_ms is not None and gen.schedule_ms >= 0.0
+    assert ticks[0] > 0  # the hook rides the drive loop
+
+    s = gen.summary()
+    assert s["scenario"] == scn.name and s["requests"] == len(rows)
+    assert s["outcomes"] == {"length": len(rows)}
+    assert s["good"] == len(rows)
+    assert set(s["per_phase"]) == {r["phase"] for r in rows}
+    assert sum(s["per_tenant"].values()) == len(rows)
+    assert s["per_phase"]["spike"]["p50_ttft_ms"] == pytest.approx(10.0)
+
+
+def test_loadgen_requires_prompt_source_and_accepts_prompt_fn():
+    scn = Scenario(name="p", arrival={"process": "batch", "count": 3})
+    with pytest.raises(ValueError, match="prompt_fn or vocab"):
+        LoadGenerator(scn, _FakeTarget())
+    target = _FakeTarget()
+    gen = LoadGenerator(scn, target, time_scale=0.0,
+                        prompt_fn=lambda row: [row["i"]] * 2)
+    gen.run()
+    assert [r.prompt_ids for r in target.reqs] == [[0, 0], [1, 1], [2, 2]]

@@ -38,10 +38,21 @@ placements land on the healthy one), and — because the shed replica's
 window then drains empty — resolve the alert and flip /healthz back to
 200, with every submitted request completing normally (zero lost).
 
+Autoscale leg (ISSUE 16): a replayable spike scenario (loadgen
+spike_scenario, saved + reloaded from disk so the drill replays the
+pinned file, not an in-memory twin) overloads two tiny-GPT replicas
+open-loop; the fleet TTFT page alert fires, the CapacityController
+scales 2 -> 4 (spawn + router.add_replica + membership lease), the alert
+resolves, and after cooldown the idle fleet drains back 4 -> 2 — every
+request finishing ok/eos/length (zero drained/error), membership leases
+tracking 2 -> 4 -> 2, and `route.requests` counting each logical request
+exactly once through the drain re-placements.
+
 Prints one JSON verdict row per check plus a summary row; exit 0 iff every
 verdict passed. Compile cache stays off (multi-device bit-equality, same
 debt as the dryrun phases). --history appends `elastic_reform_pause_ms`,
-`fleet_collect_ms`, `fleet_snapshot_age_ms` and `slo_eval_ms` rows to
+`fleet_collect_ms`, `fleet_snapshot_age_ms`, `slo_eval_ms`,
+`autoscale_recovery_s` and `loadgen_schedule_ms` rows to
 BENCH_HISTORY.jsonl for tools/bench_gate.py.
 
 Run:  JAX_PLATFORMS=cpu python tools/elastic_drill.py
@@ -277,6 +288,187 @@ def _slo_leg(verdict, work):
         obs_metrics.reset()
 
 
+def _autoscale_leg(verdict, work):
+    """Closed-loop autoscale episode (ISSUE 16): a replayable spike
+    scenario overloads a 2-replica fleet, the TTFT page alert fires, the
+    CapacityController scales 2 -> 4, the alert resolves, and after the
+    cooldown the idle fleet scales back 4 -> 2 — with every request
+    finishing normally (zero drained/error) and ``route.requests``
+    counting each logical request exactly once through the drain
+    re-placements. Self-contained like _slo_leg. Returns
+    (autoscale_recovery_s, loadgen_schedule_ms, request count).
+    """
+    import urllib.error
+    import urllib.request
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import membership
+    from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+    from paddle_tpu.distributed.store import FileStore
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+    from paddle_tpu.observability import capacity as obs_capacity
+    from paddle_tpu.observability import exporter as obs_exporter
+    from paddle_tpu.observability import metrics as obs_metrics
+    from paddle_tpu.observability import slo as obs_slo
+    from paddle_tpu.serving.engine import ServingEngine
+    from paddle_tpu.serving.loadgen import LoadGenerator, spike_scenario
+    from paddle_tpu.serving.router import ReplicaRouter
+
+    set_hybrid_communicate_group(None)
+    paddle.seed(0)
+    cfg = gpt_tiny()
+    model = GPTForPretraining(cfg)
+    model.eval()
+
+    def mk(name):
+        return ServingEngine(model, slot_count=1, ladder=(8, 16, 32),
+                             max_new_cap=4, max_seq_len=48,
+                             steps_per_dispatch=1)
+
+    store = FileStore(os.path.join(work, "autoscale_store"), timeout=20.0)
+    engines = {"r0": mk("r0"), "r1": mk("r1")}
+    router = ReplicaRouter(engines)
+
+    # the pinned scenario file round-trips through disk first: the drill
+    # runs what a replay would run, not an in-memory twin
+    scenario = spike_scenario(duration_s=5.0, rate_rps=2.0,
+                              spike_factor=10.0, max_new=3)
+    scn_path = scenario.save(os.path.join(work, "spike10x.json"))
+    from paddle_tpu.serving.loadgen import Scenario
+    scenario = Scenario.load(scn_path)
+    sched = scenario.schedule_doc()
+    verdict("autoscale_scenario_replayable",
+            sched == Scenario.load(scn_path).schedule_doc()
+            and sched == spike_scenario(
+                duration_s=5.0, rate_rps=2.0, spike_factor=10.0,
+                max_new=3).schedule_doc(),
+            events=len(scenario.schedule()), doc_bytes=len(sched))
+
+    # warm-compile the seed replicas dark — XLA stays out of the TTFT SLI
+    # and out of the metrics the controller reads
+    for i in range(4):
+        router.submit(scenario.prompt_tokens(i, 5, cfg.vocab_size),
+                      max_new_tokens=2)
+    router.run()
+
+    exp = obs_exporter.start_exporter(0)  # also enables the registry
+    alerts_path = os.path.join(work, "autoscale_alerts.jsonl")
+    cap_path = os.path.join(work, "capacity.jsonl")
+    win = [obs_slo.BurnWindow(2.0, 0.4, 2.0, "page")]
+    # fleet-level specs (no replica label): replicas the controller spawns
+    # mid-episode are covered without touching the spec set
+    specs = obs_slo.default_serving_slos(windows=win, ttft_ms=150.0)
+    slo_eng = obs_slo.install_engine(specs=specs, alerts_path=alerts_path)
+    events = []
+    slo_eng.add_hook(events.append)
+    for name, eng in engines.items():
+        eng.register_replica(store, name, lease_s=30.0)
+
+    ctl = obs_capacity.CapacityController(
+        router, spawn=mk,
+        policy=obs_capacity.CapacityPolicy(
+            min_replicas=2, max_replicas=4, cooldown_s=1.0,
+            idle_sustain_s=0.8, occupancy_low=0.35, queue_low=0.5,
+            budget_min=0.0),
+        slo_engine=slo_eng, store=store, lease_s=30.0,
+        jsonl_path=cap_path)
+    obs_capacity.install_controller(ctl)
+
+    def replica_members():
+        g = membership.current_generation(store)
+        prefix = f"__elastic__/gen{g}/replica/"
+        return sorted(k[len(prefix):] for k in store.list_keys(prefix))
+
+    fleet_sizes = [len(router.replicas)]
+    member_sizes = [len(replica_members())]
+
+    def on_tick():
+        slo_eng.tick()
+        ctl.poll()
+        n = len(router.replicas)
+        if n != fleet_sizes[-1]:
+            fleet_sizes.append(n)
+            member_sizes.append(len(replica_members()))
+
+    try:
+        gen = LoadGenerator(scenario, router, vocab=cfg.vocab_size,
+                            time_scale=0.5)
+        handles = gen.run(on_tick=on_tick)
+        # keep ticking past the traffic: the idle fleet must come back to
+        # min_replicas on its own once sustain + cooldown elapse
+        deadline = time.time() + 30.0
+        while (len(router.replicas) > 2 or ctl._retiring) \
+                and time.time() < deadline:
+            router.step()
+            on_tick()
+            time.sleep(0.01)
+        on_tick()
+
+        fired = next((e for e in events if e["state"] == "firing"), None)
+        resolved = [e for e in events if e["state"] == "resolved"]
+        verdict("autoscale_alert_fires",
+                fired is not None and fired["severity"] == "page",
+                slo=fired["slo"] if fired else None,
+                burn=round(fired["burn"], 2) if fired else None)
+        verdict("autoscale_scales_out",
+                ctl.scale_outs >= 1 and max(fleet_sizes) == 4,
+                scale_outs=ctl.scale_outs, fleet_sizes=fleet_sizes)
+        verdict("autoscale_alert_resolves",
+                bool(resolved) and not slo_eng.firing(),
+                resolves=len(resolved))
+        verdict("autoscale_scales_back",
+                ctl.scale_ins >= 1
+                and sorted(router.replicas) == ["r0", "r1"]
+                and not ctl._retiring,
+                scale_ins=ctl.scale_ins,
+                replicas=sorted(router.replicas))
+        # membership leases track the elastic replica set: 2 -> 4 -> 2
+        verdict("autoscale_membership_follows",
+                max(member_sizes) == 4
+                and replica_members() == ["r0", "r1"],
+                member_sizes=member_sizes, final=replica_members())
+        summary = gen.summary()
+        bad = {o: n for o, n in summary["outcomes"].items()
+               if o not in ("ok", "eos", "length")}
+        verdict("autoscale_zero_lost", not bad and summary["good"]
+                == len(handles), outcomes=summary["outcomes"],
+                requests=len(handles))
+        # counter audit (the satellite-5 regression, live): drain
+        # re-placements must not double-count the scale-in signal
+        reg = obs_metrics.active_registry()
+        routed_n = int(reg.counter("route.requests").value)
+        replaced_n = int(reg.counter("route.replaced").value)
+        served_n = int(reg.counter("serve.requests").value)
+        verdict("autoscale_route_counts_once",
+                routed_n == len(handles) == served_n,
+                route_requests=routed_n, serve_requests=served_n,
+                route_replaced=replaced_n, submitted=len(handles))
+        with urllib.request.urlopen(exp.url + "/capacity",
+                                    timeout=10) as resp:
+            cap_doc = json.loads(resp.read().decode())
+        with open(cap_path) as f:
+            cap_recs = [json.loads(ln) for ln in f if ln.strip()]
+        actions = [r["action"] for r in cap_recs if r["action"] != "hold"]
+        verdict("autoscale_decisions_logged",
+                cap_doc["scale_outs"] >= 1 and cap_doc["scale_ins"] >= 1
+                and "scale_out" in actions and "scale_in" in actions
+                and all("signals" in r for r in cap_recs),
+                jsonl_actions=actions, route_scale_outs=cap_doc["scale_outs"])
+        recovery_s = (resolved[-1]["ts"] - fired["ts"]
+                      if resolved and fired else None)
+        verdict("autoscale_recovery_timed",
+                recovery_s is not None and gen.schedule_ms is not None,
+                recovery_s=round(recovery_s, 3) if recovery_s else None,
+                schedule_ms=round(gen.schedule_ms, 3)
+                if gen.schedule_ms else None)
+        return recovery_s, gen.schedule_ms, len(handles)
+    finally:
+        obs_capacity.uninstall_controller()
+        obs_slo.uninstall_engine()
+        obs_exporter.stop_exporter()
+        obs_metrics.reset()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps-per-leg", type=int, default=3)
@@ -400,6 +592,10 @@ def main():
     try:
         # ---- SLO self-healing leg: fire -> shed -> resolve, zero lost ----
         slo_eval_ms, slo_spec_count = _slo_leg(verdict, work)
+
+        # ---- autoscale leg: spike -> page -> 2->4 -> resolve -> 4->2 ----
+        autoscale_recovery_s, loadgen_schedule_ms, autoscale_reqs = \
+            _autoscale_leg(verdict, work)
 
         store = FileStore(store_dir, timeout=20.0)
         coord = ElasticCoordinator(store, topology_for=topo,
@@ -578,6 +774,10 @@ def main():
             "fleet_collect_ms": round(fleet_collect_ms, 3),
             "fleet_snapshot_age_ms": round(fleet_age_ms, 1),
             "slo_eval_ms": round(slo_eval_ms, 3),
+            "autoscale_recovery_s": (round(autoscale_recovery_s, 3)
+                                     if autoscale_recovery_s else None),
+            "loadgen_schedule_ms": (round(loadgen_schedule_ms, 3)
+                                    if loadgen_schedule_ms else None),
             "committed_steps_lost": 0 if ok else None,
         }), flush=True)
         if args.history and ok:
@@ -610,6 +810,20 @@ def main():
                 "vs_baseline": None,
                 "extra": {"platform": jax.default_backend(),
                           "replicas": 2, "specs": slo_spec_count}})
+            _append_history({
+                "metric": "autoscale_recovery_s",
+                "value": round(autoscale_recovery_s, 3), "unit": "s",
+                "vs_baseline": None,
+                "extra": {"platform": jax.default_backend(),
+                          "scenario": "spike10x", "replicas_from": 2,
+                          "replicas_peak": 4}})
+            _append_history({
+                "metric": "loadgen_schedule_ms",
+                "value": round(loadgen_schedule_ms, 3), "unit": "ms",
+                "vs_baseline": None,
+                "extra": {"platform": jax.default_backend(),
+                          "scenario": "spike10x",
+                          "requests": autoscale_reqs}})
         exit_code = 0 if ok else 1
     finally:
         fl.disable()

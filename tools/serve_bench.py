@@ -71,21 +71,26 @@ def _useful_len(row, eos):
     return len(lst)
 
 
-def build_workload(rng, vocab, lengths, max_new, model, paddle):
-    """Mixed-length requests; half get an eos that greedy decoding actually
-    emits early (probed from the model), so completion lengths mix too."""
+def build_workload(rng, vocab, scenario, model, paddle):
+    """Materialize a loadgen Scenario's schedule into engine requests
+    (the scenario owns the arrival order and the length mix — one
+    implementation repo-wide); half get an eos that greedy decoding
+    actually emits early (probed from the model), so completion lengths
+    mix too."""
     work = []
-    for i, plen in enumerate(lengths):
+    for row in scenario.schedule():
+        plen, max_new = row["prompt_len"], row["max_new"]
         prompt = rng.randint(0, vocab, (plen,)).astype(np.int64)
         eos = None
-        if i % 2 == 0:
+        if row["i"] % 2 == 0:
             # probe a token greedy will emit a few steps in -> genuine early
             # EOS mid-decode (not at the first token)
             probe = model.generate(paddle.to_tensor(prompt[None]),
                                    max_new_tokens=min(4, max_new),
                                    temperature=0).numpy()[0, plen:]
             eos = int(probe[-1])
-        work.append({"prompt": prompt, "eos": eos, "max_new": max_new})
+        work.append({"prompt": prompt, "eos": eos, "max_new": max_new,
+                     "tenant": row["tenant"]})
     return work
 
 
@@ -112,7 +117,8 @@ def run_engine(model, work, slots, ladder, max_new, max_seq_len,
                         steps_per_dispatch=steps_per_dispatch)
     t0 = time.perf_counter()
     reqs = [eng.submit(w["prompt"], max_new_tokens=w["max_new"],
-                       temperature=0.0, eos_token_id=w["eos"]) for w in work]
+                       temperature=0.0, eos_token_id=w["eos"],
+                       tenant=w.get("tenant")) for w in work]
     eng.run()
     wall = time.perf_counter() - t0
     useful = sum(len(r.tokens) for r in reqs)
@@ -331,13 +337,21 @@ def main():
         run_shared_prefix(args, model, paddle, monitor, metrics)
         return
 
-    # >= 8 distinct prompt lengths spread over the ladder
+    # >= 8 distinct prompt lengths spread over the ladder, declared as a
+    # replayable loadgen scenario (batch arrivals + deterministic length
+    # cycle = the exact workload the pinned numbers were measured on)
+    from paddle_tpu.serving.loadgen import Scenario
+
     base_lengths = [3, 5, 6, 7, 9, 11, 13, 15, 18, 21, 25, 28]
-    lengths = [base_lengths[i % len(base_lengths)]
-               for i in range(args.requests)]
+    scenario = Scenario(
+        name="serve_bench_mixed", seed=args.seed,
+        arrival={"process": "batch", "count": args.requests},
+        prompt_len={"dist": "cycle", "values": base_lengths},
+        max_new={"dist": "fixed", "value": args.max_new})
+    lengths = [r["prompt_len"] for r in scenario.schedule()]
     assert len(set(lengths)) >= min(8, args.requests)
-    work = build_workload(rng, model.config.vocab_size, lengths,
-                          args.max_new, model, paddle)
+    work = build_workload(rng, model.config.vocab_size, scenario,
+                          model, paddle)
 
     def counter(name):
         rep = monitor.registry().report()

@@ -125,9 +125,22 @@ def summarize_records(recs, emit_json=True):
     routes = [r for r in recs if r.get("event") == "route"]
     health = [r for r in recs if r.get("event") == "health"]
     alerts = [r for r in recs if r.get("event") == "alert"]
+    caps = [r for r in recs if r.get("event") == "capacity"]
     recs = [r for r in recs if r.get("event") not in ("serve_request",
                                                       "serve_step", "health",
-                                                      "route", "alert")]
+                                                      "route", "alert",
+                                                      "capacity")]
+    if not recs and caps and not (serve_reqs or serve_steps or routes
+                                  or health):
+        # capacity.jsonl (plus, in one merged view, alerts.jsonl): the
+        # scaling timeline joined against the alert timeline so "alert
+        # fired -> scaled -> resolved" reads as one story
+        out = _summarize_capacity(caps, alerts, emit_json=False)
+        if alerts:
+            out["alerts"] = _summarize_alerts(alerts, emit_json=False)
+        if emit_json:
+            print(json.dumps({"summary": out}))
+        return out
     if not recs and alerts and not (serve_reqs or serve_steps or routes
                                     or health):
         return _summarize_alerts(alerts, emit_json=emit_json)
@@ -143,6 +156,9 @@ def summarize_records(recs, emit_json=True):
                                emit_json=False)
         if alerts:
             out["alerts"] = _summarize_alerts(alerts, emit_json=False)
+        if caps:
+            out["capacity"] = _summarize_capacity(caps, alerts,
+                                                  emit_json=False)
         if emit_json:
             print(json.dumps({"summary": out}))
         return out
@@ -299,6 +315,92 @@ def _summarize_alerts(alerts, emit_json=True):
         "still_firing": sorted(n for n, s in per.items()
                                if s["unresolved"]),
     }
+    if emit_json:
+        print(json.dumps({"summary": summary}))
+    return summary
+
+
+def _summarize_capacity(caps, alerts=(), emit_json=True):
+    """capacity.jsonl (observability/capacity.py decision records): the
+    scaling timeline. When alert records ride along (fleet mode, or the
+    drill's merged stream) the two are interleaved by ts into ONE table,
+    so "alert fired -> scaled out -> resolved -> scaled back" reads as a
+    single story with the controller's reaction/recovery latencies."""
+    caps = sorted(caps, key=lambda r: r.get("ts", 0))
+    alerts = sorted(alerts, key=lambda r: r.get("ts", 0))
+    merged = sorted(
+        [("capacity", r) for r in caps] + [("alert", r) for r in alerts],
+        key=lambda kr: kr[1].get("ts", 0))
+    t0 = merged[0][1].get("ts", 0)
+    # a controller polled from a drive loop logs hundreds of steady holds
+    # between actions; the table keeps only the eventful rows (actions,
+    # cooldown/flap holds, alerts) — the counts below stay complete
+    shown = [(k, r) for k, r in merged
+             if k == "alert" or r.get("action") != "hold"
+             or r.get("reason") != "steady"]
+    elided = len(merged) - len(shown)
+    rows = []
+    for kind, r in shown:
+        if kind == "capacity":
+            sig = r.get("signals", {})
+            firing = sig.get("firing") or []
+            detail = r.get("reason", "")
+            if sig:
+                detail += (f" occ={sig.get('occupancy', 0):.2f}"
+                           f" q={sig.get('queued', 0)}"
+                           f" firing={len(firing)}")
+            rows.append([f"{r.get('ts', 0) - t0:+.3f}s", kind,
+                         r.get("action"),
+                         f"{r.get('replicas')}->{r.get('target')}", detail])
+        else:
+            rows.append([f"{r.get('ts', 0) - t0:+.3f}s", kind,
+                         f"{r.get('slo')}:{r.get('state')}", "-",
+                         f"{r.get('severity') or ''} "
+                         f"burn={r.get('burn', 0):.2f}x"])
+    print("scaling timeline:")
+    _fmt_table(["t", "event", "action", "replicas", "detail"], rows)
+    if elided:
+        print(f"({elided} steady holds elided)")
+    actions = {}
+    for r in caps:
+        a = r.get("action")
+        actions[a] = actions.get(a, 0) + 1
+    counts = [r.get("replicas") for r in caps
+              if isinstance(r.get("replicas"), int)]
+    targets = [r.get("target") for r in caps
+               if isinstance(r.get("target"), int)]
+    # controller latencies vs the alert stream: fired -> first scale_out
+    # (reaction) and fired -> last resolve (recovery, the drill's pin)
+    first_fire = next((r.get("ts") for r in alerts
+                       if r.get("state") == "firing"), None)
+    first_out = next((r.get("ts") for r in caps
+                      if r.get("action") == "scale_out"), None)
+    last_resolve = next((r.get("ts") for r in reversed(alerts)
+                         if r.get("state") == "resolved"), None)
+    summary = {
+        "kind": "capacity_timeline",
+        "decisions": len(caps),
+        "span_s": round(caps[-1].get("ts", 0) - caps[0].get("ts", 0), 3),
+        "actions": actions,
+        "scale_outs": actions.get("scale_out", 0),
+        "scale_ins": actions.get("scale_in", 0),
+        "replicas_initial": counts[0] if counts else None,
+        "replicas_peak": max(targets + counts) if counts else None,
+        "replicas_final": (targets[-1] if targets else
+                           (counts[-1] if counts else None)),
+    }
+    if first_fire is not None and first_out is not None:
+        summary["reaction_s"] = round(first_out - first_fire, 3)
+    if first_fire is not None and last_resolve is not None:
+        summary["recovery_s"] = round(last_resolve - first_fire, 3)
+    line = (f"capacity: scale_outs={summary['scale_outs']} "
+            f"scale_ins={summary['scale_ins']} "
+            f"replicas {summary['replicas_initial']}"
+            f"->{summary['replicas_peak']}->{summary['replicas_final']}")
+    if "recovery_s" in summary:
+        line += (f"  reaction={summary.get('reaction_s', '-')}s "
+                 f"recovery={summary['recovery_s']}s")
+    print(line)
     if emit_json:
         print(json.dumps({"summary": summary}))
     return summary
