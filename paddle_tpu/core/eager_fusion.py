@@ -53,6 +53,13 @@ _FUSABLE_BINARY = frozenset({
     "add", "subtract", "multiply", "divide", "maximum", "minimum",
     "pow", "fmax", "fmin", "atan2", "hypot", "logaddexp",
 })
+# Segment TERMINATORS: admitted only when at least one operand is already a
+# pending chain (so the elementwise prologue and the closing contraction
+# compile as ONE composite — the decode-megakernel direction), then forced
+# immediately: a contraction's output feeds shape-changing consumers more
+# often than another fusable op, and eager forcing keeps the lazy window
+# elementwise-only.
+_FUSABLE_TERMINATOR = frozenset({"matmul"})
 MAX_CHAIN = 64
 
 _FUSED_CHAINS = _monitor.stat("dispatch.fused_chains")
@@ -164,12 +171,34 @@ def _is_float(d) -> bool:
     return r
 
 
+def _matmul_shape(sa, sb, attrs):
+    """Output shape of paddle-semantics matmul (transpose_x/transpose_y,
+    leading batch dims broadcast), or None when this call should take the
+    normal dispatch path (1-D operands keep their special-case semantics
+    out of the lazy window; shape errors surface from the real kernel)."""
+    if len(sa) < 2 or len(sb) < 2:
+        return None
+    sa, sb = list(sa), list(sb)
+    if attrs.get("transpose_x"):
+        sa[-2], sa[-1] = sa[-1], sa[-2]
+    if attrs.get("transpose_y"):
+        sb[-2], sb[-1] = sb[-1], sb[-2]
+    if sa[-1] != sb[-2]:
+        return None
+    try:
+        batch = np.broadcast_shapes(tuple(sa[:-2]), tuple(sb[:-2]))
+    except ValueError:
+        return None
+    return tuple(batch) + (sa[-2], sb[-1])
+
+
 def try_fuse(name, kernel, tensor_args, attrs, closure_vals, defaults, akey):
     """Record one whitelisted elementwise op as a pending node; returns a
     LazyTensor, or None when the call must take the normal dispatch path.
     closure_vals/defaults/akey are the frozen kernel parts the dispatch fast
     lane already computed (shared admission work, not recomputed here)."""
     n_args = len(tensor_args)
+    terminator = False
     if n_args == 1:
         # binary names arrive with one tensor arg through the op wrappers'
         # python-scalar fast path (the scalar is baked into the kernel's
@@ -178,7 +207,9 @@ def try_fuse(name, kernel, tensor_args, attrs, closure_vals, defaults, akey):
             return None
     elif n_args == 2:
         if name not in _FUSABLE_BINARY:
-            return None
+            if name not in _FUSABLE_TERMINATOR:
+                return None
+            terminator = True
     else:
         return None
     code = kernel.__code__  # fast lane guarantees a python kernel
@@ -208,7 +239,15 @@ def try_fuse(name, kernel, tensor_args, attrs, closure_vals, defaults, akey):
             return None  # mixed dtypes: promotion rules stay on the slow path
         shapes.append(shp)
 
-    if len(shapes) == 1 or shapes[0] == shapes[1]:
+    if terminator:
+        # only worth recording when it actually closes a pending chain —
+        # a standalone contraction gains nothing from the lazy detour
+        if not any(isinstance(i, _Node) for i in inputs):
+            return None
+        out_shape = _matmul_shape(shapes[0], shapes[1], attrs)
+        if out_shape is None:
+            return None
+    elif len(shapes) == 1 or shapes[0] == shapes[1]:
         out_shape = tuple(shapes[0])
     else:
         try:
@@ -222,7 +261,7 @@ def try_fuse(name, kernel, tensor_args, attrs, closure_vals, defaults, akey):
         if isinstance(inp, _Node):
             inp.consumers += 1
     t = _lazy_tensor(new)
-    if size >= MAX_CHAIN:
+    if terminator or size >= MAX_CHAIN:
         _force(new)
     return t
 

@@ -64,7 +64,8 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, prompt_ids, max_new_tokens, temperature, top_k, top_p,
-                 eos_token_id, seed, trace_ctx=None, tenant=None):
+                 eos_token_id, seed, trace_ctx=None, tenant=None,
+                 speculate_k=0):
         import numpy as np
 
         self.id = next(Request._ids)
@@ -84,6 +85,14 @@ class Request:
         self.eos_token_id = (int(eos_token_id) if eos_token_id is not None
                              else None)
         self.seed = int(seed)
+        # speculative decoding opt-in: > 0 asks the engine to draft this
+        # many tokens per verify window (snapped up to the engine's
+        # spec_ladder rung; requires a draft model). Proposed/accepted/
+        # bonus accumulate across the request's verify dispatches.
+        self.speculate_k = int(speculate_k)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_bonus = 0
         self.tokens: List[int] = []      # generated tokens (incl. eos if hit)
         self.prefix_hit = False          # paged: >= 1 page matched the trie
         self.shared_tokens = 0           # paged: prompt tokens served from
@@ -175,13 +184,34 @@ class ServingEngine:
                  sink=None, kv_layout: str = "contiguous",
                  kv_page_tokens: Optional[int] = None,
                  kv_num_pages: Optional[int] = None,
-                 kv_cache_dtype: Optional[str] = None):
+                 kv_cache_dtype: Optional[str] = None,
+                 draft_model=None, spec_ladder: Sequence[int] = (4,)):
         import jax.numpy as jnp
         import numpy as np
 
         cfg = model.config
         self.model = model
         model.eval()
+        # speculative decoding (opt-in per request via submit(speculate_k=)):
+        # a small draft GPT proposes k tokens, one shape-stable verify
+        # dispatch scores all k+1 positions through the target. The draft
+        # shares the target's tokenizer space — vocab agreement is a hard
+        # precondition of token-level acceptance.
+        self.draft_model = draft_model
+        if draft_model is not None:
+            draft_model.eval()
+            if draft_model.config.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.config.vocab_size} != target "
+                    f"vocab {cfg.vocab_size}: speculative acceptance "
+                    "compares token ids, the vocabularies must agree")
+            self.spec_ladder = tuple(sorted(int(k) for k in spec_ladder))
+            if not self.spec_ladder or min(self.spec_ladder) < 1:
+                raise ValueError(
+                    f"spec_ladder must be non-empty positive rungs, got "
+                    f"{spec_ladder!r}")
+        else:
+            self.spec_ladder = ()
         self.slot_count = int(slot_count)
         if self.slot_count < 1:
             raise ValueError(f"slot_count must be >= 1, got {slot_count}")
@@ -269,6 +299,21 @@ class ServingEngine:
             self._vcs = [jnp.zeros((S, T, nh, hd), self._cache_dtype)
                          for _ in range(cfg.num_layers)]
 
+        # draft KV cache: always slot-contiguous (draft rows rewind by
+        # offset alone — rejected rows go stale-but-inert under the causal
+        # mask, so the draft never needs page bookkeeping even when the
+        # target cache is paged)
+        if draft_model is not None:
+            dcfg = draft_model.config
+            dnh = dcfg.num_heads
+            dhd = dcfg.hidden_size // dcfg.num_heads
+            self._dkcs = [jnp.zeros((S, T, dnh, dhd), self._cache_dtype)
+                          for _ in range(dcfg.num_layers)]
+            self._dvcs = [jnp.zeros((S, T, dnh, dhd), self._cache_dtype)
+                          for _ in range(dcfg.num_layers)]
+        else:
+            self._dkcs = self._dvcs = None
+
         # host-side per-slot state (tiny arrays, re-staged every step)
         self._offsets = np.zeros(S, np.int32)
         self._last_tok = np.zeros(S, np.int32)
@@ -279,9 +324,19 @@ class ServingEngine:
         self._eos = np.full(S, _NO_EOS, np.int32)
         self._remaining = np.zeros(S, np.int32)
         self._seeds = np.zeros(S, np.int32)
+        # per-slot speculative window rung (0 = plain decode for this slot);
+        # mixed spec/non-spec slots share one verify dispatch — non-spec
+        # rows run it as a 1-wide window, emitting exactly the decode token
+        self._spec_k = np.zeros(S, np.int32)
         self._slot_req: List[Optional[Request]] = [None] * S
 
         self._prefill_fns: Dict[int, Any] = {}
+        # draft prefill executables, one per PROMPT bucket (the draft cache
+        # never shares pages, so even paged prefix hits draft-prefill the
+        # whole prompt); verify executables keyed by (family, k-rung) — the
+        # spec ladder bounds compile count exactly like the prompt ladder
+        self._draft_prefill_fns: Dict[int, Any] = {}
+        self._verify_fns: Dict[Any, Any] = {}
         # decode executables keyed by sampling FAMILY only ("greedy" skips
         # the sort/cumsum sampling machinery entirely; "sample" carries all
         # sampling params as traced per-slot vectors) — never by prompt
@@ -309,29 +364,50 @@ class ServingEngine:
         self._cache_dtype = (mm_dtype if mm_dtype is not None
                              else self.model.gpt.wte.weight._data.dtype)
         w_dtype = _autocast_dtype_for("matmul", ())
-        if w_dtype is not None:
-            params = {k: (v.astype(w_dtype)
-                          if v.ndim >= 2 and jnp.issubdtype(
-                              v.dtype, jnp.floating) else v)
-                      for k, v in params.items()}
-        self._params = params
+
+        def _cast(params):
+            if w_dtype is None:
+                return params
+            return {k: (v.astype(w_dtype)
+                        if v.ndim >= 2 and jnp.issubdtype(
+                            v.dtype, jnp.floating) else v)
+                    for k, v in params.items()}
+
+        self._params = _cast(params)
+        if getattr(self, "draft_model", None) is not None:
+            dstate = self.draft_model.state_dict(
+                include_non_persistable_buffer=True)
+            self._dparams = _cast({k: v._data for k, v in dstate.items()})
+        else:
+            self._dparams = None
 
     # ------------------------------------------------------------- public
     def submit(self, prompt_ids, max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                eos_token_id=None, seed: int = 0, trace_ctx=None,
-               tenant=None) -> Request:
+               tenant=None, speculate_k: int = 0) -> Request:
         """Enqueue a request; returns the live Request handle (tokens fill
         in as the engine runs). max_new_tokens is clamped to the engine cap
         and to the cache room left after the prompt's bucket. trace_ctx
         (fleet.TraceContext) threads a fleet request id + parent span
-        through every span this request records."""
+        through every span this request records. speculate_k > 0 opts this
+        request into speculative decoding (snapped up to the engine's
+        spec_ladder rung; needs a draft model)."""
         if self._draining:
             raise RuntimeError(
                 "ServingEngine is draining (SIGTERM/begin_drain): admission "
                 "is closed; submit to a live replica")
+        if speculate_k:
+            if speculate_k < 0:
+                raise ValueError(
+                    f"speculate_k must be >= 0, got {speculate_k}")
+            if self.draft_model is None:
+                raise ValueError(
+                    "speculate_k > 0 needs a draft model: construct the "
+                    "engine with draft_model=")
         req = Request(prompt_ids, max_new_tokens, temperature, top_k, top_p,
-                      eos_token_id, seed, trace_ctx=trace_ctx, tenant=tenant)
+                      eos_token_id, seed, trace_ctx=trace_ctx, tenant=tenant,
+                      speculate_k=speculate_k)
         plen = len(req.prompt_ids)
         req.bucket = bucket_for(plen, self.ladder)  # raises if oversize
         room = self.max_seq_len - req.bucket
@@ -353,7 +429,7 @@ class ServingEngine:
         slots after the step (0 = fully drained)."""
         self._admit()
         if self._active.any():
-            self._decode_step()
+            self._advance_step()
         return int(self._active.sum())
 
     def run(self, max_steps: Optional[int] = None) -> List[Request]:
@@ -425,7 +501,7 @@ class ServingEngine:
                     if req is not None and req.done_ts is None:
                         self._finish(req, outcome="drained")
                 break
-            self._decode_step()
+            self._advance_step()
         drain_ms = (time.perf_counter() - t0) * 1000.0
         mreg = _obs_metrics.active_registry()
         if mreg is not None:
@@ -469,6 +545,12 @@ class ServingEngine:
             "kv_layout": self.kv_layout,
             "kv_cache_bytes": self.kv_cache_bytes(),
         }
+        if self.draft_model is not None:
+            out.update({
+                "spec_ladder": self.spec_ladder,
+                "verify_executables": len(self._verify_fns),
+                "draft_prefill_executables": len(self._draft_prefill_fns),
+            })
         if self.kv_layout == "paged":
             out.update({
                 "page_tokens": self.page_tokens,
@@ -622,6 +704,16 @@ class ServingEngine:
         with _swapped_state(self.model, params), _tracing(), no_grad():
             return self.model._head_logits(Tensor(h_arr))._data
 
+    def _draft_head_traced(self, dparams, h_arr):
+        """Draft-model hidden -> logits with weights from traced params."""
+        from ..core.autograd import no_grad
+        from ..core.tensor import Tensor
+        from ..jit import _swapped_state, _tracing
+
+        with _swapped_state(self.draft_model, dparams), _tracing(), \
+                no_grad():
+            return self.draft_model._head_logits(Tensor(h_arr))._data
+
     # ---- prefill -------------------------------------------------------
     def _build_prefill(self, bucket: int):
         import jax
@@ -717,6 +809,83 @@ class ServingEngine:
 
         return jax.jit(prefill, donate_argnums=(1,))
 
+    # ---- speculative decoding: draft prefill ---------------------------
+    def _build_draft_prefill(self, bucket: int):
+        """Draft-model prompt prefill, one executable per prompt rung.
+        Writes the draft K/V for positions 0..plen-1 into the slot's row
+        of the (always contiguous) draft cache — no sampling, no logits:
+        the draft's first proposal comes out of the verify program's scan.
+        Right-pad junk past plen is inert: every padded position is
+        rewritten by a later draft scan step before any query attends it,
+        the same argument the target prefill pad relies on."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..jit import functional_call
+
+        dcfg = self.draft_model.config
+        nh = dcfg.num_heads
+        hd = dcfg.hidden_size // dcfg.num_heads
+        cache_dtype = self._cache_dtype
+        dgpt = self.draft_model.gpt
+
+        def prefill(dparams, dkcs, dvcs, ids, slot):
+            dgpt_params = {k[len("gpt."):]: v for k, v in dparams.items()
+                           if k.startswith("gpt.")}
+            caches = [(Tensor(jnp.zeros((1, bucket, nh, hd), cache_dtype)),
+                       Tensor(jnp.zeros((1, bucket, nh, hd), cache_dtype)),
+                       Tensor(jnp.int32(0))) for _ in range(dcfg.num_layers)]
+            _h, caches = functional_call(dgpt, dgpt_params, Tensor(ids),
+                                         caches=caches)
+            new_kcs, new_vcs = [], []
+            start = (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0))
+            for big_k, big_v, layer in zip(dkcs, dvcs, caches):
+                new_kcs.append(jax.lax.dynamic_update_slice(
+                    big_k, layer[0]._data.astype(big_k.dtype), start))
+                new_vcs.append(jax.lax.dynamic_update_slice(
+                    big_v, layer[1]._data.astype(big_v.dtype), start))
+            return new_kcs, new_vcs
+
+        return jax.jit(prefill, donate_argnums=(1, 2))
+
+    def _seat_spec(self, req: Request, slot: int) -> None:
+        """Per-seat speculative setup, called at every seating site (slot
+        reuse must clear a predecessor's rung). Spec requests snap their
+        speculate_k UP to the nearest ladder rung and get a draft-model
+        prompt prefill; for paged full-hit replay seats the draft still
+        prefills the whole prompt (the draft cache is contiguous and has
+        no prefix sharing — position plen-1's verify-scan rewrite is a
+        same-value overwrite)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..core import monitor
+
+        if req.speculate_k <= 0 or self.draft_model is None:
+            self._spec_k[slot] = 0
+            return
+        rung = self.spec_ladder[-1]
+        for r in self.spec_ladder:
+            if r >= req.speculate_k:
+                rung = r
+                break
+        self._spec_k[slot] = rung
+        bucket = req.bucket
+        plen = len(req.prompt_ids)
+        fn = self._draft_prefill_fns.get(bucket)
+        if fn is None:
+            fn = self._draft_prefill_fns[bucket] = \
+                self._build_draft_prefill(bucket)
+        padded = np.zeros((1, bucket), np.int64)
+        padded[0, :plen] = req.prompt_ids
+        call_args = (self._dparams, self._dkcs, self._dvcs,
+                     jnp.asarray(padded), jnp.int32(slot))
+        self._stash_exec(f"serve.dprefill_b{bucket}", fn, call_args)
+        monitor.stat("serving.draft_prefill_dispatches").increase()
+        self._dkcs, self._dvcs = fn(*call_args)
+        self._note_exec_compiles(fn, "serving.draft_prefill_compiles")
+
     def _admit(self) -> None:
         import jax.numpy as jnp
         import numpy as np
@@ -799,6 +968,7 @@ class ServingEngine:
             self._remaining[slot] = req.max_new_tokens - 1
             self._seeds[slot] = req.seed
             self._slot_req[slot] = req
+            self._seat_spec(req, slot)
 
     # ---- paged admission ----------------------------------------------
     def _pages_reserved_inflight(self) -> int:
@@ -908,6 +1078,7 @@ class ServingEngine:
             self._remaining[slot] = req.max_new_tokens
             self._seeds[slot] = req.seed
             self._slot_req[slot] = req
+            self._seat_spec(req, slot)
             return True
 
         # partial hit / miss: allocate the prompt's unshared pages and
@@ -986,6 +1157,7 @@ class ServingEngine:
         self._remaining[slot] = req.max_new_tokens - 1
         self._seeds[slot] = req.seed
         self._slot_req[slot] = req
+        self._seat_spec(req, slot)
         return True
 
     # ---- decode --------------------------------------------------------
@@ -1145,6 +1317,518 @@ class ServingEngine:
                     page = self._pool.alloc()
                     self._tables[i, pi] = page
                     self._slot_pages[i].append(page)
+
+    # ---- speculative decoding: verify ----------------------------------
+    def _spec_commit(self, jax, jnp, logits, dlogits_sk, props, off, tok,
+                     active, n_draft, temps, top_k, top_p, eos, remaining,
+                     seeds, k, greedy_only):
+        """Acceptance + commit math shared by both verify layouts (runs
+        inside the jitted verify program).
+
+        logits [S, k+1, V] are the target's window scores: column j was
+        computed from the token at position off+j, so it predicts the
+        token at position off+j+1. Greedy: accept the longest prefix where
+        the draft agrees with the target argmax; the emitted row IS the
+        target argmax row, so greedy speculative output is bit-identical
+        to sequential greedy decode. Sampled: standard leftover-
+        distribution speculative sampling — accept d_i when
+        u_i < p_t(d_i)/p_d(d_i) (u_i from the ACCEPT_SALT stream), resample
+        a rejection column from normalize(max(p_t - p_d, 0)). The bonus /
+        rejection column draws with the PLAIN request_key stream, so a
+        fully-accepted window's bonus token — and every n_draft==0 row —
+        emits the exact token a sequential decode step would have."""
+        from .sampling import (ACCEPT_SALT, filtered_probs, request_key,
+                               residual_sample, sample_tokens, spec_key)
+
+        S = logits.shape[0]
+        T = self.max_seq_len
+        cols = jnp.arange(k + 1, dtype=jnp.int32)[None, :]       # [1, k+1]
+        colk = jnp.arange(k, dtype=jnp.int32)[None, :]           # [1, k]
+        in_window = colk < n_draft[:, None]                      # [S, k]
+        tgt_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if greedy_only:
+            accept = (tgt_greedy[:, :k] == props) & in_window
+            a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                        axis=1)                                  # [S]
+            emit = tgt_greedy
+        else:
+            V = logits.shape[-1]
+            t_rep = jnp.repeat(temps, k)
+            k_rep = jnp.repeat(top_k, k)
+            p_rep = jnp.repeat(top_p, k)
+            p_t = filtered_probs(logits[:, :k].reshape((S * k, V)),
+                                 t_rep, k_rep, p_rep).reshape((S, k, V))
+            p_d = filtered_probs(dlogits_sk.reshape((S * k, V)),
+                                 t_rep, k_rep, p_rep).reshape((S, k, V))
+            pt_d = jnp.take_along_axis(p_t, props[..., None],
+                                       axis=-1)[..., 0]          # [S, k]
+            pd_d = jnp.take_along_axis(p_d, props[..., None],
+                                       axis=-1)[..., 0]
+            positions = (off[:, None] + 1 + colk).reshape(-1)    # [S*k]
+            akeys = jax.vmap(spec_key, in_axes=(0, 0, None))(
+                jnp.repeat(seeds, k), positions, ACCEPT_SALT)
+            u = jax.vmap(jax.random.uniform)(akeys).reshape((S, k))
+            ratio = pt_d / jnp.maximum(pd_d, 1e-38)
+            exact = tgt_greedy[:, :k] == props
+            accept = (jnp.where(temps[:, None] == 0.0, exact, u < ratio)
+                      & in_window)
+            a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                        axis=1)
+            # column a's replacement token: greedy rows take the target
+            # argmax; full-accept (and n_draft==0) rows sample the plain
+            # per-position stream — the exact sequential draw — and
+            # rejections take the residual distribution
+            greedy_fix = jnp.take_along_axis(tgt_greedy, a[:, None],
+                                             axis=1)[:, 0]
+            La = jnp.take_along_axis(logits, a[:, None, None],
+                                     axis=1)[:, 0]               # [S, V]
+            rkeys = jax.vmap(request_key)(seeds, off + 1 + a)
+            bonus_tok = sample_tokens(La, rkeys, temps, top_k, top_p)
+            a_k = jnp.clip(a, 0, k - 1)
+            pt_a = jnp.take_along_axis(p_t, a_k[:, None, None],
+                                       axis=1)[:, 0]
+            pd_a = jnp.take_along_axis(p_d, a_k[:, None, None],
+                                       axis=1)[:, 0]
+            resampled = residual_sample(rkeys, pt_a, pd_a)
+            final_tok = jnp.where(
+                temps == 0.0, greedy_fix,
+                jnp.where(a >= n_draft, bonus_tok, resampled))
+            props_pad = jnp.concatenate([props, props[:, -1:]], axis=1)
+            emit = jnp.where(cols < a[:, None], props_pad,
+                             final_tok[:, None])
+        # commit: cut at the first emitted EOS, then the token budget —
+        # the same order a sequential decode would stop in
+        m_raw = a + 1
+        is_eos = ((eos[:, None] != _NO_EOS) & (emit == eos[:, None])
+                  & (cols < m_raw[:, None]))
+        any_eos = jnp.any(is_eos, axis=1)
+        m = jnp.where(any_eos, jnp.argmax(is_eos, axis=1) + 1, m_raw)
+        m = jnp.minimum(m, remaining) * active.astype(jnp.int32)
+        new_off = off + m
+        last_emit = jnp.take_along_axis(
+            emit, jnp.clip(m - 1, 0, k)[:, None], axis=1)[:, 0]
+        new_tok = jnp.where(active, last_emit, tok)
+        new_remaining = remaining - m
+        hit_eos = active & (eos != _NO_EOS) & (new_tok == eos)
+        new_active = (active & ~hit_eos & (new_remaining > 0)
+                      & (new_off < T))
+        return (new_off, new_tok, new_active, new_remaining, emit, m, a,
+                hit_eos)
+
+    def _build_verify(self, family: str, k: int):
+        """Contiguous-layout verify program, one executable per (sampling
+        family, ladder rung k): a draft scan proposes k tokens, then
+        ONE [S, k+1] window forward through the target scores every
+        proposal plus the bonus position, and the commit math accepts the
+        longest agreeing prefix. Rejected rows need no cache surgery —
+        the offset rewind leaves them as inert stale rows (causal masking
+        hides them, and they are rewritten before any query attends them,
+        the same argument decode's idle-row tip writes rely on)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..jit import functional_call
+        from .sampling import DRAFT_SALT, sample_tokens, spec_key
+
+        gpt = self.model.gpt
+        dgpt = self.draft_model.gpt
+        greedy_only = family == "greedy"
+
+        def verify(params, dparams, kcs, vcs, dkcs, dvcs, off, tok, active,
+                   n_draft, temps, top_k, top_p, eos, remaining, seeds):
+            gpt_params = {n[len("gpt."):]: v for n, v in params.items()
+                          if n.startswith("gpt.")}
+            dgpt_params = {n[len("gpt."):]: v for n, v in dparams.items()
+                           if n.startswith("gpt.")}
+
+            def dstep(carry, i):
+                dkcs, dvcs, cur = carry
+                caches = [(Tensor(kc), Tensor(vc), Tensor(off + i))
+                          for kc, vc in zip(dkcs, dvcs)]
+                h, caches = functional_call(
+                    dgpt, dgpt_params,
+                    Tensor(cur[:, None].astype(jnp.int64)), caches=caches)
+                dlogits = self._draft_head_traced(dparams, h._data[:, 0])
+                if greedy_only:
+                    d = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+                    out = d
+                else:
+                    keys = jax.vmap(spec_key, in_axes=(0, 0, None))(
+                        seeds, off + i + 1, DRAFT_SALT)
+                    d = sample_tokens(dlogits, keys, temps, top_k, top_p)
+                    out = (d, dlogits)
+                return ([c[0]._data for c in caches],
+                        [c[1]._data for c in caches], d), out
+
+            # k+1 steps, last proposal discarded: the extra step feeds d_k
+            # so the draft cache stays dense through position off+k — a
+            # fully-accepted window advances the frontier past off+k, and
+            # a hole there would poison every later window's draft
+            # attention (accept-rate collapse, not a correctness bug)
+            (dkcs, dvcs, _), outs = jax.lax.scan(
+                dstep, (dkcs, dvcs, tok),
+                jnp.arange(k + 1, dtype=jnp.int32))
+            if greedy_only:
+                props = outs.T[:, :k]                            # [S, k]
+                dlogits_sk = None
+            else:
+                props = outs[0].T[:, :k]
+                dlogits_sk = jnp.moveaxis(outs[1], 0, 1)[:, :k]  # [S, k, V]
+
+            win = jnp.concatenate([tok[:, None], props], axis=1)
+            caches = [(Tensor(kc), Tensor(vc), Tensor(off))
+                      for kc, vc in zip(kcs, vcs)]
+            h, caches = functional_call(gpt, gpt_params,
+                                        Tensor(win.astype(jnp.int64)),
+                                        caches=caches)
+            S = win.shape[0]
+            logits = self._head_traced(
+                params, h._data.reshape((S * (k + 1), -1))
+            ).reshape((S, k + 1, -1))
+            kcs = [c[0]._data for c in caches]
+            vcs = [c[1]._data for c in caches]
+            (new_off, new_tok, new_active, new_remaining, emit, m, a,
+             hit_eos) = self._spec_commit(
+                jax, jnp, logits, dlogits_sk, props, off, tok, active,
+                n_draft, temps, top_k, top_p, eos, remaining, seeds, k,
+                greedy_only)
+            return (kcs, vcs, dkcs, dvcs, new_off, new_tok, new_active,
+                    new_remaining, emit, m, a, hit_eos)
+
+        return jax.jit(verify, donate_argnums=(2, 3, 4, 5))
+
+    def _build_verify_paged(self, family: str, k: int):
+        """Paged-layout verify: target K/V flows through the donated pool
+        state with a 2-D [S, k+1] write mask — columns past a row's
+        n_draft have no pages allocated and redirect to the scratch page,
+        and a prefix-replay row's column 0 (position plen-1, living in a
+        SHARED page) takes the same scratch redirect the decode replay
+        seat uses. The draft cache stays contiguous. Rollback beyond the
+        accepted frontier is host-side page-table truncation."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+        from ..jit import functional_call
+        from . import kv_pages as _kvp
+        from .sampling import DRAFT_SALT, sample_tokens, spec_key
+
+        gpt = self.model.gpt
+        dgpt = self.draft_model.gpt
+        greedy_only = family == "greedy"
+        pt = self.page_tokens
+        quant = self._kv_quantized
+        compute_dtype = self._cache_dtype
+
+        def verify(params, dparams, state, dkcs, dvcs, off, tok, active,
+                   replay, n_draft, temps, top_k, top_p, eos, remaining,
+                   seeds):
+            gpt_params = {n[len("gpt."):]: v for n, v in params.items()
+                          if n.startswith("gpt.")}
+            dgpt_params = {n[len("gpt."):]: v for n, v in dparams.items()
+                           if n.startswith("gpt.")}
+            tables = state["tables"]
+
+            def dstep(carry, i):
+                dkcs, dvcs, cur = carry
+                caches = [(Tensor(kc), Tensor(vc), Tensor(off + i))
+                          for kc, vc in zip(dkcs, dvcs)]
+                h, caches = functional_call(
+                    dgpt, dgpt_params,
+                    Tensor(cur[:, None].astype(jnp.int64)), caches=caches)
+                dlogits = self._draft_head_traced(dparams, h._data[:, 0])
+                if greedy_only:
+                    d = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+                    out = d
+                else:
+                    keys = jax.vmap(spec_key, in_axes=(0, 0, None))(
+                        seeds, off + i + 1, DRAFT_SALT)
+                    d = sample_tokens(dlogits, keys, temps, top_k, top_p)
+                    out = (d, dlogits)
+                return ([c[0]._data for c in caches],
+                        [c[1]._data for c in caches], d), out
+
+            # k+1 steps, last proposal discarded — keeps the draft cache
+            # dense through off+k (see the contiguous builder)
+            (dkcs, dvcs, _), outs = jax.lax.scan(
+                dstep, (dkcs, dvcs, tok),
+                jnp.arange(k + 1, dtype=jnp.int32))
+            if greedy_only:
+                props = outs.T[:, :k]
+                dlogits_sk = None
+            else:
+                props = outs[0].T[:, :k]
+                dlogits_sk = jnp.moveaxis(outs[1], 0, 1)[:, :k]
+
+            win = jnp.concatenate([tok[:, None], props], axis=1)
+            cols = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+            wmask = (active[:, None] & (cols <= n_draft[:, None])
+                     & ~(replay[:, None] & (cols == 0)))
+            st = {"k": state["k"], "v": state["v"], "ks": state["ks"],
+                  "vs": state["vs"]}
+            caches = _kvp.layer_views(st, tables, off, wmask, pt,
+                                      compute_dtype)
+            h, caches = functional_call(gpt, gpt_params,
+                                        Tensor(win.astype(jnp.int64)),
+                                        caches=caches)
+            S = win.shape[0]
+            logits = self._head_traced(
+                params, h._data.reshape((S * (k + 1), -1))
+            ).reshape((S, k + 1, -1))
+            new_state = {
+                "k": [c.k_pool for c in caches],
+                "v": [c.v_pool for c in caches],
+                "ks": [c.k_scale for c in caches] if quant else [],
+                "vs": [c.v_scale for c in caches] if quant else [],
+                "tables": tables,
+            }
+            (new_off, new_tok, new_active, new_remaining, emit, m, a,
+             hit_eos) = self._spec_commit(
+                jax, jnp, logits, dlogits_sk, props, off, tok, active,
+                n_draft, temps, top_k, top_p, eos, remaining, seeds, k,
+                greedy_only)
+            new_replay = replay & ~active
+            return (new_state, dkcs, dvcs, new_off, new_tok, new_active,
+                    new_replay, new_remaining, emit, m, a, hit_eos)
+
+        return jax.jit(verify, donate_argnums=(2, 3, 4))
+
+    def _spec_dispatch_rung(self) -> int:
+        """Window rung for the next dispatch: the max ladder rung among
+        active speculating slots, or 0 when the dispatch must fall back to
+        plain decode. Contiguous layout falls back while any active slot
+        sits on the last cache row — the window's unmasked per-row writes
+        would collapse onto row T-1 and corrupt the position the bonus
+        column reads (bounded: only the final token of a max-length
+        sequence takes the slow path)."""
+        import numpy as np
+
+        if self.draft_model is None or not self._active.any():
+            return 0
+        rungs = self._spec_k[self._active]
+        if not rungs.any():
+            return 0
+        if (self.kv_layout != "paged"
+                and int(self._offsets[self._active].max())
+                >= self.max_seq_len - 1):
+            return 0
+        return int(rungs.max())
+
+    def _advance_step(self) -> None:
+        """One generation dispatch: the speculative verify program when
+        any active slot opted in (non-spec slots ride along with a zero
+        draft window and emit bit-identically to decode), plain decode
+        otherwise."""
+        k = self._spec_dispatch_rung()
+        if k:
+            self._verify_step(k)
+        else:
+            self._decode_step()
+
+    def _prealloc_verify_pages(self, n_draft) -> None:
+        """Paged pre-verify: cover every position the window may write —
+        off..off+n_draft per active slot (a replay slot's column 0 is
+        scratch-redirected, so its coverage starts at off+1). n_draft is
+        clamped to remaining-1 on the host, so this never exceeds the
+        admission reservation (end = off + remaining)."""
+        import numpy as np
+
+        from . import kv_pages as _kvp
+
+        pt = self.page_tokens
+        for i in np.nonzero(self._active)[0]:
+            first = int(self._offsets[i]) + (1 if self._replay[i] else 0)
+            last = min(int(self._offsets[i]) + int(n_draft[i]),
+                       self.max_seq_len - 1)
+            for pi in range(first // pt, last // pt + 1):
+                if self._tables[i, pi] == 0:
+                    if not self._prefix.ensure_free(1):
+                        raise _kvp.PoolExhausted(
+                            f"verify needs a page for slot {i} and none is "
+                            "free or evictable (reservation accounting "
+                            "violated)")
+                    page = self._pool.alloc()
+                    self._tables[i, pi] = page
+                    self._slot_pages[i].append(page)
+
+    def _verify_step(self, k: int) -> None:
+        """Host driver for one speculative verify dispatch: draft scan +
+        [S, k+1] target window + accept/commit on device, then per-slot
+        token append, paged page-table truncation past the accepted
+        frontier, and spec telemetry."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..core import monitor
+        from . import kv_pages as _kvp
+
+        family = ("greedy"
+                  if not self._temps[self._active].any() else "sample")
+        paged = self.kv_layout == "paged"
+        fn = self._verify_fns.get((family, k))
+        if fn is None:
+            fn = self._verify_fns[(family, k)] = (
+                self._build_verify_paged(family, k) if paged
+                else self._build_verify(family, k))
+        # per-slot draft window: the request's rung, clamped so the window
+        # never outruns the token budget (keeps paged writes inside the
+        # admission reservation) or the cache end, and zero on non-spec
+        # rows — which then emit exactly one sequentially-sampled token
+        n_draft = np.minimum(self._spec_k,
+                             np.maximum(self._remaining - 1, 0))
+        n_draft = np.minimum(
+            n_draft, np.maximum(self.max_seq_len - 2 - self._offsets, 0))
+        n_draft = np.where(self._active, n_draft, 0).astype(np.int32)
+        if paged:
+            self._prealloc_verify_pages(n_draft)
+            state = dict(self._pool_state,
+                         tables=jnp.asarray(self._tables))
+            call_args = (self._params, self._dparams, state, self._dkcs,
+                         self._dvcs, jnp.asarray(self._offsets),
+                         jnp.asarray(self._last_tok),
+                         jnp.asarray(self._active),
+                         jnp.asarray(self._replay), jnp.asarray(n_draft),
+                         jnp.asarray(self._temps), jnp.asarray(self._topk),
+                         jnp.asarray(self._topp), jnp.asarray(self._eos),
+                         jnp.asarray(self._remaining),
+                         jnp.asarray(self._seeds))
+            self._stash_exec(f"serve.verify_{family}_k{k}", fn, call_args,
+                             donate=(2, 3, 4))
+        else:
+            call_args = (self._params, self._dparams, self._kcs, self._vcs,
+                         self._dkcs, self._dvcs,
+                         jnp.asarray(self._offsets),
+                         jnp.asarray(self._last_tok),
+                         jnp.asarray(self._active), jnp.asarray(n_draft),
+                         jnp.asarray(self._temps), jnp.asarray(self._topk),
+                         jnp.asarray(self._topp), jnp.asarray(self._eos),
+                         jnp.asarray(self._remaining),
+                         jnp.asarray(self._seeds))
+            self._stash_exec(f"serve.verify_{family}_k{k}", fn, call_args,
+                             donate=(2, 3, 4, 5))
+        active_before = self._active.copy()
+        t0 = time.perf_counter()
+        try:
+            if paged:
+                (self._pool_state, self._dkcs, self._dvcs, off, tok, active,
+                 replay, remaining, emit, m, a, hits) = fn(*call_args)
+                self._replay = np.array(replay)
+            else:
+                (self._kcs, self._vcs, self._dkcs, self._dvcs, off, tok,
+                 active, remaining, emit, m, a, hits) = fn(*call_args)
+            self._note_exec_compiles(fn, "serving.verify_compiles")
+            self._offsets = np.array(off)
+            self._last_tok = np.array(tok)
+            self._active = np.array(active)
+            self._remaining = np.array(remaining)
+            emit = np.asarray(emit)                 # [S, k+1]
+            m = np.asarray(m)
+            a = np.asarray(a)
+            hits = np.asarray(hits)
+        except Exception as e:
+            fr = _obs_flight.get()
+            if fr is not None:
+                fr.dump("serve_verify_exception",
+                        {"step": self._steps, "family": family, "k": k,
+                         "error": repr(e)})
+            for slot in np.nonzero(self._active)[0]:
+                req = self._slot_req[slot]
+                if req is not None and req.done_ts is None:
+                    self._finish(req, outcome="error")
+            raise
+        t1 = time.perf_counter()
+        tr = _obs_tracer.get_tracer()
+        if tr.enabled:
+            tr.record_complete("serve.verify_step", t0, t1,
+                               {"step": self._steps, "family": family,
+                                "k": k})
+        self._steps += 1
+        now = time.perf_counter()
+        mreg = _obs_metrics.active_registry()
+        emitted = proposed = accepted = bonus = 0
+        for slot in np.nonzero(active_before)[0]:
+            req = self._slot_req[slot]
+            ms = int(m[slot])
+            for j in range(ms):
+                req.tokens.append(int(emit[slot, j]))
+            emitted += ms
+            if req.first_token_ts is None:   # prefix-replay first token
+                req.first_token_ts = now
+            nd = int(n_draft[slot])
+            acc = int(min(ms, int(a[slot])))
+            bn = int(ms > int(a[slot]))
+            req.spec_proposed += nd
+            req.spec_accepted += acc
+            req.spec_bonus += bn
+            proposed += nd
+            accepted += acc
+            bonus += bn
+            if nd and mreg is not None:
+                mreg.histogram("spec.accept_rate",
+                               boundaries=_OCCUPANCY_BUCKETS).observe(
+                    acc / nd)
+            if paged:
+                # rollback: any page whose positions lie wholly past the
+                # accepted frontier was only touched by rejected draft
+                # rows — truncate it out of the table and free it (always
+                # slot-private: shared prompt pages sit below the frontier)
+                _kvp.truncate_row(
+                    self._tables, self._slot_pages[slot],
+                    self._prefix.release, slot,
+                    int(self._offsets[slot]) // self.page_tokens + 1)
+            if not self._active[slot]:
+                req.finish_reason = "eos" if hits[slot] else "length"
+                self._slot_req[slot] = None
+                if paged:
+                    self._release_slot(slot)
+                self._finish(req, now)
+        self._count_tokens(emitted)
+        monitor.stat("serving.steps").increase()
+        monitor.stat("serving.verify_dispatches").increase()
+        monitor.stat("serving.spec.proposed").increase(proposed)
+        monitor.stat("serving.spec.accepted").increase(accepted)
+        monitor.stat("serving.spec.bonus").increase(bonus)
+        occupancy = float(active_before.mean())
+        if mreg is not None:
+            mreg.counter("serve.spec.proposed").inc(proposed)
+            mreg.counter("serve.spec.accepted").inc(accepted)
+            mreg.counter("serve.spec.bonus").inc(bonus)
+            mreg.histogram("serve.decode_step_ms").observe((t1 - t0) * 1e3)
+            mreg.histogram("serve.occupancy",
+                           boundaries=_OCCUPANCY_BUCKETS).observe(occupancy)
+            mreg.gauge("serve.queue_depth").set(len(self._queue))
+            mreg.gauge("serve.active_slots").set(int(self._active.sum()))
+            if paged:
+                mreg.gauge("serve.pages_in_use").set(self._pool.in_use)
+                mreg.gauge("serve.pages_cached").set(self._pool.cached)
+                mreg.gauge("serve.prefix_hit_rate").set(
+                    self._prefix.hit_rate)
+        fr = _obs_flight.get()
+        if self.sink is not None or fr is not None:
+            rec = {
+                "event": "serve_step", "step": self._steps,
+                "ts": time.time(),
+                # one target forward per verify dispatch — trace_summary
+                # derives dispatches-per-token from this field
+                "steps_per_dispatch": 1,
+                "active_slots": int(active_before.sum()),
+                "slot_count": self.slot_count,
+                "occupancy": round(occupancy, 4),
+                "queue_depth": len(self._queue),
+                "tokens": emitted,
+                "spec": True, "spec_window": k,
+                "spec_proposed": proposed, "spec_accepted": accepted,
+                "spec_bonus": bonus,
+            }
+            if paged:
+                rec["pages_in_use"] = self._pool.in_use
+                rec["pages_cached"] = self._pool.cached
+                rec["prefix_hit_rate"] = round(self._prefix.hit_rate, 4)
+            if self.sink is not None:
+                self.sink.write(rec)
+            if fr is not None:
+                fr.record(rec)
 
     def _decode_step(self) -> None:
         import jax.numpy as jnp
@@ -1352,6 +2036,11 @@ class ServingEngine:
                 "prefix_hit": req.prefix_hit,
                 "shared_tokens": req.shared_tokens,
             }
+            if req.speculate_k:
+                rec["spec_k"] = req.speculate_k
+                rec["spec_proposed"] = req.spec_proposed
+                rec["spec_accepted"] = req.spec_accepted
+                rec["spec_bonus"] = req.spec_bonus
             if req.tenant is not None:
                 rec["tenant"] = req.tenant
             if req.trace_ctx is not None:

@@ -258,6 +258,35 @@ def update_and_read(cache: PagedLayerCache, k, v):
     return kc, vc, new_cache
 
 
+def truncate_row(tables, slot_pages: List[int], release, slot: int,
+                 keep_pages: int) -> int:
+    """Speculative-decode rollback for a paged slot: drop the page-table
+    entries past ``keep_pages`` and return their pages to the pool.
+
+    After a verify window is partially rejected the slot's offset rewinds
+    to the accepted frontier; pages past ``keep_pages`` (the page holding
+    the next write position) hold only rejected rows. They are always
+    slot-private — shared prefix pages and trie-published prompt pages all
+    sit at indices below ``new_off // page_tokens`` because generation
+    positions start at the prompt length — so releasing them through the
+    prefix cache frees them outright (no trie node, refcount hits zero).
+
+    tables: host [slots, max_pages] int32; slot_pages: the slot's owned/
+    shared page list (mutated); release: RadixPrefixCache.release.
+    Returns the number of pages freed.
+    """
+    freed = 0
+    for pi in range(keep_pages, tables.shape[1]):
+        page = int(tables[slot, pi])
+        if page == ZERO_PAGE:
+            continue
+        tables[slot, pi] = ZERO_PAGE
+        slot_pages.remove(page)
+        release(page)
+        freed += 1
+    return freed
+
+
 def make_pool_state(num_layers: int, num_pages: int, page_tokens: int,
                     num_heads: int, head_dim: int, slots: int,
                     max_pages: int, store_dtype, quantized: bool) -> Dict:

@@ -70,3 +70,43 @@ def request_key(seed, position, base=None):
     if base is None:
         base = jax.random.key(0)
     return jax.random.fold_in(jax.random.fold_in(base, seed), position)
+
+
+# Speculative-decode stream salts: the draft proposal and the acceptance
+# uniform for position p must each draw from streams DISJOINT from the
+# request_key(seed, p) stream — the residual/bonus sample at p reuses the
+# plain stream so a fully-accepted window emits the exact token sequential
+# decode would have sampled there.
+DRAFT_SALT = 0x5BEC
+ACCEPT_SALT = 0xACCE
+
+
+def spec_key(seed, position, salt):
+    """request_key folded one level deeper — the draft-proposal and
+    acceptance-uniform streams of speculative decoding."""
+    return jax.random.fold_in(request_key(seed, position), salt)
+
+
+def filtered_probs(logits, temperature, top_k, top_p):
+    """Per-row post-filter sampling distribution [n, V] — softmax over the
+    temperature-scaled, top-k/top-p-masked logits. This is the p(token)
+    both sides of the speculative acceptance test u < p_t(d)/p_d(d) must
+    agree on (filtering applied to target and draft identically, or the
+    leftover-distribution correction loses its exactness)."""
+    logits = jnp.asarray(logits, jnp.float32)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    return jax.nn.softmax(filter_topk_topp(scaled, top_k, top_p), axis=-1)
+
+
+def residual_sample(keys, p_target, p_draft):
+    """Leftover-distribution sample after a rejected draft token: one draw
+    per row from normalize(max(p_t - p_d, 0)) (Leviathan et al. speculative
+    sampling). Rows where the residual has zero mass (p_t == p_d exactly —
+    unreachable in exact arithmetic because the acceptance ratio is then 1)
+    fall back to p_t. Returns int32 [n]."""
+    res = jnp.maximum(p_target - p_draft, 0.0)
+    mass = jnp.sum(res, axis=-1, keepdims=True)
+    res = jnp.where(mass > 0.0, res, p_target)
+    logp = jnp.log(jnp.maximum(res, 1e-38))
+    return jax.vmap(jax.random.categorical)(keys, logp).astype(jnp.int32)

@@ -167,11 +167,11 @@ def test_fusion_nonfusable_consumer_forces_chain():
     _fusion(True)
     try:
         s = paddle.tanh(x)
-        m = paddle.matmul(s, s)          # not fusable: forces s transparently
+        m = paddle.sum(s)                # not fusable: forces s transparently
     finally:
         _fusion(False)
-    t = np.tanh(x.numpy())
-    np.testing.assert_allclose(m.numpy(), t @ t, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(m.numpy(), np.tanh(x.numpy()).sum(),
+                               rtol=1e-4, atol=1e-6)
 
 
 def test_fusion_chain_cap_bounds_graph():
@@ -247,3 +247,60 @@ def test_fusion_scale_op_attrs():
         _fusion(False)
     np.testing.assert_allclose(got, np.arange(4, dtype=np.float32) * 6 + 1,
                                rtol=1e-6)
+
+
+# ---- matmul terminator ----
+
+def test_fusion_matmul_terminator_bit_identical():
+    """A matmul closing an elementwise prologue compiles as ONE composite,
+    and the result must be bit-identical to the unfused path — fusion is a
+    dispatch optimization, never a numerics change."""
+    rng = np.random.RandomState(7)
+    x = paddle.to_tensor(rng.randn(16, 32).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(32, 8).astype(np.float32))
+
+    def chain():
+        return paddle.matmul(paddle.tanh(x) * 0.5 + 0.25, w)
+
+    ref = chain().numpy()                # fusion off: op-by-op
+    _fusion(True)
+    try:
+        c0 = ef._FUSED_CHAINS.get()
+        got = chain().numpy()
+        # prologue + terminating contraction forced as one segment
+        assert ef._FUSED_CHAINS.get() == c0 + 1
+    finally:
+        _fusion(False)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_fusion_matmul_transpose_variants_keyed_separately():
+    """transpose_x/transpose_y ride in the node key (via the frozen attr
+    key), so composite cache hits can never cross transpose variants."""
+    a = paddle.to_tensor(np.random.RandomState(8)
+                         .randn(8, 8).astype(np.float32))
+    ref_plain = paddle.matmul(paddle.tanh(a), a).numpy()
+    ref_trans = paddle.matmul(paddle.tanh(a), a, transpose_y=True).numpy()
+    _fusion(True)
+    try:
+        plain = paddle.matmul(paddle.tanh(a), a).numpy()
+        trans = paddle.matmul(paddle.tanh(a), a, transpose_y=True).numpy()
+    finally:
+        _fusion(False)
+    np.testing.assert_array_equal(plain, ref_plain)
+    np.testing.assert_array_equal(trans, ref_trans)
+
+
+def test_fusion_standalone_matmul_skips_lazy_detour():
+    """A matmul with no pending operand gains nothing from the lazy window
+    and must take the normal dispatch path untouched."""
+    a = paddle.to_tensor(np.ones((4, 4), np.float32))
+    _fusion(True)
+    try:
+        c0 = ef._FUSED_CHAINS.get()
+        out = paddle.matmul(a, a)
+        assert type(out) is Tensor       # not deferred, not recorded
+        assert ef._FUSED_CHAINS.get() == c0
+    finally:
+        _fusion(False)
+    np.testing.assert_allclose(out.numpy(), np.full((4, 4), 4, np.float32))

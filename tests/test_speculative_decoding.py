@@ -1,0 +1,241 @@
+"""Draft-model speculative decoding (ISSUE 17 tentpole): k-token draft
+propose + ONE shape-stable [slots, k+1] target verify dispatch + KV
+rollback of rejected rows.
+
+The contracts that must never drift:
+- numerics: greedy speculative output is token-identical to legacy
+  generate() — acceptance only moves WHICH dispatch scores a position,
+  never what it scores — across mixed spec/non-spec slot populations,
+  both KV layouts (incl. paged replay seats), and EOS inside the verify
+  window;
+- shape stability: verify executables are bounded by the spec ladder x
+  sampling families, never by request count or acceptance history;
+- contracts: the verify executables donate both models' caches and stay
+  host-transfer-free (analyze() green with default contracts).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor
+from paddle_tpu.models import GPTForPretraining, gpt_tiny
+from paddle_tpu.serving import ServingEngine
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.distributed.mesh import set_hybrid_communicate_group
+
+    set_hybrid_communicate_group(None)
+    paddle.seed(0)
+    m = GPTForPretraining(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def draft(model):
+    paddle.seed(1)                       # different weights, same vocab
+    d = GPTForPretraining(gpt_tiny())
+    d.eval()
+    return d
+
+
+def _counter(name):
+    return monitor.registry().report().get(name, {}).get("value", 0)
+
+
+def _legacy_greedy(model, prompt, n_new, eos=None):
+    out = model.generate(paddle.to_tensor(prompt[None]),
+                         max_new_tokens=n_new, temperature=0,
+                         eos_token_id=eos).numpy()[0]
+    return out
+
+
+def _spec_engine(model, draft_model, **kw):
+    kw.setdefault("slot_count", 3)
+    kw.setdefault("ladder", (8, 16, 32))
+    kw.setdefault("max_new_cap", 16)
+    kw.setdefault("steps_per_dispatch", 4)
+    kw.setdefault("spec_ladder", (4,))
+    return ServingEngine(model, draft_model=draft_model, **kw)
+
+
+# ---------------------------------------------------------------- numerics
+def test_spec_greedy_matches_legacy_generate(model, draft):
+    """Acceptance: greedy speculative output token-identical to legacy
+    generate(), with spec and non-spec requests sharing the same verify
+    dispatches (non-spec rows ride with an empty window)."""
+    rng = np.random.RandomState(0)
+    eng = _spec_engine(model, draft)
+    prompts = [rng.randint(0, 1024, (n,)).astype(np.int64)
+               for n in (5, 7, 9, 12, 3, 17)]
+    v0 = _counter("serving.verify_dispatches")
+    p0 = _counter("serving.spec.proposed")
+    reqs = [eng.submit(p, max_new_tokens=8, temperature=0.0,
+                       speculate_k=4 if i % 2 == 0 else 0)
+            for i, p in enumerate(prompts)]
+    eng.run()
+    for p, r in zip(prompts, reqs):
+        assert r.done and r.finish_reason == "length"
+        np.testing.assert_array_equal(r.output_ids(),
+                                      _legacy_greedy(model, p, 8))
+    assert _counter("serving.verify_dispatches") > v0
+    assert _counter("serving.spec.proposed") > p0
+    assert (_counter("serving.spec.accepted")
+            <= _counter("serving.spec.proposed"))
+
+
+def test_spec_self_draft_reduces_target_forwards(model):
+    """draft == target is the training-free oracle: every in-window
+    proposal agrees, so the request finishes in strictly fewer target
+    forwards than emitted tokens (the whole point of the optimisation)."""
+    rng = np.random.RandomState(1)
+    eng = _spec_engine(model, model)
+    p = rng.randint(0, 1024, (6,)).astype(np.int64)
+    s0 = _counter("serving.steps")
+    r = eng.submit(p, max_new_tokens=12, temperature=0.0, speculate_k=4)
+    eng.run()
+    forwards = _counter("serving.steps") - s0
+    np.testing.assert_array_equal(r.output_ids(),
+                                  _legacy_greedy(model, p, 12))
+    assert forwards < len(r.tokens)
+    assert r.spec_proposed > 0
+    assert r.spec_accepted == r.spec_proposed  # oracle: nothing rejected
+
+
+def test_spec_eos_inside_verify_window(model):
+    """EOS emitted mid-window must cut the accepted prefix exactly there:
+    same tokens and finish_reason as sequential greedy with the same eos."""
+    rng = np.random.RandomState(2)
+    p = rng.randint(0, 1024, (6,)).astype(np.int64)
+    gen = _legacy_greedy(model, p, 10)[len(p):]  # unconstrained stream
+    eos = int(gen[2])                            # fires mid-decode
+    cut = int(np.where(gen == eos)[0][0]) + 1
+    eng = _spec_engine(model, model)
+    r = eng.submit(p, max_new_tokens=10, temperature=0.0,
+                   eos_token_id=eos, speculate_k=4)
+    eng.run()
+    assert r.finish_reason == "eos"
+    assert r.tokens[-1] == eos
+    assert len(r.tokens) == cut < 10
+    np.testing.assert_array_equal(r.tokens, gen[:cut])
+
+
+def test_spec_paged_matches_dense_including_replay_seat(model, draft):
+    """Paged spec decode (page-table rollback) is token-identical to the
+    contiguous engine (offset rewind), including a full-prefix-hit replay
+    seat where the draft cache is rebuilt without a target prefill."""
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, 1024, (16,)).astype(np.int64)  # 2 full pages
+    others = [rng.randint(0, 1024, (n,)).astype(np.int64) for n in (5, 11)]
+
+    def run(paged):
+        kw = dict(slot_count=3, ladder=(8, 16, 32), max_new_cap=8,
+                  max_seq_len=48, steps_per_dispatch=4,
+                  draft_model=draft, spec_ladder=(4,))
+        if paged:
+            eng = ServingEngine(model, kv_layout="paged",
+                                kv_page_tokens=8, **kw)
+        else:
+            eng = ServingEngine(model, **kw)
+        outs = []
+        for _ in range(2):  # pass 2 re-submits: paged replays the prefix
+            reqs = [eng.submit(prompt, max_new_tokens=5, temperature=0.0,
+                               speculate_k=4)]
+            reqs += [eng.submit(o, max_new_tokens=5, temperature=0.0)
+                     for o in others]
+            eng.run()
+            outs.append([list(r.output_ids()) for r in reqs])
+        if paged:
+            assert eng.stats()["prefix"]["full_hits"] >= 1
+        return outs
+
+    paged_outs = run(True)
+    assert paged_outs == run(False)
+    np.testing.assert_array_equal(paged_outs[0][0],
+                                  _legacy_greedy(model, prompt, 5))
+
+
+def test_nonspec_sampled_rows_unchanged_by_spec_neighbors(model, draft):
+    """A sampled NON-spec request seated next to a speculative one must be
+    bit-identical to the same request in a plain engine: sampling keys on
+    (seed, position), and a non-spec row's verify column 0 reuses the
+    exact sequential-decode RNG stream."""
+    rng = np.random.RandomState(7)
+    p = rng.randint(0, 1024, (6,)).astype(np.int64)
+    other = rng.randint(0, 1024, (9,)).astype(np.int64)
+
+    plain = ServingEngine(model, slot_count=2, ladder=(8, 16),
+                          max_new_cap=16, steps_per_dispatch=4)
+    solo = plain.submit(p, max_new_tokens=8, temperature=0.8, top_k=50,
+                        top_p=0.9, seed=7)
+    plain.run()
+
+    eng = _spec_engine(model, draft, slot_count=2, ladder=(8, 16))
+    spec_n = eng.submit(other, max_new_tokens=8, temperature=0.0,
+                        speculate_k=4)
+    crowd = eng.submit(p, max_new_tokens=8, temperature=0.8, top_k=50,
+                       top_p=0.9, seed=7)
+    eng.run()
+    assert crowd.tokens == solo.tokens
+    np.testing.assert_array_equal(spec_n.output_ids(),
+                                  _legacy_greedy(model, other, 8))
+
+
+# ---------------------------------------------------------- shape stability
+def test_spec_compile_count_bounded_by_ladder_and_families(model, draft):
+    """Verify executables <= sampling families (2) x spec ladder rungs —
+    never a function of request count, window history, or acceptance."""
+    rng = np.random.RandomState(5)
+    eng = _spec_engine(model, draft, spec_ladder=(2, 4))
+    for i in range(6):
+        p = rng.randint(0, 1024, (4 + 3 * i,)).astype(np.int64)
+        eng.submit(p, max_new_tokens=6,
+                   temperature=0.0 if i % 2 else 0.8,
+                   top_k=0 if i % 2 else 50, seed=100 + i,
+                   speculate_k=2 if i % 3 == 0 else 4)
+    eng.run()
+    st = eng.stats()
+    assert st["verify_executables"] <= 2 * len(eng.spec_ladder)
+    assert st["draft_prefill_executables"] <= len(eng.ladder)
+    assert st["spec_ladder"] == (2, 4)
+
+
+# ---------------------------------------------------------------- contracts
+def test_spec_executables_lint_clean(model, draft):
+    """HLO gate: verify programs donate BOTH models' caches (steady-state
+    holds one copy of each) and make zero host transfers."""
+    rng = np.random.RandomState(6)
+    eng = _spec_engine(model, draft)
+    eng.submit(rng.randint(0, 1024, (5,)).astype(np.int64),
+               max_new_tokens=6, temperature=0.0, speculate_k=4)
+    eng.run()
+    rep = eng.analyze()
+    assert rep.ok, rep.format()
+    assert any(lbl.startswith("serve.verify_") for lbl in rep.checked)
+    assert any(lbl.startswith("serve.dprefill_b") for lbl in rep.checked)
+
+
+# ---------------------------------------------------------------- validation
+def test_spec_draft_vocab_mismatch_raises(model):
+    from paddle_tpu.models.gpt import GPTConfig
+
+    paddle.seed(2)
+    bad = GPTForPretraining(GPTConfig(vocab_size=512, hidden_size=128,
+                                      num_layers=2, num_heads=4,
+                                      max_seq_len=128))
+    bad.eval()
+    with pytest.raises(ValueError, match="vocab"):
+        ServingEngine(model, draft_model=bad, spec_ladder=(4,))
+
+
+def test_submit_speculate_without_draft_raises(model):
+    eng = ServingEngine(model, slot_count=2, ladder=(8,), max_new_cap=4)
+    with pytest.raises(ValueError, match="draft"):
+        eng.submit(np.arange(5, dtype=np.int64), speculate_k=4)
+
+
+def test_spec_bad_ladder_raises(model, draft):
+    with pytest.raises(ValueError, match="spec_ladder"):
+        ServingEngine(model, draft_model=draft, spec_ladder=())

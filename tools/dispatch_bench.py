@@ -16,6 +16,8 @@ Workloads (all through public paddle_tpu ops):
                 targets (dispatch overhead dominates per-op execution)
   small_chain   scalar_chain on a [16] vector — pure dispatch cost
   grad_chain    y = tanh(y) with autograd recording (tape + vjp wiring)
+  matmul_chain  elementwise prologue closed by a matmul — the fusion
+                TERMINATOR path (prologue + contraction = one composite)
 
 Prints one JSON line per (mode, workload) with ops_per_sec, then a summary
 with the fast/legacy and fusion/legacy speedups. Run on CPU:
@@ -76,8 +78,21 @@ def _workloads(paddle, np):
         y.numpy()
         return n
 
+    w64 = paddle.to_tensor(np.random.RandomState(5)
+                           .randn(64, 64).astype(np.float32))
+    x64 = paddle.to_tensor(np.random.RandomState(6)
+                           .randn(64, 64).astype(np.float32))
+
+    def matmul_chain(n):
+        y = x64
+        for _ in range(n):
+            y = paddle.matmul(paddle.tanh(y) * 0.1, w64)
+        y.numpy()
+        return 3 * n
+
     return [("unary_chain", unary_chain), ("scalar_chain", scalar_chain),
-            ("small_chain", small_chain), ("grad_chain", grad_chain)]
+            ("small_chain", small_chain), ("grad_chain", grad_chain),
+            ("matmul_chain", matmul_chain)]
 
 
 def main():
@@ -122,7 +137,8 @@ def main():
     import jax
 
     summary = {"platform": jax.default_backend(), "n_ops": args.n}
-    for wname in ("unary_chain", "scalar_chain", "small_chain", "grad_chain"):
+    for wname in ("unary_chain", "scalar_chain", "small_chain", "grad_chain",
+                  "matmul_chain"):
         leg = results.get(("legacy", wname))
         if not leg:
             continue

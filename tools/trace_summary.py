@@ -416,12 +416,17 @@ def _summarize_serve(serve_reqs, serve_steps, routes=(), emit_json=True):
         return [r[k] * scale for r in recs
                 if isinstance(r.get(k), (int, float))]
 
+    # per-request speculative acceptance rate (requests that proposed at
+    # least one draft token — spec fields ride on serve_request records)
+    accept_rates = [r["spec_accepted"] / r["spec_proposed"]
+                    for r in serve_reqs if r.get("spec_proposed")]
     pcts = _pctl_table([
         ("ttft", "ms", col(serve_reqs, "ttft_s", 1e3)),
         ("tpot", "ms", col(serve_reqs, "tpot_s", 1e3)),
         ("queue_wait", "ms", col(serve_reqs, "queue_wait_s", 1e3)),
         ("request_wall", "ms", col(serve_reqs, "wall_s", 1e3)),
         ("occupancy", "frac", col(serve_steps, "occupancy")),
+        ("spec_accept_rate", "frac", accept_rates),
         ("pages_in_use", "pages", col(serve_steps, "pages_in_use")),
         ("route_queue_depth", "n", col(routes, "queue_depth")),
     ])
@@ -444,6 +449,33 @@ def _summarize_serve(serve_reqs, serve_steps, routes=(), emit_json=True):
     if outcomes:
         print("outcomes: " + "  ".join(f"{k}={v}" for k, v in
                                        sorted(outcomes.items())))
+    # speculative-decoding rollup: serve_step rows carry per-dispatch
+    # proposed/accepted/bonus; steps_per_dispatch is the target forwards a
+    # dispatch cost (1 for a verify window), so forwards / decode tokens
+    # is the dispatches-per-token the spec bench pins below 1.0
+    spec_steps = [r for r in serve_steps if r.get("spec")]
+    if spec_steps or accept_rates:
+        proposed = sum(r.get("spec_proposed", 0) for r in spec_steps)
+        accepted = sum(r.get("spec_accepted", 0) for r in spec_steps)
+        bonus = sum(r.get("spec_bonus", 0) for r in spec_steps)
+        forwards = sum(r.get("steps_per_dispatch", 1) for r in serve_steps)
+        step_toks = sum(r.get("tokens", 0) for r in serve_steps)
+        dpt = forwards / step_toks if step_toks else None
+        summary["spec"] = {
+            "verify_dispatches": len(spec_steps),
+            "proposed": proposed, "accepted": accepted, "bonus": bonus,
+            "accept_rate": (round(accepted / proposed, 4)
+                            if proposed else None),
+            "target_forwards": forwards,
+            "dispatches_per_token": (round(dpt, 4)
+                                     if dpt is not None else None),
+        }
+        print(f"speculative: verify_dispatches={len(spec_steps)} "
+              f"proposed={proposed} accepted={accepted} bonus={bonus} "
+              f"accept_rate={summary['spec']['accept_rate']}")
+        if dpt is not None:
+            print(f"target dispatches per decoded token: {dpt:.3f} "
+                  f"({forwards} forwards / {step_toks} tokens)")
     # paged-KV gauges ride on serve_step records (engine.py emits them only
     # on the paged layout); report the final sample — the steady state
     hit_rates = col(serve_steps, "prefix_hit_rate")
