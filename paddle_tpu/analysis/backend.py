@@ -102,3 +102,40 @@ def native_bf16_collective_reason() -> Optional[str]:
 
 def backend_keeps_bf16_on_wire() -> bool:
     return native_bf16_collective_reason() is None
+
+
+def aot_serving_reason(device_count: Optional[int] = None,
+                       platform: Optional[str] = None) -> Optional[str]:
+    """None when AOT serving precompilation is safe on this backend, else
+    the human-readable skip reason.
+
+    Cache-SERVED multi-device executables are nondeterministic on this
+    jax/XLA CPU (the collective-result leak core.compile_cache documents),
+    and the AOT warm-start bundle exists precisely to serve executables
+    from the persistent store — so a multi-device CPU serving mesh must
+    fall back to lazy compilation rather than risk replica divergence.
+    Single-device (any platform) and TPU/GPU meshes precompile freely.
+
+    ``device_count``/``platform`` are injectable for tests; the live values
+    come from jax at call time (NOT lru-cached: serving meshes reform)."""
+    if device_count is None or platform is None:
+        import jax
+
+        devs = jax.devices()
+        if device_count is None:
+            device_count = len(devs)
+        if platform is None:
+            platform = jax.default_backend()
+    if device_count <= 1:
+        return None
+    if platform == "cpu":
+        return (f"multi-device XLA cpu mesh ({device_count} devices): "
+                f"cache-served executables are nondeterministic on this "
+                f"jax — AOT bundle serving needs a single-device or "
+                f"TPU/GPU mesh")
+    return None
+
+
+def backend_supports_aot_serving(device_count: Optional[int] = None,
+                                 platform: Optional[str] = None) -> bool:
+    return aot_serving_reason(device_count, platform) is None

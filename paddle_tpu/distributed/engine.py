@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core import compile_cache as _compile_cache
 from ..core import flags as _flags
 from ..core import monitor as _monitor
+from ..core.exec_registry import ExecutableRegistry
 from ..core import random as random_mod
 from ..core.tensor import Tensor
 from ..jit import functional_call
@@ -47,12 +48,11 @@ from . import prefetcher as _pf
 from .mesh import HybridCommunicateGroup, get_hybrid_communicate_group
 
 # jit-path observability (core.monitor registry): every compile of a step
-# program is counted and its dispatch wall time accumulated; a compile on a
-# step function that ALREADY had an executable is a recompile — the
-# shape/dtype-churn alarm the reference surfaces via its cache-miss logs.
-_JIT_COMPILES = _monitor.stat("engine.jit_compiles")
-_JIT_RECOMPILES = _monitor.stat("engine.jit_recompiles")
-_JIT_COMPILE_MS = _monitor.stat("engine.jit_compile_ms")
+# program is counted (engine.jit_compiles / jit_recompiles / jit_compile_ms,
+# now driven through ExecutableRegistry.note_compiles with
+# engine_counters=True); a compile on a step function that ALREADY had an
+# executable is a recompile — the shape/dtype-churn alarm the reference
+# surfaces via its cache-miss logs.
 _NAN_LOSS_STEPS = _monitor.stat("engine.nan_loss_steps")
 
 
@@ -61,25 +61,6 @@ def _jit_cache_size(fn) -> int:
         return fn._cache_size()
     except Exception:
         return -1
-
-
-def _note_compile(n_before: int, n_after: int, wall_s: float,
-                  persistent_before: int = -1) -> bool:
-    """Update compile counters from a jitted fn's executable-cache growth
-    across one dispatch; returns whether this dispatch compiled. With the
-    persistent compilation cache on, the compile is also classified
-    cold/warm (engine.compile_cold / engine.compile_warm + _ms): a compile
-    that wrote no new serialized entry was deserialized from the store."""
-    if n_before < 0 or n_after <= n_before:
-        return False
-    _JIT_COMPILES.increase()
-    ms = int(wall_s * 1000)
-    _JIT_COMPILE_MS.increase(ms)
-    if n_before > 0:
-        _JIT_RECOMPILES.increase()
-    _compile_cache.note_compile(ms, persistent_before,
-                                _compile_cache.entries())
-    return True
 
 
 def _divides(n, d):
@@ -200,13 +181,18 @@ class TrainStepEngine:
             self.opt_state[n] = tuple(
                 jax.device_put(s, self._opt_sharding(spec)) for s in st)
 
-        self._step_fn = None
+        # ONE keyed ExecutableRegistry replaces the step/accum/scan fn
+        # caches (keys ("train.step",), ("train.accum",)+config,
+        # ("train.run_steps", fixed)); unbounded — the train working set is
+        # a handful of pinned executables per topology. The legacy
+        # attribute views (_step_fn, _accum_fns, _exec_stash) stay as
+        # properties over it.
+        self._execs = ExecutableRegistry(name="train")
         # microbatch gradient accumulation (distributed/grad_comm.py): K
         # splits the global batch inside ONE compiled program — one dispatch
         # and one deferred fused gradient all-reduce per optimizer step.
         # Mutable until the first accumulated step; fns cached per config.
         self.microbatches = max(1, int(microbatches))
-        self._accum_fns = {}
         self._grad_residual = None     # error-feedback state, lazily built
         self._gspmd_warned = False
         # ZeRO weight-update sharding (grad_comm.make_zero_accum_step):
@@ -220,8 +206,7 @@ class TrainStepEngine:
         self._batch_shardings = None   # resolved lazily from the first batch
         self._pending_h2d = None       # (h2d_ms, depth) staged by prefetch()
         self.prefetcher = None         # last DevicePrefetcher built by prefetch()
-        self._scan_fns = {True: None, False: None}  # fixed_batch -> jitted scan
-        self._scan_batch_shardings = {}
+        self._scan_batch_shardings = {}  # fixed_batch -> shardings
         self._step_count = optimizer._step_count
         self._key = jax.random.key(random_mod.default_generator().initial_seed() or 0)
         self.last_loss = None
@@ -240,9 +225,6 @@ class TrainStepEngine:
         # default) keeps the step program byte-identical to pre-health builds
         self._health = _obs_health.from_env_or_flags(
             {n: tuple(self._state_refs[n].shape) for n in self._param_names})
-        # label -> (jitted fn, abstract args): what introspect_executables()
-        # AOT-lowers for memory/cost analysis without holding live buffers
-        self._exec_stash = {}
         # FLAGS_ckpt_dir / PADDLE_TPU_CKPT_DIR: elastic checkpointing
         # (distributed/elastic.py) — async crash-safe snapshots every
         # FLAGS_ckpt_interval steps. None (the default) costs one flag read
@@ -346,12 +328,42 @@ class TrainStepEngine:
             self._ckpt.close()
         self._ckpt = None
 
+    # ---- legacy executable-cache views over the ExecutableRegistry ------
+    @property
+    def _step_fn(self):
+        entry = self._execs.entry_for(("train.step",))
+        return entry.fn if entry is not None else None
+
+    @_step_fn.setter
+    def _step_fn(self, fn) -> None:
+        if fn is None:
+            self._execs.discard("train.step")
+        else:
+            self._execs.put(("train.step",), fn, label="train.step",
+                            pin=True)
+
+    @property
+    def _accum_fns(self):
+        """Legacy view: {(k, dtype, use_residual, chunk, health_on, zero):
+        fn} — the config tuple is the registry key minus its program id."""
+        return {e.key[1:]: e.fn for e in self._execs.entries()
+                if e.key[0] == "train.accum"}
+
+    @property
+    def _exec_stash(self):
+        """label -> (jitted fn, abstract args), owned by the registry."""
+        return self._execs.stash_map()
+
+    def exec_registry(self) -> ExecutableRegistry:
+        """This engine's ExecutableRegistry (step/accum/scan executables)."""
+        return self._execs
+
     def _invalidate_step_fns(self) -> None:
         """Drop cached step executables + their introspection stash — the
         next step() recompiles with the new output signature."""
-        self._step_fn = None
-        self._accum_fns = {}
-        self._exec_stash = {}
+        self._execs.discard("train.step")
+        self._execs.discard("train.accum")
+        self._execs.clear_stash()
 
     def reform_mesh(self, new_hcg: HybridCommunicateGroup) -> None:
         """Live in-memory mesh reformation (elastic autoscaling).
@@ -464,7 +476,7 @@ class TrainStepEngine:
         self.opt_state = new_opt_state
         self._zero_opt = new_zero
         self._invalidate_step_fns()
-        self._scan_fns = {True: None, False: None}
+        self._execs.discard("train.run_steps")
         self._scan_batch_shardings = {}
         self._batch_shardings = None
         # error-feedback residual is per-replica accumulator state tied to
@@ -484,9 +496,6 @@ class TrainStepEngine:
         auto-capture now when FLAGS_exec_introspect is on. Abstract
         ShapeDtypeStructs replace the arrays (no live-buffer retention);
         PRNG keys stay concrete (extended dtypes don't round-trip avals)."""
-        if label in self._exec_stash:
-            return
-
         def aval(a):
             try:
                 if jax.dtypes.issubdtype(a.dtype, jax.dtypes.prng_key):
@@ -499,13 +508,7 @@ class TrainStepEngine:
                                         weak_type=getattr(a, "weak_type",
                                                           False))
 
-        avals = jax.tree_util.tree_map(aval, call_args)
-        self._exec_stash[label] = (fn, avals)
-        if _flags.flag("exec_introspect"):
-            try:
-                _obs_exec.capture_jit(label, fn, avals)
-            except Exception:
-                pass  # diagnostic path must never break training
+        self._execs.stash(label, fn, call_args, donate=(), aval_fn=aval)
 
     def introspect_executables(self, force: bool = False) -> Dict[str, dict]:
         """Capture XLA memory_analysis()/cost_analysis() for every train
@@ -1179,11 +1182,15 @@ class TrainStepEngine:
         autotune.set_step(self._step_count + 1)
         health_on = self._health is not None
         cache_key = (k, dtype, use_residual, chunk, health_on, zero)
-        if cache_key not in self._accum_fns:
-            build = self._build_zero_accum if zero else self._build_accum
-            self._accum_fns[cache_key] = build(
-                arrays, k, dtype, use_residual, chunk)
-        fn = self._accum_fns[cache_key]
+        label = (f"train.zero_k{k}_{dtype}" if zero
+                 else f"train.accum_k{k}_{dtype}") + \
+            ("_res" if use_residual else "")
+        build = self._build_zero_accum if zero else self._build_accum
+        entry = self._execs.get_or_build(
+            ("train.accum",) + cache_key,
+            lambda: build(arrays, k, dtype, use_residual, chunk),
+            label=label, pin=True)
+        fn = entry.fn
         staged, self._pending_h2d = self._pending_h2d, None
         arrays, h2d_ms = self._place_batch(
             arrays, self._batch_shardings,
@@ -1203,9 +1210,6 @@ class TrainStepEngine:
         mreg = _obs_metrics.active_registry()
         n0 = _jit_cache_size(fn)
         p0 = _compile_cache.entries() if n0 == 0 else -1
-        label = (f"train.zero_k{k}_{dtype}" if zero
-                 else f"train.accum_k{k}_{dtype}") + \
-            ("_res" if use_residual else "")
         t0 = time.perf_counter()
         try:
             opt_in = (self._ensure_zero_opt() if zero
@@ -1233,7 +1237,9 @@ class TrainStepEngine:
                         {"step": self._step_count, "error": repr(e)})
             raise
         t1 = time.perf_counter()
-        compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0, p0)
+        compiled = self._execs.note_compiles(
+            entry, n_before=n0, n_after=_jit_cache_size(fn), wall_s=t1 - t0,
+            persistent_before=p0, engine_counters=True) > 0
         if zero:
             rs_b, ag_b = ((0, 0) if nrep <= 1 else _gc.zero_payload_bytes(
                 self._n_grad_elems(), nrep, dtype, chunk,
@@ -1378,8 +1384,10 @@ class TrainStepEngine:
             raise ValueError(f"run_steps needs at least one step, got K={k}")
         from ..core import autotune
         autotune.set_step(self._step_count + k)
-        if self._scan_fns[fixed] is None:
-            self._scan_fns[fixed] = self._build_scan(arrays, fixed)
+        scan_entry = self._execs.get_or_build(
+            ("train.run_steps", fixed),
+            lambda: self._build_scan(arrays, fixed),
+            label="train.run_steps", pin=True)
         arrays, h2d_ms = self._place_batch(
             arrays, self._scan_batch_shardings[fixed],
             timed=self.telemetry is not None)
@@ -1396,7 +1404,7 @@ class TrainStepEngine:
         for _ in range(k):
             self._key, sub = jax.random.split(self._key)
             subs.append(sub)
-        fn = self._scan_fns[fixed]
+        fn = scan_entry.fn
         tele = self.telemetry
         fr = _obs_flight.get()
         mreg = _obs_metrics.active_registry()
@@ -1416,7 +1424,9 @@ class TrainStepEngine:
                         {"step0": step0, "steps": k, "error": repr(e)})
             raise
         t1 = time.perf_counter()
-        compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0, p0)
+        compiled = self._execs.note_compiles(
+            scan_entry, n_before=n0, n_after=_jit_cache_size(fn),
+            wall_s=t1 - t0, persistent_before=p0, engine_counters=True) > 0
         tr = _obs_tracer.get_tracer()
         if tr.enabled:
             tr.record_complete("engine.run_steps", t0, t1,
@@ -1477,8 +1487,9 @@ class TrainStepEngine:
         self._check_batch(arrays)
         from ..core import autotune
         autotune.set_step(self._step_count + 1)
-        if self._step_fn is None:
-            self._step_fn = self._build(arrays)
+        step_entry = self._execs.get_or_build(
+            ("train.step",), lambda: self._build(arrays),
+            label="train.step", pin=True)
         # place batch according to specs (host->device with the right
         # sharding); arrays staged by prefetch() arrive already placed and
         # skip the put — their H2D stats were captured at issue time
@@ -1497,7 +1508,7 @@ class TrainStepEngine:
             self._lr_cache = (lr_val, jnp.float32(lr_val))
         lr = self._lr_cache[1]
         self._key, sub = jax.random.split(self._key)
-        fn = self._step_fn
+        fn = step_entry.fn
         tele = self.telemetry
         fr = _obs_flight.get()
         mreg = _obs_metrics.active_registry()
@@ -1523,7 +1534,9 @@ class TrainStepEngine:
                         {"step": self._step_count, "error": repr(e)})
             raise
         t1 = time.perf_counter()
-        compiled = _note_compile(n0, _jit_cache_size(fn), t1 - t0, p0)
+        compiled = self._execs.note_compiles(
+            step_entry, n_before=n0, n_after=_jit_cache_size(fn),
+            wall_s=t1 - t0, persistent_before=p0, engine_counters=True) > 0
         tr = _obs_tracer.get_tracer()
         if tr.enabled:
             tr.record_complete("engine.step", t0, t1,

@@ -424,34 +424,34 @@ def _pipe_block_fwd(x, p, nh, hd):
     return x + m @ p["fc2_w"] + p["fc2_b"]
 
 
-def _decode_jit_get(model, key, build):
-    """LRU-bounded per-model decode-executable cache (generate/generate_beam).
+def _decode_exec_registry(model):
+    """Per-model decode ExecutableRegistry (generate/generate_beam).
 
-    Keyed by the full sampling/shape tuple and bounded by
-    FLAGS_decode_jit_cache_size, so traffic cycling through sampling configs
-    cannot grow the per-model dict without bound. core.monitor counters:
-    decode.jit_compiles (new executables), decode.cache_evictions (LRU drops).
-    """
-    from collections import OrderedDict
-
+    One registry instance per model, keyed by the full sampling/shape tuple
+    and bounded live by FLAGS_decode_jit_cache_size, so traffic cycling
+    through sampling configs cannot grow the per-model store without bound.
+    Legacy core.monitor counters ride as registry aliases:
+    decode.jit_compiles (new executables), decode.cache_evictions (LRU
+    drops)."""
     from ..core import flags as _flags
-    from ..core import monitor as _monitor
+    from ..core.exec_registry import ExecutableRegistry
 
-    cache = model.__dict__.setdefault("_generate_jit_cache", OrderedDict())
-    if not isinstance(cache, OrderedDict):
-        cache = model.__dict__["_generate_jit_cache"] = OrderedDict(cache)
-    fn = cache.get(key)
-    if fn is not None:
-        cache.move_to_end(key)
-        return fn
-    fn = cache[key] = build()
-    _monitor.stat("decode.jit_compiles").increase()
-    limit = int(_flags.flag("decode_jit_cache_size"))
-    if limit > 0:
-        while len(cache) > limit:
-            cache.popitem(last=False)
-            _monitor.stat("decode.cache_evictions").increase()
-    return fn
+    reg = model.__dict__.get("_decode_exec_registry")
+    if not isinstance(reg, ExecutableRegistry):
+        reg = model.__dict__["_decode_exec_registry"] = ExecutableRegistry(
+            name="gpt.decode",
+            capacity=lambda: int(_flags.flag("decode_jit_cache_size")),
+            miss_counter="decode.jit_compiles",
+            eviction_counter="decode.cache_evictions")
+    return reg
+
+
+def _decode_jit_get(model, key, build):
+    """Decode-executable lookup through the model's ExecutableRegistry; the
+    label (key[0]) distinguishes greedy/sampled generate from beam search in
+    registry telemetry."""
+    reg = _decode_exec_registry(model)
+    return reg.get_or_build(key, build, label=key[0]).fn
 
 
 class GPTForPretraining(nn.Layer):
@@ -511,6 +511,12 @@ class GPTForPretraining(nn.Layer):
 
             return L.matmul(h, self.gpt.wte.weight, transpose_y=True)
         return self.lm_head(h)
+
+    def decode_exec_registry(self):
+        """This model's decode ExecutableRegistry (generate/generate_beam
+        executables, LRU-bounded by FLAGS_decode_jit_cache_size). Public so
+        benches/tests can inspect or clear the decode executable set."""
+        return _decode_exec_registry(self)
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=0, top_p=1.0, eos_token_id=None, seed=0,
@@ -722,9 +728,10 @@ class GPTForPretraining(nn.Layer):
             # identical) would retrace the stale closure. Bucketed keys use
             # the RUNG, not the prompt length — the whole bucket shares one
             # executable (plen stays a traced argument).
-            cache_key = (b, padded_len, bucketed, max_new_tokens,
-                         float(temperature), int(top_k), float(top_p),
-                         eos_token_id, amp_key, str(cache_dtype))
+            cache_key = ("gpt.generate", b, padded_len, bucketed,
+                         max_new_tokens, float(temperature), int(top_k),
+                         float(top_p), eos_token_id, amp_key,
+                         str(cache_dtype))
             fn = _decode_jit_get(self, cache_key, lambda: jax.jit(run))
             out = fn(params, ids, jnp.int32(prompt), jax.random.key(seed))
             if bucketed:
@@ -881,7 +888,7 @@ class GPTForPretraining(nn.Layer):
             amp = _amp
             amp_key = ((str(amp.dtype), amp.level, frozenset(amp.white),
                         frozenset(amp.black)) if amp is not None else None)
-            cache_key = ("beam", b, prompt, max_new_tokens, K,
+            cache_key = ("gpt.generate_beam", b, prompt, max_new_tokens, K,
                          float(length_penalty), eos_token_id, amp_key,
                          str(cache_dtype))
             fn = _decode_jit_get(self, cache_key, lambda: jax.jit(run))

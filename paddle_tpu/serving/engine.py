@@ -37,6 +37,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
 from ..core import flags as _flags
+from ..core.exec_registry import ExecutableRegistry
 from ..observability import exec_introspect as _obs_exec
 from ..observability import exporter as _obs_exporter
 from ..observability import flight_recorder as _obs_flight
@@ -49,13 +50,6 @@ _NO_EOS = -1
 # slot-occupancy fractions live in (0, 1]: linear buckets, not the default
 # log-spaced latency boundaries
 _OCCUPANCY_BUCKETS = tuple(round(0.1 * i, 1) for i in range(1, 11))
-
-
-def _jit_cache_size(fn) -> int:
-    try:
-        return fn._cache_size()
-    except Exception:
-        return -1
 
 
 class Request:
@@ -330,24 +324,22 @@ class ServingEngine:
         self._spec_k = np.zeros(S, np.int32)
         self._slot_req: List[Optional[Request]] = [None] * S
 
-        self._prefill_fns: Dict[int, Any] = {}
-        # draft prefill executables, one per PROMPT bucket (the draft cache
-        # never shares pages, so even paged prefix hits draft-prefill the
-        # whole prompt); verify executables keyed by (family, k-rung) — the
-        # spec ladder bounds compile count exactly like the prompt ladder
-        self._draft_prefill_fns: Dict[int, Any] = {}
-        self._verify_fns: Dict[Any, Any] = {}
-        # decode executables keyed by sampling FAMILY only ("greedy" skips
-        # the sort/cumsum sampling machinery entirely; "sample" carries all
-        # sampling params as traced per-slot vectors) — never by prompt
-        # length, max_new_tokens, or the sampling values themselves
-        self._decode_fns: Dict[str, Any] = {}
-        self._fn_cache_sizes: Dict[int, int] = {}  # id(fn) -> last size
-        # label -> (jitted fn, abstract args) for introspect_executables()
-        self._exec_stash: Dict[str, Any] = {}
-        # label -> donate_argnums of the stashed fn (default_contracts
-        # derives each label's donation floor from these positions)
-        self._exec_donated: Dict[str, tuple] = {}
+        # ONE keyed ExecutableRegistry replaces the four parallel executable
+        # dicts this engine used to carry (prefill rungs, draft-prefill
+        # rungs, verify (family, k) pairs, decode families). Keys are
+        # ("serve.<kind>", ...distinguishers); every entry is admitted
+        # PINNED — the serving working set must never be LRU-evicted under
+        # a live slot (the ISSUE-18 hazard fix: with a tiny
+        # FLAGS_decode_jit_cache_size the registry refuses eviction and
+        # counts exec.registry.evict_refusals instead of breaking decode).
+        # Decode keys stay off prompt length, max_new_tokens, and sampling
+        # values — the family strings ("greedy"/"sample") and the ladder
+        # rungs bound the executable count exactly as before.
+        self._execs = ExecutableRegistry(
+            name="serve",
+            capacity=lambda: int(_flags.flag("decode_jit_cache_size")))
+        # set by precompile() when the backend probe gates AOT off
+        self.aot_skip_reason: Optional[str] = None
 
     # ------------------------------------------------------------- params
     def refresh_params(self) -> None:
@@ -442,6 +434,8 @@ class ServingEngine:
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
+        if steps:
+            self._emit_registry_rollup()
         return self._completed[done0:]
 
     # ---------------------------------------------------- elastic replica
@@ -506,6 +500,7 @@ class ServingEngine:
         mreg = _obs_metrics.active_registry()
         if mreg is not None:
             mreg.histogram("elastic.drain_ms").observe(drain_ms)
+        self._emit_registry_rollup()
         self.retire()
         return self._completed[done0:]
 
@@ -540,16 +535,17 @@ class ServingEngine:
             "draining": self._draining,
             "slot_count": self.slot_count,
             "ladder": self.ladder,
-            "prefill_executables": len(self._prefill_fns),
-            "decode_executables": len(self._decode_fns),
+            "prefill_executables": self._execs.count("serve.prefill"),
+            "decode_executables": self._execs.count("serve.decode"),
             "kv_layout": self.kv_layout,
             "kv_cache_bytes": self.kv_cache_bytes(),
         }
         if self.draft_model is not None:
             out.update({
                 "spec_ladder": self.spec_ladder,
-                "verify_executables": len(self._verify_fns),
-                "draft_prefill_executables": len(self._draft_prefill_fns),
+                "verify_executables": self._execs.count("serve.verify"),
+                "draft_prefill_executables":
+                    self._execs.count("serve.dprefill"),
             })
         if self.kv_layout == "paged":
             out.update({
@@ -597,6 +593,23 @@ class ServingEngine:
         return len(self._queue)
 
     # ---------------------------------------------------------- internals
+    @property
+    def _exec_stash(self):
+        """label -> (jitted fn, abstract args), now owned by the registry
+        (introspect_executables / analysis / mem_report read this view)."""
+        return self._execs.stash_map()
+
+    @property
+    def _exec_donated(self):
+        """label -> donate_argnums of the stashed fn (default_contracts
+        derives each label's donation floor from these positions)."""
+        return self._execs.donated_map()
+
+    def exec_registry(self) -> ExecutableRegistry:
+        """This engine's ExecutableRegistry (every prefill/decode/verify/
+        draft executable, plus the AOT fast paths precompile() installs)."""
+        return self._execs
+
     def _stash_exec(self, label: str, fn, call_args,
                     donate: tuple = (1, 2)) -> None:
         """First call per label: remember (jitted fn, abstract args) so
@@ -604,23 +617,7 @@ class ServingEngine:
         auto-capture now when FLAGS_exec_introspect is on. ShapeDtypeStructs
         replace the arrays — no live (or donated) buffer is retained.
         donate records the fn's donate_argnums for default_contracts()."""
-        if label in self._exec_stash:
-            return
-        self._exec_donated[label] = tuple(donate)
-        import jax
-
-        # weak_type rides along for the recompile-hazard analysis pass
-        avals = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                           weak_type=getattr(a, "weak_type",
-                                                             False)),
-            call_args)
-        self._exec_stash[label] = (fn, avals)
-        if _flags.flag("exec_introspect"):
-            try:
-                _obs_exec.capture_jit(label, fn, avals)
-            except Exception:
-                pass  # diagnostic path must never break serving
+        self._execs.stash(label, fn, call_args, donate=donate)
 
     def introspect_executables(self, force: bool = False) -> Dict[str, dict]:
         """Capture XLA memory_analysis()/cost_analysis() for every prefill/
@@ -676,24 +673,158 @@ class ServingEngine:
             contracts = self.default_contracts()
         return _an.PassManager().run(progs, contracts, dump=dump)
 
-    def _note_exec_compiles(self, fn, counter: str) -> None:
-        """Count executable-cache growth of a jitted fn into core.monitor —
-        the regression alarm that keeps prefill/decode keyed off prompt
-        length (tests assert totals <= ladder size)."""
+    def _program_device_span(self) -> int:
+        """Devices a single serving executable spans. The engine keeps
+        params/KV on the default device and compiles no collectives, so
+        the span is 1 regardless of how many devices the process exposes;
+        a future sharded serving mesh widens this (and the AOT gate with
+        it)."""
+        return 1
+
+    # ---- AOT ladder precompilation (ISSUE 18) ---------------------------
+    def precompile(self, families: Sequence[str] = ("greedy", "sample"),
+                   force: bool = False) -> Dict[str, Any]:
+        """AOT-compile the full serving ladder before the first request:
+        every (prefill rung x sampling family x spec rung) executable is
+        lowered at its exact dispatch signature and compiled via
+        ``jit(...).lower().compile()``, then installed as the registry
+        entry's dispatch fast path. With FLAGS_compile_cache_dir pointing
+        at an AOT bundle (tools/aot_bundle.py) every compile deserializes
+        WARM — a fresh replica joins the fleet with zero cold compiles.
+
+        Gated by analysis.backend.aot_serving_reason(): cache-served
+        multi-device executables are nondeterministic on this jax's CPU, so
+        a multi-device CPU serving mesh skips (reason recorded in
+        ``aot_skip_reason`` and the returned dict) unless ``force``. The
+        probe keys on the device span of the PROGRAMS this engine compiles
+        — one device until serving grows a mesh — not the process device
+        count: an 8-virtual-device drill process still precompiles its
+        single-device replicas.
+
+        Returns {"precompiled", "skipped", "cold", "warm", "wall_ms"}."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..analysis.backend import aot_serving_reason
         from ..core import monitor
 
-        n = _jit_cache_size(fn)
-        prev = self._fn_cache_sizes.get(id(fn))
-        if n < 0:  # no _cache_size on this jax: count one per wrapper
-            if prev is None:
-                self._fn_cache_sizes[id(fn)] = 0
-                monitor.stat(counter).increase()
+        reason = None if force else aot_serving_reason(
+            device_count=self._program_device_span())
+        if reason is not None:
+            self.aot_skip_reason = reason
+            monitor.stat("serving.aot_skipped").increase()
+            return {"precompiled": 0, "skipped": reason,
+                    "cold": 0, "warm": 0, "wall_ms": 0.0}
+        self.aot_skip_reason = None
+        paged = self.kv_layout == "paged"
+        S = self.slot_count
+
+        def slot_vecs():
+            return (jnp.asarray(self._offsets), jnp.asarray(self._last_tok),
+                    jnp.asarray(self._active))
+
+        def sampling_vecs():
+            return (jnp.asarray(self._temps), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), jnp.asarray(self._eos),
+                    jnp.asarray(self._remaining), jnp.asarray(self._seeds))
+
+        def pool_state():
+            return dict(self._pool_state, tables=jnp.asarray(self._tables))
+
+        plan = []  # (key, build, label, donate, call_args)
+        for bucket in self.ladder:
+            padded = jnp.asarray(np.zeros((1, bucket), np.int64))
+            if paged:
+                args = (self._params, pool_state(), padded, jnp.int32(0),
+                        jnp.int32(0), jnp.int32(0), jnp.float32(0.0),
+                        jnp.int32(0), jnp.float32(1.0), jnp.int32(0))
+                plan.append((("serve.prefill", bucket),
+                             (lambda b=bucket:
+                              self._build_prefill_paged(b)),
+                             f"serve.prefill_b{bucket}", (1,), args))
+            else:
+                args = (self._params, self._kcs, self._vcs, padded,
+                        jnp.int32(0), jnp.int32(0), jnp.float32(0.0),
+                        jnp.int32(0), jnp.float32(1.0), jnp.int32(0))
+                plan.append((("serve.prefill", bucket),
+                             (lambda b=bucket: self._build_prefill(b)),
+                             f"serve.prefill_b{bucket}", (1, 2), args))
+            if self.draft_model is not None:
+                dargs = (self._dparams, self._dkcs, self._dvcs, padded,
+                         jnp.int32(0))
+                plan.append((("serve.dprefill", bucket),
+                             (lambda b=bucket:
+                              self._build_draft_prefill(b)),
+                             f"serve.dprefill_b{bucket}", (1, 2), dargs))
+        for family in families:
+            if paged:
+                args = (self._params, pool_state(), *slot_vecs(),
+                        jnp.asarray(self._replay), *sampling_vecs())
+                plan.append((("serve.decode", family),
+                             (lambda f=family:
+                              self._build_decode_paged(f)),
+                             f"serve.decode_{family}", (1,), args))
+            else:
+                args = (self._params, self._kcs, self._vcs, *slot_vecs(),
+                        *sampling_vecs())
+                plan.append((("serve.decode", family),
+                             (lambda f=family: self._build_decode(f)),
+                             f"serve.decode_{family}", (1, 2), args))
+            if self.draft_model is None:
+                continue
+            for k in self.spec_ladder:
+                n_draft = jnp.asarray(np.zeros(S, np.int32))
+                if paged:
+                    args = (self._params, self._dparams, pool_state(),
+                            self._dkcs, self._dvcs, *slot_vecs(),
+                            jnp.asarray(self._replay), n_draft,
+                            *sampling_vecs())
+                    donate = (2, 3, 4)
+                    build = (lambda f=family, kk=k:
+                             self._build_verify_paged(f, kk))
+                else:
+                    args = (self._params, self._dparams, self._kcs,
+                            self._vcs, self._dkcs, self._dvcs,
+                            *slot_vecs(), n_draft, *sampling_vecs())
+                    donate = (2, 3, 4, 5)
+                    build = (lambda f=family, kk=k:
+                             self._build_verify(f, kk))
+                plan.append((("serve.verify", family, k), build,
+                             f"serve.verify_{family}_k{k}", donate, args))
+
+        from ..core import compile_cache as _compile_cache
+
+        cold0 = monitor.stat("engine.compile_cold").get()
+        warm0 = monitor.stat("engine.compile_warm").get()
+        t0 = time.perf_counter()
+        n = 0
+        for key, build, label, donate, call_args in plan:
+            entry = self._execs.get_or_build(key, build, label=label,
+                                             donate=donate, pin=True)
+            if entry.aot is None or force:
+                self._execs.precompile(entry, call_args)
+                n += 1
+            self._stash_exec(label, entry.fn, call_args, donate=donate)
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        monitor.stat("serving.aot_precompiles").increase(n)
+        return {"precompiled": n, "skipped": None,
+                "cold": monitor.stat("engine.compile_cold").get() - cold0,
+                "warm": monitor.stat("engine.compile_warm").get() - warm0,
+                "wall_ms": wall_ms,
+                "cache_dir": _compile_cache.cache_dir()}
+
+    def _emit_registry_rollup(self) -> None:
+        """Cumulative exec-registry rollup record for the trace sink /
+        flight recorder (trace_summary's per-label registry table)."""
+        fr = _obs_flight.get()
+        if self.sink is None and fr is None:
             return
-        if prev is None:
-            prev = 0
-        if n > prev:
-            monitor.stat(counter).increase(n - prev)
-        self._fn_cache_sizes[id(fn)] = n
+        rec = dict(self._execs.rollup(), event="exec_registry",
+                   ts=time.time())
+        if self.sink is not None:
+            self.sink.write(rec)
+        if fr is not None:
+            fr.record(rec)
 
     def _head_traced(self, params, h_arr):
         """last-position hidden -> logits with weights from traced params."""
@@ -873,18 +1004,22 @@ class ServingEngine:
         self._spec_k[slot] = rung
         bucket = req.bucket
         plen = len(req.prompt_ids)
-        fn = self._draft_prefill_fns.get(bucket)
-        if fn is None:
-            fn = self._draft_prefill_fns[bucket] = \
-                self._build_draft_prefill(bucket)
+        entry = self._execs.get_or_build(
+            ("serve.dprefill", bucket),
+            lambda: self._build_draft_prefill(bucket),
+            label=f"serve.dprefill_b{bucket}", donate=(1, 2), pin=True)
         padded = np.zeros((1, bucket), np.int64)
         padded[0, :plen] = req.prompt_ids
         call_args = (self._dparams, self._dkcs, self._dvcs,
                      jnp.asarray(padded), jnp.int32(slot))
-        self._stash_exec(f"serve.dprefill_b{bucket}", fn, call_args)
+        self._stash_exec(f"serve.dprefill_b{bucket}", entry.fn, call_args)
         monitor.stat("serving.draft_prefill_dispatches").increase()
-        self._dkcs, self._dvcs = fn(*call_args)
-        self._note_exec_compiles(fn, "serving.draft_prefill_compiles")
+        p0 = self._execs.persistent_before(entry)
+        t0 = time.perf_counter()
+        self._dkcs, self._dvcs = entry(*call_args)
+        self._execs.note_compiles(
+            entry, wall_s=time.perf_counter() - t0, persistent_before=p0,
+            counter="serving.draft_prefill_compiles")
 
     def _admit(self) -> None:
         import jax.numpy as jnp
@@ -909,9 +1044,10 @@ class ServingEngine:
             bucket = req.bucket
             plen = len(req.prompt_ids)
             req.admit_ts = time.perf_counter()    # queue wait ends here
-            fn = self._prefill_fns.get(bucket)
-            if fn is None:
-                fn = self._prefill_fns[bucket] = self._build_prefill(bucket)
+            entry = self._execs.get_or_build(
+                ("serve.prefill", bucket),
+                lambda: self._build_prefill(bucket),
+                label=f"serve.prefill_b{bucket}", donate=(1, 2), pin=True)
             padded = np.zeros((1, bucket), np.int64)
             padded[0, :plen] = req.prompt_ids
             call_args = (self._params, self._kcs, self._vcs,
@@ -919,13 +1055,18 @@ class ServingEngine:
                          jnp.int32(slot), jnp.float32(req.temperature),
                          jnp.int32(req.top_k), jnp.float32(req.top_p),
                          jnp.int32(req.seed))
-            self._stash_exec(f"serve.prefill_b{bucket}", fn, call_args)
+            self._stash_exec(f"serve.prefill_b{bucket}", entry.fn, call_args)
             from ..core import monitor
 
             monitor.stat("serving.prefill_dispatches").increase()
+            p0 = self._execs.persistent_before(entry)
+            t0 = time.perf_counter()
             try:
-                self._kcs, self._vcs, tok = fn(*call_args)
-                self._note_exec_compiles(fn, "serving.prefill_compiles")
+                self._kcs, self._vcs, tok = entry(*call_args)
+                self._execs.note_compiles(
+                    entry, wall_s=time.perf_counter() - t0,
+                    persistent_before=p0,
+                    counter="serving.prefill_compiles")
                 first = int(tok)                  # device sync = first token
             except Exception as e:
                 fr = _obs_flight.get()
@@ -1095,10 +1236,10 @@ class ServingEngine:
             page = self._pool.alloc()
             self._tables[slot, pi] = page
             self._slot_pages[slot].append(page)
-        fn = self._prefill_fns.get(tbucket)
-        if fn is None:
-            fn = self._prefill_fns[tbucket] = self._build_prefill_paged(
-                tbucket)
+        entry = self._execs.get_or_build(
+            ("serve.prefill", tbucket),
+            lambda: self._build_prefill_paged(tbucket),
+            label=f"serve.prefill_b{tbucket}", donate=(1,), pin=True)
         padded = np.zeros((1, tbucket), np.int64)
         padded[0, :tail] = req.prompt_ids[base:]
         state = dict(self._pool_state, tables=jnp.asarray(self._tables))
@@ -1106,12 +1247,16 @@ class ServingEngine:
                      jnp.int32(tail), jnp.int32(base), jnp.int32(slot),
                      jnp.float32(req.temperature), jnp.int32(req.top_k),
                      jnp.float32(req.top_p), jnp.int32(req.seed))
-        self._stash_exec(f"serve.prefill_b{tbucket}", fn, call_args,
+        self._stash_exec(f"serve.prefill_b{tbucket}", entry.fn, call_args,
                          donate=(1,))
         monitor.stat("serving.prefill_dispatches").increase()
+        p0 = self._execs.persistent_before(entry)
+        t0 = time.perf_counter()
         try:
-            new_state, tok = fn(*call_args)
-            self._note_exec_compiles(fn, "serving.prefill_compiles")
+            new_state, tok = entry(*call_args)
+            self._execs.note_compiles(
+                entry, wall_s=time.perf_counter() - t0, persistent_before=p0,
+                counter="serving.prefill_compiles")
             first = int(tok)                  # device sync = first token
         except Exception as e:
             fr = _obs_flight.get()
@@ -1666,11 +1811,12 @@ class ServingEngine:
         family = ("greedy"
                   if not self._temps[self._active].any() else "sample")
         paged = self.kv_layout == "paged"
-        fn = self._verify_fns.get((family, k))
-        if fn is None:
-            fn = self._verify_fns[(family, k)] = (
-                self._build_verify_paged(family, k) if paged
-                else self._build_verify(family, k))
+        entry = self._execs.get_or_build(
+            ("serve.verify", family, k),
+            lambda: (self._build_verify_paged(family, k) if paged
+                     else self._build_verify(family, k)),
+            label=f"serve.verify_{family}_k{k}",
+            donate=(2, 3, 4) if paged else (2, 3, 4, 5), pin=True)
         # per-slot draft window: the request's rung, clamped so the window
         # never outruns the token budget (keeps paged writes inside the
         # admission reservation) or the cache end, and zero on non-spec
@@ -1693,8 +1839,8 @@ class ServingEngine:
                          jnp.asarray(self._topp), jnp.asarray(self._eos),
                          jnp.asarray(self._remaining),
                          jnp.asarray(self._seeds))
-            self._stash_exec(f"serve.verify_{family}_k{k}", fn, call_args,
-                             donate=(2, 3, 4))
+            self._stash_exec(f"serve.verify_{family}_k{k}", entry.fn,
+                             call_args, donate=(2, 3, 4))
         else:
             call_args = (self._params, self._dparams, self._kcs, self._vcs,
                          self._dkcs, self._dvcs,
@@ -1705,19 +1851,22 @@ class ServingEngine:
                          jnp.asarray(self._topp), jnp.asarray(self._eos),
                          jnp.asarray(self._remaining),
                          jnp.asarray(self._seeds))
-            self._stash_exec(f"serve.verify_{family}_k{k}", fn, call_args,
-                             donate=(2, 3, 4, 5))
+            self._stash_exec(f"serve.verify_{family}_k{k}", entry.fn,
+                             call_args, donate=(2, 3, 4, 5))
         active_before = self._active.copy()
+        p0 = self._execs.persistent_before(entry)
         t0 = time.perf_counter()
         try:
             if paged:
                 (self._pool_state, self._dkcs, self._dvcs, off, tok, active,
-                 replay, remaining, emit, m, a, hits) = fn(*call_args)
+                 replay, remaining, emit, m, a, hits) = entry(*call_args)
                 self._replay = np.array(replay)
             else:
                 (self._kcs, self._vcs, self._dkcs, self._dvcs, off, tok,
-                 active, remaining, emit, m, a, hits) = fn(*call_args)
-            self._note_exec_compiles(fn, "serving.verify_compiles")
+                 active, remaining, emit, m, a, hits) = entry(*call_args)
+            self._execs.note_compiles(
+                entry, wall_s=time.perf_counter() - t0, persistent_before=p0,
+                counter="serving.verify_compiles")
             self._offsets = np.array(off)
             self._last_tok = np.array(tok)
             self._active = np.array(active)
@@ -1840,11 +1989,12 @@ class ServingEngine:
         family = ("greedy"
                   if not self._temps[self._active].any() else "sample")
         paged = self.kv_layout == "paged"
-        fn = self._decode_fns.get(family)
-        if fn is None:
-            fn = self._decode_fns[family] = (
-                self._build_decode_paged(family) if paged
-                else self._build_decode(family))
+        entry = self._execs.get_or_build(
+            ("serve.decode", family),
+            lambda: (self._build_decode_paged(family) if paged
+                     else self._build_decode(family)),
+            label=f"serve.decode_{family}",
+            donate=(1,) if paged else (1, 2), pin=True)
         if paged:
             self._prealloc_decode_pages()
             state = dict(self._pool_state,
@@ -1857,7 +2007,7 @@ class ServingEngine:
                          jnp.asarray(self._topp), jnp.asarray(self._eos),
                          jnp.asarray(self._remaining),
                          jnp.asarray(self._seeds))
-            self._stash_exec(f"serve.decode_{family}", fn, call_args,
+            self._stash_exec(f"serve.decode_{family}", entry.fn, call_args,
                              donate=(1,))
         else:
             call_args = (self._params, self._kcs, self._vcs,
@@ -1868,17 +2018,20 @@ class ServingEngine:
                          jnp.asarray(self._topp), jnp.asarray(self._eos),
                          jnp.asarray(self._remaining),
                          jnp.asarray(self._seeds))
-            self._stash_exec(f"serve.decode_{family}", fn, call_args)
+            self._stash_exec(f"serve.decode_{family}", entry.fn, call_args)
+        p0 = self._execs.persistent_before(entry)
         t0 = time.perf_counter()
         try:
             if paged:
                 (self._pool_state, off, tok, active, replay, remaining,
-                 toks, was_active, hits) = fn(*call_args)
+                 toks, was_active, hits) = entry(*call_args)
                 self._replay = np.array(replay)
             else:
                 (self._kcs, self._vcs, off, tok, active, remaining, toks,
-                 was_active, hits) = fn(*call_args)
-            self._note_exec_compiles(fn, "serving.decode_compiles")
+                 was_active, hits) = entry(*call_args)
+            self._execs.note_compiles(
+                entry, wall_s=time.perf_counter() - t0, persistent_before=p0,
+                counter="serving.decode_compiles")
             # np.array (copy): zero-copy views of jax buffers are read-only,
             # and _admit mutates these in place when it seats the next request
             self._offsets = np.array(off)

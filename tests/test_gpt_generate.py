@@ -97,7 +97,7 @@ def test_length_guard(model):
 
 def test_generate_under_amp_caches_separately():
     """Tracing generate under paddle.amp.auto_cast bakes bf16 matmuls into
-    the decode executable; the amp scope must be part of the jit cache key
+    the decode executable; the amp scope must be part of the registry key
     so f32 and bf16 programs never collide."""
     import numpy as np
 
@@ -114,7 +114,7 @@ def test_generate_under_amp_caches_separately():
         out_bf16 = m.generate(ids, max_new_tokens=4, temperature=0)
     assert out_bf16.shape == out_f32.shape == [2, 12]
     # two distinct cached executables (amp state in the key)
-    assert len(m._generate_jit_cache) == 2
+    assert len(m.decode_exec_registry()) == 2
     # prompts are echoed verbatim either way
     np.testing.assert_array_equal(out_bf16.numpy()[:, :8], ids.numpy())
 
@@ -125,7 +125,7 @@ def test_prompt_bucket_identical_tokens_and_shared_executable(model):
     to the unpadded run (greedy), and every prompt length in a bucket must
     share ONE executable (keyed on the rung, prompt length traced)."""
     rng = np.random.RandomState(11)
-    model._generate_jit_cache.clear()
+    model.decode_exec_registry().clear()
     for plen in (3, 5, 7, 8):                 # all land in the 8-rung
         ids = rng.randint(0, 1024, (2, plen)).astype(np.int64)
         plain = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
@@ -135,7 +135,7 @@ def test_prompt_bucket_identical_tokens_and_shared_executable(model):
                                   prompt_bucket=(8, 16, 32)).numpy()
         np.testing.assert_array_equal(plain, bucketed)
     # 4 exact-shape executables + ONE shared bucketed executable
-    keys = list(model._generate_jit_cache.keys())
+    keys = list(model.decode_exec_registry().keys())
     assert len(keys) == 5
     # sampling under a bucket is deterministic per seed too
     ids = rng.randint(0, 1024, (1, 5)).astype(np.int64)
@@ -178,12 +178,12 @@ def test_generate_jit_cache_lru_bounded(model):
         ["decode_jit_cache_size"])["FLAGS_decode_jit_cache_size"]
     try:
         paddle.set_flags({"decode_jit_cache_size": 2})
-        model._generate_jit_cache.clear()
+        model.decode_exec_registry().clear()
         c0 = counter("decode.jit_compiles")
         e0 = counter("decode.cache_evictions")
         for t in (0.5, 0.6, 0.7, 0.8):        # 4 configs, bound 2
             model.generate(ids, max_new_tokens=2, temperature=t, seed=1)
-        assert len(model._generate_jit_cache) == 2
+        assert len(model.decode_exec_registry()) == 2
         assert counter("decode.jit_compiles") - c0 == 4
         assert counter("decode.cache_evictions") - e0 == 2
         # LRU: most recent configs survive — no recompile on re-use
@@ -192,10 +192,10 @@ def test_generate_jit_cache_lru_bounded(model):
         assert counter("decode.jit_compiles") == c1
         # beam executables share the same bounded cache
         model.generate(ids, max_new_tokens=2, num_beams=2)
-        assert len(model._generate_jit_cache) == 2
+        assert len(model.decode_exec_registry()) == 2
     finally:
         paddle.set_flags({"decode_jit_cache_size": old})
-        model._generate_jit_cache.clear()
+        model.decode_exec_registry().clear()
 
 
 def test_top_k_clamped_to_vocab(model):

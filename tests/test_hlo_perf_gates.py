@@ -512,7 +512,7 @@ def test_decode_loop_cache_in_place_no_weight_casts():
     with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
         model.generate(paddle.to_tensor(ids), max_new_tokens=new,
                        temperature=0)
-        jf = next(iter(model._generate_jit_cache.values()))
+        jf = next(iter(model.decode_exec_registry().values()))
         params = {k: v._data for k, v in model.state_dict(
             include_non_persistable_buffer=True).items()}
         # run(params, ids, plen, key): plen traced since the prompt-bucket
@@ -613,7 +613,7 @@ def test_decode_loop_weights_precast_to_bf16():
     with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
         model.generate(paddle.to_tensor(ids), max_new_tokens=48,
                        temperature=0)
-        jf = next(iter(model._generate_jit_cache.values()))
+        jf = next(iter(model.decode_exec_registry().values()))
         params = {k: v._data for k, v in model.state_dict(
             include_non_persistable_buffer=True).items()}
         # run(params, ids, plen, key) — see the cache-in-place gate above

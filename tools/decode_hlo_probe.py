@@ -57,16 +57,19 @@ def main():
                       (args.batch, args.prompt)).astype(np.int64)
 
     import jax
+    import jax.numpy as jnp
 
     with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
         # reach the same cached executable generate() builds internally
         model.generate(paddle.to_tensor(ids), max_new_tokens=args.new,
                        temperature=0)
-        jitted = next(iter(model._generate_jit_cache.values()))
+        jitted = next(iter(model.decode_exec_registry().values()))
         lowered_params = {k: v._data for k, v in model.state_dict(
             include_non_persistable_buffer=True).items()}
         key = jax.random.key(0)
-        hlo = jitted.lower(lowered_params, ids, key).compile()
+        # run(params, ids, plen, key) — plen traced since the bucket round
+        hlo = jitted.lower(lowered_params, ids, jnp.int32(args.prompt),
+                           key).compile()
     text = hlo.as_text()
 
     nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads

@@ -429,6 +429,151 @@ def run_speculative(args, model, paddle, monitor, metrics):
                                        "accept_rate": accept_rate}))
 
 
+def run_aot(args, model, paddle, monitor, metrics):
+    """AOT warm-start leg (ISSUE 18): cold replica vs bundle-warm replica.
+
+    The cold replica serves the mixed workload with the persistent compile
+    cache OFF — its first token pays the prefill+decode compiles, and the
+    dispatch compile counters record how many. Then tools/aot_bundle.py
+    builds a bundle at the same engine config, and a FRESH engine loads it:
+    ``precompile()`` deserializes every executable warm, so the warm
+    replica's first token is execute-only. Hard acceptance (the ISSUE 18
+    pins): warm join's ``engine.compile_cold`` delta == 0 while
+    ``engine.compile_warm`` grew (both-flat would just mean the cache was
+    off), zero dispatch compiles on the warm replica, and token-identical
+    output vs the cold replica. ``--history`` appends the
+    ``serve_aot_warm_join`` first-token speedup for tools/bench_gate.py."""
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import aot_bundle
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.loadgen import Scenario
+
+    ladder = tuple(int(x) for x in args.ladder.split(","))
+    rng = np.random.RandomState(args.seed)
+    base_lengths = [3, 5, 6, 7, 9, 11, 13, 15, 18, 21, 25, 28]
+    scenario = Scenario(
+        name="serve_bench_aot", seed=args.seed,
+        arrival={"process": "batch", "count": args.requests},
+        prompt_len={"dist": "cycle", "values": base_lengths},
+        max_new={"dist": "fixed", "value": args.max_new})
+    work = build_workload(rng, model.config.vocab_size, scenario,
+                          model, paddle)
+
+    def counter(name):
+        return monitor.registry().report().get(name, {}).get("value", 0)
+
+    def dispatch_compiles():
+        return sum(counter(f"serving.{k}_compiles")
+                   for k in ("prefill", "decode", "verify", "draft_prefill"))
+
+    def one_pass(eng):
+        t0 = time.perf_counter()
+        reqs = [eng.submit(w["prompt"], max_new_tokens=w["max_new"],
+                           temperature=0.0, eos_token_id=w["eos"])
+                for w in work]
+        eng.run()
+        return time.perf_counter() - t0, reqs
+
+    # ---- cold replica: persistent cache off, every compile is paid ------
+    prev_cache = _flags.flag("compile_cache_dir")
+    paddle.set_flags({"compile_cache_dir": ""})
+    try:
+        cold_eng = ServingEngine(
+            model, slot_count=args.slots, ladder=ladder,
+            max_new_cap=args.max_new, max_seq_len=args.max_seq_len,
+            steps_per_dispatch=args.steps_per_dispatch)
+        c0 = dispatch_compiles()
+        cold_wall, cold_reqs = one_pass(cold_eng)
+        cold_compiles = dispatch_compiles() - c0
+    finally:
+        paddle.set_flags({"compile_cache_dir": prev_cache})
+    cold_first_s = cold_reqs[0].ttft_s
+
+    # ---- build the bundle at the same engine config ---------------------
+    bundle = tempfile.mkdtemp(prefix="serve_aot_bundle_")
+    t0 = time.perf_counter()
+    manifest = aot_bundle.build_bundle(
+        bundle, slots=args.slots, ladder=ladder, max_new_cap=args.max_new,
+        max_seq_len=args.max_seq_len,
+        steps_per_dispatch=args.steps_per_dispatch, seed=args.seed)
+    build_wall = time.perf_counter() - t0
+    if manifest["report"]["skipped"]:
+        raise SystemExit("aot leg: backend probe refused precompilation: "
+                         + manifest["report"]["skipped"])
+
+    # ---- warm replica: fresh engine, bundle-backed precompile -----------
+    kcold0 = counter("engine.compile_cold")
+    kwarm0 = counter("engine.compile_warm")
+    t0 = time.perf_counter()
+    eng, rep = aot_bundle.load_engine(bundle, model=model)
+    join_wall = time.perf_counter() - t0
+    cold_delta = counter("engine.compile_cold") - kcold0
+    warm_delta = counter("engine.compile_warm") - kwarm0
+    c0 = dispatch_compiles()
+    warm_wall, warm_reqs = one_pass(eng)
+    warm_compiles = dispatch_compiles() - c0
+    warm_first_s = warm_reqs[0].ttft_s
+    mismatches = sum(list(a.tokens) != list(b.tokens)
+                     for a, b in zip(cold_reqs, warm_reqs))
+    speedup = cold_first_s / max(warm_first_s, 1e-9)
+
+    import jax
+    platform = jax.default_backend()
+    summary = {
+        "scenario": "aot", "requests": len(work), "slots": args.slots,
+        "ladder": list(ladder), "max_new": args.max_new,
+        "cold": {
+            "first_token_ms": round(cold_first_s * 1e3, 1),
+            "wall_s": round(cold_wall, 3),
+            "dispatch_compiles": cold_compiles,
+        },
+        "bundle": {
+            "dir": bundle, "build_wall_s": round(build_wall, 3),
+            "precompiled": manifest["report"]["precompiled"],
+            "store_entries": manifest["store_entries"],
+        },
+        "warm_join": {
+            "join_wall_s": round(join_wall, 3),
+            "first_token_ms": round(warm_first_s * 1e3, 1),
+            "wall_s": round(warm_wall, 3),
+            "dispatch_compiles": warm_compiles,
+            "compile_cold_delta": cold_delta,
+            "compile_warm_delta": warm_delta,
+        },
+        "first_token_speedup": round(speedup, 2),
+        "token_mismatches": mismatches,
+        "aot_ok": (cold_delta == 0 and warm_delta > 0
+                   and warm_compiles == 0 and mismatches == 0),
+    }
+    print(json.dumps(summary, indent=2), flush=True)
+    if args.history:
+        _append_history({
+            "metric": "serve_aot_warm_join", "value": round(speedup, 2),
+            "unit": "x", "vs_baseline": None,
+            "extra": {"scenario": "aot", "platform": platform,
+                      "slots": args.slots, "requests": len(work),
+                      "max_new": args.max_new,
+                      "cold_first_token_ms": round(cold_first_s * 1e3, 1),
+                      "warm_first_token_ms": round(warm_first_s * 1e3, 1),
+                      "warm_join_cold_compiles": cold_delta,
+                      "warm_join_warm_compiles": warm_delta,
+                      "warm_dispatch_compiles": warm_compiles,
+                      "token_mismatches": mismatches}})
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2)
+    if not summary["aot_ok"]:
+        raise SystemExit("aot acceptance failed: " + json.dumps(
+            {"compile_cold_delta": cold_delta,
+             "compile_warm_delta": warm_delta,
+             "warm_dispatch_compiles": warm_compiles,
+             "token_mismatches": mismatches}))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--slots", type=int, default=4)
@@ -457,6 +602,9 @@ def main():
                          "instead of the legacy-vs-engine one")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft window rung for --speculative")
+    ap.add_argument("--aot", action="store_true",
+                    help="run the AOT warm-start leg: cold replica vs "
+                         "bundle-warm replica (tools/aot_bundle.py)")
     ap.add_argument("--history", action="store_true",
                     help="append BENCH_HISTORY.jsonl rows (bench_gate pins)")
     args = ap.parse_args()
@@ -487,6 +635,9 @@ def main():
     if args.speculative:
         run_speculative(args, model, paddle, monitor, metrics)
         return
+    if args.aot:
+        run_aot(args, model, paddle, monitor, metrics)
+        return
 
     # >= 8 distinct prompt lengths spread over the ladder, declared as a
     # replayable loadgen scenario (batch arrivals + deterministic length
@@ -509,7 +660,7 @@ def main():
         return rep.get(name, {}).get("value", 0)
 
     # ---- legacy: one generate() per request -------------------------------
-    model._generate_jit_cache = {}  # drop the probe's executables
+    model.decode_exec_registry().clear()  # drop the probe's executables
     c0 = counter("decode.jit_compiles")
     legacy_cold_wall, legacy_useful, legacy_outs = run_legacy(
         model, paddle, work)

@@ -126,10 +126,12 @@ def summarize_records(recs, emit_json=True):
     health = [r for r in recs if r.get("event") == "health"]
     alerts = [r for r in recs if r.get("event") == "alert"]
     caps = [r for r in recs if r.get("event") == "capacity"]
+    regs = [r for r in recs if r.get("event") == "exec_registry"]
     recs = [r for r in recs if r.get("event") not in ("serve_request",
                                                       "serve_step", "health",
                                                       "route", "alert",
-                                                      "capacity")]
+                                                      "capacity",
+                                                      "exec_registry")]
     if not recs and caps and not (serve_reqs or serve_steps or routes
                                   or health):
         # capacity.jsonl (plus, in one merged view, alerts.jsonl): the
@@ -153,7 +155,7 @@ def summarize_records(recs, emit_json=True):
         return out
     if not recs:
         out = _summarize_serve(serve_reqs, serve_steps, routes,
-                               emit_json=False)
+                               regs=regs, emit_json=False)
         if alerts:
             out["alerts"] = _summarize_alerts(alerts, emit_json=False)
         if caps:
@@ -215,7 +217,7 @@ def summarize_records(recs, emit_json=True):
               f"zero_update_steps={zsteps}")
     if serve_reqs or serve_steps or routes:
         summary["serve"] = _summarize_serve(serve_reqs, serve_steps, routes,
-                                            emit_json=False)
+                                            regs=regs, emit_json=False)
     if health:
         summary["health"] = _summarize_health(health, emit_json=False)
     if alerts:
@@ -406,11 +408,14 @@ def _summarize_capacity(caps, alerts=(), emit_json=True):
     return summary
 
 
-def _summarize_serve(serve_reqs, serve_steps, routes=(), emit_json=True):
+def _summarize_serve(serve_reqs, serve_steps, routes=(), regs=(),
+                     emit_json=True):
     """Percentile table over serve_request/serve_step/route records
     (ServingEngine + ReplicaRouter sink streams): TTFT/TPOT/queue-wait/
     request-wall + occupancy, plus the paged-KV gauges (pages in use,
-    prefix hit rate) and router placement breakdown when present."""
+    prefix hit rate), router placement breakdown, and the executable-
+    registry rollup (per-label hit/miss/eviction + cold-vs-warm compile
+    percentiles) when the engine emitted exec_registry records."""
 
     def col(recs, k, scale=1.0):
         return [r[k] * scale for r in recs
@@ -505,6 +510,38 @@ def _summarize_serve(serve_reqs, serve_steps, routes=(), emit_json=True):
         rows = [[name, n] for name, n in sorted(per_replica.items())]
         print("router placements:")
         _fmt_table(["replica", "requests"], rows)
+    if regs:
+        # the engine emits a CUMULATIVE rollup per run()/drain(): the last
+        # record per registry name is that registry's episode total
+        latest = {}
+        for r in regs:
+            latest[r.get("registry")] = r
+        for name, reg in sorted(latest.items()):
+            labels = reg.get("labels") or {}
+            print(f"exec registry [{name}]: entries={reg.get('entries')} "
+                  f"hits={reg.get('hits')} misses={reg.get('misses')} "
+                  f"evictions={reg.get('evictions')} "
+                  f"evict_refusals={reg.get('evict_refusals')} "
+                  f"aot_fallbacks={reg.get('aot_fallbacks')}")
+            rows = [[lbl, st.get("hits", 0), st.get("misses", 0),
+                     st.get("evictions", 0)]
+                    for lbl, st in sorted(labels.items())]
+            if rows:
+                _fmt_table(["label", "hits", "misses", "evictions"], rows)
+            reg_pcts = _pctl_table([
+                ("compile_cold", "ms", reg.get("compile_cold_ms") or []),
+                ("compile_warm", "ms", reg.get("compile_warm_ms") or []),
+                ("compile_all", "ms", reg.get("compile_ms") or []),
+            ])
+            summary.setdefault("exec_registry", {})[name] = {
+                "entries": reg.get("entries"),
+                "hits": reg.get("hits"), "misses": reg.get("misses"),
+                "evictions": reg.get("evictions"),
+                "evict_refusals": reg.get("evict_refusals"),
+                "aot_fallbacks": reg.get("aot_fallbacks"),
+                "labels": labels,
+                "compile_percentiles": reg_pcts,
+            }
     if emit_json:
         print(json.dumps({"summary": summary}))
     return summary
@@ -564,6 +601,30 @@ def summarize_snapshot_doc(doc, emit_json=True):
         "gauges": len(doc.get("gauges", {})),
         "percentiles": pcts,
     }
+    # executable-registry rollup (core/exec_registry.py): per-label
+    # hit/miss/eviction counters; the cold-vs-warm compile_ms percentiles
+    # ride the generic histogram table above (exec.registry.compile_*_ms)
+    ex_pre = "exec.registry."
+    per_label, top = {}, {}
+    for k, v in sorted((doc.get("counters") or {}).items()):
+        if not k.startswith(ex_pre):
+            continue
+        label, _, stat = k[len(ex_pre):].rpartition(".")
+        if label and stat in ("hits", "misses", "evictions"):
+            per_label.setdefault(label, {})[stat] = int(v)
+        else:
+            top[k[len(ex_pre):]] = int(v)
+    if per_label or top:
+        rows = [[lbl, st.get("hits", 0), st.get("misses", 0),
+                 st.get("evictions", 0)]
+                for lbl, st in sorted(per_label.items())]
+        if rows:
+            print("executable registry (per label):")
+            _fmt_table(["label", "hits", "misses", "evictions"], rows)
+        if top:
+            print("exec registry totals: " + "  ".join(
+                f"{k}={v}" for k, v in sorted(top.items())))
+        summary["exec_registry"] = {"labels": per_label, **top}
     if slo_gauges:
         summary["slo_gauges"] = slo_gauges
         summary["slo_firing"] = sorted(
