@@ -133,6 +133,21 @@ define_flag("zero_update", False,
             "incompatible configs warn once and run the replicated (or "
             "GSPMD) update. Also per-engine: TrainStepEngine("
             "zero_update=True)")
+define_flag("fsdp", False,
+            "fully sharded data parallelism on the fused gradient path "
+            "(arXiv:2004.13336 taken past the optimizer state; "
+            "distributed/grad_comm.py make_fsdp_accum_step): parameters "
+            "live ONLY as contiguous per-layer flat f32 1/N shards between "
+            "steps, each layer's weights all-gather just before their "
+            "forward/backward use inside the compiled step, gradients "
+            "reduce-scatter back onto the owning shard, and the uniform "
+            "elementwise optimizer rule runs shard-locally — param AND "
+            "opt-state residency drop to ~1/N with no trailing parameter "
+            "gather. Same eligibility gate as zero_update (pure "
+            "data-parallel meshes, uniform rules); ineligible configs warn "
+            "once and run the replicated (or GSPMD) path. Supersedes "
+            "zero_update when both are set. Also per-engine: "
+            "TrainStepEngine(fsdp=True)")
 define_flag("health_monitor", False,
             "compute training-health statistics (global + per-parameter "
             "grad/weight norms, update-to-weight ratios, non-finite "

@@ -196,33 +196,54 @@ def capture_snapshot(engine) -> Snapshot:
         except Exception:
             pass  # non-jax or already-host arrays: materialize below anyway
 
-    for arr in engine.params.values():
-        issue(arr)
-    if engine._zero_opt is not None:
-        for flat in engine._zero_opt:
+    fsdp = getattr(engine, "_fsdp_params", None) is not None
+    if fsdp:
+        for flat in engine._fsdp_params:
             issue(flat)
-    elif engine.opt_state is not None:
-        for comps in engine.opt_state.values():
-            for c in comps:
-                issue(c)
+        for col in engine._fsdp_opt:
+            for flat in col:
+                issue(flat)
+    else:
+        for arr in engine.params.values():
+            issue(arr)
+        if engine._zero_opt is not None:
+            for flat in engine._zero_opt:
+                issue(flat)
+        elif engine.opt_state is not None:
+            for comps in engine.opt_state.values():
+                for c in comps:
+                    issue(c)
 
-    params = {n: _host_pieces(arr) for n, arr in engine.params.items()}
     opt = None
     zero = None
-    if engine._zero_opt is not None:
-        n, n_pad, shard, nrep = engine._zero_layout()
-        zero = {"meta": {"n": int(n), "n_pad": int(n_pad),
-                         "nrep": int(nrep),
-                         "slots": len(engine._zero_opt)},
-                "pieces": []}
-        for j, flat in enumerate(engine._zero_opt):
-            for off, piece in _flat_pieces(flat):
-                zero["pieces"].append((j, off, piece))
-    elif engine.opt_state is not None:
+    if fsdp:
+        # decode the per-bucket flat shards host-side into the ordinary
+        # replicated manifest sections (params per-name, opt per name.slot)
+        # — every restore path (replicated, ZeRO, fsdp, changed dp degree)
+        # then works unchanged off the same manifest, and the fsdp target
+        # re-encodes lazily on its next step
+        params = {n: _host_pieces(arr)
+                  for n, arr in engine._gather_fsdp_params().items()}
         opt = {}
-        for n, comps in engine.opt_state.items():
+        for n, comps in engine._gather_fsdp_opt().items():
             for ci, c in enumerate(comps):
                 opt[f"{n}.{ci}"] = _host_pieces(c)
+    else:
+        params = {n: _host_pieces(arr) for n, arr in engine.params.items()}
+        if engine._zero_opt is not None:
+            n, n_pad, shard, nrep = engine._zero_layout()
+            zero = {"meta": {"n": int(n), "n_pad": int(n_pad),
+                             "nrep": int(nrep),
+                             "slots": len(engine._zero_opt)},
+                    "pieces": []}
+            for j, flat in enumerate(engine._zero_opt):
+                for off, piece in _flat_pieces(flat):
+                    zero["pieces"].append((j, off, piece))
+        elif engine.opt_state is not None:
+            opt = {}
+            for n, comps in engine.opt_state.items():
+                for ci, c in enumerate(comps):
+                    opt[f"{n}.{ci}"] = _host_pieces(c)
 
     key_words = np.array(jax.random.key_data(engine._key), copy=True)
     snap = Snapshot(
@@ -476,6 +497,14 @@ def restore_checkpoint(engine, path: str, manifest: Optional[dict] = None) -> in
 
     if manifest is None:
         manifest = verify_checkpoint(path)
+    if getattr(engine, "_fsdp_params", None) is not None or \
+            engine.params is None:
+        # restore lands in the replicated layout: drop the fsdp shard
+        # residency; the next fsdp step re-encodes lazily (bit-exact — the
+        # f32 encode is a straight copy into the bucket-padded buffers)
+        engine._fsdp_params = None
+        engine._fsdp_opt = None
+        engine.params = {}
     for n in engine._param_names:
         if n not in manifest["params"]:
             raise KeyError(f"checkpoint missing param {n}")

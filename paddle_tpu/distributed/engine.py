@@ -133,7 +133,8 @@ class TrainStepEngine:
                  hcg: Optional[HybridCommunicateGroup] = None, strategy=None,
                  input_specs: Optional[List[P]] = None, donate: bool = True,
                  num_model_inputs: Optional[int] = None,
-                 microbatches: int = 1, zero_update: bool = False):
+                 microbatches: int = 1, zero_update: bool = False,
+                 fsdp: bool = False):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -203,6 +204,17 @@ class TrainStepEngine:
         self._zero_opt = None          # tuple of flat [n_pad] f32 slot shards
         self._zero_warned = False
         self._zero_reason = "unset"    # cached fallback reason (None = ok)
+        # Full FSDP (grad_comm.make_fsdp_accum_step): params AND opt state
+        # live only as per-layer flat f32 1/N shards between steps after the
+        # first sharded step (self.params/self.opt_state become None;
+        # _gather_fsdp_params/_gather_fsdp_opt reconstruct the dict forms).
+        # Same eligibility gate as zero_update; supersedes it when both set.
+        self.fsdp = bool(fsdp)
+        self._fsdp_params = None       # tuple of per-bucket [pad] f32 shards
+        self._fsdp_opt = None          # tuple (per slot) of per-bucket shards
+        self._fsdp_warned = False
+        self._fsdp_cache = None        # (nrep, chunk) -> bucket layout
+        self._param_dtypes = None      # captured at fsdp engagement
         self._batch_shardings = None   # resolved lazily from the first batch
         self._pending_h2d = None       # (h2d_ms, depth) staged by prefetch()
         self.prefetcher = None         # last DevicePrefetcher built by prefetch()
@@ -397,18 +409,29 @@ class TrainStepEngine:
             new_hcg.degrees["sharding"] > 1
 
         # ---- host gather off the OLD mesh (owned copies) ----
-        host_params = {n: np.array(self.params[n], copy=True)
-                       for n in self._param_names}
-        host_opt = None
-        if self.opt_state is not None:
-            host_opt = {n: tuple(np.array(s, copy=True)
-                                 for s in self.opt_state[n])
-                        for n in self._param_names}
+        fsdp_live = self._fsdp_params is not None
         host_zero = None
-        if self._zero_opt is not None:
-            n_elems = self._n_grad_elems()
-            host_zero = [np.array(f, copy=True)[:n_elems]
-                         for f in self._zero_opt]
+        if fsdp_live:
+            # decode the per-layer bucket shards into the replicated host
+            # view — exactly the bytes a synchronous checkpoint at this
+            # boundary would hold — then re-encode below against the NEW
+            # replica count (the flat param shards reslice, like ZeRO's)
+            host_params = {n: np.array(v, copy=True)
+                           for n, v in self._gather_fsdp_params().items()}
+            host_opt = {n: tuple(np.array(s, copy=True) for s in slots)
+                        for n, slots in self._gather_fsdp_opt().items()}
+        else:
+            host_params = {n: np.array(self.params[n], copy=True)
+                           for n in self._param_names}
+            host_opt = None
+            if self.opt_state is not None:
+                host_opt = {n: tuple(np.array(s, copy=True)
+                                     for s in self.opt_state[n])
+                            for n in self._param_names}
+            if self._zero_opt is not None:
+                n_elems = self._n_grad_elems()
+                host_zero = [np.array(f, copy=True)[:n_elems]
+                             for f in self._zero_opt]
 
         # ---- rebuild placements against the NEW mesh (temporaries) ----
         new_param_specs = {}
@@ -417,8 +440,9 @@ class TrainStepEngine:
             p = self._state_refs[n]
             spec = _param_spec(p, p.shape, new_hcg)
             new_param_specs[n] = spec
-            new_params[n] = jax.device_put(
-                host_params[n], NamedSharding(new_mesh, spec))
+            if not fsdp_live:     # fsdp re-encodes shards, never replicates
+                new_params[n] = jax.device_put(
+                    host_params[n], NamedSharding(new_mesh, spec))
         new_opt_specs = {
             n: _opt_state_spec(new_param_specs[n],
                                self._state_refs[n].shape, new_hcg,
@@ -432,7 +456,7 @@ class TrainStepEngine:
             return NamedSharding(new_mesh, spec)
 
         new_opt_state = None
-        if host_opt is not None:
+        if host_opt is not None and not fsdp_live:
             new_opt_state = {
                 n: tuple(jax.device_put(s, _opt_sh(new_opt_specs[n]))
                          for s in host_opt[n])
@@ -456,6 +480,21 @@ class TrainStepEngine:
                 flats.append(jax.device_put(buf, sh))
             new_zero = tuple(flats)
 
+        new_fsdp_params = new_fsdp_opt = None
+        if fsdp_live:
+            batch_axes = tuple(a for a in ("dp", "sharding")
+                               if new_hcg.degrees[a] > 1)
+            nrep_new = _gc.replica_count(new_mesh, batch_axes)
+            buckets_new = _gc.fsdp_buckets(
+                {n: tuple(self._state_refs[n].shape)
+                 for n in self._param_names},
+                nrep_new, _gc.chunk_size(), layer_key=self._fsdp_layer_key())
+            spec = P(batch_axes if len(batch_axes) > 1
+                     else (batch_axes[0] if batch_axes else None))
+            new_fsdp_params, new_fsdp_opt = self._encode_fsdp_state(
+                host_params, host_opt, buckets_new,
+                NamedSharding(new_mesh, spec))
+
         # surface transfer failures (OOM, detached device) BEFORE commit
         for arr in new_params.values():
             arr.block_until_ready()
@@ -466,15 +505,23 @@ class TrainStepEngine:
         if new_zero is not None:
             for f in new_zero:
                 f.block_until_ready()
+        if new_fsdp_params is not None:
+            for f in new_fsdp_params:
+                f.block_until_ready()
+            for slot in new_fsdp_opt:
+                for f in slot:
+                    f.block_until_ready()
 
         # ---- commit + drop every mesh-derived cache ----
         self.hcg = new_hcg
         self.mesh = new_mesh
         self.param_specs = new_param_specs
-        self.params = new_params
+        self.params = None if fsdp_live else new_params
         self.opt_specs = new_opt_specs
         self.opt_state = new_opt_state
         self._zero_opt = new_zero
+        self._fsdp_params = new_fsdp_params
+        self._fsdp_opt = new_fsdp_opt
         self._invalidate_step_fns()
         self._execs.discard("train.run_steps")
         self._scan_batch_shardings = {}
@@ -487,6 +534,8 @@ class TrainStepEngine:
         self._lr_cache = (None, None)
         self._zero_reason = "unset"
         self._zero_warned = False
+        self._fsdp_cache = None
+        self._fsdp_warned = False
         self._gspmd_warned = False
 
     # ---- compiled-executable introspection (observability/exec_introspect) --
@@ -577,6 +626,30 @@ class TrainStepEngine:
                                  "all-reduce": (0, clip_hi - 1),
                                  "all-to-all": 0},
                     while_loops=(1, None), name="zero-decomposition"),
+                # fsdp: exactly L per-bucket weight gathers + ONE grad
+                # reduce-scatter, zero full-buffer all-reduces, K-independent
+                # (int8 swaps the scatter for two EQuARX all-to-alls)
+                _an.ProgramContract(
+                    "train.fsdp_*_f32",
+                    collectives={"all-gather": len(self._fsdp_layout()),
+                                 "reduce-scatter": 1,
+                                 "all-reduce": (0, clip_hi - 1),
+                                 "all-to-all": 0},
+                    while_loops=(1, None), name="fsdp-decomposition"),
+                _an.ProgramContract(
+                    "train.fsdp_*_bf16*",
+                    collectives={"all-gather": len(self._fsdp_layout()),
+                                 "reduce-scatter": 1,
+                                 "all-reduce": (0, clip_hi - 1),
+                                 "all-to-all": 0},
+                    while_loops=(1, None), name="fsdp-decomposition-bf16"),
+                _an.ProgramContract(
+                    "train.fsdp_*_int8*",
+                    collectives={"all-gather": len(self._fsdp_layout()),
+                                 "reduce-scatter": 0,
+                                 "all-to-all": 2,
+                                 "all-reduce": (0, clip_hi - 1)},
+                    while_loops=(1, None), name="fsdp-quantized"),
                 _an.ProgramContract(
                     "train.step", requires_combining=True,
                     collectives={"all-reduce": (1, 4)},
@@ -938,7 +1011,10 @@ class TrainStepEngine:
     def _zero_on(self) -> bool:
         """True when this step runs the ZeRO weight-update-sharded program
         (requested AND compatible). Incompatible configs warn ONCE and run
-        the replicated (or GSPMD) update."""
+        the replicated (or GSPMD) update. Yields to fsdp — the fully
+        sharded path subsumes the weight-update sharding."""
+        if self._fsdp_on():
+            return False
         if not self._zero_requested():
             return False
         reason = self._zero_fallback_reason()
@@ -1051,6 +1127,220 @@ class TrainStepEngine:
             "replicated_opt_bytes": slots * n * 4,
             "sharded_opt_bytes_per_device": slots * shard * 4,
         }
+
+    # ---- FSDP: fully sharded parameters (arXiv:2004.13336, all the way) ----
+    def _fsdp_requested(self) -> bool:
+        return bool(self.fsdp or _flags.flag("fsdp"))
+
+    def _fsdp_on(self) -> bool:
+        """True when this step runs the fully-sharded program (requested
+        AND compatible — the eligibility gate is exactly ZeRO's: pure-dp
+        mesh, uniform elementwise rule, global-norm/value clip, no
+        offload). Incompatible configs warn ONCE and run the replicated
+        (or GSPMD) update. Supersedes zero_update when both are set."""
+        if not self._fsdp_requested():
+            return False
+        reason = self._zero_fallback_reason()
+        if reason is None:
+            return True
+        if not self._fsdp_warned:
+            import warnings
+
+            warnings.warn("fsdp requested but falling back to the "
+                          f"replicated update: {reason}")
+            self._fsdp_warned = True
+        return False
+
+    def _fsdp_layer_key(self):
+        """The model's bucket-granularity hook (``fsdp_layer_key(name)``)
+        or None for grad_comm.default_layer_key (one bucket per module)."""
+        return getattr(self.model, "fsdp_layer_key", None)
+
+    def _fsdp_layout(self):
+        """Per-layer bucket metadata of the flat sorted-name parameter
+        vector for the current mesh (cached per (nrep, chunk)): each
+        bucket is a contiguous run of names sharing a layer key, padded
+        to a multiple of nrep*chunk — these are the per-layer all-gather
+        boundaries and the shard shapes of the resident state."""
+        nrep = _gc.replica_count(self.mesh, self._batch_axes())
+        chunk = _gc.chunk_size()
+        if self._fsdp_cache is not None and \
+                self._fsdp_cache[0] == (nrep, chunk):
+            return self._fsdp_cache[1]
+        buckets = _gc.fsdp_buckets(
+            {n: tuple(self._state_refs[n].shape)
+             for n in self._param_names},
+            nrep, chunk, layer_key=self._fsdp_layer_key())
+        self._fsdp_cache = ((nrep, chunk), buckets)
+        return buckets
+
+    def fsdp_memory_model(self):
+        """Analytic param+opt residency of the fsdp path: replicated
+        bytes vs per-bucket flat-shard bytes per device (~1/N for BOTH
+        params and optimizer state — ZeRO only shards the latter), plus
+        the per-step wire bytes (L bucket weight gathers + one grad
+        reduce-scatter). The measured counterpart is
+        introspect_executables() argument bytes (tools/mem_report.py)."""
+        buckets = self._fsdp_layout()
+        nrep = _gc.replica_count(self.mesh, self._batch_axes())
+        slots = self._zero_n_slots()
+        n = self._n_grad_elems()
+        shard_elems = [b["shard"] for b in buckets]
+        rs_b, ag_b, per_layer = _gc.fsdp_payload_bytes(
+            shard_elems, nrep, _gc.comm_dtype(), _gc.chunk_size())
+        return {
+            "replicas": nrep,
+            "n_grad_elems": n,
+            "opt_slots": slots,
+            "buckets": [{"key": b["key"], "n": b["n"], "pad": b["pad"],
+                         "shard": b["shard"], "ag_bytes": ab}
+                        for b, ab in zip(buckets, per_layer)],
+            "replicated_param_bytes": n * 4,
+            "sharded_param_bytes_per_device": sum(shard_elems) * 4,
+            "replicated_opt_bytes": slots * n * 4,
+            "sharded_opt_bytes_per_device": slots * sum(shard_elems) * 4,
+            "rs_bytes": rs_b,
+            "ag_bytes": ag_b,
+        }
+
+    def _encode_fsdp_state(self, params_src, opt_src, buckets, sh):
+        """Encode replicated host-view params (+ opt-state dict) into the
+        per-bucket flat f32 [pad] buffers placed with sharding ``sh``
+        (sorted-name order within each bucket, zero pad tail). Returns
+        (per-bucket param tuple, per-slot tuple of per-bucket tuples)."""
+        n_slots = self._zero_n_slots()
+        p_out = []
+        o_cols = [[] for _ in range(n_slots)]
+        for b in buckets:
+            pbuf = np.zeros((b["pad"],), np.float32)
+            obufs = [np.zeros((b["pad"],), np.float32)
+                     for _ in range(n_slots)]
+            off = 0
+            for nm in b["names"]:
+                size = int(np.prod(self._state_refs[nm].shape) or 1)
+                pbuf[off:off + size] = np.asarray(
+                    params_src[nm], np.float32).reshape(-1)
+                if opt_src is not None:
+                    for j in range(n_slots):
+                        obufs[j][off:off + size] = np.asarray(
+                            opt_src[nm][j], np.float32).reshape(-1)
+                off += size
+            p_out.append(jax.device_put(pbuf, sh))
+            for j in range(n_slots):
+                o_cols[j].append(jax.device_put(obufs[j], sh))
+        return tuple(p_out), tuple(tuple(col) for col in o_cols)
+
+    def _ensure_fsdp_state(self):
+        """Lazy ONE-WAY conversion of the replicated params + opt state
+        into per-bucket flat f32 1/N shards. After the first fsdp step
+        self.params AND self.opt_state are None — the bucket shards ARE
+        the state; _gather_fsdp_params()/_gather_fsdp_opt() reconstruct
+        the replicated views for checkpoints/sync_to_model."""
+        buckets = self._fsdp_layout()
+        if self._fsdp_params is not None:
+            if len(self._fsdp_params) != len(buckets) or any(
+                    f.shape != (b["pad"],)
+                    for f, b in zip(self._fsdp_params, buckets)):
+                raise ValueError(
+                    "the flat sharded parameter state was built for a "
+                    "different bucket layout — FLAGS_grad_comm_chunk or "
+                    "the mesh changed after the first fsdp step; rebuild "
+                    "the engine")
+            return self._fsdp_params, self._fsdp_opt
+        self._param_dtypes = {n: np.dtype(self.params[n].dtype)
+                              for n in self._param_names}
+        opt_src = self._gather_zero_opt()  # dict view (handles prior ZeRO)
+        self._fsdp_params, self._fsdp_opt = self._encode_fsdp_state(
+            {n: np.asarray(self.params[n]) for n in self._param_names},
+            opt_src, buckets, self._residual_sharding())
+        self.params = None   # one-way: the bucket shards are the state now
+        self.opt_state = None
+        self._zero_opt = None
+        return self._fsdp_params, self._fsdp_opt
+
+    def _gather_fsdp_params(self):
+        """Reconstruct the replicated {name: array} param dict from the
+        bucket shards (host gather; checkpoint/sync convenience). Returns
+        self.params unchanged when fsdp never engaged."""
+        if self._fsdp_params is None:
+            return self.params
+        dts = self._param_dtypes or {}
+        out = {}
+        for b, f in zip(self._fsdp_layout(), self._fsdp_params):
+            flat = np.asarray(f)
+            off = 0
+            for nm in b["names"]:
+                shape = tuple(self._state_refs[nm].shape)
+                size = int(np.prod(shape) or 1)
+                out[nm] = flat[off:off + size].reshape(shape).astype(
+                    dts.get(nm, np.float32), copy=False)
+                off += size
+        return out
+
+    def _gather_fsdp_opt(self):
+        """Replicated {name: (slot, ...)} opt-state dict decoded from the
+        bucket shards; falls through to the ZeRO/replicated forms when
+        fsdp never engaged."""
+        if self._fsdp_params is None:
+            return self._gather_zero_opt()
+        cols = [[np.asarray(f) for f in col] for col in self._fsdp_opt]
+        out = {}
+        for bi, b in enumerate(self._fsdp_layout()):
+            off = 0
+            for nm in b["names"]:
+                shape = tuple(self._state_refs[nm].shape)
+                size = int(np.prod(shape) or 1)
+                out[nm] = tuple(col[bi][off:off + size].reshape(shape)
+                                for col in cols)
+                off += size
+        return out
+
+    def _build_fsdp_accum(self, batch_avals, k, dtype, use_residual, chunk):
+        """Jit the fully-sharded accumulation step: parameters enter AND
+        leave as per-bucket flat f32 [pad] buffers sharded 1/N over the
+        data axes (exactly like the ZeRO opt slots), each bucket
+        all-gathers just before use inside the step, and ONE
+        reduce-scatter lands the grads on the owning shard for the
+        shard-local clip+update. No trailing parameter gather — that is
+        the argument-bytes win over _build_zero_accum."""
+        compute = self._build_compute_loss()
+        health = self._health
+        dts = self._param_dtypes or {}
+        param_templates = {
+            n: jax.ShapeDtypeStruct(
+                tuple(self._state_refs[n].shape),
+                self.params[n].dtype if self.params is not None
+                else dts.get(n, np.dtype(np.float32)))
+            for n in self._param_names}
+        buckets = self._fsdp_layout()
+        step = _gc.make_fsdp_accum_step(
+            compute_loss=compute, flat_update=self._make_flat_update(),
+            clip=self.optimizer._grad_clip, mesh=self.mesh,
+            batch_axes=self._batch_axes(), k=k, dtype=dtype, chunk=chunk,
+            use_residual=use_residual, param_templates=param_templates,
+            buckets=buckets,
+            health_partial=(health.make_sharded_stats()
+                            if health is not None else None))
+        batch_shardings = self._shardings_for(batch_avals)
+        shard_sh = self._residual_sharding()
+        p_sh = tuple(shard_sh for _ in buckets)
+        opt_sh = tuple(p_sh for _ in range(self._zero_n_slots()))
+        scalar = NamedSharding(self.mesh, P())
+        in_sh = (p_sh, opt_sh)
+        out_sh = (scalar, p_sh, opt_sh)
+        donate = (0, 1)
+        if use_residual:
+            in_sh += (shard_sh,)
+            out_sh += (shard_sh,)
+            donate = (0, 1, 2)
+        if health is not None:
+            out_sh += (shard_sh,)  # [nrep, 4P] per-replica rows ride LAST
+        return jax.jit(
+            step,
+            in_shardings=in_sh + (scalar, scalar, scalar) + batch_shardings,
+            out_shardings=out_sh,
+            donate_argnums=donate if self._donate else (),
+        )
 
     def _build_zero_accum(self, batch_avals, k, dtype, use_residual, chunk):
         """Jit the ZeRO weight-update-sharded accumulation step: same scan
@@ -1181,11 +1471,18 @@ class TrainStepEngine:
         from ..core import autotune
         autotune.set_step(self._step_count + 1)
         health_on = self._health is not None
-        cache_key = (k, dtype, use_residual, chunk, health_on, zero)
-        label = (f"train.zero_k{k}_{dtype}" if zero
+        fsdp = self._fsdp_on()
+        # fsdp appends rather than widening the tuple so non-fsdp keys stay
+        # identical to the PR 18 registry layout (pinned by test_zero_update)
+        cache_key = (k, dtype, use_residual, chunk, health_on, zero) + \
+            ((True,) if fsdp else ())
+        label = (f"train.fsdp_k{k}_{dtype}" if fsdp
+                 else f"train.zero_k{k}_{dtype}" if zero
                  else f"train.accum_k{k}_{dtype}") + \
             ("_res" if use_residual else "")
-        build = self._build_zero_accum if zero else self._build_accum
+        build = (self._build_fsdp_accum if fsdp
+                 else self._build_zero_accum if zero
+                 else self._build_accum)
         entry = self._execs.get_or_build(
             ("train.accum",) + cache_key,
             lambda: build(arrays, k, dtype, use_residual, chunk),
@@ -1212,22 +1509,30 @@ class TrainStepEngine:
         p0 = _compile_cache.entries() if n0 == 0 else -1
         t0 = time.perf_counter()
         try:
-            opt_in = (self._ensure_zero_opt() if zero
-                      else self._opt_to_hbm(self.opt_state))
+            if fsdp:
+                p_in, opt_in = self._ensure_fsdp_state()
+            else:
+                p_in = self.params
+                opt_in = (self._ensure_zero_opt() if zero
+                          else self._opt_to_hbm(self.opt_state))
             if use_residual:
-                call_args = (self.params, opt_in,
+                call_args = (p_in, opt_in,
                              self._ensure_residual(), lr,
                              jnp.int32(self._step_count), sub) + tuple(arrays)
                 self._stash_exec(label, fn, call_args)
                 outs = fn(*call_args)
-                loss, self.params, new_opt, self._grad_residual = outs[:4]
+                loss, new_p, new_opt, self._grad_residual = outs[:4]
             else:
-                call_args = (self.params, opt_in,
+                call_args = (p_in, opt_in,
                              lr, jnp.int32(self._step_count),
                              sub) + tuple(arrays)
                 self._stash_exec(label, fn, call_args)
                 outs = fn(*call_args)
-                loss, self.params, new_opt = outs[:3]
+                loss, new_p, new_opt = outs[:3]
+            if fsdp:
+                self._fsdp_params = tuple(new_p)
+            else:
+                self.params = new_p
             hbuf = outs[-1] if health_on else None
             if tele is not None or fr is not None or mreg is not None:
                 jax.block_until_ready(loss)
@@ -1240,7 +1545,16 @@ class TrainStepEngine:
         compiled = self._execs.note_compiles(
             entry, n_before=n0, n_after=_jit_cache_size(fn), wall_s=t1 - t0,
             persistent_before=p0, engine_counters=True) > 0
-        if zero:
+        if fsdp:
+            # L per-bucket weight gathers + one grad reduce-scatter; the
+            # health partials ride a sharded output (no collective bytes)
+            rs_b, ag_b = ((0, 0) if nrep <= 1 else _gc.fsdp_payload_bytes(
+                [b["shard"] for b in self._fsdp_layout()], nrep, dtype,
+                chunk)[:2])
+            comm_bytes = rs_b + ag_b
+            _gc.RS_BYTES.increase(rs_b)
+            _gc.AG_BYTES.increase(ag_b)
+        elif zero:
             rs_b, ag_b = ((0, 0) if nrep <= 1 else _gc.zero_payload_bytes(
                 self._n_grad_elems(), nrep, dtype, chunk,
                 4 * len(self._param_names) if health_on else 0))
@@ -1260,12 +1574,21 @@ class TrainStepEngine:
             tr.record_complete("engine.accum_step", t0, t1,
                                {"step": self._step_count, "compiled": compiled,
                                 "microbatches": k, "grad_comm_dtype": dtype,
-                                "zero_update": zero})
-        if zero:
+                                "zero_update": zero, "fsdp": fsdp})
+        if fsdp:
+            self._fsdp_opt = tuple(tuple(col) for col in new_opt)
+        elif zero:
             self._zero_opt = tuple(new_opt)
         else:
             self.opt_state = self._opt_to_home(new_opt)
         if hbuf is not None:
+            if fsdp:
+                # per-replica [nrep, 4P] segment partials: the cross-shard
+                # sum happens HERE (host-side) instead of as an in-program
+                # all-reduce, and only on fetch steps — off-interval steps
+                # skip the D2H entirely
+                hbuf = (np.asarray(hbuf).sum(axis=0, dtype=np.float32)
+                        if self._health.wants(self._step_count) else None)
             self._health.on_step(self._step_count, hbuf)
         self.last_loss = Tensor(loss)
         rec = None
@@ -1277,7 +1600,8 @@ class TrainStepEngine:
                 h2d_ms=h2d_ms, prefetch_depth=prefetch_depth,
                 microbatches=k, grad_comm_dtype=dtype,
                 grad_comm_bytes=comm_bytes,
-                extra=({"zero_update": True} if zero else None))
+                extra=({"fsdp": True} if fsdp
+                       else {"zero_update": True} if zero else None))
         if fr is not None or mreg is not None:
             self._obs_step_tail(fr, mreg, rec, t0, t1, h2d_ms, compiled, loss)
         if self._ckpt is not None:
@@ -1369,6 +1693,14 @@ class TrainStepEngine:
         (pinned by tests/test_zero_update.py).
         """
         arrays = self._to_arrays(batch)
+        if self._fsdp_on():
+            raise ValueError(
+                "run_steps (the fused K-step scan lane) does not compose "
+                "with fsdp: the scan carries the replicated params/opt-"
+                "state dicts while the fsdp path owns per-layer flat 1/N "
+                "shards per data replica. Use step() (one dispatch per "
+                "optimizer step, L bucket all-gathers + one reduce-"
+                "scatter) or disable fsdp for this engine.")
         if self._zero_on():
             raise ValueError(
                 "run_steps (the fused K-step scan lane) does not compose "
@@ -1477,7 +1809,7 @@ class TrainStepEngine:
     def step(self, *batch) -> Tensor:
         arrays = self._to_arrays(batch)
         if (self.microbatches > 1 or _gc.comm_dtype() != "f32"
-                or self._zero_on()):
+                or self._zero_on() or self._fsdp_on()):
             # grad_comm path: K in-program microbatches + one deferred fused
             # gradient all-reduce (and/or low-precision collectives, and/or
             # the ZeRO weight-update sharding). The default (K=1, f32, no
@@ -1593,15 +1925,19 @@ class TrainStepEngine:
 
     def sync_to_model(self):
         """Write engine-owned (possibly sharded) params back into the eager Layer."""
+        params = (self.params if self.params is not None
+                  else self._gather_fsdp_params())
         for n in self._param_names:
             # np.asarray gathers a sharded global array to host, then re-uploads dense
-            self._state_refs[n]._data = jnp.asarray(np.asarray(self.params[n]))
+            self._state_refs[n]._data = jnp.asarray(np.asarray(params[n]))
         return self.model
 
     def state_dict(self):
+        params = (self.params if self.params is not None
+                  else self._gather_fsdp_params())
         out = {}
         for n in self._param_names:
-            out[n] = Tensor(jnp.asarray(np.asarray(self.params[n])))
+            out[n] = Tensor(jnp.asarray(np.asarray(params[n])))
         for n in self._buffer_names:
             out[n] = Tensor(self.buffers[n])
         return out

@@ -578,6 +578,314 @@ def make_zero_accum_step(*, compute_loss: Callable, flat_update: Callable,
     return step
 
 
+def default_layer_key(name: str) -> str:
+    """Fallback per-layer fsdp bucket key: the parameter's owning module
+    path (everything before the final attribute), so e.g. a Linear's weight
+    and bias share one bucket. Models override by defining an
+    ``fsdp_layer_key(name)`` method that groups at the granularity whose
+    gather should hide under the previous layer's compute (models/gpt.py
+    groups one transformer block per bucket)."""
+    return name.rsplit(".", 1)[0] if "." in name else name
+
+
+def fsdp_buckets(param_shapes: Dict[str, Sequence[int]], nrep: int,
+                 chunk: int, layer_key: Optional[Callable] = None):
+    """Per-layer bucket layout of the sorted-name flat parameter vector.
+
+    Walks the names in sorted order (== ravel_pytree dict flatten order ==
+    health.segment_layout) and cuts a bucket at every change of the layer
+    key — buckets are maximal contiguous RUNS, so a key that reappears
+    later in the order simply opens another bucket and every bucket stays a
+    contiguous slice of the flat vector. Each bucket pads to a multiple of
+    nrep*chunk (equal per-replica shards AND an exact int8 chunk grid);
+    these are the per-layer all-gather boundaries of the fsdp step. Returns
+    dicts: {key, names, off (flat offset of the first real element),
+    n (real elements), pad (padded length), shard (pad // nrep)}."""
+    key_fn = layer_key or default_layer_key
+    unit = max(1, nrep) * max(1, chunk)
+    buckets: list = []
+    off = 0
+    for nm in sorted(param_shapes):
+        key = str(key_fn(nm))
+        size = int(np.prod(tuple(param_shapes[nm])) or 1)
+        if not buckets or key != buckets[-1]["key"]:
+            buckets.append({"key": key, "names": [], "off": off, "n": 0})
+        buckets[-1]["names"].append(nm)
+        buckets[-1]["n"] += size
+        off += size
+    for b in buckets:
+        b["pad"] = -(-b["n"] // unit) * unit
+        b["shard"] = b["pad"] // max(1, nrep)
+    return buckets
+
+
+def fsdp_payload_bytes(shard_elems: Sequence[int], nrep: int, dtype: str,
+                       chunk: int) -> Tuple[int, int, list]:
+    """(reduce_scatter_bytes, all_gather_bytes, per_layer_ag_bytes) per
+    device per step for the fsdp path — the local contribution handed to
+    each collective, the payload_bytes convention. The gather leg is L
+    per-bucket f32 weight-shard gathers (there is NO trailing full-
+    parameter gather — that is the arg-bytes win over ZeRO); the scatter
+    leg carries the bucket-padded grads plus one aux loss column per
+    replica row (int8: the aux column rides the f32 scales exchange)."""
+    nrep = max(1, nrep)
+    s_total = int(sum(shard_elems))
+    if dtype == "f32":
+        rs = nrep * (s_total + 1) * 4
+    elif dtype == "bf16":
+        rs = nrep * (s_total + 1) * 2
+    else:  # int8 payload + one f32 scale per chunk + the aux loss column
+        rs = nrep * s_total * 1 + nrep * (s_total // chunk + 1) * 4
+    per_layer = [int(s) * 4 for s in shard_elems]
+    return rs, sum(per_layer), per_layer
+
+
+def make_fsdp_accum_step(*, compute_loss: Callable, flat_update: Callable,
+                         clip, mesh: Mesh, batch_axes: Sequence[str], k: int,
+                         dtype: str, chunk: int, use_residual: bool,
+                         param_templates: Dict[str, jax.ShapeDtypeStruct],
+                         buckets: Sequence[dict],
+                         health_partial: Optional[Callable] = None):
+    """Fully sharded data parallelism (arXiv:2004.13336 taken the rest of
+    the way): parameters arrive as per-layer flat f32 SHARDS and leave the
+    same way — no replicated copy exists between steps.
+
+    Inside the compiled step, each bucket's weight shard is all-gathered
+    just before the forward/backward consumes it (L independent per-layer
+    gathers issued up front, so XLA's scheduler can hide each one under a
+    neighbouring bucket's compute), the accumulation scan runs against the
+    gathered view, and the post-scan reduction is ONE reduce-scatter over
+    the bucket-shard-major permutation of the flat gradient buffer — each
+    replica receives exactly the mean-grad slices for the shards it owns.
+    Clip + the uniform elementwise optimizer rule then run per bucket on
+    shard-local state and the updated shards are simply RETURNED: unlike
+    the ZeRO step there is no trailing parameter all-gather, which is what
+    drops per-device parameter residency to ~1/nrep. Per optimizer step
+    the HLO carries exactly L all-gathers + 1 reduce-scatter (f32/bf16;
+    int8 swaps the reduce-scatter for two all-to-alls of EQuARX payload +
+    scales) and ZERO full-buffer all-reduces, independent of K.
+
+    Bit-exactness vs the replicated trajectory at f32 rides on the same
+    property the ZeRO step pinned: psum_scatter(tiled)'s per-element
+    reduction order matches psum, and the permutation only relabels
+    positions. The loss rides an aux column every replica writes
+    identically into every destination row, so the scattered sum IS the
+    global sum. Health partials can't ride a gather slab here (there is
+    none, and they need the post-update shard), so each replica emits its
+    [4P] segment partial as a sharded [nrep, 4P] output the engine sums
+    host-side — zero extra collectives.
+
+    Returns step(p_shards, opt_shards[, residual], lr, step_i, key, *batch)
+    -> (loss, new_p_shards, new_opt_shards[, new_residual][, health])."""
+    if use_residual and dtype == "f32":
+        raise ValueError("error feedback needs a low-precision dtype")
+    axes = tuple(a for a in batch_axes if mesh.shape[a] > 1)
+    d0 = _spec_axes(axes)
+    nrep = replica_count(mesh, axes)
+    names = sorted(param_templates)
+    shapes = {nm: tuple(param_templates[nm].shape) for nm in names}
+    dtypes = {nm: param_templates[nm].dtype for nm in names}
+    sizes = {nm: int(np.prod(shapes[nm]) or 1) for nm in names}
+    assert [nm for b in buckets for nm in b["names"]] == names
+    n = sum(sizes.values())
+    s_total = sum(b["shard"] for b in buckets)       # local elems per replica
+    soffs = np.concatenate(
+        [[0], np.cumsum([b["shard"] for b in buckets])]).astype(np.int64)
+    poffs = np.concatenate(
+        [[0], np.cumsum([b["pad"] for b in buckets])]).astype(np.int64)
+    # flat-index -> parameter-ordinal map per replica row (bucket-shard
+    # order); pad slots land in segment P and are dropped by the partial
+    seg_ids = None
+    if health_partial is not None:
+        ordinal = {nm: i for i, nm in enumerate(names)}
+        seg_ids = np.full((nrep, s_total), len(names), np.int32)
+        for bi, b in enumerate(buckets):
+            ids_b = np.full((b["pad"],), len(names), np.int32)
+            o = 0
+            for nm in b["names"]:
+                ids_b[o:o + sizes[nm]] = ordinal[nm]
+                o += sizes[nm]
+            seg_ids[:, soffs[bi]:soffs[bi + 1]] = ids_b.reshape(
+                nrep, b["shard"])
+
+    def _gather_params(p_shards):
+        """L per-bucket all-gathers -> the replicated param dict the
+        forward/backward consumes. tiled=True concatenates replica shards
+        in row-major replica order — the inverse of the reshape(nrep, shard)
+        the scatter side uses, so the contiguous bucket reassembles."""
+        params = {}
+        for b, pl in zip(buckets, p_shards):
+            full = jax.lax.all_gather(pl, axes, tiled=True) if axes else pl
+            o = 0
+            for nm in b["names"]:
+                params[nm] = (full[o:o + sizes[nm]].reshape(shapes[nm])
+                              .astype(dtypes[nm]))
+                o += sizes[nm]
+        return params
+
+    def _rows(flat):
+        """[n] grads in global (sorted-name) order -> [nrep, s_total]
+        destination-major rows: row r holds replica r's shard of every
+        bucket, in bucket order — the layout psum_scatter(tiled) scatters
+        by."""
+        segs = []
+        for b in buckets:
+            seg = jnp.pad(flat[b["off"]:b["off"] + b["n"]],
+                          (0, b["pad"] - b["n"]))
+            segs.append(seg.reshape(nrep, b["shard"]))
+        return jnp.concatenate(segs, axis=1)
+
+    def _scatter(flat, local_loss):
+        """The ONE gradient reduce-scatter: [n] f32 local partial-mean
+        grads -> ([s_total] reduced MEAN grad shards in bucket-shard order,
+        reduced mean loss, new residual [n] | None). Every replica writes
+        its local mean loss into the aux column of EVERY destination row,
+        so each scattered slice carries the full cross-replica loss sum.
+        With no collective axes this degrades to the identity plus the
+        quantize/dequantize roundtrip, mirroring the ZeRO _scatter."""
+        if dtype == "f32":
+            buf = jnp.concatenate(
+                [_rows(flat),
+                 jnp.full((nrep, 1), local_loss, jnp.float32)],
+                axis=1).reshape(-1)
+            out = (jax.lax.psum_scatter(buf, axes, scatter_dimension=0,
+                                        tiled=True) if axes else buf)
+            return out[:s_total] / nrep, out[s_total] / nrep, None
+        if dtype == "bf16":
+            b16 = flat.astype(jnp.bfloat16)
+            res = flat - b16.astype(jnp.float32) if use_residual else None
+            buf = jnp.concatenate(
+                [_rows(b16),
+                 jnp.full((nrep, 1), local_loss, jnp.bfloat16)],
+                axis=1).reshape(-1)
+            out = (jax.lax.psum_scatter(buf, axes, scatter_dimension=0,
+                                        tiled=True) if axes else buf)
+            out = out.astype(jnp.float32)
+            return out[:s_total] / nrep, out[s_total] / nrep, res
+        # int8: quantized reduce-scatter from two all-to-alls over the
+        # bucket-padded buffer (every bucket pad is a chunk multiple, so
+        # the chunk grid tiles each bucket exactly); the f32 aux loss
+        # column rides the scales exchange and dequant-sum reduces it
+        padbuf = jnp.concatenate(
+            [jnp.pad(flat[b["off"]:b["off"] + b["n"]],
+                     (0, b["pad"] - b["n"])) for b in buckets])
+        q, scale = _quantize_int8(padbuf, chunk)
+        res = None
+        if use_residual:
+            err = padbuf - _dequantize_int8(q, scale, padbuf.shape[0])
+            res = jnp.concatenate(
+                [err[poffs[i]:poffs[i] + b["n"]]
+                 for i, b in enumerate(buckets)])
+        qs = jnp.concatenate(
+            [q[poffs[i] // chunk:poffs[i + 1] // chunk]
+             .reshape(nrep, b["shard"] // chunk, chunk)
+             for i, b in enumerate(buckets)], axis=1)
+        ss = jnp.concatenate(
+            [scale[poffs[i] // chunk:poffs[i + 1] // chunk]
+             .reshape(nrep, b["shard"] // chunk)
+             for i, b in enumerate(buckets)], axis=1)
+        ss = jnp.concatenate(
+            [ss, jnp.full((nrep, 1), local_loss, jnp.float32)], axis=1)
+        if axes:
+            qs = jax.lax.all_to_all(qs, axes, split_axis=0, concat_axis=0)
+            ss = jax.lax.all_to_all(ss, axes, split_axis=0, concat_axis=0)
+        g = jnp.sum(qs.astype(jnp.float32) * ss[:, :s_total // chunk, None],
+                    axis=0).reshape(s_total)
+        return g / nrep, jnp.sum(ss[:, -1]) / nrep, res
+
+    def _local(p_shards, lr, step_i, key, residual, opt, *lbatch):
+        params = _gather_params(p_shards)
+        mbs = tuple(b.reshape((k, b.shape[0] // k) + b.shape[1:])
+                    for b in lbatch)
+        zero_flat, _ = ravel_pytree(
+            {nm: jnp.zeros(v.shape, jnp.float32)
+             for nm, v in params.items()})
+        shard_key = key
+        for ax in axes:  # decorrelate dropout streams across data replicas
+            shard_key = jax.random.fold_in(shard_key,
+                                           jax.lax.axis_index(ax))
+
+        def body(carry, mb):
+            acc, i = carry
+            sub = jax.random.fold_in(shard_key, i)
+            loss, g = jax.value_and_grad(
+                lambda ps: compute_loss(ps, sub, *mb))(params)
+            gflat, _ = ravel_pytree(g)
+            return (acc + gflat.astype(jnp.float32), i + jnp.int32(1)), loss
+
+        (acc, _), losses = jax.lax.scan(body, (zero_flat, jnp.int32(0)), mbs)
+        flat = acc / k
+        if residual is not None:
+            flat = flat + residual[0]
+        g_all, loss, new_res = _scatter(flat, losses.mean())
+        raw_g = g_all                       # pre-clip: health attribution
+        g_all = _clip_shard(g_all, clip, axes)
+        new_ps = []
+        new_opt_cols = [[] for _ in opt]
+        for i, b in enumerate(buckets):
+            g_b = g_all[soffs[i]:soffs[i + 1]]
+            opt_b = tuple(slot[i] for slot in opt)
+            new_p_b, new_opt_b = flat_update(p_shards[i], g_b, opt_b,
+                                             lr, step_i)
+            new_ps.append(new_p_b)
+            for j, col in enumerate(new_opt_b):
+                new_opt_cols[j].append(col)
+        outs = (loss, tuple(new_ps),
+                tuple(tuple(col) for col in new_opt_cols))
+        if use_residual:
+            outs += (new_res[None],)
+        if health_partial is not None:
+            r = jnp.int32(0)
+            for ax in axes:
+                r = r * jnp.int32(mesh.shape[ax]) + jax.lax.axis_index(ax)
+            ids = jax.lax.dynamic_slice(
+                jnp.asarray(seg_ids), (r, jnp.int32(0)), (1, s_total))[0]
+            hp = health_partial(raw_g, jnp.concatenate(list(p_shards)),
+                                jnp.concatenate(new_ps), ids)
+            outs += (hp[None],)             # [1, 4P] row per replica
+        return outs
+
+    def _region_call(p_shards, lr, step_i, key, residual, opt, batch):
+        if not axes:
+            return _local(p_shards, lr, step_i, key, residual, opt, *batch)
+        in_specs = ((P(d0), P(), P(), P())  # per-bucket weight shards first
+                    + ((P(d0),) if use_residual else ())
+                    + (P(d0),)              # per-slot per-bucket opt shards
+                    + tuple(P(d0) for _ in batch))
+        out_specs = (P(), P(d0), P(d0))
+        if use_residual:
+            out_specs += (P(d0),)
+        if health_partial is not None:
+            out_specs += (P(d0),)           # per-replica health rows
+
+        def region(p_shards, lr, step_i, key, *rest):
+            if use_residual:
+                return _local(p_shards, lr, step_i, key, rest[0], rest[1],
+                              *rest[2:])
+            return _local(p_shards, lr, step_i, key, None, rest[0],
+                          *rest[1:])
+
+        fn = shard_map(region, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+        if use_residual:
+            return fn(tuple(p_shards), lr, step_i, key, residual,
+                      tuple(opt), *batch)
+        return fn(tuple(p_shards), lr, step_i, key, tuple(opt), *batch)
+
+    if use_residual:
+        def step(p_shards, opt_shards, residual, lr, step_i, key, *batch):
+            return _region_call(p_shards, lr, step_i, key, residual,
+                                opt_shards, batch)
+
+        return step
+
+    def step(p_shards, opt_shards, lr, step_i, key, *batch):
+        return _region_call(p_shards, lr, step_i, key, None, opt_shards,
+                            batch)
+
+    return step
+
+
 def make_accum_step_gspmd(*, compute_loss: Callable, update: Callable, clip,
                           mesh: Mesh, k: int, batch_specs: Sequence[P],
                           param_specs: Optional[Dict[str, P]] = None,

@@ -227,6 +227,24 @@ class GPTModel(nn.Layer):
             x = blk(x)
         return self.ln_f(x)
 
+    @staticmethod
+    def fsdp_layer_key(name: str) -> str:
+        """FSDP bucket granularity: one bucket per transformer block (the
+        unit whose all-gather should hide under the previous block's
+        matmuls), the token/position embeddings together, and everything
+        else (final norm) in one tail bucket. Name-prefix based so it works
+        for both GPTModel params and GPTForPretraining's 'gpt.'-qualified
+        view of them."""
+        import re
+
+        m = re.match(r"(.*\bblocks\.\d+)\.", name)
+        if m:
+            return m.group(1)
+        if ".wte." in name or ".wpe." in name or \
+                name.startswith(("wte.", "wpe.")):
+            return "embeddings"
+        return "final"
+
 
 class GPTForPretrainingPipe(nn.Layer):
     """Pipeline-parallel GPT (the reference's GPTForPretrainingPipe/PipelineLayer
@@ -494,6 +512,10 @@ class GPTForPretraining(nn.Layer):
             return logits
         loss = self.loss_fn(logits, labels)
         return R.mean(loss)
+
+    # param names here are 'gpt.blocks.N.*' / 'gpt.wte.*' / 'lm_head.*';
+    # the prefix-insensitive key delegates cleanly
+    fsdp_layer_key = staticmethod(GPTModel.fsdp_layer_key)
 
     def _can_fuse_loss(self):
         if self.lm_head is not None:

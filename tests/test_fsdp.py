@@ -1,0 +1,503 @@
+"""Full FSDP: sharded-resident parameters with per-layer gather/compute
+overlap (ISSUE 19 tentpole).
+
+The composition matrix under test, layer by layer:
+
+- **bit-exactness**: with parameters living ONLY as contiguous 1/N flat
+  f32 shards between steps (per-layer all-gather just before use,
+  reduce-scatter of grads onto the owning shard, shard-local update, NO
+  trailing param all-gather), the trajectory reproduces the replicated
+  fused-all-reduce engine bit for bit — loss AND gathered params AND
+  gathered opt state — at dp4 and dp8.
+- **HLO gate**: exactly L per-bucket all-gathers + ONE reduce-scatter per
+  optimizer step independent of microbatch count K, ZERO full-buffer
+  all-reduces, microbatch scan while-loop intact — with health partials
+  riding the same program. Skipped on backends that combine collectives
+  (exact per-bucket counts would be rewritten), the shared
+  analysis.backend probe.
+- **checkpointing**: an engaged fsdp engine checkpoints as ordinary
+  per-parameter manifest sections, so a save at dp8 restores bit-equal
+  into an fsdp engine at dp4 (cross-dp reslice) AND into a replicated
+  engine; live_reshard dp4 -> dp2 -> dp4 is bit-identical to the
+  save/restore path with zero committed steps lost.
+- **health attribution**: a NaN injected into one parameter is named even
+  though that parameter's bucket shards live on OTHER replicas — the
+  per-replica [4P] partials ride the step outputs as a sharded [nrep,4P]
+  slab and are summed host-side (no extra collective).
+- **low precision**: bf16 reduce-scatter with error feedback equals the
+  replicated bf16 engine exactly; int8 rides the scales all-to-all
+  (2 all-to-alls, 0 reduce-scatters).
+- **fallbacks**: non-pure-dp meshes and non-uniform optimizer rules warn
+  ONCE ("fsdp requested ...") and run the replicated path bit-identically;
+  run_steps refuses an active fsdp engine.
+- **memory**: exec_introspect argument bytes drop by the analytic
+  param+opt sharded-state delta of engine.fsdp_memory_model() and land
+  strictly below the ZeRO executable (which still holds replicated
+  params).
+"""
+import os
+import tempfile
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis as an
+from paddle_tpu.core import monitor
+from paddle_tpu.distributed import grad_comm
+from paddle_tpu.distributed.elastic import (CheckpointManager, live_reshard,
+                                            restore_latest)
+from paddle_tpu.distributed.engine import TrainStepEngine
+from paddle_tpu.distributed.mesh import (HybridCommunicateGroup,
+                                         set_hybrid_communicate_group)
+from paddle_tpu.observability import (exec_introspect, flight_recorder,
+                                      health, metrics)
+
+
+@pytest.fixture(autouse=True)
+def _observability_cleanup():
+    yield
+    metrics.reset()
+    flight_recorder.disable()
+    health.reset()
+    exec_introspect.reset()
+
+
+def _dp(n=8):
+    set_hybrid_communicate_group(None)
+    return HybridCommunicateGroup(dp_degree=n, devices=jax.devices()[:n])
+
+
+def _make(k=2, mode="fsdp", hcg=None, seed=0, width=32, in_dim=16,
+          optimizer="adamw"):
+    paddle.seed(seed)
+    net = paddle.nn.Sequential(paddle.nn.Linear(in_dim, width),
+                               paddle.nn.ReLU(),
+                               paddle.nn.Linear(width, 4))
+    if optimizer == "adamw":
+        opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                     parameters=net.parameters())
+    else:
+        opt = paddle.optimizer.Lars(learning_rate=0.01,
+                                    parameters=net.parameters())
+    return TrainStepEngine(net, opt, loss_fn=paddle.nn.CrossEntropyLoss(),
+                           hcg=hcg if hcg is not None else _dp(),
+                           microbatches=k,
+                           zero_update=(mode == "zero"),
+                           fsdp=(mode == "fsdp"))
+
+
+def _batch(n=32, in_dim=16):
+    rng = np.random.RandomState(0)
+    return (paddle.to_tensor(rng.randn(n, in_dim).astype(np.float32)),
+            paddle.to_tensor(rng.randint(0, 4, (n,)).astype(np.int64)))
+
+
+def _losses(engine, x, y, steps=3):
+    return [float(engine.step(x, y).item()) for _ in range(steps)]
+
+
+def _fsdp_compiled(eng):
+    (label, (fn, avals)), = [kv for kv in eng._exec_stash.items()
+                             if kv[0].startswith("train.fsdp")]
+    return label, fn.lower(*avals).compile()
+
+
+def _skip_if_backend_combines():
+    """Exact per-bucket all-gather counts only hold on backends that do NOT
+    combine collectives — the shared analysis.backend probe (the inverse of
+    test_hlo_perf_gates' combining-required gates)."""
+    if an.collective_combining_reason() is None:
+        pytest.skip("backend combines collectives; exact per-bucket "
+                    "all-gather counts are rewritten")
+
+
+# ----------------------------------------------------------- bit-exactness
+
+@pytest.mark.parametrize("dp", [4, 8])
+def test_f32_fsdp_bit_equal_to_replicated(dp):
+    """Sharded-resident params, per-bucket gathers, grad reduce-scatter,
+    shard-local update — and the trajectory is STILL bit-equal to the
+    replicated fused-all-reduce engine: loss, params, and opt state, for
+    five steps with K=2 microbatches."""
+    hcg = _dp(dp)
+    x, y = _batch()
+    er = _make(k=2, mode=None, hcg=hcg)
+    ef = _make(k=2, hcg=hcg)
+    lr, lf = _losses(er, x, y, steps=5), _losses(ef, x, y, steps=5)
+    assert lf == lr  # exact float equality, not allclose
+
+    # fsdp engaged: flat shards own ALL state, the replicated dicts are gone
+    assert ef._fsdp_params is not None and ef.params is None
+    assert ef.opt_state is None and ef._zero_opt is None
+
+    pf, of = ef._gather_fsdp_params(), ef._gather_fsdp_opt()
+    for n in er.params:
+        np.testing.assert_array_equal(np.asarray(er.params[n]),
+                                      np.asarray(pf[n]), err_msg=n)
+        for a, b in zip(er.opt_state[n], of[n]):
+            np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                          np.asarray(b, np.float32))
+
+
+# ---------------------------------------------------------------- HLO gate
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_hlo_per_bucket_gathers_one_reduce_scatter_no_all_reduce(k):
+    """The compiled step holds exactly L per-bucket all-gathers and ONE
+    reduce-scatter independent of K, zero full-buffer all-reduces and zero
+    all-to-alls (f32), keeps the microbatch scan while-loop — and there is
+    NO trailing param all-gather (L gathers total, not L+1) — with health
+    partials riding the same program."""
+    _skip_if_backend_combines()
+    ef = _make(k=k)
+    ef.enable_health(interval=1)
+    x, y = _batch()
+    ef.step(x, y)
+    label, comp = _fsdp_compiled(ef)
+    assert label == f"train.fsdp_k{k}_f32"
+    L = len(ef._fsdp_layout())
+    assert L >= 2  # per-layer, not one monolithic slab
+    rep = an.check_compiled(label, comp, an.ProgramContract(
+        collectives={"all-gather": L, "reduce-scatter": 1,
+                     "all-reduce": 0, "all-to-all": 0},
+        while_loops=(1, None),
+        allow_host_calls=True, max_constant_bytes=None))
+    assert rep.ok, f"fsdp decomposition contract broken:\n{rep.format()}"
+    assert ef._health.recent()  # health rode the same program
+    ef.disable_health()
+
+
+def test_int8_rides_scales_all_to_all():
+    """int8 payloads exchange chunk scales through the two all-to-alls of
+    the quantized path (no reduce-scatter op), still L all-gathers, and the
+    losses stay finite."""
+    _skip_if_backend_combines()
+    paddle.set_flags({"grad_comm_dtype": "int8", "grad_comm_chunk": 16})
+    ef = _make(k=2)
+    x, y = _batch()
+    li = _losses(ef, x, y, steps=3)
+    assert all(np.isfinite(li))
+    label, comp = _fsdp_compiled(ef)
+    rep = an.check_compiled(label, comp, an.ProgramContract(
+        collectives={"all-gather": len(ef._fsdp_layout()),
+                     "reduce-scatter": 0, "all-to-all": 2, "all-reduce": 0},
+        while_loops=(1, None),
+        allow_host_calls=True, max_constant_bytes=None))
+    assert rep.ok, rep.format()
+
+
+# ----------------------------------------------- bf16 + error feedback
+
+def test_bf16_error_feedback_equals_replicated_bf16():
+    """bf16 reduce-scatter with error feedback: the fsdp trajectory equals
+    the replicated bf16 engine EXACTLY (both quantize identically), the
+    residual is carried sharded state and is donated each step."""
+    paddle.set_flags({"grad_comm_dtype": "bf16",
+                      "grad_comm_error_feedback": True})
+    hcg = _dp()
+    x, y = _batch()
+    er = _make(k=2, mode=None, hcg=hcg)
+    ef = _make(k=2, hcg=hcg)
+    ef.step(x, y)
+    res0 = ef._grad_residual
+    assert res0 is not None
+    er.step(x, y)  # keep the two engines on the same step index
+    la = [float(er.step(x, y).item()) for _ in range(3)]
+    lb = [float(ef.step(x, y).item()) for _ in range(3)]
+    assert lb == la
+    assert res0.is_deleted()  # donated through the step
+    assert not ef._grad_residual.is_deleted()
+
+
+# ------------------------------------------------------------ checkpointing
+
+def test_checkpoint_cross_dp_reslice_restore_bit_equal():
+    """A save from an ENGAGED fsdp dp8 engine restores bit-equal into an
+    engaged fsdp dp4 engine (different bucket pads — the manifest carries
+    ordinary per-parameter sections, resliced on re-engage) and the two
+    continue bit-identically to a replicated dp4 engine restored from the
+    same checkpoint."""
+    x, y = _batch()
+    src = _make(k=2, hcg=_dp(8))
+    _losses(src, x, y, steps=3)
+    src_params = {n: np.asarray(v).tobytes()
+                  for n, v in src._gather_fsdp_params().items()}
+    src_opt = {n: tuple(np.asarray(s, np.float32).tobytes() for s in sl)
+               for n, sl in src._gather_fsdp_opt().items()}
+    with tempfile.TemporaryDirectory() as td:
+        mgr = CheckpointManager(td, async_save=False)
+        mgr.save(src, block=True)
+        mgr.close()
+
+        ef4 = _make(k=2, hcg=_dp(4), seed=7)
+        _losses(ef4, x, y, steps=1)  # engage the dp4 shard layout first
+        restore_latest(ef4, td)
+        got_p = {n: np.asarray(v).tobytes()
+                 for n, v in (ef4.params
+                              if ef4.params is not None
+                              else ef4._gather_fsdp_params()).items()}
+        assert got_p == src_params
+
+        er4 = _make(k=2, mode=None, hcg=_dp(4), seed=9)
+        restore_latest(er4, td)
+        lf, lr = _losses(ef4, x, y, steps=3), _losses(er4, x, y, steps=3)
+        assert lf == lr
+        got_o = {n: tuple(np.asarray(s, np.float32).tobytes() for s in sl)
+                 for n, sl in ef4._gather_fsdp_opt().items()}
+        ctl_o = {n: tuple(np.asarray(s, np.float32).tobytes() for s in sl)
+                 for n, sl in er4.opt_state.items()}
+        assert got_o == ctl_o
+        # both resumed from the same bytes: step counts advanced in lockstep
+        assert ef4._step_count == er4._step_count
+
+
+def test_live_reshard_bit_identical_to_restore():
+    """live_reshard of an engaged fsdp engine dp4 -> dp2 -> dp4 re-slices
+    the flat shards in memory; at every leg the state and the continued
+    losses are bit-identical to a control engine restored from a
+    synchronous checkpoint onto the same topology — zero committed steps
+    lost."""
+    x, y = _batch()
+
+    def param_bytes(eng):
+        ps = eng.params if eng.params is not None \
+            else eng._gather_fsdp_params()
+        return {n: np.asarray(ps[n]).tobytes() for n in eng._param_names}
+
+    def opt_bytes(eng):
+        o = eng._gather_fsdp_opt() if eng._fsdp_params is not None \
+            else eng.opt_state
+        return {n: tuple(np.asarray(s, np.float32).tobytes() for s in o[n])
+                for n in eng._param_names}
+
+    with tempfile.TemporaryDirectory() as td:
+        live = _make(k=2, hcg=_dp(4))
+        _losses(live, x, y, steps=3)
+        committed = live._step_count
+        for leg, dp in enumerate((2, 4)):
+            ckdir = os.path.join(td, f"leg{leg}")
+            mgr = CheckpointManager(ckdir, async_save=False)
+            mgr.save(live, block=True)
+            mgr.close()
+            ctrl = _make(k=2, hcg=_dp(dp), seed=7 + leg)
+            _losses(ctrl, x, y, steps=1)  # engage the target layout
+            restore_latest(ctrl, ckdir)
+            pause_ms = live_reshard(live, _dp(dp))
+            assert pause_ms >= 0.0 and live.hcg.degrees["dp"] == dp
+            assert live._fsdp_params is not None and live.params is None
+            assert live._step_count == committed
+            assert param_bytes(live) == param_bytes(ctrl)
+            ll, lc = _losses(live, x, y, steps=3), _losses(ctrl, x, y,
+                                                           steps=3)
+            assert ll == lc, (leg, ll, lc)
+            assert opt_bytes(live) == opt_bytes(ctrl)
+            committed = live._step_count
+
+
+# ----------------------------------------------------- health attribution
+
+class _Probe(paddle.nn.Layer):
+    """Loss = mse + sum((tail.weight * s.mean())**2): the `s` batch column
+    drives tail.weight's gradient to inf without touching any other
+    parameter — data-driven injection into the compiled step."""
+
+    def __init__(self):
+        super().__init__()
+        self.body = paddle.nn.Linear(8, 8)
+        self.tail = paddle.nn.Linear(8, 8)
+
+    def forward(self, x, y, s):
+        h = self.tail(self.body(x))
+        mse = ((h - y) ** 2).mean()
+        canary = ((self.tail.weight * s.mean()) ** 2).sum()
+        return mse + canary
+
+
+def test_health_attribution_names_param_across_shard_owners():
+    """tail.weight's bucket shards are spread over all 8 replicas; the
+    per-replica partial stats ride the step outputs as a sharded [nrep,4P]
+    slab (NO extra collective) and the host-side sum still attributes the
+    injected inf to tail.weight by name, and to no other parameter."""
+    paddle.set_flags({"grad_comm_chunk": 16})
+    hcg = _dp(8)
+    paddle.seed(0)
+    net = _Probe()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    ef = TrainStepEngine(net, opt, loss_fn=None, hcg=hcg, microbatches=2,
+                         fsdp=True)
+    ef.enable_health(interval=1)
+    assert len(ef._fsdp_layout()) >= 2  # body and tail in separate buckets
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 8).astype("float32"))
+    y = jnp.asarray(rng.randn(16, 8).astype("float32"))
+    healthy = jnp.zeros((16,), jnp.float32)
+    poisoned = jnp.full((16,), 1e25, jnp.float32)
+    ef.step(x, y, healthy)
+    ef.step(x, y, healthy)
+    ef.step(x, y, poisoned)
+
+    recs = ef._health.recent()
+    assert [r["step"] for r in recs] == [1, 2, 3]
+    assert recs[1]["nonfinite_count"] == 0
+    bad = recs[2]
+    assert bad["nonfinite_count"] > 0
+    assert bad["first_nonfinite_param"] == "tail.weight"
+    for name, pp in bad["per_param"].items():
+        if name != "tail.weight":
+            assert pp["nonfinite"] == 0, f"{name} wrongly flagged"
+    ef.disable_health()
+
+
+def test_health_stats_parity_with_replicated():
+    """The host-summed fsdp health stats agree with the replicated engine's
+    in-program psum stats (f32 sum order differs, so allclose — the
+    attribution test above pins the exact names)."""
+    hcg = _dp(8)
+    x, y = _batch()
+    er = _make(k=2, mode=None, hcg=hcg)
+    ef = _make(k=2, hcg=hcg)
+    er.enable_health(interval=1)
+    ef.enable_health(interval=1)
+    for _ in range(2):
+        er.step(x, y)
+        ef.step(x, y)
+    rr, rf = er._health.recent()[-1], ef._health.recent()[-1]
+    np.testing.assert_allclose(rr["grad_norm"], rf["grad_norm"], rtol=1e-5)
+    assert rr["nonfinite_count"] == rf["nonfinite_count"] == 0
+    er.disable_health()
+    ef.disable_health()
+
+
+# --------------------------------------------------------------- fallbacks
+
+def test_mp_mesh_falls_back_with_single_warning():
+    """A non-pure-dp topology can't own contiguous flat shards per dp
+    replica; the engine warns ONCE ('fsdp requested ...') and runs the
+    replicated path — same losses as the plain engine, params stay
+    resident replicated."""
+    set_hybrid_communicate_group(None)
+    hcg = HybridCommunicateGroup(dp_degree=4, mp_degree=2)
+    x, y = _batch()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ef = _make(k=2, hcg=hcg)
+        lm = _losses(ef, x, y, steps=3)
+    fsdp_warns = [m for m in w if "fsdp requested" in str(m.message)]
+    assert len(fsdp_warns) == 1, [str(m.message) for m in w]
+    assert ef._fsdp_params is None and ef.params is not None
+    assert ef._fsdp_warned  # and won't warn again
+    assert all(len(key) == 6 for key in ef._accum_fns)  # never engaged
+    lr = _losses(_make(k=2, mode=None, hcg=hcg), x, y, steps=3)
+    np.testing.assert_allclose(lm, lr, rtol=1e-5)
+
+
+def test_non_uniform_optimizer_rule_falls_back_bit_identical():
+    """lars trust ratios aren't a uniform elementwise rule over a flat
+    slice — same eligibility gate as ZeRO. fsdp warns once and the
+    trajectory is bit-identical to the plain replicated lars engine."""
+    hcg = _dp()
+    x, y = _batch()
+    lr = _losses(_make(k=2, mode=None, hcg=hcg, optimizer="lars"), x, y,
+                 steps=3)
+    with pytest.warns(UserWarning, match="fsdp requested"):
+        ef = _make(k=2, hcg=hcg, optimizer="lars")
+        lf = _losses(ef, x, y, steps=3)
+    assert lf == lr
+    assert ef._fsdp_params is None
+
+
+def test_run_steps_rejects_active_fsdp():
+    """run_steps is the fused K-OPTIMIZER-step scan lane over the
+    replicated state dict; silently running it with sharded-resident
+    params would diverge from step() semantics, so it raises."""
+    x, y = _batch()
+    ef = _make(k=1)
+    with pytest.raises(ValueError, match="fsdp"):
+        ef.run_steps(x, y, steps=2)
+
+
+def test_fsdp_supersedes_zero_update():
+    """fsdp=True + zero_update=True: fsdp wins (it strictly dominates —
+    sharded params AND opt), the zero path never engages, and the
+    trajectory still matches replicated bit for bit."""
+    hcg = _dp()
+    x, y = _batch()
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    eb = TrainStepEngine(net, opt, loss_fn=paddle.nn.CrossEntropyLoss(),
+                         hcg=hcg, microbatches=2, zero_update=True,
+                         fsdp=True)
+    lb = _losses(eb, x, y, steps=3)
+    assert eb._fsdp_params is not None and eb._zero_opt is None
+    lr = _losses(_make(k=2, mode=None, hcg=hcg), x, y, steps=3)
+    assert lb == lr
+
+
+# --------------------------------------------------- memory + byte counters
+
+def test_param_opt_arg_bytes_scale_one_over_n_and_undercut_zero():
+    """exec_introspect: the fsdp executable's per-device argument bytes
+    drop by the analytic param+opt sharded-state delta that
+    fsdp_memory_model() predicts (~1/N with bucket padding) and land
+    STRICTLY below the ZeRO executable, which still holds replicated
+    params."""
+    paddle.set_flags({"grad_comm_chunk": 64})
+    hcg = _dp()
+    x, y = _batch(n=32, in_dim=128)
+    er = _make(k=2, mode=None, hcg=hcg, width=128, in_dim=128)
+    ez = _make(k=2, mode="zero", hcg=hcg, width=128, in_dim=128)
+    ef = _make(k=2, hcg=hcg, width=128, in_dim=128)
+    er.step(x, y)
+    ez.step(x, y)
+    ef.step(x, y)
+
+    mm = ef.fsdp_memory_model()
+    assert mm["opt_slots"] == 2 and mm["replicas"] == 8
+    repl_state = mm["replicated_param_bytes"] + mm["replicated_opt_bytes"]
+    shard_state = (mm["sharded_param_bytes_per_device"]
+                   + mm["sharded_opt_bytes_per_device"])
+    # big model + small chunk: padding is noise, sharded ~= replicated/8
+    assert shard_state < repl_state / 6
+
+    rep = er.introspect_executables()["train.accum_k2_f32"]
+    zer = ez.introspect_executables()["train.zero_k2_f32"]
+    fsd = ef.introspect_executables()["train.fsdp_k2_f32"]
+    measured = (rep["argument_size_in_bytes"]
+                - fsd["argument_size_in_bytes"])
+    assert measured == pytest.approx(repl_state - shard_state, rel=0.05)
+    assert fsd["argument_size_in_bytes"] < zer["argument_size_in_bytes"] \
+        < rep["argument_size_in_bytes"]
+
+
+def test_rs_ag_byte_counters_and_telemetry():
+    """grad_comm.rs_bytes / ag_bytes count the fsdp collective payloads
+    (K-independent per step) and surface as counter deltas in step
+    telemetry records, which carry the fsdp marker."""
+    from paddle_tpu.observability.step_telemetry import StepTelemetry
+
+    ef = _make(k=4)
+    ef.telemetry = StepTelemetry(collect_memory=False)
+    rs0 = monitor.stat("grad_comm.rs_bytes").get()
+    ag0 = monitor.stat("grad_comm.ag_bytes").get()
+    x, y = _batch()
+    ef.step(x, y)
+    ef.step(x, y)
+    shards = [b["shard"] for b in ef._fsdp_layout()]
+    rs_b, ag_b, per_layer = grad_comm.fsdp_payload_bytes(
+        shards, 8, "f32", grad_comm.chunk_size())
+    assert len(per_layer) == len(shards)
+    assert monitor.stat("grad_comm.rs_bytes").get() - rs0 == 2 * rs_b
+    assert monitor.stat("grad_comm.ag_bytes").get() - ag0 == 2 * ag_b
+    rec = ef.telemetry.sink.records[-1]
+    assert rec["fsdp"] is True
+    assert rec["microbatches"] == 4
+    assert rec["grad_comm_bytes"] == rs_b + ag_b

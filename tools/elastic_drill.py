@@ -21,6 +21,13 @@ and the drill
      back to restore_latest (the hard-crash path) instead of hanging —
      and the engine must keep training afterwards.
 
+FSDP leg (ISSUE 19): a second engine with fully sharded-resident
+parameters (contiguous flat 1/N param+opt f32 shards, per-bucket
+gathers inside the step) reslices live dp8 -> dp6 -> dp8 with zero
+committed steps lost, bit-identical at every leg — losses, gathered
+params, gathered opt state — to a checkpoint-restore control engaged on
+the same topology.
+
 Fleet-federation leg (ISSUE 14): every worker also enables the metrics
 registry, observes a deterministic synthetic `train.step_ms` stream, and
 runs a FleetPublisher on a short deadline; the driver's FleetCollector
@@ -776,6 +783,60 @@ def main():
             steps(ctrl8, args.steps_per_leg)
         verdict("loss_bit_continuous_6to8", live8 == ctl8,
                 live=live8, control=ctl8)
+
+        # ---- fsdp leg (ISSUE 19): sharded-resident params resliced live,
+        # dp8 -> dp6 -> dp8, zero committed steps lost. The coordinator
+        # legs above prove the membership-driven trigger; this leg proves
+        # the FULL-FSDP state machinery — flat 1/N param+opt shards
+        # decoded host-side, re-bucketed for the new replica count,
+        # re-encoded — against checkpoint-restore controls on the same
+        # topology, bit for bit.
+        from paddle_tpu.distributed.elastic import live_reshard
+
+        def fsdp_engine(dp, seed):
+            paddle.seed(seed)
+            model = paddle.nn.Sequential(
+                paddle.nn.Linear(64, 256), paddle.nn.ReLU(),
+                paddle.nn.Linear(256, 64), paddle.nn.ReLU(),
+                paddle.nn.Linear(64, 8))
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+            return TrainStepEngine(model, opt,
+                                   loss_fn=paddle.nn.CrossEntropyLoss(),
+                                   hcg=hcg(dp), fsdp=True)
+
+        def fsdp_state_bytes(e):
+            ps = e.params if e.params is not None \
+                else e._gather_fsdp_params()
+            op = e._gather_fsdp_opt() if e._fsdp_params is not None \
+                else e.opt_state
+            return ({nm: np.asarray(ps[nm]).tobytes()
+                     for nm in e._param_names},
+                    {nm: tuple(np.asarray(s, np.float32).tobytes()
+                               for s in op[nm]) for nm in e._param_names})
+
+        engf = fsdp_engine(8, seed=0)
+        steps(engf, args.steps_per_leg)
+        fsdp_committed = engf._step_count
+        verdict("fsdp_dp8_warm_engaged",
+                engf._fsdp_params is not None and engf.params is None
+                and fsdp_committed == args.steps_per_leg)
+        for leg_i, dp_to in enumerate((6, 8)):
+            ckf = checkpoint(engf, f"ck_fsdp{leg_i}")
+            ctrlf = fsdp_engine(dp_to, seed=11 + leg_i)
+            steps(ctrlf, 1)  # engage the target shard layout
+            restore_latest(ctrlf, ckf)
+            live_reshard(engf, hcg(dp_to))
+            livef = steps(engf, args.steps_per_leg)
+            ctlf = steps(ctrlf, args.steps_per_leg)
+            verdict(f"fsdp_reshard_to_dp{dp_to}",
+                    engf.hcg.degrees["dp"] == dp_to
+                    and engf._fsdp_params is not None
+                    and engf._step_count == fsdp_committed
+                    + (leg_i + 1) * args.steps_per_leg
+                    and livef == ctlf
+                    and fsdp_state_bytes(engf) == fsdp_state_bytes(ctrlf),
+                    live=livef, control=ctlf)
 
         # ---- hard-crash fallback: fault mid-reshard -> flight + restore
         ck3 = checkpoint(eng, "ck_fault")

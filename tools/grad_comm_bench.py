@@ -32,6 +32,19 @@ rows that tools/bench_gate.py gates against tools/bench_baseline.json:
 
   JAX_PLATFORMS=cpu python tools/grad_comm_bench.py --zero \\
       [--dp 4,8] [--k 2] [--steps 8] [--history]
+
+--fsdp mode (ISSUE 19): full FSDP — parameters resident ONLY as 1/N flat
+f32 shards between steps, per-layer all-gathers inside the compiled step,
+reduce-scatter of grads, NO trailing param all-gather — vs the ZeRO
+weight-update-sharded step and the replicated baseline at dp4/dp8.
+Reports steps/s, measured executable argument/peak bytes for all three
+variants, and the analytic sharded-state fraction
+(param+opt bytes per device over the replicated total, ~1/N). --history
+rows feed the `fsdp_steps_per_s_dp8` / `fsdp_param_bytes_frac` pins in
+tools/bench_baseline.json:
+
+  JAX_PLATFORMS=cpu python tools/grad_comm_bench.py --fsdp \\
+      [--dp 4,8] [--k 2] [--steps 8] [--history]
 """
 from __future__ import annotations
 
@@ -149,6 +162,89 @@ def _run_zero(args):
                 "unit": "bytes", "vs_baseline": None, "extra": dict(extra)})
 
 
+def _run_fsdp(args):
+    _force_host_devices(max(int(d) for d in args.dp.split(",")))
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.engine import TrainStepEngine
+    from paddle_tpu.distributed.mesh import (HybridCommunicateGroup,
+                                             set_hybrid_communicate_group)
+
+    k = args.k
+    rng = np.random.RandomState(0)
+    xs = rng.randn(args.batch, 256).astype(np.float32)
+    ys = rng.randint(0, 4, (args.batch,)).astype(np.int64)
+
+    def build(dp, mode):
+        set_hybrid_communicate_group(None)
+        hcg = HybridCommunicateGroup(dp_degree=dp, devices=jax.devices()[:dp])
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(256, 256),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(256, 4))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=net.parameters())
+        return TrainStepEngine(net, opt,
+                               loss_fn=paddle.nn.CrossEntropyLoss(),
+                               hcg=hcg, microbatches=k,
+                               zero_update=(mode == "zero"),
+                               fsdp=(mode == "fsdp"))
+
+    def measure(eng):
+        x, y = paddle.to_tensor(xs), paddle.to_tensor(ys)
+        float(eng.step(x, y).item())  # warm: compile outside the window
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            loss = eng.step(x, y)
+        final = float(loss.item())
+        dt = time.perf_counter() - t0
+        stats, = eng.introspect_executables(force=True).values()
+        return round(args.steps / dt, 3), final, stats
+
+    for dp in (int(d) for d in args.dp.split(",")):
+        sps_r, loss_r, st_r = measure(build(dp, None))
+        sps_z, loss_z, st_z = measure(build(dp, "zero"))
+        ef = build(dp, "fsdp")
+        sps_f, loss_f, st_f = measure(ef)
+        mm = ef.fsdp_memory_model()
+        repl_state = (mm["replicated_param_bytes"]
+                      + mm["replicated_opt_bytes"])
+        shard_state = (mm["sharded_param_bytes_per_device"]
+                       + mm["sharded_opt_bytes_per_device"])
+        frac = round(shard_state / repl_state, 4)
+        row = {
+            "dp": dp, "microbatches": k, "effective_batch": args.batch,
+            "n_grad_elems": mm["n_grad_elems"],
+            "buckets": len(mm["buckets"]),
+            "steps_per_sec_replicated": sps_r,
+            "steps_per_sec_zero": sps_z,
+            "steps_per_sec_fsdp": sps_f,
+            "state_bytes_replicated": repl_state,
+            "state_bytes_fsdp_per_device": shard_state,
+            "fsdp_param_bytes_frac": frac,
+            "arg_bytes_replicated": st_r.get("argument_size_in_bytes"),
+            "arg_bytes_zero": st_z.get("argument_size_in_bytes"),
+            "arg_bytes_fsdp": st_f.get("argument_size_in_bytes"),
+            "peak_bytes_replicated": st_r.get("peak_bytes"),
+            "peak_bytes_zero": st_z.get("peak_bytes"),
+            "peak_bytes_fsdp": st_f.get("peak_bytes"),
+            "final_loss_bit_equal": loss_r == loss_f == loss_z,
+        }
+        print(json.dumps(row))
+        if args.history:
+            extra = {"platform": jax.default_backend(), **row}
+            _append_history({
+                "metric": "grad_comm_fsdp_steps_per_sec",
+                "value": sps_f, "unit": "steps/s", "vs_baseline": None,
+                "extra": dict(extra)})
+            _append_history({
+                "metric": "fsdp_param_bytes_frac",
+                "value": frac, "unit": "ratio", "vs_baseline": None,
+                "extra": dict(extra)})
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=32,
@@ -159,15 +255,21 @@ def main():
     ap.add_argument("--zero", action="store_true",
                     help="replicated vs ZeRO weight-update-sharded step "
                          "on dp virtual-device meshes")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="replicated vs ZeRO vs full FSDP (sharded-resident "
+                         "params) on dp virtual-device meshes")
     ap.add_argument("--dp", default="4,8",
-                    help="--zero mode: comma list of dp degrees")
+                    help="--zero/--fsdp mode: comma list of dp degrees")
     ap.add_argument("--k", type=int, default=2,
-                    help="--zero mode: microbatches per step")
+                    help="--zero/--fsdp mode: microbatches per step")
     ap.add_argument("--history", action="store_true",
-                    help="--zero mode: append BENCH_HISTORY.jsonl rows")
+                    help="--zero/--fsdp mode: append BENCH_HISTORY.jsonl "
+                         "rows")
     args = ap.parse_args()
     if args.zero:
         return _run_zero(args)
+    if args.fsdp:
+        return _run_fsdp(args)
     ks = [int(k) for k in args.ks.split(",")]
 
     import jax

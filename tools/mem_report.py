@@ -17,8 +17,13 @@ replicated K-microbatch step AND the ZeRO weight-update-sharded step
 (ISSUE 9) on a dp8 virtual mesh and adds the replicated-vs-sharded
 optimizer-state column: per-device opt bytes from engine.zero_memory_model
 (analytic) cross-checked against the executables' argument-byte delta
-(measured). Ends with the tools-convention machine-readable
-{"summary": ...} JSON line.
+(measured). --fsdp does the same for the full FSDP step (ISSUE 19):
+params+opt resident only as 1/N flat shards, so the argument-byte delta
+vs the replicated executable must match engine.fsdp_memory_model()'s
+analytic ~1/N state shrink (asserted, 5% tolerance — batch and scalar
+arguments cancel in the delta) and come in strictly below the ZeRO
+executable's argument bytes (ZeRO still holds replicated params). Ends
+with the tools-convention machine-readable {"summary": ...} JSON line.
 """
 from __future__ import annotations
 
@@ -59,9 +64,14 @@ def main():
                     help="also report the ZeRO weight-update-sharded step "
                          "on a dp8 virtual mesh: replicated vs sharded "
                          "optimizer-state bytes per device")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="also report the full FSDP step on a dp8 virtual "
+                         "mesh: replicated vs ZeRO vs sharded-resident "
+                         "param+opt bytes per device (analytic vs measured, "
+                         "asserted)")
     args = ap.parse_args()
 
-    if args.zero:
+    if args.zero or args.fsdp:
         # dp8 virtual devices; must precede the first jax import
         xf = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in xf:
@@ -179,6 +189,100 @@ def main():
         }
         print()
 
+    fsdp_summary = None
+    if args.fsdp:
+        k = max(2, args.microbatches)
+
+        def build_fsdp_dp8(mode):
+            # same MLP rationale as --zero: full FSDP needs pure dp with
+            # fully-replicated templates; the GPT's mp dist_attrs keep it
+            # on the GSPMD path by design
+            set_hybrid_communicate_group(None)
+            hcg = HybridCommunicateGroup(dp_degree=8,
+                                         devices=jax.devices()[:8])
+            paddle.seed(0)
+            model = paddle.nn.Sequential(paddle.nn.Linear(256, 256),
+                                         paddle.nn.ReLU(),
+                                         paddle.nn.Linear(256, 4))
+            opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                         parameters=model.parameters())
+            return TrainStepEngine(model, opt,
+                                   loss_fn=paddle.nn.CrossEntropyLoss(),
+                                   hcg=hcg, microbatches=k,
+                                   zero_update=(mode == "zero"),
+                                   fsdp=(mode == "fsdp"))
+
+        bz = -(-args.batch // (8 * k)) * (8 * k)
+        xf = rng.randn(bz, 256).astype(np.float32)
+        yf = rng.randint(0, 4, (bz,)).astype(np.int64)
+
+        def aot_stats_f(eng):
+            (label, (fn, avals)), = eng._exec_stash.items()
+            return exec_introspect.stats_for(label,
+                                             fn.lower(*avals).compile())
+
+        stats3 = {}
+        for mode in (None, "zero", "fsdp"):
+            e = build_fsdp_dp8(mode)
+            e.step(xf, yf)
+            stats3[mode] = aot_stats_f(e)
+            if mode == "fsdp":
+                mmf = e.fsdp_memory_model()
+
+        repl_state = (mmf["replicated_param_bytes"]
+                      + mmf["replicated_opt_bytes"])
+        shard_state = (mmf["sharded_param_bytes_per_device"]
+                       + mmf["sharded_opt_bytes_per_device"])
+        arg_r = stats3[None]["argument_size_in_bytes"]
+        arg_z = stats3["zero"]["argument_size_in_bytes"]
+        arg_f = stats3["fsdp"]["argument_size_in_bytes"]
+
+        def ratio(a, b):
+            return (f"{a / b:.3f}" if isinstance(a, int)
+                    and isinstance(b, int) and b else "-")
+
+        print(f"\nFull FSDP (dp8, K={k}) — per-device bytes, "
+              "replicated vs ZeRO vs sharded-resident params:")
+        _fmt_table(
+            ["quantity", "replicated_MB", "zero_MB", "fsdp_MB",
+             "fsdp_ratio"],
+            [[f"param+opt state, adamw x{mmf['opt_slots']} slots (analytic)",
+              _mb(repl_state),
+              _mb(mmf["replicated_param_bytes"]
+                  + mmf["sharded_opt_bytes_per_device"]),
+              _mb(shard_state), ratio(shard_state, repl_state)],
+             ["executable arguments (measured)",
+              _mb(arg_r), _mb(arg_z), _mb(arg_f), ratio(arg_f, arg_r)],
+             ["executable peak (measured)",
+              _mb(stats3[None].get("peak_bytes")),
+              _mb(stats3["zero"].get("peak_bytes")),
+              _mb(stats3["fsdp"].get("peak_bytes")),
+              ratio(stats3["fsdp"].get("peak_bytes"),
+                    stats3[None].get("peak_bytes"))]])
+        # the ~1/N claim, measured: batch + scalar arguments cancel in the
+        # replicated-minus-fsdp delta, leaving exactly the state shrink
+        delta_meas = arg_r - arg_f
+        delta_ana = repl_state - shard_state
+        assert abs(delta_meas - delta_ana) <= 0.05 * delta_ana, (
+            f"measured argument-byte delta {delta_meas} disagrees with the "
+            f"analytic sharded-state delta {delta_ana}")
+        assert arg_f < arg_z < arg_r, (
+            f"fsdp arguments must undercut ZeRO (replicated params) which "
+            f"must undercut replicated: {arg_f} !< {arg_z} !< {arg_r}")
+        fsdp_summary = {
+            "replicas": mmf["replicas"], "microbatches": k,
+            "buckets": len(mmf["buckets"]),
+            "replicated_state_bytes": repl_state,
+            "sharded_state_bytes_per_device": shard_state,
+            "arg_bytes_replicated": arg_r,
+            "arg_bytes_zero": arg_z,
+            "arg_bytes_fsdp": arg_f,
+            "arg_delta_measured": delta_meas,
+            "arg_delta_analytic": delta_ana,
+            "peak_bytes_fsdp": stats3["fsdp"].get("peak_bytes"),
+        }
+        print()
+
     if args.serve:
         from paddle_tpu.serving import ServingEngine
 
@@ -209,6 +313,8 @@ def main():
     }
     if zero_summary is not None:
         summary["zero"] = zero_summary
+    if fsdp_summary is not None:
+        summary["fsdp"] = fsdp_summary
     print(json.dumps({"summary": summary}))
 
 
