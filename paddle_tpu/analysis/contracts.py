@@ -68,6 +68,15 @@ class ProgramContract:
         custom-calls in the program are violations.
     max_constant_bytes: largest literal that may be baked into the program
         (None disables the constant-bloat check).
+    schedule_order: declared schedule discipline read from the SCHEDULED
+        optimized-HLO text (the modules jax compiles are is_scheduled, so
+        definition order IS execution order). The one discipline today is
+        "all-gather-ahead" (the fsdp gather-prefetch window): each bucket's
+        all-gather definition must precede the previous bucket's dominant
+        dot/fusion consumer — the CPU-checkable proof that the prefetch
+        actually moved the gathers ahead of the compute that hides them.
+        Skipped on combining backends (per-bucket gathers get fused there,
+        so bucket order is unreadable). None = unchecked.
     """
 
     label: str = "*"
@@ -81,6 +90,7 @@ class ProgramContract:
     comm_min_elems: int = 64
     allow_host_calls: bool = False
     max_constant_bytes: Optional[int] = 2 * 1024 * 1024
+    schedule_order: Optional[str] = None
     name: str = ""  # optional display name for reports
 
     def matches(self, label: str) -> bool:

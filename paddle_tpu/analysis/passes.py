@@ -19,11 +19,16 @@ host-transfer       infeed/outfeed/send/recv or host-callback custom-calls
                     inside a step program
 constant-bloat      literals above max_constant_bytes baked into the HLO
 recompile-hazard    weak-type / Python-scalar leaks in the traced signature
+schedule-order      declared schedule disciplines read from the scheduled
+                    module text; "all-gather-ahead" proves the fsdp gather
+                    window moved each bucket's all-gather ahead of the
+                    previous bucket's compute
 =================== =========================================================
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Tuple
+import re
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .backend import (collective_combining_reason,
                       native_bf16_collective_reason)
@@ -156,6 +161,77 @@ def recompile_hazard(prog: Program, c: ProgramContract) -> PassResult:
     return vs, []
 
 
+# all-gather DEFINITION lines with their instruction name captured; async
+# `-done` halves complete the matching `-start` and define no new gather
+_AG_DEF_RE = re.compile(r"^\s*(%?all-gather(?!-done)[-.\w]*)\s*=")
+_CHANNEL_RE = re.compile(r"channel_id=(\d+)")
+
+
+def _first_consumer(lines: List[str], start: int, name: str,
+                    ) -> Tuple[Optional[int], Optional[str]]:
+    """(line index, kind) of the dominant consumer of instruction `name`:
+    the first line after `start` (within the same computation — names are
+    scoped) that takes %name as an operand and is a fusion/dot, falling
+    back to the first consumer of any kind. Kind is "dominant" or "plain"
+    or None when nothing consumes it before the computation closes."""
+    tok = re.compile(re.escape(name if name.startswith("%") else "%" + name)
+                     + r"(?![-.\w])")
+    fallback = None
+    for j in range(start + 1, len(lines)):
+        if lines[j].startswith("}"):
+            break
+        if not tok.search(lines[j]):
+            continue
+        if " fusion(" in lines[j] or " dot(" in lines[j] \
+                or " convolution(" in lines[j]:
+            return j, "dominant"
+        if fallback is None:
+            fallback = j
+    return (fallback, None if fallback is None else "plain")
+
+
+def schedule_order(prog: Program, c: ProgramContract) -> PassResult:
+    name = "schedule-order"
+    if c.schedule_order is None:
+        return [], []
+    if c.schedule_order != "all-gather-ahead":
+        return [Violation(
+            prog.label, name,
+            f"unknown schedule_order discipline {c.schedule_order!r} "
+            f"(known: 'all-gather-ahead')")], []
+    reason = collective_combining_reason()
+    if reason is None:
+        return [], [Skip(
+            prog.label, name,
+            "backend combines collectives: per-bucket all-gathers are "
+            "fused, bucket schedule order is unreadable")]
+    # jax-compiled modules are is_scheduled=true, so definition order in
+    # the optimized text IS the execution schedule. Bucket order follows
+    # channel ids (assigned in emission = bucket order) when present.
+    lines = prog.hlo_text.splitlines()
+    ags = []
+    for i, ln in enumerate(lines):
+        m = _AG_DEF_RE.match(ln)
+        if m:
+            ch = _CHANNEL_RE.search(ln)
+            ags.append((int(ch.group(1)) if ch else len(ags),
+                        i, m.group(1).strip()))
+    ags.sort(key=lambda t: (t[0], t[1]))
+    vs: List[Violation] = []
+    for (_, li, ni), (_, lj, nj) in zip(ags, ags[1:]):
+        ci, kind = _first_consumer(lines, li, ni)
+        if ci is None:
+            continue
+        if lj >= ci:
+            vs.append(Violation(
+                prog.label, name,
+                f"{nj} is defined at line {lj + 1}, after bucket "
+                f"predecessor {ni}'s {kind or ''} consumer at line "
+                f"{ci + 1} — gathers sit just-in-time, the prefetch "
+                f"window did not move them ahead"))
+    return vs, []
+
+
 #: pass name -> pass fn, in report order
 PASSES: Dict[str, PassFn] = {
     "collective-contract": collective_contract,
@@ -164,4 +240,5 @@ PASSES: Dict[str, PassFn] = {
     "host-transfer": host_transfer,
     "constant-bloat": constant_bloat,
     "recompile-hazard": recompile_hazard,
+    "schedule-order": schedule_order,
 }

@@ -148,6 +148,21 @@ define_flag("fsdp", False,
             "once and run the replicated (or GSPMD) path. Supersedes "
             "zero_update when both are set. Also per-engine: "
             "TrainStepEngine(fsdp=True)")
+define_flag("fsdp_prefetch", 2,
+            "gather-prefetch window depth of the fsdp forward pass "
+            "(distributed/grad_comm.py make_fsdp_accum_step): with depth d "
+            ">= 2, bucket L's gathered weights are released through a "
+            "value-identity select pin tied to the all-gathers for "
+            "buckets L+1..L+d-1, so every valid schedule issues the next "
+            "bucket's gather before the current bucket's compute consumes "
+            "its params (double-buffered at the default 2), the ahead "
+            "buffers stay resident across the microbatch scan (the "
+            "measurable live-window bytes), and the backward pass mirrors "
+            "the window in descending bucket order. 0 disables the window "
+            "(just-in-time gathers). The depth is clamped so live-gathered "
+            "bytes never exceed the two largest adjacent buckets. Pins are "
+            "identity on values: every depth is bit-equal to depth 0 (and "
+            "to the replicated trajectory)")
 define_flag("health_monitor", False,
             "compute training-health statistics (global + per-parameter "
             "grad/weight norms, update-to-weight ratios, non-finite "

@@ -566,8 +566,25 @@ class TrainStepEngine:
         active). Costs one extra AOT compile per uncaptured label."""
         out = {}
         for label, (fn, avals) in list(self._exec_stash.items()):
-            out[label] = _obs_exec.capture_jit(label, fn, avals, force=force)
+            out[label] = _obs_exec.capture_jit(
+                label, fn, avals, force=force,
+                extra=self._introspect_extra(label))
         return out
+
+    def _introspect_extra(self, label: str):
+        """Per-label annotations merged into exec_introspect stats: fsdp
+        train programs carry the resolved gather-prefetch depth and the
+        analytic live-gathered window bytes, so the
+        exec.train.fsdp_*.fsdp_window_bytes gauge lands next to the
+        measured temp bytes it bounds (mem_report cross-checks the two)."""
+        if not label.startswith("train.fsdp"):
+            return None
+        depth = self._fsdp_prefetch()
+        return {"fsdp_prefetch": depth,
+                "fsdp_window_bytes": _gc.fsdp_window_bytes(
+                    self._fsdp_layout(), depth),
+                "fsdp_ahead_bytes": _gc.fsdp_prefetch_ahead_bytes(
+                    self._fsdp_layout(), depth)}
 
     # ---- static analysis (paddle_tpu.analysis) ----------------------------
     def _analysis_state_bytes(self, include_opt: bool = True) -> int:
@@ -604,6 +621,12 @@ class TrainStepEngine:
         if ndp > 1 and self._dp_pure():
             # ByGlobalNorm clip adds one scalar norm psum to the fused reduce
             clip_hi = 2 if self.optimizer._grad_clip is not None else 1
+            # with a resolved prefetch window the f32/bf16 fsdp programs
+            # additionally promise the overlap-ahead schedule: each bucket's
+            # all-gather defined before the previous bucket's dominant
+            # consumer (ISSUE 20's schedule-order pass)
+            sched = ("all-gather-ahead" if self._fsdp_prefetch() >= 2
+                     else None)
             cs += [
                 _an.ProgramContract(
                     "train.accum_*_f32",
@@ -635,14 +658,16 @@ class TrainStepEngine:
                                  "reduce-scatter": 1,
                                  "all-reduce": (0, clip_hi - 1),
                                  "all-to-all": 0},
-                    while_loops=(1, None), name="fsdp-decomposition"),
+                    while_loops=(1, None), schedule_order=sched,
+                    name="fsdp-decomposition"),
                 _an.ProgramContract(
                     "train.fsdp_*_bf16*",
                     collectives={"all-gather": len(self._fsdp_layout()),
                                  "reduce-scatter": 1,
                                  "all-reduce": (0, clip_hi - 1),
                                  "all-to-all": 0},
-                    while_loops=(1, None), name="fsdp-decomposition-bf16"),
+                    while_loops=(1, None), schedule_order=sched,
+                    name="fsdp-decomposition-bf16"),
                 _an.ProgramContract(
                     "train.fsdp_*_int8*",
                     collectives={"all-gather": len(self._fsdp_layout()),
@@ -1174,6 +1199,15 @@ class TrainStepEngine:
         self._fsdp_cache = ((nrep, chunk), buckets)
         return buckets
 
+    def _fsdp_prefetch(self) -> int:
+        """Resolved gather-prefetch window depth: FLAGS_fsdp_prefetch
+        clamped against the current bucket layout so live-gathered bytes
+        never exceed the two largest adjacent buckets (the double-buffer
+        bound). Recomputed per step — reform_mesh() re-buckets, so the
+        windowed step fns rebuild at the new topology's clamp."""
+        return _gc.fsdp_prefetch_depth(self._fsdp_layout(),
+                                       int(_flags.flag("fsdp_prefetch")))
+
     def fsdp_memory_model(self):
         """Analytic param+opt residency of the fsdp path: replicated
         bytes vs per-bucket flat-shard bytes per device (~1/N for BOTH
@@ -1188,7 +1222,12 @@ class TrainStepEngine:
         shard_elems = [b["shard"] for b in buckets]
         rs_b, ag_b, per_layer = _gc.fsdp_payload_bytes(
             shard_elems, nrep, _gc.comm_dtype(), _gc.chunk_size())
+        depth = self._fsdp_prefetch()
         return {
+            "prefetch": depth,
+            "window_bytes": _gc.fsdp_window_bytes(buckets, depth),
+            "window_bytes_jit": _gc.fsdp_window_bytes(buckets, 0),
+            "ahead_bytes": _gc.fsdp_prefetch_ahead_bytes(buckets, depth),
             "replicas": nrep,
             "n_grad_elems": n,
             "opt_slots": slots,
@@ -1318,7 +1357,7 @@ class TrainStepEngine:
             clip=self.optimizer._grad_clip, mesh=self.mesh,
             batch_axes=self._batch_axes(), k=k, dtype=dtype, chunk=chunk,
             use_residual=use_residual, param_templates=param_templates,
-            buckets=buckets,
+            buckets=buckets, prefetch=self._fsdp_prefetch(),
             health_partial=(health.make_sharded_stats()
                             if health is not None else None))
         batch_shardings = self._shardings_for(batch_avals)
@@ -1473,9 +1512,12 @@ class TrainStepEngine:
         health_on = self._health is not None
         fsdp = self._fsdp_on()
         # fsdp appends rather than widening the tuple so non-fsdp keys stay
-        # identical to the PR 18 registry layout (pinned by test_zero_update)
+        # identical to the PR 18 registry layout (pinned by test_zero_update);
+        # the resolved prefetch depth rides the same append so flipping
+        # FLAGS_fsdp_prefetch rebuilds the windowed step fn
+        fsdp_pf = self._fsdp_prefetch() if fsdp else 0
         cache_key = (k, dtype, use_residual, chunk, health_on, zero) + \
-            ((True,) if fsdp else ())
+            ((True, fsdp_pf) if fsdp else ())
         label = (f"train.fsdp_k{k}_{dtype}" if fsdp
                  else f"train.zero_k{k}_{dtype}" if zero
                  else f"train.accum_k{k}_{dtype}") + \
@@ -1600,7 +1642,9 @@ class TrainStepEngine:
                 h2d_ms=h2d_ms, prefetch_depth=prefetch_depth,
                 microbatches=k, grad_comm_dtype=dtype,
                 grad_comm_bytes=comm_bytes,
-                extra=({"fsdp": True} if fsdp
+                extra=({"fsdp": True, "fsdp_prefetch": fsdp_pf,
+                        "fsdp_window_bytes": _gc.fsdp_window_bytes(
+                            self._fsdp_layout(), fsdp_pf)} if fsdp
                        else {"zero_update": True} if zero else None))
         if fr is not None or mreg is not None:
             self._obs_step_tail(fr, mreg, rec, t0, t1, h2d_ms, compiled, loss)

@@ -640,11 +640,54 @@ def fsdp_payload_bytes(shard_elems: Sequence[int], nrep: int, dtype: str,
     return rs, sum(per_layer), per_layer
 
 
+def fsdp_window_bytes(buckets: Sequence[dict], depth: int) -> int:
+    """Analytic live-gathered bytes of a depth-``depth`` fsdp prefetch
+    window: the max over window positions of the summed FULL (padded, f32)
+    gathered bucket bytes held live at once — while bucket i's compute
+    runs, buckets i..i+depth-1 are gathered. Depth 0 and 1 both hold one
+    bucket (just-in-time); the default depth 2 holds the worst adjacent
+    pair. This is the bound the exec.train.fsdp_* window-bytes gauge
+    reports and tools/mem_report.py checks against measured temp bytes."""
+    gb = [int(b["pad"]) * 4 for b in buckets]
+    if not gb:
+        return 0
+    d = max(1, min(int(depth), len(gb)))
+    return max(sum(gb[i:i + d]) for i in range(len(gb) - d + 1))
+
+
+def fsdp_prefetch_ahead_bytes(buckets: Sequence[dict], depth: int) -> int:
+    """Analytic EXTRA resident bytes a depth-``depth`` window holds vs the
+    just-in-time baseline: the raw gathered buffers of buckets 1..depth-1
+    (f32, padded) stay live across the whole microbatch scan — the step fn
+    pins them with a post-scan read, so this delta is exactly measurable
+    as depth-d temp bytes minus depth-0 temp bytes on the SAME model
+    (tools/mem_report.py hard-asserts it). For the canonical two-bucket
+    report model this is the second bucket's gather size. 0 below depth
+    2."""
+    if int(depth) < 2:
+        return 0
+    return sum(int(b["pad"]) * 4 for b in buckets[1:int(depth)])
+
+
+def fsdp_prefetch_depth(buckets: Sequence[dict], requested: int) -> int:
+    """Clamp the requested gather-prefetch depth so the live window never
+    exceeds the two largest adjacent gathered buckets (the double-buffer
+    byte bound): the largest d <= requested whose fsdp_window_bytes fits
+    under the depth-2 window. <= 0 stays 0 (just-in-time, no barriers)."""
+    d = min(int(requested), max(1, len(buckets)))
+    if d <= 0:
+        return 0
+    cap = fsdp_window_bytes(buckets, 2)
+    while d > 2 and fsdp_window_bytes(buckets, d) > cap:
+        d -= 1
+    return d
+
+
 def make_fsdp_accum_step(*, compute_loss: Callable, flat_update: Callable,
                          clip, mesh: Mesh, batch_axes: Sequence[str], k: int,
                          dtype: str, chunk: int, use_residual: bool,
                          param_templates: Dict[str, jax.ShapeDtypeStruct],
-                         buckets: Sequence[dict],
+                         buckets: Sequence[dict], prefetch: int = 0,
                          health_partial: Optional[Callable] = None):
     """Fully sharded data parallelism (arXiv:2004.13336 taken the rest of
     the way): parameters arrive as per-layer flat f32 SHARDS and leave the
@@ -675,11 +718,25 @@ def make_fsdp_accum_step(*, compute_loss: Callable, flat_update: Callable,
     [4P] segment partial as a sharded [nrep, 4P] output the engine sums
     host-side — zero extra collectives.
 
+    With ``prefetch`` depth d >= 2 the gathers run under an overlap-ahead
+    window: bucket i's gathered view is released through a value-identity
+    select pin tied to the all-gathers for buckets i+1..i+d-1, so every
+    consumer of bucket i carries a REAL data dependency on the next
+    window's gathers — any valid schedule issues AG(i+1) before bucket i's
+    compute (double-buffered at d=2), which is exactly what the
+    schedule-order analysis contract reads out of the optimized HLO. The
+    backward pass mirrors the window on the per-bucket cotangents in
+    DESCENDING bucket order (bucket i's grads release together with
+    buckets i-1..i-d+1's). The depth is clamped by fsdp_prefetch_depth so
+    live-gathered bytes never exceed the two largest adjacent buckets.
+    Both pins are identity on values: every depth is bit-equal to depth 0.
+
     Returns step(p_shards, opt_shards[, residual], lr, step_i, key, *batch)
     -> (loss, new_p_shards, new_opt_shards[, new_residual][, health])."""
     if use_residual and dtype == "f32":
         raise ValueError("error feedback needs a low-precision dtype")
     axes = tuple(a for a in batch_axes if mesh.shape[a] > 1)
+    depth = fsdp_prefetch_depth(buckets, prefetch) if axes else 0
     d0 = _spec_axes(axes)
     nrep = replica_count(mesh, axes)
     names = sorted(param_templates)
@@ -708,20 +765,76 @@ def make_fsdp_accum_step(*, compute_loss: Callable, flat_update: Callable,
             seg_ids[:, soffs[bi]:soffs[bi + 1]] = ids_b.reshape(
                 nrep, b["shard"])
 
-    def _gather_params(p_shards):
+    def _gather_params(p_shards, step_i):
         """L per-bucket all-gathers -> the replicated param dict the
         forward/backward consumes. tiled=True concatenates replica shards
         in row-major replica order — the inverse of the reshape(nrep, shard)
-        the scatter side uses, so the contiguous bucket reassembles."""
+        the scatter side uses, so the contiguous bucket reassembles.
+
+        With prefetch depth >= 2 each gathered bucket is RELEASED through
+        a value-identity select pin tied to the NEXT window's gathers:
+        ``step_i >= INT32_MIN`` is true for every possible step index, but
+        a runtime comparison cannot be constant-folded, so the (never
+        taken) other branch makes AG(i+1..i+depth-1) REAL operands of
+        bucket i's consumers — every valid schedule, including the
+        sequential one the schedule-order contract reads out of the
+        optimized HLO, must issue the next bucket's gather before the
+        current bucket's compute. (A plain optimization_barrier does not
+        survive here: XLA expands barriers before scheduling, so they
+        leave no trace in the scheduled module.) Depth 0 emits the bare
+        just-in-time gathers of PR 19.
+
+        Returns (params, hold): `hold` is the list of raw gathered
+        buffers the window keeps ahead of the first bucket's compute
+        (fulls[1:depth]) — the caller pins them live across the
+        microbatch scan, which is what makes the analytic window delta
+        measurable in the executable's temp bytes."""
+        fulls = [jax.lax.all_gather(pl, axes, tiled=True) if axes else pl
+                 for pl in p_shards]
+        hold = list(fulls[1:depth]) if depth >= 2 else []
+        if depth >= 2:
+            ok = step_i >= jnp.int32(-2 ** 31)
+            pinned = []
+            for i, f in enumerate(fulls):
+                ahead = fulls[i + 1:i + depth]
+                if ahead:
+                    probe = sum(a[0] for a in ahead)
+                    f = jnp.where(ok, f, jnp.broadcast_to(probe, f.shape))
+                pinned.append(f)
+            fulls = pinned
         params = {}
-        for b, pl in zip(buckets, p_shards):
-            full = jax.lax.all_gather(pl, axes, tiled=True) if axes else pl
+        for b, full in zip(buckets, fulls):
             o = 0
             for nm in b["names"]:
                 params[nm] = (full[o:o + sizes[nm]].reshape(shapes[nm])
                               .astype(dtypes[nm]))
                 o += sizes[nm]
+        return params, hold
+
+    @jax.custom_vjp
+    def _window_mirror(params):
         return params
+
+    def _window_mirror_fwd(params):
+        return params, None
+
+    def _window_mirror_bwd(_, ct):
+        # backward twin of the gather window: the backward pass walks the
+        # buckets in descending order, so bucket i's param cotangents are
+        # released only together with buckets i-1..i-depth+1's — bucket
+        # i-1's grad work is forced live under bucket i's grad consumption,
+        # mirroring the forward prefetch. Identity on values.
+        groups = [[ct[nm] for nm in b["names"]] for b in buckets]
+        for i in range(len(groups) - 1, 0, -1):
+            behind = [x for g in groups[max(0, i - depth + 1):i] for x in g]
+            if behind:
+                out = jax.lax.optimization_barrier(
+                    tuple(groups[i]) + tuple(behind))
+                groups[i] = list(out[:len(groups[i])])
+        return ({nm: x for b, g in zip(buckets, groups)
+                 for nm, x in zip(b["names"], g)},)
+
+    _window_mirror.defvjp(_window_mirror_fwd, _window_mirror_bwd)
 
     def _rows(flat):
         """[n] grads in global (sorted-name) order -> [nrep, s_total]
@@ -794,7 +907,7 @@ def make_fsdp_accum_step(*, compute_loss: Callable, flat_update: Callable,
         return g / nrep, jnp.sum(ss[:, -1]) / nrep, res
 
     def _local(p_shards, lr, step_i, key, residual, opt, *lbatch):
-        params = _gather_params(p_shards)
+        params, window_hold = _gather_params(p_shards, step_i)
         mbs = tuple(b.reshape((k, b.shape[0] // k) + b.shape[1:])
                     for b in lbatch)
         zero_flat, _ = ravel_pytree(
@@ -809,7 +922,9 @@ def make_fsdp_accum_step(*, compute_loss: Callable, flat_update: Callable,
             acc, i = carry
             sub = jax.random.fold_in(shard_key, i)
             loss, g = jax.value_and_grad(
-                lambda ps: compute_loss(ps, sub, *mb))(params)
+                lambda ps: compute_loss(
+                    _window_mirror(ps) if depth >= 2 else ps, sub, *mb)
+            )(params)
             gflat, _ = ravel_pytree(g)
             return (acc + gflat.astype(jnp.float32), i + jnp.int32(1)), loss
 
@@ -818,6 +933,19 @@ def make_fsdp_accum_step(*, compute_loss: Callable, flat_update: Callable,
         if residual is not None:
             flat = flat + residual[0]
         g_all, loss, new_res = _scatter(flat, losses.mean())
+        if window_hold:
+            # keep the window's ahead-gathered buffers resident across the
+            # microbatch scan: the dead select branch reads each buffer at
+            # an index only known after the loss exists, so XLA cannot
+            # hoist the read before the while loop or free the buffers
+            # under it. This is what tools/mem_report.py measures as the
+            # depth-0 -> depth-2 temp-byte delta (fsdp_prefetch_ahead_bytes
+            # analytically). Identity on values: the pin branch never runs.
+            idx = jnp.clip(jnp.asarray(loss * 0).astype(jnp.int32), 0, 0)
+            probe = sum(jax.lax.dynamic_index_in_dim(f, idx, keepdims=False)
+                        for f in window_hold)
+            loss = jnp.where(step_i >= jnp.int32(-2 ** 31), loss,
+                             probe.astype(loss.dtype))
         raw_g = g_all                       # pre-clip: health attribution
         g_all = _clip_shard(g_all, clip, axes)
         new_ps = []

@@ -34,11 +34,17 @@ _MEM_FIELDS = ("argument_size_in_bytes", "output_size_in_bytes",
 _COST_FIELDS = ("flops", "transcendentals", "bytes accessed")
 
 
-def stats_for(label: str, compiled) -> Dict[str, Any]:
+def stats_for(label: str, compiled,
+              extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Flat stats dict for one compiled executable. Every field is
     best-effort: backends that expose no memory_analysis (or partial cost
-    models) just omit keys rather than fail."""
+    models) just omit keys rather than fail. ``extra`` merges caller
+    annotations (numeric ones become gauges via capture) — the engines use
+    it to land analytic bounds (e.g. the fsdp live-gather window bytes)
+    next to the measured temp bytes they bound."""
     out: Dict[str, Any] = {"label": label}
+    if extra:
+        out.update(extra)
     try:
         ma = compiled.memory_analysis()
     except Exception:
@@ -67,13 +73,14 @@ def stats_for(label: str, compiled) -> Dict[str, Any]:
     return out
 
 
-def capture(label: str, compiled, force: bool = False) -> Dict[str, Any]:
+def capture(label: str, compiled, force: bool = False,
+            extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Extract + remember stats for `compiled` (deduped by label unless
     force), feed registry gauges when metrics are active."""
     with _lock:
         if not force and label in _captured:
             return _captured[label]
-    st = stats_for(label, compiled)
+    st = stats_for(label, compiled, extra=extra)
     with _lock:
         _captured[label] = st
     from ..core import monitor as _monitor
@@ -89,14 +96,15 @@ def capture(label: str, compiled, force: bool = False) -> Dict[str, Any]:
     return st
 
 
-def capture_jit(label: str, fn, args, force: bool = False) -> Dict[str, Any]:
+def capture_jit(label: str, fn, args, force: bool = False,
+                extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """AOT-lower + compile a jitted fn at the given avals and capture its
     analysis. One extra XLA compile per (new) label — diagnostic cost."""
     with _lock:
         if not force and label in _captured:
             return _captured[label]
     compiled = fn.lower(*args).compile()
-    return capture(label, compiled, force=True)
+    return capture(label, compiled, force=True, extra=extra)
 
 
 def captured() -> Dict[str, Dict[str, Any]]:

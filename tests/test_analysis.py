@@ -246,6 +246,126 @@ def test_collective_contract_violation_and_combining_skip():
         assert rep2.skips[0].reason == an.collective_combining_reason()
 
 
+# ------------------------------------------- schedule-order (ISSUE 20 sat 3)
+
+# Two-bucket synthetic scheduled modules. In the "ahead" twin, bucket 1's
+# all-gather is DEFINED before bucket 0's dominant (fusion) consumer — the
+# shape the prefetch window produces; the "-done" line pins that async
+# halves are never counted as gather definitions. In the just-in-time twin
+# each gather sits immediately before its own consumer.
+_AHEAD_HLO = (
+    "  %all-gather.1 = f32[16]{0} all-gather(%p0), channel_id=1\n"
+    "  %all-gather.2 = f32[16]{0} all-gather(%p1), channel_id=2\n"
+    "  %fusion.1 = f32[4]{0} fusion(%all-gather.1), kind=kLoop\n"
+    "  %fusion.2 = f32[4]{0} fusion(%all-gather.2), kind=kLoop\n"
+    "  %all-gather-done.9 = f32[16]{0} all-gather-done(%s)\n")
+
+_JIT_HLO = (
+    "  %all-gather.1 = f32[16]{0} all-gather(%p0), channel_id=1\n"
+    "  %fusion.1 = f32[4]{0} fusion(%all-gather.1), kind=kLoop\n"
+    "  %all-gather.2 = f32[16]{0} all-gather(%p1), channel_id=2\n"
+    "  %fusion.2 = f32[4]{0} fusion(%all-gather.2), kind=kLoop\n")
+
+
+def _sched_contract(**kw):
+    return an.ProgramContract(schedule_order="all-gather-ahead",
+                              allow_host_calls=True, max_constant_bytes=None,
+                              **kw)
+
+
+def test_schedule_order_clean_twin_and_jit_violation():
+    """The seeded violation / clean-twin pair for the schedule-order pass:
+    just-in-time gather placement fails the all-gather-ahead discipline,
+    the prefetch-shaped module passes it. On combining backends the pass
+    SKIPS (bucket order is unreadable once gathers are fused) — the shared
+    analysis.backend probe, same posture as the collective-count gates."""
+    clean = an.check_text("t", _AHEAD_HLO, _sched_contract())
+    jit = an.check_text("t", _JIT_HLO, _sched_contract())
+    if an.backend_combines_collectives():
+        assert clean.ok and jit.ok
+        assert [s.pass_name for s in jit.skips] == ["schedule-order"]
+        assert [s.pass_name for s in clean.skips] == ["schedule-order"]
+    else:
+        assert clean.ok, clean.format()
+        assert {v.pass_name for v in jit.violations} == {"schedule-order"}
+        msg = jit.violations[0].message
+        assert "%all-gather.2" in msg and "just-in-time" in msg
+
+
+def test_schedule_order_orders_buckets_by_channel_id():
+    """Bucket order is channel_id order (assigned in emission = bucket
+    order), NOT textual order: swapping the channel ids on the clean twin
+    makes %all-gather.1 the SECOND bucket, defined after its predecessor's
+    consumer? No — defined first, so the swapped module is still clean;
+    swapping them on the jit twin keeps it a violation either way."""
+    swapped = _AHEAD_HLO.replace("channel_id=1", "channel_id=9")
+    rep = an.check_text("t", swapped, _sched_contract())
+    if an.backend_combines_collectives():
+        assert rep.ok
+    else:
+        # now AG.2 (ch2) is bucket 0; its successor AG.1 (ch9) is defined
+        # BEFORE AG.2's fusion consumer -> still satisfies the discipline
+        assert rep.ok, rep.format()
+
+
+def test_schedule_order_unknown_discipline_is_a_violation():
+    rep = an.check_text("t", _AHEAD_HLO, an.ProgramContract(
+        schedule_order="bogus-discipline", allow_host_calls=True,
+        max_constant_bytes=None))
+    assert {v.pass_name for v in rep.violations} == {"schedule-order"}
+    assert "unknown schedule_order" in rep.violations[0].message
+
+
+def _fsdp_engine(prefetch, dp=8, k=2):
+    paddle.set_flags({"fsdp_prefetch": prefetch})
+    set_hybrid_communicate_group(None)
+    hcg = HybridCommunicateGroup(dp_degree=dp, devices=jax.devices()[:dp])
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=net.parameters())
+    eng = TrainStepEngine(net, opt, loss_fn=paddle.nn.CrossEntropyLoss(),
+                          hcg=hcg, microbatches=k, fsdp=True)
+    rng = np.random.RandomState(0)
+    eng.step(jnp.asarray(rng.randn(32, 16).astype("float32")),
+             jnp.asarray(rng.randint(0, 4, (32,)).astype("int64")))
+    return eng
+
+
+def test_fsdp_prefetch_executable_lints_clean_and_jit_program_violates():
+    """ISSUE 20 acceptance, both directions on the REAL executables: the
+    depth-2 fsdp step satisfies default_contracts() — the existing
+    L-AG/1-RS/0-AR collective counts AND the new all-gather-ahead
+    schedule-order discipline read from the scheduled optimized module —
+    while the depth-0 just-in-time program, held to the same discipline by
+    a forced contract, is the seeded violation (its default contracts gate
+    the discipline off below depth 2, so its own analyze() stays green)."""
+    eng = _fsdp_engine(prefetch=2)
+    assert any(c.schedule_order == "all-gather-ahead"
+               for c in eng.default_contracts())
+    rep = eng.analyze()
+    assert rep.ok, rep.format()
+    assert any(lbl.startswith("train.fsdp_k2") for lbl in rep.checked)
+
+    e0 = _fsdp_engine(prefetch=0)
+    assert all(c.schedule_order is None for c in e0.default_contracts())
+    rep0 = e0.analyze()
+    assert rep0.ok, rep0.format()
+    forced = an.ProgramContract("train.fsdp_*",
+                                schedule_order="all-gather-ahead",
+                                allow_host_calls=True,
+                                max_constant_bytes=None, name="forced")
+    progs = an.programs_from_stash(e0._exec_stash)
+    out = an.PassManager().run(progs, [forced])
+    if an.backend_combines_collectives():
+        assert out.ok and [s.pass_name for s in out.skips] == [
+            "schedule-order"]
+    else:
+        assert {v.pass_name for v in out.violations} == {"schedule-order"}
+        assert "just-in-time" in out.violations[0].message
+
+
 # -------------------------------------------------------------- green path
 
 def test_train_engine_default_executables_lint_clean():

@@ -143,6 +143,122 @@ def test_f32_fsdp_bit_equal_to_replicated(dp):
                                           np.asarray(b, np.float32))
 
 
+# -------------------------------------- gather-prefetch window (ISSUE 20)
+
+@pytest.mark.parametrize("dp,k", [(4, 2), (4, 4), (8, 2), (8, 4)])
+@pytest.mark.parametrize("dtype", ["f32", "bf16"])
+def test_prefetch_depth2_bit_equal_to_jit(dp, k, dtype):
+    """The overlap-ahead window is identity on VALUES: the depth-2
+    double-buffered trajectory equals the depth-0 just-in-time one bit for
+    bit — loss, gathered params, gathered opt state — across dp, microbatch
+    count, and wire dtype (and depth 0 is already pinned against the
+    replicated engine above, so depth 2 is transitively bit-equal to it
+    too). The window pins are dead select branches, never taken."""
+    if dtype == "bf16":
+        paddle.set_flags({"grad_comm_dtype": "bf16",
+                          "grad_comm_error_feedback": True})
+    hcg = _dp(dp)
+    x, y = _batch()
+    paddle.set_flags({"fsdp_prefetch": 0})
+    e0 = _make(k=k, hcg=hcg)
+    l0 = _losses(e0, x, y, steps=3)
+    paddle.set_flags({"fsdp_prefetch": 2})
+    e2 = _make(k=k, hcg=hcg)
+    l2 = _losses(e2, x, y, steps=3)
+    assert l2 == l0  # exact float equality, not allclose
+    p0, p2 = e0._gather_fsdp_params(), e2._gather_fsdp_params()
+    o0, o2 = e0._gather_fsdp_opt(), e2._gather_fsdp_opt()
+    for n in p0:
+        np.testing.assert_array_equal(np.asarray(p0[n]), np.asarray(p2[n]),
+                                      err_msg=n)
+        for a, b in zip(o0[n], o2[n]):
+            np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                          np.asarray(b, np.float32))
+
+
+def test_prefetch_window_helpers_clamp_and_byte_math():
+    """fsdp_window_bytes / fsdp_prefetch_depth / fsdp_prefetch_ahead_bytes:
+    the analytic window is the max adjacent run of padded f32 gather bytes,
+    the requested depth is clamped so the live window never exceeds the
+    depth-2 (two largest adjacent buckets) bound, and the ahead-bytes delta
+    counts exactly the buckets held across the scan."""
+    buckets = [{"pad": 64}, {"pad": 16}, {"pad": 48}, {"pad": 8}]
+    # gather bytes per bucket: [256, 64, 192, 32]
+    assert grad_comm.fsdp_window_bytes(buckets, 0) == 256  # jit: one bucket
+    assert grad_comm.fsdp_window_bytes(buckets, 1) == 256
+    assert grad_comm.fsdp_window_bytes(buckets, 2) == 320  # 256 + 64
+    assert grad_comm.fsdp_window_bytes(buckets, 3) == 512  # 256 + 64 + 192
+    assert grad_comm.fsdp_window_bytes(buckets, 99) == 544  # whole run
+    assert grad_comm.fsdp_window_bytes([], 2) == 0
+
+    assert grad_comm.fsdp_prefetch_ahead_bytes(buckets, 0) == 0
+    assert grad_comm.fsdp_prefetch_ahead_bytes(buckets, 1) == 0
+    assert grad_comm.fsdp_prefetch_ahead_bytes(buckets, 2) == 64
+    assert grad_comm.fsdp_prefetch_ahead_bytes(buckets, 3) == 64 + 192
+
+    for req in (0, -3):
+        assert grad_comm.fsdp_prefetch_depth(buckets, req) == 0
+    assert grad_comm.fsdp_prefetch_depth(buckets, 1) == 1
+    assert grad_comm.fsdp_prefetch_depth(buckets, 2) == 2  # always fits
+    for req in (3, 99):  # window(3) = 512 > 320 cap: clamp back to 2
+        assert grad_comm.fsdp_prefetch_depth(buckets, req) == 2
+    # a head-heavy layout whose deeper windows stay under the cap keeps
+    # the requested depth
+    shrink = [{"pad": 100}, {"pad": 10}, {"pad": 0}, {"pad": 0}]
+    assert grad_comm.fsdp_prefetch_depth(shrink, 4) == 4
+
+
+def test_prefetch_window_gauge_matches_memory_model():
+    """The exec.train.fsdp_* introspection stats carry the window gauges —
+    prefetch depth, analytic live-window bytes, ahead (across-scan) bytes —
+    and they agree with fsdp_memory_model() and the grad_comm analytic
+    helpers on the engine's real bucket layout."""
+    ef = _make(k=2)  # FLAGS_fsdp_prefetch default: 2
+    x, y = _batch()
+    ef.step(x, y)
+    buckets = ef._fsdp_layout()
+    mm = ef.fsdp_memory_model()
+    assert mm["prefetch"] == 2
+    assert mm["window_bytes"] == grad_comm.fsdp_window_bytes(buckets, 2)
+    assert mm["window_bytes_jit"] == grad_comm.fsdp_window_bytes(buckets, 0)
+    assert mm["ahead_bytes"] == grad_comm.fsdp_prefetch_ahead_bytes(
+        buckets, 2)
+    assert mm["window_bytes"] > mm["window_bytes_jit"] > 0
+    assert mm["ahead_bytes"] == mm["window_bytes"] - max(
+        int(b["pad"]) * 4 for b in buckets[:1])
+
+    stats = ef.introspect_executables()["train.fsdp_k2_f32"]
+    assert stats["fsdp_prefetch"] == 2
+    assert stats["fsdp_window_bytes"] == mm["window_bytes"]
+    assert stats["fsdp_ahead_bytes"] == mm["ahead_bytes"]
+
+
+def test_prefetch_flag_keys_executable_cache_append_only():
+    """Flipping FLAGS_fsdp_prefetch mid-life rebuilds the compiled step
+    under a NEW cache key — the fsdp key appends (True, depth) to the
+    shared 6-tuple — and the trajectory stays bit-continuous across the
+    flip (the window is value-identity). Non-fsdp keys keep the original
+    6-tuple shape: the extension is append-only."""
+    hcg = _dp()
+    x, y = _batch()
+    ef = _make(k=2, hcg=hcg)
+    la = [float(ef.step(x, y).item())]
+    paddle.set_flags({"fsdp_prefetch": 0})
+    la.append(float(ef.step(x, y).item()))
+    keys = list(ef._accum_fns)
+    assert len(keys) == 2
+    assert all(len(kk) == 8 and kk[6] is True for kk in keys)
+    assert sorted(kk[7] for kk in keys) == [0, 2]
+    # the flip is bit-continuous: a never-flipped depth-0 engine walks the
+    # exact same trajectory
+    e0 = _make(k=2, hcg=hcg)
+    assert _losses(e0, x, y, steps=2) == la
+
+    er = _make(k=2, mode=None, hcg=hcg)
+    er.step(x, y)
+    assert all(len(kk) == 6 for kk in er._accum_fns)
+
+
 # ---------------------------------------------------------------- HLO gate
 
 @pytest.mark.parametrize("k", [2, 4])
@@ -501,3 +617,6 @@ def test_rs_ag_byte_counters_and_telemetry():
     assert rec["fsdp"] is True
     assert rec["microbatches"] == 4
     assert rec["grad_comm_bytes"] == rs_b + ag_b
+    assert rec["fsdp_prefetch"] == 2  # FLAGS_fsdp_prefetch default depth
+    assert rec["fsdp_window_bytes"] == grad_comm.fsdp_window_bytes(
+        ef._fsdp_layout(), 2)

@@ -815,11 +815,17 @@ def main():
                     {nm: tuple(np.asarray(s, np.float32).tobytes()
                                for s in op[nm]) for nm in e._param_names})
 
+        # ISSUE 20: the whole leg runs under the overlap-ahead gather
+        # window — reform_mesh must drop and rebuild the WINDOWED step fns
+        # (depth re-clamped per new bucket layout) while keeping the
+        # trajectory bit-continuous vs the restore controls.
+        paddle.set_flags({"fsdp_prefetch": 2})
         engf = fsdp_engine(8, seed=0)
         steps(engf, args.steps_per_leg)
         fsdp_committed = engf._step_count
         verdict("fsdp_dp8_warm_engaged",
                 engf._fsdp_params is not None and engf.params is None
+                and engf._fsdp_prefetch() == 2
                 and fsdp_committed == args.steps_per_leg)
         for leg_i, dp_to in enumerate((6, 8)):
             ckf = checkpoint(engf, f"ck_fsdp{leg_i}")
@@ -829,9 +835,12 @@ def main():
             live_reshard(engf, hcg(dp_to))
             livef = steps(engf, args.steps_per_leg)
             ctlf = steps(ctrlf, args.steps_per_leg)
+            rebuilt_windowed = all(
+                kk[-1] == 2 for kk in engf._accum_fns if len(kk) == 8)
             verdict(f"fsdp_reshard_to_dp{dp_to}",
                     engf.hcg.degrees["dp"] == dp_to
                     and engf._fsdp_params is not None
+                    and engf._fsdp_prefetch() == 2 and rebuilt_windowed
                     and engf._step_count == fsdp_committed
                     + (leg_i + 1) * args.steps_per_leg
                     and livef == ctlf

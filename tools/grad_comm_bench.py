@@ -39,8 +39,13 @@ reduce-scatter of grads, NO trailing param all-gather — vs the ZeRO
 weight-update-sharded step and the replicated baseline at dp4/dp8.
 Reports steps/s, measured executable argument/peak bytes for all three
 variants, and the analytic sharded-state fraction
-(param+opt bytes per device over the replicated total, ~1/N). --history
-rows feed the `fsdp_steps_per_s_dp8` / `fsdp_param_bytes_frac` pins in
+(param+opt bytes per device over the replicated total, ~1/N). The fsdp
+leg additionally runs a prefetch column (ISSUE 20): the same step at
+FLAGS_fsdp_prefetch=0 (just-in-time gathers) vs the default depth-2
+overlap-ahead window — steps/s for both, the analytic live-window bytes
+per depth, and the bit-equality of the two trajectories. --history rows
+feed the `fsdp_steps_per_s_dp8` / `fsdp_param_bytes_frac` /
+`fsdp_prefetch_steps_per_s_dp8` / `fsdp_prefetch_window_bytes` pins in
 tools/bench_baseline.json:
 
   JAX_PLATFORMS=cpu python tools/grad_comm_bench.py --fsdp \\
@@ -206,6 +211,12 @@ def _run_fsdp(args):
     for dp in (int(d) for d in args.dp.split(",")):
         sps_r, loss_r, st_r = measure(build(dp, None))
         sps_z, loss_z, st_z = measure(build(dp, "zero"))
+        # prefetch column: the same fsdp step at depth 0 (just-in-time
+        # gathers) and at the default depth-2 overlap-ahead window; the
+        # window is value-identity, so the losses must stay bit-equal
+        paddle.set_flags({"fsdp_prefetch": 0})
+        sps_f0, loss_f0, st_f0 = measure(build(dp, "fsdp"))
+        paddle.set_flags({"fsdp_prefetch": 2})
         ef = build(dp, "fsdp")
         sps_f, loss_f, st_f = measure(ef)
         mm = ef.fsdp_memory_model()
@@ -221,6 +232,11 @@ def _run_fsdp(args):
             "steps_per_sec_replicated": sps_r,
             "steps_per_sec_zero": sps_z,
             "steps_per_sec_fsdp": sps_f,
+            "steps_per_sec_fsdp_jit": sps_f0,
+            "fsdp_prefetch": mm["prefetch"],
+            "fsdp_window_bytes": mm["window_bytes"],
+            "fsdp_window_bytes_jit": mm["window_bytes_jit"],
+            "prefetch_loss_bit_equal": loss_f0 == loss_f,
             "state_bytes_replicated": repl_state,
             "state_bytes_fsdp_per_device": shard_state,
             "fsdp_param_bytes_frac": frac,
@@ -243,6 +259,14 @@ def _run_fsdp(args):
                 "metric": "fsdp_param_bytes_frac",
                 "value": frac, "unit": "ratio", "vs_baseline": None,
                 "extra": dict(extra)})
+            _append_history({
+                "metric": "fsdp_prefetch_steps_per_sec",
+                "value": sps_f, "unit": "steps/s", "vs_baseline": None,
+                "extra": dict(extra)})
+            _append_history({
+                "metric": "fsdp_prefetch_window_bytes",
+                "value": mm["window_bytes"], "unit": "bytes",
+                "vs_baseline": None, "extra": dict(extra)})
 
 
 def main():

@@ -22,7 +22,11 @@ params+opt resident only as 1/N flat shards, so the argument-byte delta
 vs the replicated executable must match engine.fsdp_memory_model()'s
 analytic ~1/N state shrink (asserted, 5% tolerance — batch and scalar
 arguments cancel in the delta) and come in strictly below the ZeRO
-executable's argument bytes (ZeRO still holds replicated params). Ends
+executable's argument bytes (ZeRO still holds replicated params). The
+fsdp step also compiles a FLAGS_fsdp_prefetch=0 (just-in-time) twin and
+asserts the measured temp-byte delta equals the analytic ahead-gather
+window (the overlap-ahead buffers the prefetch keeps resident — for the
+two-bucket report model, exactly the second bucket's gather size). Ends
 with the tools-convention machine-readable {"summary": ...} JSON line.
 """
 from __future__ import annotations
@@ -222,12 +226,20 @@ def main():
                                              fn.lower(*avals).compile())
 
         stats3 = {}
-        for mode in (None, "zero", "fsdp"):
-            e = build_fsdp_dp8(mode)
+        # "fsdp" runs at the default prefetch depth (the overlap-ahead
+        # window); "fsdp_jit" is the SAME engine at FLAGS_fsdp_prefetch=0
+        # (just-in-time gathers) — the pair whose temp-byte delta the
+        # window assert below pins
+        for mode, pf in ((None, None), ("zero", None), ("fsdp", 2),
+                         ("fsdp_jit", 0)):
+            if pf is not None:
+                paddle.set_flags({"fsdp_prefetch": pf})
+            e = build_fsdp_dp8("fsdp" if mode == "fsdp_jit" else mode)
             e.step(xf, yf)
             stats3[mode] = aot_stats_f(e)
             if mode == "fsdp":
                 mmf = e.fsdp_memory_model()
+        paddle.set_flags({"fsdp_prefetch": 2})
 
         repl_state = (mmf["replicated_param_bytes"]
                       + mmf["replicated_opt_bytes"])
@@ -241,22 +253,37 @@ def main():
             return (f"{a / b:.3f}" if isinstance(a, int)
                     and isinstance(b, int) and b else "-")
 
-        print(f"\nFull FSDP (dp8, K={k}) — per-device bytes, "
-              "replicated vs ZeRO vs sharded-resident params:")
+        temp_pf = stats3["fsdp"].get("temp_size_in_bytes")
+        temp_jit = stats3["fsdp_jit"].get("temp_size_in_bytes")
+        print(f"\nFull FSDP (dp8, K={k}, prefetch={mmf['prefetch']}) — "
+              "per-device bytes, replicated vs ZeRO vs sharded-resident "
+              "params (fsdp_jit = same step at FLAGS_fsdp_prefetch=0):")
         _fmt_table(
             ["quantity", "replicated_MB", "zero_MB", "fsdp_MB",
-             "fsdp_ratio"],
+             "fsdp_jit_MB", "fsdp_ratio"],
             [[f"param+opt state, adamw x{mmf['opt_slots']} slots (analytic)",
               _mb(repl_state),
               _mb(mmf["replicated_param_bytes"]
                   + mmf["sharded_opt_bytes_per_device"]),
-              _mb(shard_state), ratio(shard_state, repl_state)],
+              _mb(shard_state), _mb(shard_state),
+              ratio(shard_state, repl_state)],
+             ["gather window, live bytes (analytic)",
+              "-", "-", _mb(mmf["window_bytes"]),
+              _mb(mmf["window_bytes_jit"]),
+              ratio(mmf["window_bytes"], mmf["window_bytes_jit"])],
              ["executable arguments (measured)",
-              _mb(arg_r), _mb(arg_z), _mb(arg_f), ratio(arg_f, arg_r)],
+              _mb(arg_r), _mb(arg_z), _mb(arg_f),
+              _mb(stats3["fsdp_jit"].get("argument_size_in_bytes")),
+              ratio(arg_f, arg_r)],
+             ["executable temp (measured)",
+              _mb(stats3[None].get("temp_size_in_bytes")),
+              _mb(stats3["zero"].get("temp_size_in_bytes")),
+              _mb(temp_pf), _mb(temp_jit), ratio(temp_pf, temp_jit)],
              ["executable peak (measured)",
               _mb(stats3[None].get("peak_bytes")),
               _mb(stats3["zero"].get("peak_bytes")),
               _mb(stats3["fsdp"].get("peak_bytes")),
+              _mb(stats3["fsdp_jit"].get("peak_bytes")),
               ratio(stats3["fsdp"].get("peak_bytes"),
                     stats3[None].get("peak_bytes"))]])
         # the ~1/N claim, measured: batch + scalar arguments cancel in the
@@ -269,6 +296,18 @@ def main():
         assert arg_f < arg_z < arg_r, (
             f"fsdp arguments must undercut ZeRO (replicated params) which "
             f"must undercut replicated: {arg_f} !< {arg_z} !< {arg_r}")
+        # the overlap-ahead window, measured: the depth-2 step holds the
+        # ahead-gathered buffers live across the microbatch scan, so its
+        # temp bytes exceed the just-in-time twin's by exactly the second
+        # bucket's gather size (same exact-delta idiom as the arg check)
+        win_meas = temp_pf - temp_jit
+        win_ana = mmf["ahead_bytes"]
+        assert win_ana > 0 and mmf["prefetch"] >= 2, (
+            f"fsdp prefetch window absent: depth {mmf['prefetch']}, "
+            f"analytic ahead bytes {win_ana}")
+        assert abs(win_meas - win_ana) <= 0.05 * win_ana, (
+            f"measured prefetch temp-byte delta {win_meas} disagrees with "
+            f"the analytic ahead-gather window {win_ana}")
         fsdp_summary = {
             "replicas": mmf["replicas"], "microbatches": k,
             "buckets": len(mmf["buckets"]),
@@ -280,6 +319,11 @@ def main():
             "arg_delta_measured": delta_meas,
             "arg_delta_analytic": delta_ana,
             "peak_bytes_fsdp": stats3["fsdp"].get("peak_bytes"),
+            "prefetch": mmf["prefetch"],
+            "window_bytes": mmf["window_bytes"],
+            "window_bytes_jit": mmf["window_bytes_jit"],
+            "window_delta_measured": win_meas,
+            "window_delta_analytic": win_ana,
         }
         print()
 

@@ -215,6 +215,17 @@ def summarize_records(recs, emit_json=True):
               f"ag_bytes={summary['grad_comm_ag_bytes']} "
               f"(+{summary['grad_comm_ag_bytes_delta']}) "
               f"zero_update_steps={zsteps}")
+    # fsdp gather-prefetch window (ISSUE 20): engaged steps carry the
+    # resolved window depth and the analytic live-window bytes
+    fsdp_recs = [r for r in recs if r.get("fsdp")]
+    if fsdp_recs:
+        last_f = fsdp_recs[-1]
+        summary["fsdp_steps"] = len(fsdp_recs)
+        summary["fsdp_prefetch"] = last_f.get("fsdp_prefetch")
+        summary["fsdp_window_bytes"] = last_f.get("fsdp_window_bytes")
+        print(f"fsdp: steps={summary['fsdp_steps']} "
+              f"prefetch={summary['fsdp_prefetch']} "
+              f"window_bytes={summary['fsdp_window_bytes']}")
     if serve_reqs or serve_steps or routes:
         summary["serve"] = _summarize_serve(serve_reqs, serve_steps, routes,
                                             regs=regs, emit_json=False)
